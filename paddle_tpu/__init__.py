@@ -1,0 +1,62 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the PaddlePaddle capability surface (reference mounted at
+/root/reference, see SURVEY.md) in idiomatic JAX/XLA/pallas/pjit:
+
+- ``Tensor`` is ``jax.Array``; eager ("dygraph") ops are jnp compositions.
+- ``jit.to_static`` replaces ProgramDesc + Executor: trace once, XLA compiles.
+- ``autograd`` is functional (``grad``/``vjp``) instead of a tape engine.
+- ``distributed`` maps fleet/collective semantics onto named mesh axes with
+  ``shard_map``/pjit and XLA collectives over ICI/DCN.
+"""
+from . import core  # noqa: F401
+from . import tensor  # noqa: F401
+from .core import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    get_device,
+    get_flags,
+    int8,
+    int16,
+    int32,
+    int64,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    seed,
+    set_default_dtype,
+    set_device,
+    set_flags,
+    uint8,
+)
+from .core.random import get_cuda_rng_state, get_rng_state, set_cuda_rng_state, set_rng_state  # noqa: F401
+from .tensor import *  # noqa: F401,F403
+from .version import __version__  # noqa: F401
+
+import jax as _jax
+
+Tensor = _jax.Array
+
+
+def disable_static(*a, **k):  # dygraph is the default; parity no-op
+    return None
+
+
+def enable_static(*a, **k):
+    raise NotImplementedError(
+        "paddle_tpu has no interpreted static-graph mode; use paddle_tpu.jit.to_static "
+        "(trace-to-XLA) which subsumes it"
+    )
+
+
+def in_dynamic_mode() -> bool:
+    return True

@@ -1,0 +1,38 @@
+"""Core substrate: device identity, dtypes, flags, errors, RNG policy.
+
+TPU-native replacement for the reference's L0 platform layer
+(``paddle/fluid/platform/``): ``Place``/``DeviceContext`` collapse onto
+``jax.Device``; streams/handles/allocators are owned by XLA.  What survives is
+the *identity* API (``set_device``/``get_device``), the flag registry, the
+enforce-style error discipline, and the seed/PRNG policy.
+"""
+from . import device, dtype, errors, flags, random  # noqa: F401
+from .device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .dtype import (  # noqa: F401
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    set_default_dtype,
+    uint8,
+)
+from .errors import EnforceNotMet, InvalidArgumentError, enforce, raise_unimplemented  # noqa: F401
+from .flags import get_flags, set_flags  # noqa: F401
+from .random import Generator, default_generator, get_rng_state, seed, set_rng_state  # noqa: F401

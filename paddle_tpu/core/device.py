@@ -1,0 +1,145 @@
+"""Device identity and discovery.
+
+Reference parity: ``platform::Place`` variants (``platform/place.h:24-94``) and
+``paddle.set_device/get_device`` (``python/paddle/device.py:181,208``).  On TPU
+a "place" is just a ``jax.Device``; the per-device stream/handle bundle
+(``platform/device_context.h``) has no equivalent because XLA owns scheduling.
+
+Design: we keep a tiny tagged ``Place`` for API compatibility, backed by the
+live ``jax.Device``.  ``set_device`` selects the default backend for eager ops
+via ``jax.default_device``; under ``jit`` placement is controlled by shardings,
+not places.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Optional
+
+import jax
+
+
+@dataclasses.dataclass(frozen=True)
+class Place:
+    """Device identity: backend kind + index (platform/place.h analog)."""
+
+    kind: str  # "cpu" | "tpu" | "gpu"
+    index: int = 0
+
+    def jax_device(self) -> jax.Device:
+        devs = jax.devices(self.kind) if self.kind != "cpu" else jax.devices("cpu")
+        if self.index >= len(devs):
+            from .errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                f"Place {self} out of range: only {len(devs)} {self.kind} device(s) visible"
+            )
+        return devs[self.index]
+
+    def __repr__(self) -> str:  # paddle prints e.g. CUDAPlace(0)
+        return f"{self.kind.upper()}Place({self.index})"
+
+
+def CPUPlace(index: int = 0) -> Place:
+    return Place("cpu", index)
+
+
+def TPUPlace(index: int = 0) -> Place:
+    return Place("tpu", index)
+
+
+def CUDAPlace(index: int = 0) -> Place:  # accepted for API parity; maps to gpu backend
+    return Place("gpu", index)
+
+
+_current_place: Optional[Place] = None
+_default_device_ctx = None
+
+
+def _backend_available(kind: str) -> bool:
+    try:
+        return len(jax.devices(kind)) > 0
+    except RuntimeError:
+        return False
+
+
+@functools.lru_cache(maxsize=None)
+def _auto_backend() -> str:
+    for kind in ("tpu", "gpu", "cpu"):
+        if _backend_available(kind):
+            return kind
+    return "cpu"
+
+
+def set_device(device: str | Place) -> Place:
+    """Select the default device for eager execution.
+
+    Accepts ``"tpu"``, ``"cpu"``, ``"gpu:0"``, ``"tpu:3"`` or a :class:`Place`.
+    Mirrors ``paddle.set_device`` (``python/paddle/device.py:181``): this is the
+    north-star hook point — ``set_device('tpu')`` makes every subsequent eager
+    op and jit compile target TPU.
+    """
+    global _current_place, _default_device_ctx
+    if isinstance(device, str):
+        kind, _, idx = device.partition(":")
+        kind = {"cuda": "gpu", "xpu": "tpu", "npu": "tpu"}.get(kind, kind)
+        place = Place(kind, int(idx) if idx else 0)
+    else:
+        place = device
+    dev = place.jax_device()  # validates
+    # jax.default_device is a context manager/config; use the config setter so it
+    # applies process-wide like paddle's global place.
+    if _default_device_ctx is not None:
+        _default_device_ctx.__exit__(None, None, None)
+    _default_device_ctx = jax.default_device(dev)
+    _default_device_ctx.__enter__()
+    _current_place = place
+    return place
+
+
+def get_device() -> str:
+    """Return current device string, e.g. ``"tpu:0"`` (device.py:208 parity)."""
+    if _current_place is None:
+        return f"{_auto_backend()}:0"
+    return f"{_current_place.kind}:{_current_place.index}"
+
+
+def current_place() -> Place:
+    if _current_place is None:
+        return Place(_auto_backend(), 0)
+    return _current_place
+
+
+def device_count(kind: Optional[str] = None) -> int:
+    kind = kind or _auto_backend()
+    return len(jax.devices(kind)) if _backend_available(kind) else 0
+
+
+def is_compiled_with_cuda() -> bool:  # fluid/framework.py:392 parity
+    return _backend_available("gpu")
+
+
+def is_compiled_with_tpu() -> bool:
+    return _backend_available("tpu")
+
+
+def XPUPlace(index: int = 0) -> Place:  # vendor alias for API parity
+    return Place(_auto_backend(), index)
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def global_device_count() -> int:
+    return jax.device_count()
+
+
+def synchronize() -> None:
+    """Block until all pending device work completes (dev_ctx->Wait parity)."""
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+def env_device_override() -> Optional[str]:
+    return os.environ.get("PADDLE_TPU_DEVICE")

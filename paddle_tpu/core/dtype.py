@@ -1,0 +1,117 @@
+"""Dtype registry and default-dtype policy.
+
+Reference parity: ``framework/data_type.h`` proto enum + ``paddle.set_default_dtype``.
+TPU-first deltas: bfloat16 is a first-class citizen (MXU native), float64 is
+discouraged (soft-emulated on TPU) but supported for CPU-mesh tests.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+
+_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "fp16": float16,
+    "bfloat16": bfloat16,
+    "bf16": bfloat16,
+    "float32": float32,
+    "fp32": float32,
+    "float64": float64,
+    "fp64": float64,
+    "double": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype: Any):
+    """Normalize a user-provided dtype spec to a numpy/jnp dtype class."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        key = dtype.lower().replace("paddle.", "")
+        if key in _ALIASES:
+            return _ALIASES[key]
+        raise ValueError(f"Unknown dtype string: {dtype!r}")
+    return np.dtype(dtype).type if not hasattr(dtype, "dtype") else dtype
+
+
+def set_default_dtype(d: Any) -> None:
+    """paddle.set_default_dtype parity; only float kinds allowed."""
+    global _default_dtype
+    d = convert_dtype(d)
+    if np.dtype(d).kind not in "f" and d is not bfloat16:
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def _x64_enabled() -> bool:
+    import jax
+
+    return bool(jax.config.jax_enable_x64)
+
+
+def canonical_index_dtype():
+    """Paddle's index dtype is int64; TPUs (and x64-disabled JAX) want int32.
+
+    All index-producing ops (argmax/topk/randint...) route through this so the
+    framework is int32-first on TPU while staying int64 when x64 is enabled.
+    """
+    return int64 if _x64_enabled() else int32
+
+
+def canonicalize(dtype: Any):
+    """Map a requested dtype to what this runtime actually supports (x64 policy)."""
+    d = convert_dtype(dtype)
+    if d is None:
+        return None
+    if not _x64_enabled():
+        if np.dtype(d) in (np.dtype("int64"), np.dtype("uint64")):
+            return int32
+        if np.dtype(d) == np.dtype("float64"):
+            return float32
+    return d
+
+
+def is_floating(dtype: Any) -> bool:
+    dtype = jnp.dtype(dtype)
+    return jnp.issubdtype(dtype, jnp.floating)
+
+
+def is_integer(dtype: Any) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def finfo(dtype):
+    return jnp.finfo(dtype)
+
+
+def iinfo(dtype):
+    return jnp.iinfo(dtype)

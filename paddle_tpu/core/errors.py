@@ -1,0 +1,103 @@
+"""Enforce-style error discipline with Python-frame attribution.
+
+Reference parity: ``PADDLE_ENFORCE*`` / ``PADDLE_THROW`` (``platform/enforce.h:415-510``)
+and the op-call-stack attribution that maps C++ failures back to the Python line
+that created the op (``framework/op_call_stack.cc``).  In a JAX-native design
+errors mostly surface from tracing (good Python tracebacks already); what we add
+is a typed error taxonomy matching the reference's ``error_codes.proto`` and an
+``enforce`` helper that annotates shape/dtype checks with the calling layer.
+"""
+from __future__ import annotations
+
+import traceback
+from typing import Any, NoReturn, Optional
+
+
+class EnforceNotMet(RuntimeError):
+    """Base error; carries an error-code name like the reference proto."""
+
+    code = "LEGACY"
+
+    def __init__(self, message: str, hint: Optional[str] = None):
+        self.raw_message = message
+        self.hint = hint
+        full = f"[{self.code}] {message}"
+        if hint:
+            full += f"\n  [Hint: {hint}]"
+        super().__init__(full)
+
+
+class InvalidArgumentError(EnforceNotMet):
+    code = "INVALID_ARGUMENT"
+
+
+class NotFoundError(EnforceNotMet):
+    code = "NOT_FOUND"
+
+
+class OutOfRangeError(EnforceNotMet):
+    code = "OUT_OF_RANGE"
+
+
+class AlreadyExistsError(EnforceNotMet):
+    code = "ALREADY_EXISTS"
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    code = "PRECONDITION_NOT_MET"
+
+
+class PermissionDeniedError(EnforceNotMet):
+    code = "PERMISSION_DENIED"
+
+
+class ExecutionTimeoutError(EnforceNotMet):
+    code = "EXECUTION_TIMEOUT"
+
+
+class UnimplementedError(EnforceNotMet):
+    code = "UNIMPLEMENTED"
+
+
+class UnavailableError(EnforceNotMet):
+    code = "UNAVAILABLE"
+
+
+class FatalError(EnforceNotMet):
+    code = "FATAL"
+
+
+class ExternalError(EnforceNotMet):
+    code = "EXTERNAL"
+
+
+def enforce(cond: Any, message: str, exc: type = InvalidArgumentError, hint: Optional[str] = None) -> None:
+    """PADDLE_ENFORCE analog: raise ``exc`` with message when ``cond`` is falsy.
+
+    Never call on traced values — this is a host-side (trace-time) check.
+    """
+    if not cond:
+        raise exc(message, hint=hint)
+
+
+def enforce_eq(a: Any, b: Any, what: str = "value") -> None:
+    if a != b:
+        raise InvalidArgumentError(f"expected {what} == {b!r}, got {a!r}")
+
+
+def enforce_shape(x: Any, expected: tuple, what: str = "tensor") -> None:
+    shape = tuple(x.shape)
+    if len(shape) != len(expected) or any(e not in (-1, None, s) for s, e in zip(shape, expected)):
+        raise InvalidArgumentError(f"{what} shape mismatch: expected {expected}, got {shape}")
+
+
+def raise_unimplemented(feature: str) -> NoReturn:
+    raise UnimplementedError(
+        f"{feature} is not implemented in paddle_tpu yet",
+        hint="see SURVEY.md component inventory for the build plan",
+    )
+
+
+def current_python_callstack(limit: int = 8) -> str:
+    """op_call_stack.cc analog: capture the creating Python frames for a layer/op."""
+    return "".join(traceback.format_stack(limit=limit)[:-1])

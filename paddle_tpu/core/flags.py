@@ -1,0 +1,91 @@
+"""Global runtime flag registry.
+
+Reference parity: gflags in ``platform/flags.cc`` surfaced through
+``paddle.set_flags/get_flags`` (``fluid/framework.py:5863,5886``) with
+``FLAGS_*`` env-var pass-through parsed at init (``platform/init.cc``).
+
+TPU mapping: most reference flags (memory fractions, cudnn workspace) are
+XLA's job; the ones that survive are debug/determinism/logging toggles plus
+XLA knobs we forward via ``jax.config`` / ``XLA_FLAGS``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Iterable, Optional
+
+_lock = threading.Lock()
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "help", "on_set")
+
+    def __init__(self, name: str, default: Any, help: str, on_set: Optional[Callable[[Any], None]] = None):
+        self.name = name
+        self.default = default
+        self.value = default
+        self.help = help
+        self.on_set = on_set
+
+
+_REGISTRY: Dict[str, _Flag] = {}
+
+
+def define_flag(name: str, default: Any, help: str = "", on_set: Optional[Callable[[Any], None]] = None) -> None:
+    with _lock:
+        if name in _REGISTRY:
+            raise KeyError(f"flag {name} already defined")
+        flag = _Flag(name, default, help, on_set)
+        _REGISTRY[name] = flag
+    env = os.environ.get(name)  # FLAGS_* env pass-through (platform/init.cc parity)
+    if env is not None:
+        set_flags({name: _parse(env, default)})
+
+
+def _parse(text: str, default: Any) -> Any:
+    if isinstance(default, bool):
+        return text.lower() in ("1", "true", "yes", "on")
+    if isinstance(default, int):
+        return int(text)
+    if isinstance(default, float):
+        return float(text)
+    return text
+
+
+def set_flags(flags: Dict[str, Any]) -> None:
+    """paddle.set_flags parity."""
+    for name, value in flags.items():
+        with _lock:
+            flag = _REGISTRY.get(name)
+            if flag is None:
+                raise KeyError(f"unknown flag {name}; defined: {sorted(_REGISTRY)}")
+            flag.value = value
+        if flag.on_set is not None:
+            flag.on_set(value)
+
+
+def get_flags(flags: Iterable[str] | str | None = None) -> Dict[str, Any]:
+    """paddle.get_flags parity; None returns all flags."""
+    with _lock:
+        if flags is None:
+            return {k: f.value for k, f in _REGISTRY.items()}
+        if isinstance(flags, str):
+            flags = [flags]
+        return {name: _REGISTRY[name].value for name in flags}
+
+
+def flag(name: str) -> Any:
+    return _REGISTRY[name].value
+
+
+# --- core flags (subset of platform/flags.cc that makes sense on TPU) ---
+define_flag("FLAGS_check_nan_inf", False, "scan outputs of each jitted step for nan/inf (debug)")
+define_flag("FLAGS_benchmark", False, "block on each step for accurate timing")
+define_flag("FLAGS_deterministic", True, "prefer deterministic XLA reductions")
+define_flag("FLAGS_log_level", 0, "verbosity for paddle_tpu host-side logging (GLOG_v analog)")
+define_flag("FLAGS_use_donated_buffers", True, "donate param/opt-state buffers into jitted train steps")
+define_flag("FLAGS_prefetch_depth", 2, "device prefetch depth for DataLoader double buffering")
+define_flag("FLAGS_amp_dtype", "bfloat16", "autocast compute dtype (bfloat16|float16)")
+define_flag("FLAGS_jit_cache", True, "reuse compiled executables across to_static calls")
+define_flag("FLAGS_seq_block_size", 512, "ring/flash attention block length on the sequence axis")
+define_flag("FLAGS_eager_mode", True, "ops execute eagerly (dygraph) when not inside jit")

@@ -1,0 +1,122 @@
+"""Seed / PRNG policy: global-seed facade over explicit JAX keys.
+
+Reference parity: ``paddle.seed`` + per-device ``framework/generator.cc``
+Generators.  JAX randomness is explicit-key; the facade keeps paddle's
+stateful-looking API while staying trace-safe:
+
+- Eager: a process-global :class:`Generator` folds a monotonically increasing
+  counter into its root key — every eager random op gets a fresh key.
+- Under ``jit``/``to_static``: folding a *constant* key inside a trace would
+  freeze randomness across calls, so the jit wrappers install a **traced** key
+  for the duration of the trace via :func:`rng_guard`; ``next_key`` derives
+  from it instead.  The wrapper passes a fresh key argument per call, so
+  compiled executables see new randomness without retracing.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import List, Optional
+
+import jax
+
+
+class Generator:
+    """Stateful key source (framework/generator.cc analog)."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._key = jax.random.key(seed)
+        self._counter = 0
+        self._lock = threading.Lock()
+
+    def manual_seed(self, seed: int) -> "Generator":
+        with self._lock:
+            self._seed = seed
+            self._key = jax.random.key(seed)
+            self._counter = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self) -> jax.Array:
+        traced = _current_traced_key()
+        with self._lock:
+            self._counter += 1
+            counter = self._counter
+        if traced is not None:
+            return jax.random.fold_in(traced, counter)
+        return jax.random.fold_in(self._key, counter)
+
+    def split(self, n: int) -> jax.Array:
+        return jax.random.split(self.next_key(), n)
+
+    def get_state(self):
+        return {"seed": self._seed, "counter": self._counter}
+
+    def set_state(self, state) -> None:
+        with self._lock:
+            self._seed = state["seed"]
+            self._key = jax.random.key(state["seed"])
+            self._counter = state["counter"]
+
+
+default_generator = Generator(0)
+
+_tls = threading.local()
+
+
+def _key_stack() -> List[jax.Array]:
+    if not hasattr(_tls, "stack"):
+        _tls.stack = []
+    return _tls.stack
+
+
+def _current_traced_key() -> Optional[jax.Array]:
+    stack = _key_stack()
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def rng_guard(key: jax.Array):
+    """Install a (possibly traced) key as the randomness source for this thread.
+
+    Used by ``jit.to_static`` so stateful-looking random ops inside the traced
+    function derive from a per-call key argument.
+    """
+    stack = _key_stack()
+    stack.append(key)
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def seed(value: int) -> Generator:
+    """paddle.seed parity: reseed the global generator."""
+    return default_generator.manual_seed(value)
+
+
+def next_key() -> jax.Array:
+    return default_generator.next_key()
+
+
+def split_key(n: int) -> jax.Array:
+    return default_generator.split(n)
+
+
+def get_rng_state():
+    return default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    default_generator.set_state(state)
+
+
+def get_cuda_rng_state():  # API-parity alias; single generator on TPU
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state) -> None:
+    set_rng_state(state)

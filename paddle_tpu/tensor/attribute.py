@@ -1,0 +1,39 @@
+"""Tensor attribute queries (reference: python/paddle/tensor/attribute.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import canonicalize
+
+
+def shape(x):
+    return jnp.asarray(x.shape, dtype=canonicalize('int64'))
+
+
+def rank(x):
+    return jnp.asarray(x.ndim, dtype=canonicalize('int64'))
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, (jax.Array, jax.core.Tracer))
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.integer)
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.complexfloating)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)

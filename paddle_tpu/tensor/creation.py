@@ -1,0 +1,132 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import canonicalize, convert_dtype, get_default_dtype
+
+
+def _dt(dtype, like=None):
+    if dtype is not None:
+        return convert_dtype(dtype)
+    if like is not None:
+        return like
+    return get_default_dtype()
+
+
+def to_tensor(data: Any, dtype=None, place=None, stop_gradient: bool = True) -> jax.Array:
+    """paddle.to_tensor parity.
+
+    ``stop_gradient`` is accepted for source compatibility; differentiation in
+    paddle_tpu is functional (``paddle_tpu.autograd.grad``), so the flag does
+    not annotate the array itself.
+    """
+    dtype = convert_dtype(dtype)
+    if isinstance(data, jax.Array) and dtype is None:
+        arr = data
+    else:
+        if isinstance(data, (list, tuple)) or np.isscalar(data) or isinstance(data, np.ndarray):
+            np_arr = np.asarray(data)
+            if dtype is None and np_arr.dtype == np.float64:
+                np_arr = np_arr.astype(get_default_dtype())  # paddle defaults python floats to fp32
+            data = np_arr
+        arr = jnp.asarray(data, dtype=dtype)
+    if place is not None:
+        arr = jax.device_put(arr, place.jax_device())
+    return arr
+
+
+def zeros(shape: Sequence[int], dtype=None) -> jax.Array:
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+def ones(shape: Sequence[int], dtype=None) -> jax.Array:
+    return jnp.ones(shape, dtype=_dt(dtype))
+
+
+def full(shape: Sequence[int], fill_value, dtype=None) -> jax.Array:
+    return jnp.full(shape, fill_value, dtype=_dt(dtype))
+
+
+def empty(shape: Sequence[int], dtype=None) -> jax.Array:
+    # XLA has no uninitialized alloc; zeros compiles to a broadcast (free-ish).
+    return jnp.zeros(shape, dtype=_dt(dtype))
+
+
+def zeros_like(x, dtype=None) -> jax.Array:
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+def ones_like(x, dtype=None) -> jax.Array:
+    return jnp.ones_like(x, dtype=convert_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None) -> jax.Array:
+    return jnp.full_like(x, fill_value, dtype=convert_dtype(dtype))
+
+
+def empty_like(x, dtype=None) -> jax.Array:
+    return jnp.zeros_like(x, dtype=convert_dtype(dtype))
+
+
+def arange(start=0, end=None, step=1, dtype=None) -> jax.Array:
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = canonicalize('int64') if all(isinstance(v, (int, np.integer)) for v in (start, end, step)) else get_default_dtype()
+    return jnp.arange(start, end, step, dtype=canonicalize(dtype))
+
+
+def linspace(start, stop, num, dtype=None) -> jax.Array:
+    return jnp.linspace(start, stop, int(num), dtype=_dt(dtype))
+
+
+def eye(num_rows: int, num_columns: Optional[int] = None, dtype=None) -> jax.Array:
+    return jnp.eye(num_rows, num_columns, dtype=_dt(dtype))
+
+
+def meshgrid(*args) -> List[jax.Array]:
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return list(jnp.meshgrid(*args, indexing="ij"))
+
+
+def diag(x, offset: int = 0, padding_value: float = 0) -> jax.Array:
+    x = jnp.asarray(x)
+    if x.ndim == 1 and padding_value != 0:
+        out = jnp.full((x.shape[0] + abs(offset),) * 2, padding_value, dtype=x.dtype)
+        idx = jnp.arange(x.shape[0])
+        r = idx if offset >= 0 else idx - offset
+        c = idx + offset if offset >= 0 else idx
+        return out.at[r, c].set(x)
+    return jnp.diag(x, k=offset)
+
+
+def diagflat(x, offset: int = 0) -> jax.Array:
+    return jnp.diagflat(x, k=offset)
+
+
+def tril(x, diagonal: int = 0) -> jax.Array:
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal: int = 0) -> jax.Array:
+    return jnp.triu(x, k=diagonal)
+
+
+def assign(x, output=None) -> jax.Array:
+    """paddle.assign: copy semantics (functional — returns the copy)."""
+    arr = jnp.asarray(x)
+    return arr + 0 if output is None else arr.astype(output.dtype)
+
+
+def clone(x) -> jax.Array:
+    return jnp.copy(x)
+
+
+def numel(x) -> int:
+    return int(np.prod(x.shape)) if x.shape else 1

@@ -1,0 +1,8 @@
+"""Einsum (reference: python/paddle/tensor/einsum.py) — direct XLA lowering."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def einsum(equation: str, *operands):
+    return jnp.einsum(equation, *operands)

@@ -1,0 +1,74 @@
+"""Linear algebra (reference: python/paddle/tensor/linalg.py; kernels
+operators/matmul_v2_op.* lower onto the MXU via jnp.matmul/dot_general)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    """matmul_v2 parity (operators/matmul_v2_op.cc:213)."""
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, axes=tuple(perm))
+
+
+def norm(x, p="fro", axis=None, keepdim: bool = False):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=keepdim))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((x != 0).astype(x.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=keepdim), 1.0 / p)
+
+
+def dist(x, y, p: float = 2):
+    return norm(x - y, p=p)
+
+
+def cross(x, y, axis: int = -1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def cholesky(x, upper: bool = False):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2) if upper else l
+
+
+def matrix_power(x, n: int):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def histogram(x, bins: int = 100, min: float = 0.0, max: float = 0.0):
+    if min == 0.0 and max == 0.0:
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(jnp.ravel(x), bins=bins, range=(lo, hi))
+    return hist

@@ -1,0 +1,72 @@
+"""Comparison / logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def isclose(x, y, rtol: float = 1e-5, atol: float = 1e-8, equal_nan: bool = False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol: float = 1e-5, atol: float = 1e-8, equal_nan: bool = False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def is_empty(x):
+    return x.size == 0

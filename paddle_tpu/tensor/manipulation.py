@@ -1,0 +1,201 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py).
+
+Gather/scatter lower onto XLA's native gather/scatter HLOs via jnp.take /
+``.at[]`` — the reference's hand-written CUDA kernels
+(operators/gather_op.cu etc.) have no TPU analog to write.
+"""
+from __future__ import annotations
+
+import builtins
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+
+
+def cast(x, dtype):
+    return jnp.asarray(x).astype(convert_dtype(dtype))
+
+
+def reshape(x, shape: Sequence[int]):
+    return jnp.reshape(x, shape)
+
+
+def flatten(x, start_axis: int = 0, stop_axis: int = -1):
+    ndim = x.ndim
+    if ndim == 0:
+        return jnp.reshape(x, (1,))
+    start = start_axis % ndim
+    stop = stop_axis % ndim
+    new_shape = list(x.shape[:start]) + [-1] + list(x.shape[stop + 1 :])
+    return jnp.reshape(x, new_shape)
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = [axis]
+    axis = tuple(a for a in axis if x.shape[a % x.ndim] == 1)
+    return jnp.squeeze(x, axis=axis) if axis else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    out = x
+    for a in sorted(a % (out.ndim + 1) for a in axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def concat(x: Sequence, axis: int = 0):
+    return jnp.concatenate(list(x), axis=axis)
+
+
+def stack(x: Sequence, axis: int = 0):
+    return jnp.stack(list(x), axis=axis)
+
+
+def unstack(x, axis: int = 0, num: Optional[int] = None) -> List:
+    num = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis=axis) for s in jnp.split(x, num, axis=axis)]
+
+
+unbind = unstack
+
+
+def split(x, num_or_sections: Union[int, Sequence[int]], axis: int = 0) -> List:
+    axis = axis % x.ndim
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    if sum(sections) != total:
+        from ..core.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"split sections {sections} must sum to dim {axis} size {total}"
+        )
+    offsets = np.cumsum(sections)[:-1].tolist()
+    return jnp.split(x, offsets, axis=axis)
+
+
+def chunk(x, chunks: int, axis: int = 0) -> List:
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def tile(x, repeat_times: Sequence[int]):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, shape: Sequence[int]):
+    shape = tuple(x.shape[i - (len(shape) - x.ndim)] if s == -1 else s for i, s in enumerate(shape))
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def broadcast_to(x, shape: Sequence[int]):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def transpose(x, perm: Sequence[int]):
+    return jnp.transpose(x, axes=tuple(perm))
+
+
+def flip(x, axis):
+    if isinstance(axis, int):
+        axis = [axis]
+    return jnp.flip(x, axis=tuple(axis))
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def gather(x, index, axis: int = 0):
+    """paddle.gather: select rows of ``axis`` by 1-D ``index``."""
+    return jnp.take(x, jnp.asarray(index).astype(jnp.int32), axis=axis)
+
+
+def gather_nd(x, index):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def take_along_axis(x, indices, axis: int):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis: int, reduce: str = "assign"):
+    values = jnp.broadcast_to(jnp.asarray(values, dtype=x.dtype), indices.shape)
+    at = _at_along_axis(x, indices, axis)
+    if reduce == "assign":
+        return at.set(values)
+    if reduce == "add":
+        return at.add(values)
+    if reduce in ("mul", "multiply"):
+        return at.multiply(values)
+    raise ValueError(f"unsupported reduce mode {reduce!r}")
+
+
+def _at_along_axis(x, indices, axis: int):
+    axis = axis % x.ndim
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in indices.shape], indexing="ij")
+    grids[axis] = indices
+    return x.at[tuple(grids)]
+
+
+def scatter(x, index, updates, overwrite: bool = True):
+    """paddle.scatter: write ``updates`` rows at 1-D ``index`` (axis 0)."""
+    index = jnp.asarray(index).astype(jnp.int32)
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle's overwrite=False sums duplicate indices after zeroing targets
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    index = jnp.asarray(index)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape):
+    zeros = jnp.zeros(shape, dtype=jnp.asarray(updates).dtype)
+    return scatter_nd_add(zeros, index, updates)
+
+
+def index_select(x, index, axis: int = 0):
+    return jnp.take(x, jnp.asarray(index).astype(jnp.int32), axis=axis)
+
+
+def slice(x, axes: Sequence[int], starts: Sequence[int], ends: Sequence[int]):
+    slices = [builtins.slice(None)] * x.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        slices[ax] = builtins.slice(s, e)
+    return x[tuple(slices)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    slices = [builtins.slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        slices[ax] = builtins.slice(s, e, st)
+    return x[tuple(slices)]
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    """Host-side helper: data-dependent output shape → not jittable (document)."""
+    res = jnp.unique(np.asarray(x), return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    return res

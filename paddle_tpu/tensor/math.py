@@ -1,0 +1,254 @@
+"""Elementwise + reduction math (reference: python/paddle/tensor/math.py;
+C++ kernels operators/elementwise/, operators/reduce_ops/ lower onto XLA)."""
+from __future__ import annotations
+
+import builtins
+
+import jax.numpy as jnp
+
+# --- elementwise binary ---
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def mod(x, y):
+    return jnp.mod(x, y)
+
+
+remainder = mod
+
+
+def pow(x, y):
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+# --- elementwise unary ---
+def abs(x):
+    return jnp.abs(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return jnp.reciprocal(jnp.sqrt(x))
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def round(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale: bool = True):
+    """paddle.scale / scale_op parity (operators/scale_op.cc)."""
+    if bias_after_scale:
+        return x * scale + bias
+    return (x + bias) * scale
+
+
+def increment(x, value=1.0):
+    return x + value
+
+
+# --- reductions ---
+def sum(x, axis=None, dtype=None, keepdim: bool = False):
+    return jnp.sum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def nansum(x, axis=None, dtype=None, keepdim: bool = False):
+    return jnp.nansum(x, axis=axis, dtype=dtype, keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim: bool = False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim: bool = False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim: bool = False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim, dtype=dtype)
+
+
+def max(x, axis=None, keepdim: bool = False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim: bool = False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+amax = max
+amin = min
+
+
+def all(x, axis=None, keepdim: bool = False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim: bool = False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim: bool = False):
+    from jax.scipy.special import logsumexp as _lse
+
+    return _lse(x, axis=axis, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased: bool = True, keepdim: bool = False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased: bool = True, keepdim: bool = False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtype)
+
+
+def add_n(inputs):
+    """paddle.add_n (sum_op) parity: sum a list of tensors."""
+    if not isinstance(inputs, (list, tuple)):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out
+
+
+def trace(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)

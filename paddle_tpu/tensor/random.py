@@ -1,0 +1,77 @@
+"""Random ops backed by the global Generator facade (core/random.py).
+
+Reference parity: python/paddle/tensor/random.py + per-op Generator
+(framework/generator.cc).  Each call pulls a fresh key from the facade, so the
+stateful paddle API works both eagerly and under to_static (where the facade
+derives from a traced per-call key).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dtype import canonicalize, convert_dtype, get_default_dtype
+from ..core.random import next_key
+
+
+def _dt(dtype):
+    return convert_dtype(dtype) or get_default_dtype()
+
+
+def uniform(shape: Sequence[int], dtype=None, min: float = -1.0, max: float = 1.0, seed: int = 0, key: Optional[jax.Array] = None):
+    key = key if key is not None else (jax.random.key(seed) if seed else next_key())
+    return jax.random.uniform(key, tuple(shape), dtype=_dt(dtype), minval=min, maxval=max)
+
+
+def rand(shape: Sequence[int], dtype=None, key: Optional[jax.Array] = None):
+    return uniform(shape, dtype=dtype, min=0.0, max=1.0, key=key)
+
+
+def randn(shape: Sequence[int], dtype=None, key: Optional[jax.Array] = None):
+    key = key if key is not None else next_key()
+    return jax.random.normal(key, tuple(shape), dtype=_dt(dtype))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape: Optional[Sequence[int]] = None, key: Optional[jax.Array] = None):
+    if shape is None:
+        shape = jnp.shape(mean) if hasattr(mean, "shape") else ()
+    key = key if key is not None else next_key()
+    return mean + std * jax.random.normal(key, tuple(shape), dtype=get_default_dtype())
+
+
+def randint(low: int = 0, high: Optional[int] = None, shape: Sequence[int] = (1,), dtype="int64", key: Optional[jax.Array] = None):
+    if high is None:
+        low, high = 0, low
+    key = key if key is not None else next_key()
+    return jax.random.randint(key, tuple(shape), low, high, dtype=canonicalize(dtype))
+
+
+def randperm(n: int, dtype="int64", key: Optional[jax.Array] = None):
+    key = key if key is not None else next_key()
+    return jax.random.permutation(key, n).astype(canonicalize(dtype))
+
+
+def bernoulli(x, key: Optional[jax.Array] = None):
+    key = key if key is not None else next_key()
+    return jax.random.bernoulli(key, p=x).astype(x.dtype)
+
+
+def multinomial(x, num_samples: int = 1, replacement: bool = False, key: Optional[jax.Array] = None):
+    key = key if key is not None else next_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(key, logits, axis=-1, shape=(*x.shape[:-1], num_samples)).astype(canonicalize('int64'))
+    # without replacement: Gumbel top-k trick (XLA-friendly, no host loop)
+    g = jax.random.gumbel(key, x.shape, dtype=logits.dtype)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return idx.astype(canonicalize('int64'))
+
+
+def poisson(x, key: Optional[jax.Array] = None):
+    key = key if key is not None else next_key()
+    return jax.random.poisson(key, x).astype(get_default_dtype())

@@ -1,0 +1,97 @@
+"""Search/sort ops (reference: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.lax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import canonicalize
+
+
+def argmax(x, axis=None, keepdim: bool = False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(canonicalize(dtype))
+
+
+def argmin(x, axis=None, keepdim: bool = False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(canonicalize(dtype))
+
+
+def argsort(x, axis: int = -1, descending: bool = False):
+    idx = jnp.argsort(x, axis=axis)
+    return jnp.flip(idx, axis=axis) if descending else idx
+
+
+def sort(x, axis: int = -1, descending: bool = False):
+    out = jnp.sort(x, axis=axis)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+def topk(x, k: int, axis: int = -1, largest: bool = True, sorted: bool = True):
+    """Returns (values, indices); lowers onto XLA's sort-based top-k."""
+    axis = axis % x.ndim
+    moved = jnp.moveaxis(x, axis, -1)
+    if largest:
+        vals, idx = jax.lax.top_k(moved, k)
+    else:
+        vals, idx = jax.lax.top_k(-moved, k)
+        vals = -vals
+    return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idx.astype(canonicalize('int64')), -1, axis)
+
+
+def kthvalue(x, k: int, axis: int = -1, keepdim: bool = False):
+    axis = axis % x.ndim
+    srt = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    vals = jnp.take(srt, k - 1, axis=axis)
+    inds = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        inds = jnp.expand_dims(inds, axis)
+    return vals, inds
+
+
+def mode(x, axis: int = -1, keepdim: bool = False):
+    # lowered as sort + run-length vote; fine for small trailing axes
+    axis = axis % x.ndim
+    srt = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    eq = jnp.equal(srt, jnp.roll(srt, 1, axis=axis))
+    eq = jnp.concatenate([jnp.zeros_like(jnp.take(eq, [0], axis=axis)), jnp.take(eq, range(1, n), axis=axis)], axis=axis)
+    run = jnp.cumsum(eq.astype(jnp.int32), axis=axis) * eq.astype(jnp.int32)
+    best = jnp.argmax(run, axis=axis)
+    vals = jnp.take_along_axis(srt, jnp.expand_dims(best, axis), axis=axis)
+    if not keepdim:
+        vals = jnp.squeeze(vals, axis=axis)
+    return vals, best.astype(canonicalize('int64'))
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return jnp.where(condition, x, y)
+
+
+def nonzero(x, as_tuple: bool = False):
+    """Data-dependent shape: host-side only (not jittable), like reference's
+    dynamic-shape ops which also break CINN/static fusion."""
+    res = np.nonzero(np.asarray(x))
+    if as_tuple:
+        return tuple(jnp.asarray(r) for r in res)
+    return jnp.asarray(np.stack(res, axis=1))
+
+
+def masked_select(x, mask):
+    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index.astype(jnp.int32), axis=1)
+
+
+def searchsorted(sorted_sequence, values, out_int32: bool = False, right: bool = False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32) if out_int32 else out.astype(canonicalize('int64'))

@@ -1,0 +1,16 @@
+"""Statistics ops (reference: python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def median(x, axis=None, keepdim: bool = False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim: bool = False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim: bool = False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)

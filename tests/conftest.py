@@ -1,0 +1,20 @@
+"""Test harness configuration.
+
+Multi-"device" SPMD tests run on a virtual 8-device CPU mesh in-process —
+strictly better than the reference's subprocess-localhost harness
+(test_dist_base.py:743), per SURVEY.md §4 note 5.  Env must be set before jax
+initializes its backends, hence module scope here.
+"""
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)

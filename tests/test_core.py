@@ -1,0 +1,79 @@
+"""Core substrate tests: device/dtype/flags/errors/random."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core import errors, flags
+
+
+def test_version():
+    assert pt.__version__
+
+
+def test_device_api():
+    place = pt.set_device("cpu")
+    assert repr(place) == "CPUPlace(0)"
+    assert pt.get_device() == "cpu:0"
+    assert pt.core.device.device_count("cpu") == 8  # virtual mesh from conftest
+
+
+def test_default_dtype():
+    assert pt.get_default_dtype() == jnp.float32
+    pt.set_default_dtype("bfloat16")
+    try:
+        x = pt.ones([2, 2])
+        assert x.dtype == jnp.bfloat16
+    finally:
+        pt.set_default_dtype("float32")
+    with pytest.raises(TypeError):
+        pt.set_default_dtype("int32")
+
+
+def test_flags_roundtrip():
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    assert pt.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+    pt.set_flags({"FLAGS_check_nan_inf": False})
+    with pytest.raises(KeyError):
+        pt.set_flags({"FLAGS_nonexistent": 1})
+
+
+def test_enforce_errors():
+    with pytest.raises(errors.InvalidArgumentError) as e:
+        errors.enforce(False, "bad arg", hint="fix it")
+    assert "INVALID_ARGUMENT" in str(e.value)
+    assert "fix it" in str(e.value)
+
+
+def test_seed_reproducible():
+    pt.seed(42)
+    a = pt.tensor.randn([4])
+    pt.seed(42)
+    b = pt.tensor.randn([4])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    c = pt.tensor.randn([4])
+    assert not np.allclose(np.asarray(b), np.asarray(c))
+
+
+def test_rng_state_roundtrip():
+    pt.seed(7)
+    pt.tensor.randn([2])
+    state = pt.get_rng_state()
+    a = pt.tensor.randn([3])
+    pt.set_rng_state(state)
+    b = pt.tensor.randn([3])
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+
+
+def test_rng_guard_traced_key():
+    from paddle_tpu.core.random import rng_guard
+
+    def f(key):
+        with rng_guard(key):
+            return pt.tensor.randn([2])
+
+    jf = jax.jit(f)
+    r1 = jf(jax.random.key(1))
+    r2 = jf(jax.random.key(2))
+    assert not np.allclose(np.asarray(r1), np.asarray(r2))  # fresh key -> fresh sample

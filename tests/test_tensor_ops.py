@@ -1,0 +1,182 @@
+"""Tensor-namespace golden tests vs numpy — the OpTest pattern
+(reference unittests/op_test.py:270) collapsed to direct numpy comparison,
+since jnp ops need no separate CPU/CUDA place sweep."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+class TestCreation:
+    def test_to_tensor(self):
+        x = pt.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert x.dtype == jnp.float32  # python floats -> default dtype
+        np.testing.assert_allclose(_np(x), [[1, 2], [3, 4]])
+        assert pt.to_tensor([1, 2]).dtype in (jnp.int32, jnp.int64)
+
+    def test_full_like_arange(self):
+        np.testing.assert_allclose(_np(pt.full([2, 3], 7)), np.full((2, 3), 7.0))
+        np.testing.assert_allclose(_np(pt.arange(1, 10, 2)), np.arange(1, 10, 2))
+        np.testing.assert_allclose(_np(pt.linspace(0, 1, 5)), np.linspace(0, 1, 5))
+
+    def test_eye_diag_tri(self):
+        np.testing.assert_allclose(_np(pt.eye(3, 4)), np.eye(3, 4))
+        np.testing.assert_allclose(_np(pt.diag(pt.to_tensor([1.0, 2.0]))), np.diag([1.0, 2.0]))
+        x = np.arange(9.0).reshape(3, 3)
+        np.testing.assert_allclose(_np(pt.tril(pt.to_tensor(x))), np.tril(x))
+        np.testing.assert_allclose(_np(pt.triu(pt.to_tensor(x), 1)), np.triu(x, 1))
+
+    def test_numel(self):
+        assert pt.numel(pt.ones([3, 4])) == 12
+
+
+class TestMath:
+    def test_binary(self, rng):
+        a, b = rng.randn(3, 4).astype("float32"), rng.rand(3, 4).astype("float32") + 1
+        ta, tb = pt.to_tensor(a), pt.to_tensor(b)
+        np.testing.assert_allclose(_np(pt.add(ta, tb)), a + b, rtol=1e-6)
+        np.testing.assert_allclose(_np(pt.subtract(ta, tb)), a - b, rtol=1e-6)
+        np.testing.assert_allclose(_np(pt.multiply(ta, tb)), a * b, rtol=1e-6)
+        np.testing.assert_allclose(_np(pt.divide(ta, tb)), a / b, rtol=1e-6)
+        np.testing.assert_allclose(_np(pt.maximum(ta, tb)), np.maximum(a, b))
+
+    def test_reductions(self, rng):
+        x = rng.randn(4, 5).astype("float32")
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(_np(pt.sum(t, axis=1)), x.sum(1), rtol=1e-5)
+        np.testing.assert_allclose(_np(pt.mean(t, axis=0, keepdim=True)), x.mean(0, keepdims=True), rtol=1e-5)
+        np.testing.assert_allclose(_np(pt.max(t)), x.max())
+        np.testing.assert_allclose(_np(pt.std(t)), x.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(_np(pt.logsumexp(t, axis=1)), np.log(np.exp(x).sum(1)), rtol=1e-5)
+
+    def test_scale_addn_clip(self, rng):
+        x = rng.randn(3, 3).astype("float32")
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(_np(pt.tensor.scale(t, 2.0, 1.0)), x * 2 + 1, rtol=1e-6)
+        np.testing.assert_allclose(_np(pt.tensor.scale(t, 2.0, 1.0, bias_after_scale=False)), (x + 1) * 2, rtol=1e-6)
+        np.testing.assert_allclose(_np(pt.add_n([t, t, t])), 3 * x, rtol=1e-6)
+        np.testing.assert_allclose(_np(pt.clip(t, -0.5, 0.5)), np.clip(x, -0.5, 0.5))
+
+    def test_cumsum(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(_np(pt.cumsum(pt.to_tensor(x), axis=1)), np.cumsum(x, 1), rtol=1e-5)
+        np.testing.assert_allclose(_np(pt.cumsum(pt.to_tensor(x))), np.cumsum(x), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_flatten_squeeze(self, rng):
+        x = rng.randn(2, 3, 4).astype("float32")
+        t = pt.to_tensor(x)
+        assert pt.reshape(t, [4, 6]).shape == (4, 6)
+        assert pt.flatten(t, 1, 2).shape == (2, 12)
+        assert pt.unsqueeze(t, [0, 2]).shape == (1, 2, 1, 3, 4)
+        assert pt.squeeze(pt.ones([1, 3, 1]), axis=0).shape == (3, 1)
+
+    def test_concat_split_stack(self, rng):
+        x = rng.randn(4, 6).astype("float32")
+        t = pt.to_tensor(x)
+        parts = pt.split(t, [2, -1], axis=1)
+        assert parts[0].shape == (4, 2) and parts[1].shape == (4, 4)
+        np.testing.assert_allclose(_np(pt.concat(parts, axis=1)), x)
+        s = pt.stack([t, t], axis=0)
+        assert s.shape == (2, 4, 6)
+        us = pt.unstack(s, axis=0)
+        np.testing.assert_allclose(_np(us[1]), x)
+
+    def test_gather_scatter(self):
+        x = pt.to_tensor(np.arange(12.0).reshape(4, 3))
+        idx = pt.to_tensor([0, 2])
+        np.testing.assert_allclose(_np(pt.gather(x, idx)), [[0, 1, 2], [6, 7, 8]])
+        upd = pt.ones([2, 3])
+        out = pt.scatter(x, idx, upd)
+        np.testing.assert_allclose(_np(out)[0], [1, 1, 1])
+        np.testing.assert_allclose(_np(out)[2], [1, 1, 1])
+
+    def test_gather_nd(self):
+        x = pt.to_tensor(np.arange(24.0).reshape(2, 3, 4))
+        idx = pt.to_tensor(np.array([[0, 1], [1, 2]]))
+        out = pt.gather_nd(x, idx)
+        np.testing.assert_allclose(_np(out), [_np(x)[0, 1], _np(x)[1, 2]])
+
+    def test_tile_expand_transpose(self, rng):
+        x = rng.randn(2, 3).astype("float32")
+        t = pt.to_tensor(x)
+        assert pt.tile(t, [2, 2]).shape == (4, 6)
+        assert pt.expand(pt.ones([1, 3]), [5, 3]).shape == (5, 3)
+        np.testing.assert_allclose(_np(pt.transpose(t, [1, 0])), x.T)
+
+    def test_take_put_along_axis(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        t = pt.to_tensor(x)
+        idx = pt.to_tensor(np.array([[0], [1], [2]]))
+        np.testing.assert_allclose(_np(pt.take_along_axis(t, idx, 1)), np.take_along_axis(x, _np(idx), 1))
+        out = pt.put_along_axis(t, idx, 9.0, 1)
+        assert _np(out)[1, 1] == 9.0
+
+
+class TestLinalg:
+    def test_matmul(self, rng):
+        a = rng.randn(2, 3, 4).astype("float32")
+        b = rng.randn(2, 4, 5).astype("float32")
+        np.testing.assert_allclose(_np(pt.matmul(pt.to_tensor(a), pt.to_tensor(b))), a @ b, rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(pt.matmul(pt.to_tensor(a), pt.to_tensor(b.swapaxes(-1, -2)), transpose_y=True)), a @ b, rtol=1e-5
+        )
+
+    def test_norm_dot(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(_np(pt.norm(pt.to_tensor(x))), np.linalg.norm(x), rtol=1e-5)
+        v = rng.randn(4).astype("float32")
+        np.testing.assert_allclose(_np(pt.dot(pt.to_tensor(v), pt.to_tensor(v))), v @ v, rtol=1e-5)
+
+    def test_einsum(self, rng):
+        a = rng.randn(3, 4).astype("float32")
+        b = rng.randn(4, 5).astype("float32")
+        np.testing.assert_allclose(_np(pt.einsum("ij,jk->ik", pt.to_tensor(a), pt.to_tensor(b))), a @ b, rtol=1e-5)
+
+
+class TestSearchLogic:
+    def test_argmax_topk_sort(self, rng):
+        x = rng.randn(3, 5).astype("float32")
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(_np(pt.argmax(t, axis=1)), x.argmax(1))
+        vals, idx = pt.topk(t, 2, axis=1)
+        np.testing.assert_allclose(_np(vals), np.sort(x, 1)[:, ::-1][:, :2], rtol=1e-6)
+        np.testing.assert_allclose(_np(pt.sort(t, descending=True)), np.sort(x, -1)[:, ::-1])
+
+    def test_where_masked(self, rng):
+        x = rng.randn(3, 4).astype("float32")
+        t = pt.to_tensor(x)
+        np.testing.assert_allclose(_np(pt.where(t > 0, t, pt.zeros_like(t))), np.where(x > 0, x, 0))
+        np.testing.assert_allclose(_np(pt.masked_select(t, t > 0)), x[x > 0])
+
+    def test_logic(self):
+        a = pt.to_tensor([1.0, 2.0, np.nan])
+        assert _np(pt.isnan(a)).tolist() == [False, False, True]
+        assert bool(pt.allclose(pt.ones([2]), pt.ones([2])))
+
+    def test_searchsorted(self):
+        seq = pt.to_tensor([1.0, 3.0, 5.0, 7.0])
+        np.testing.assert_allclose(_np(pt.searchsorted(seq, pt.to_tensor([4.0]))), [2])
+
+
+class TestRandomOps:
+    def test_shapes_ranges(self):
+        pt.seed(0)
+        u = pt.tensor.uniform([100], min=2.0, max=3.0)
+        assert u.shape == (100,) and float(u.min()) >= 2.0 and float(u.max()) <= 3.0
+        r = pt.tensor.randint(0, 5, [50])
+        assert int(_np(r).max()) < 5
+        p = pt.tensor.randperm(10)
+        assert sorted(_np(p).tolist()) == list(range(10))
+
+    def test_multinomial_no_replacement(self):
+        pt.seed(0)
+        probs = pt.to_tensor([0.1, 0.2, 0.3, 0.4])
+        s = pt.tensor.multinomial(probs, 4, replacement=False)
+        assert sorted(_np(s).tolist()) == [0, 1, 2, 3]
