@@ -137,8 +137,16 @@ def global_device_count() -> int:
 
 
 def synchronize() -> None:
-    """Block until all pending device work completes (dev_ctx->Wait parity)."""
-    (jax.device_put(0) + 0).block_until_ready()
+    """Block until all pending device work completes (dev_ctx->Wait parity).
+
+    Waits on every live jax.Array — unlike enqueueing a fresh trivial op, this
+    actually orders against previously dispatched async work.
+    """
+    for arr in jax.live_arrays():
+        try:
+            arr.block_until_ready()
+        except RuntimeError:
+            pass  # deleted/donated buffers
 
 
 def env_device_override() -> Optional[str]:

@@ -1,0 +1,44 @@
+"""Source-compat shims for the tensor namespace.
+
+The reference threads a cosmetic ``name=`` kwarg into ProgramDesc variable
+naming (fluid/layer_helper.py); under XLA there is no per-op variable to name,
+so every public op accepts and ignores it.  The shim is applied to each
+defining submodule *and* the package namespace so both surfaces
+(``paddle_tpu.matmul`` and ``paddle_tpu.tensor.linalg.matmul``) agree.
+"""
+from __future__ import annotations
+
+import functools
+import inspect
+import types
+
+
+def accept_name_kwarg(fn):
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return fn
+    params = sig.parameters
+    if "name" in params or any(p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()):
+        return fn  # already takes name (or **kwargs swallows it)
+
+    @functools.wraps(fn)
+    def wrapper(*args, name=None, **kwargs):
+        return fn(*args, **kwargs)
+
+    wrapper.__signature__ = sig.replace(
+        parameters=[
+            *params.values(),
+            inspect.Parameter("name", inspect.Parameter.KEYWORD_ONLY, default=None),
+        ]
+    )
+    wrapper.__paddle_tpu_name_shim__ = True
+    return wrapper
+
+
+def install_name_kwarg(module_globals: dict) -> None:
+    for key, val in list(module_globals.items()):
+        if key.startswith("_"):
+            continue
+        if isinstance(val, types.FunctionType) and not getattr(val, "__paddle_tpu_name_shim__", False):
+            module_globals[key] = accept_name_kwarg(val)

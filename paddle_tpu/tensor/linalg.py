@@ -2,6 +2,7 @@
 operators/matmul_v2_op.* lower onto the MXU via jnp.matmul/dot_general)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -63,6 +64,113 @@ def cholesky(x, upper: bool = False):
 
 def matrix_power(x, n: int):
     return jnp.linalg.matrix_power(x, n)
+
+
+def svd(x, full_matrices: bool = False):
+    """paddle.linalg.svd parity: returns (U, S, Vh-transposed-to-V^H as paddle's VH)."""
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+def qr(x, mode: str = "reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def inv(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond: float = 1e-15, hermitian: bool = False):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper: bool = True, transpose: bool = False, unitriangular: bool = False):
+    import jax.scipy.linalg as jsl
+
+    return jsl.solve_triangular(x, y, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logabsdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logabsdet])
+
+
+def _on_cpu(fn, *args):
+    """Run a decomposition that has no TPU lowering on the host CPU.
+
+    XLA has no TPU kernel for general (non-symmetric) eigendecomposition; like
+    the host-only search ops, these raise a clear error under tracing and
+    otherwise compute on the CPU backend.
+    """
+    if any(isinstance(a, jax.core.Tracer) for a in args):
+        from ..core.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"paddle_tpu.{fn.__name__ if hasattr(fn, '__name__') else fn} has no TPU "
+            "lowering and cannot run under jit/to_static; call it eagerly."
+        )
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        return fn(*[jax.device_put(a, cpu) for a in args])
+
+
+def eig(x):
+    return _on_cpu(jnp.linalg.eig, x)
+
+
+def eigh(x, UPLO: str = "L"):
+    return jnp.linalg.eigh(x, UPLO=UPLO)
+
+
+def eigvals(x):
+    return _on_cpu(jnp.linalg.eigvals, x)
+
+
+def eigvalsh(x, UPLO: str = "L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def matrix_rank(x, tol=None, hermitian: bool = False):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+def cond(x, p=None):
+    return jnp.linalg.cond(x, p=p)
+
+
+def multi_dot(tensors):
+    return jnp.linalg.multi_dot(tensors)
+
+
+def lu(x, pivot: bool = True, get_infos: bool = False):
+    import jax.scipy.linalg as jsl
+
+    if not pivot:
+        from ..core.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            "paddle_tpu.lu only supports pivot=True (partial pivoting), matching "
+            "the reference's GPU path"
+        )
+    lu_mat, piv = jsl.lu_factor(x)
+    piv = piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    if get_infos:
+        info = jnp.zeros(x.shape[:-2], dtype=jnp.int32)
+        return lu_mat, piv, info
+    return lu_mat, piv
 
 
 def histogram(x, bins: int = 100, min: float = 0.0, max: float = 0.0):

@@ -30,6 +30,13 @@ def flatten(x, start_axis: int = 0, stop_axis: int = -1):
         return jnp.reshape(x, (1,))
     start = start_axis % ndim
     stop = stop_axis % ndim
+    if start > stop:
+        from ..core.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"flatten requires start_axis <= stop_axis, got {start_axis} > {stop_axis} "
+            f"for ndim={ndim}"
+        )
     new_shape = list(x.shape[:start]) + [-1] + list(x.shape[stop + 1 :])
     return jnp.reshape(x, new_shape)
 
@@ -196,6 +203,14 @@ def strided_slice(x, axes, starts, ends, strides):
 
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
-    """Host-side helper: data-dependent output shape → not jittable (document)."""
+    """Host-side helper: data-dependent output shape → not jittable; raises a
+    clear error on tracers (use jnp.unique with size= for a fixed-size variant)."""
+    if isinstance(x, jax.core.Tracer):
+        from ..core.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            "paddle_tpu.unique has a data-dependent output shape and cannot run "
+            "under jit/to_static; compute it eagerly or use jnp.unique(..., size=N)."
+        )
     res = jnp.unique(np.asarray(x), return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
     return res

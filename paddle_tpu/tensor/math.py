@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import builtins
 
+import jax
 import jax.numpy as jnp
 
 # --- elementwise binary ---
@@ -137,6 +138,100 @@ def tanh(x):
 
 def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
     return scale_b * jnp.tanh(scale_a * x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def erf(x):
+    from jax.scipy.special import erf as _erf
+
+    return _erf(x)
+
+
+def erfinv(x):
+    from jax.scipy.special import erfinv as _erfinv
+
+    return _erfinv(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def lgamma(x):
+    from jax.scipy.special import gammaln
+
+    return gammaln(x)
+
+
+def digamma(x):
+    from jax.scipy.special import digamma as _digamma
+
+    return _digamma(x)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def nan_to_num(x, nan: float = 0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def diff(x, n: int = 1, axis: int = -1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
 
 
 def ceil(x):

@@ -39,7 +39,8 @@ standard_normal = randn
 
 def normal(mean=0.0, std=1.0, shape: Optional[Sequence[int]] = None, key: Optional[jax.Array] = None):
     if shape is None:
-        shape = jnp.shape(mean) if hasattr(mean, "shape") else ()
+        # independent samples over the broadcast of mean/std shapes
+        shape = jnp.broadcast_shapes(jnp.shape(mean), jnp.shape(std))
     key = key if key is not None else next_key()
     return mean + std * jax.random.normal(key, tuple(shape), dtype=get_default_dtype())
 

@@ -54,37 +54,77 @@ def kthvalue(x, k: int, axis: int = -1, keepdim: bool = False):
 
 
 def mode(x, axis: int = -1, keepdim: bool = False):
-    # lowered as sort + run-length vote; fine for small trailing axes
+    """Most-frequent value along ``axis`` plus its index in the *original* tensor.
+
+    Lowered as stable sort + segmented run-length count (O(n log n), jittable):
+    run starts/ends are recovered with cummax/cummin scans, so counts reset at
+    each new value (reference kernel: paddle/fluid/operators/mode_op.*).
+    """
     axis = axis % x.ndim
-    srt = jnp.sort(x, axis=axis)
     n = x.shape[axis]
-    eq = jnp.equal(srt, jnp.roll(srt, 1, axis=axis))
-    eq = jnp.concatenate([jnp.zeros_like(jnp.take(eq, [0], axis=axis)), jnp.take(eq, range(1, n), axis=axis)], axis=axis)
-    run = jnp.cumsum(eq.astype(jnp.int32), axis=axis) * eq.astype(jnp.int32)
-    best = jnp.argmax(run, axis=axis)
-    vals = jnp.take_along_axis(srt, jnp.expand_dims(best, axis), axis=axis)
+    order = jnp.argsort(x, axis=axis)  # stable → last pos of a run has max orig index
+    srt = jnp.take_along_axis(x, order, axis=axis)
+    idx = _iota_like(srt, axis)
+    prev = jnp.roll(srt, 1, axis=axis)
+    nxt = jnp.roll(srt, -1, axis=axis)
+    is_start = idx == 0
+    is_start = is_start | jnp.not_equal(srt, prev)
+    is_end = (idx == n - 1) | jnp.not_equal(srt, nxt)
+    start_pos = jax.lax.cummax(jnp.where(is_start, idx, -1), axis=axis)
+    end_pos = jax.lax.cummin(jnp.where(is_end, idx, n), axis=axis, reverse=True)
+    count = end_pos - start_pos + 1
+    best = jnp.argmax(count, axis=axis)  # first max → smallest tied mode value
+    best_k = jnp.expand_dims(best, axis)
+    vals = jnp.take_along_axis(srt, best_k, axis=axis)
+    # paddle returns the index of the last occurrence in the original tensor
+    last_sorted_pos = jnp.take_along_axis(end_pos, best_k, axis=axis)
+    orig_index = jnp.take_along_axis(order, last_sorted_pos, axis=axis)
     if not keepdim:
         vals = jnp.squeeze(vals, axis=axis)
-    return vals, best.astype(canonicalize('int64'))
+        orig_index = jnp.squeeze(orig_index, axis=axis)
+    return vals, orig_index.astype(canonicalize("int64"))
+
+
+def _iota_like(x, axis: int):
+    return jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
 
 
 def where(condition, x=None, y=None):
     if x is None and y is None:
         return nonzero(condition, as_tuple=True)
+    if x is None or y is None:
+        from ..core.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            "paddle_tpu.where requires x and y to be both given or both None; "
+            f"got x={'None' if x is None else 'set'}, y={'None' if y is None else 'set'}"
+        )
     return jnp.where(condition, x, y)
 
 
+def _host_only(x, op: str):
+    if isinstance(x, jax.core.Tracer):
+        from ..core.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"paddle_tpu.{op} has a data-dependent output shape and cannot run "
+            f"under jit/to_static. Compute it eagerly, or use a fixed-size "
+            f"masked formulation (e.g. topk/where with a static size)."
+        )
+    return np.asarray(x)
+
+
 def nonzero(x, as_tuple: bool = False):
-    """Data-dependent shape: host-side only (not jittable), like reference's
-    dynamic-shape ops which also break CINN/static fusion."""
-    res = np.nonzero(np.asarray(x))
+    """Data-dependent shape → host-side only; raises a clear error on tracers."""
+    res = np.nonzero(_host_only(x, "nonzero"))
     if as_tuple:
         return tuple(jnp.asarray(r) for r in res)
     return jnp.asarray(np.stack(res, axis=1))
 
 
 def masked_select(x, mask):
-    return jnp.asarray(np.asarray(x)[np.asarray(mask)])
+    """Data-dependent shape → host-side only; raises a clear error on tracers."""
+    return jnp.asarray(_host_only(x, "masked_select")[_host_only(mask, "masked_select")])
 
 
 def index_sample(x, index):

@@ -1,0 +1,88 @@
+"""Regression tests for round-1 advisor findings (ADVICE.md) and debt items."""
+import jax
+import numpy as np
+import pytest
+
+import paddle_tpu as p
+from paddle_tpu.core.errors import InvalidArgumentError
+
+
+class TestMode:
+    def test_basic_last_index(self):
+        v, i = p.mode(p.to_tensor([3.0, 1.0, 1.0, 1.0, 2.0, 2.0]))
+        assert float(v) == 1.0
+        assert int(i) == 3  # last occurrence of the mode in the original tensor
+
+    def test_batched(self):
+        x = np.array([[2.0, 2.0, 3.0, 3.0, 3.0], [5.0, 5.0, 5.0, 1.0, 1.0]])
+        v, i = p.mode(p.to_tensor(x), axis=-1)
+        np.testing.assert_allclose(np.asarray(v), [3.0, 5.0])
+        np.testing.assert_array_equal(np.asarray(i), [4, 2])
+
+    def test_keepdim_and_jit(self):
+        x = p.to_tensor([3.0, 1.0, 1.0, 1.0, 2.0, 2.0])
+        v, i = p.mode(x, keepdim=True)
+        assert v.shape == (1,)
+        v2, i2 = jax.jit(lambda t: p.mode(t))(x)
+        assert float(v2) == 1.0 and int(i2) == 3
+
+    def test_all_distinct(self):
+        v, i = p.mode(p.to_tensor([4.0, 2.0, 7.0]))
+        assert float(v) == 2.0  # all counts 1 → smallest value wins (first max)
+
+
+class TestNormalBroadcast:
+    def test_tensor_std_independent_samples(self):
+        p.seed(7)
+        out = p.normal(0.0, p.to_tensor([1.0, 1.0, 1.0, 1.0]))
+        vals = np.asarray(out)
+        assert out.shape == (4,)
+        assert len(np.unique(vals)) > 1  # independent, not one broadcast sample
+
+    def test_broadcast_mean_std(self):
+        out = p.normal(p.to_tensor(np.zeros((2, 1))), p.to_tensor(np.ones((1, 3))))
+        assert out.shape == (2, 3)
+
+
+class TestValidation:
+    def test_scatter_nd_exported(self):
+        out = p.scatter_nd(p.to_tensor([[1], [3]]), p.to_tensor([9.0, 10.0]), [5])
+        np.testing.assert_allclose(np.asarray(out), [0.0, 9.0, 0.0, 10.0, 0.0])
+
+    def test_flatten_bad_axes(self):
+        with pytest.raises(InvalidArgumentError):
+            p.flatten(p.ones([2, 3, 4]), 2, 1)
+
+    def test_where_single_arg(self):
+        with pytest.raises(InvalidArgumentError):
+            p.where(p.to_tensor([True]), x=p.to_tensor([1.0]))
+
+    def test_host_only_ops_raise_on_tracers(self):
+        for op in (p.nonzero, p.unique, lambda t: p.masked_select(t, t > 0)):
+            with pytest.raises(InvalidArgumentError):
+                jax.jit(op)(p.ones([3]))
+
+
+class TestNewOps:
+    def test_inverse_trig_and_special(self):
+        x = p.to_tensor([0.1, 0.5])
+        np.testing.assert_allclose(np.asarray(p.asin(x)), np.arcsin([0.1, 0.5]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(p.erf(x)), [0.112463, 0.5205], atol=1e-4)
+        np.testing.assert_allclose(np.asarray(p.sigmoid(p.to_tensor(0.0))), 0.5)
+        np.testing.assert_allclose(np.asarray(p.lgamma(p.to_tensor(1.0))), 0.0, atol=1e-6)
+
+    def test_linalg_solve_inv_qr_svd(self):
+        a = np.array([[3.0, 1.0], [1.0, 2.0]], dtype=np.float32)
+        b = np.array([9.0, 8.0], dtype=np.float32)
+        x = p.solve(p.to_tensor(a), p.to_tensor(b))
+        np.testing.assert_allclose(a @ np.asarray(x), b, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(p.inv(p.to_tensor(a))) @ a, np.eye(2), atol=1e-5)
+        q, r = p.qr(p.to_tensor(a))
+        np.testing.assert_allclose(np.asarray(q) @ np.asarray(r), a, atol=1e-5)
+        u, s, vh = p.svd(p.to_tensor(a))
+        np.testing.assert_allclose(np.asarray(u) * np.asarray(s) @ np.asarray(vh), a, atol=1e-5)
+
+    def test_name_kwarg_accepted(self):
+        assert float(p.add(p.to_tensor(1.0), p.to_tensor(2.0), name="out")) == 3.0
+        assert p.reshape(p.ones([4]), [2, 2], name="r").shape == (2, 2)
+        assert p.matmul(p.ones([2, 2]), p.ones([2, 2]), name="m").shape == (2, 2)
