@@ -3,9 +3,10 @@
 A ground-up rebuild of the PaddlePaddle capability surface (reference mounted at
 /root/reference, see SURVEY.md) in idiomatic JAX/XLA/pallas/pjit:
 
-- ``Tensor`` is ``jax.Array``; eager ("dygraph") ops are jnp compositions.
-- ``jit.to_static`` replaces ProgramDesc + Executor: trace once, XLA compiles.
-- ``autograd`` is functional (``grad``/``vjp``) instead of a tape engine.
+- ``Tensor`` wraps ``jax.Array``; eager ("dygraph") ops are jnp compositions
+  recorded on a per-op ``jax.vjp`` tape so ``loss.backward()`` works.
+- ``jit.to_static`` replaces ProgramDesc + Executor: trace once, XLA compiles;
+  under jit the tape is bypassed and ``jax.grad`` differentiates.
 - ``distributed`` maps fleet/collective semantics onto named mesh axes with
   ``shard_map``/pjit and XLA collectives over ICI/DCN.
 """
@@ -39,12 +40,11 @@ from .core import (  # noqa: F401
     uint8,
 )
 from .core.random import get_cuda_rng_state, get_rng_state, set_cuda_rng_state, set_rng_state  # noqa: F401
+from .framework import Tensor  # noqa: F401
+from .framework.engine import backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .tensor import *  # noqa: F401,F403
+from . import autograd  # noqa: F401
 from .version import __version__  # noqa: F401
-
-import jax as _jax
-
-Tensor = _jax.Array
 
 
 def disable_static(*a, **k):  # dygraph is the default; parity no-op

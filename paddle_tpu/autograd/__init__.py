@@ -1,0 +1,81 @@
+"""``paddle_tpu.autograd`` — autograd facade.
+
+Reference parity: ``python/paddle/autograd/`` + the dygraph engines
+(``imperative/basic_engine.cc``, ``partial_grad_engine.cc``).  Eager mode uses
+the tape in ``framework.engine``; jitted code uses ``jax.grad`` directly (see
+``paddle_tpu.jit``).
+"""
+from ..framework.engine import backward, grad, is_grad_enabled, no_grad, set_grad_enabled, enable_grad  # noqa: F401
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled", "PyLayer"]
+
+
+class PyLayer:
+    """Custom-autograd extension point (reference: paddle.autograd.PyLayer,
+    python/paddle/autograd/py_layer.py).
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx, *grads)``.
+    Implemented as a recorded op whose pullback calls the user's backward.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        import jax
+        import jax.numpy as jnp
+
+        from ..framework import engine
+        from ..framework.tensor import Tensor
+
+        class _Ctx:
+            def __init__(self):
+                self._saved = ()
+
+            def save_for_backward(self, *tensors):
+                self._saved = tensors
+
+            def saved_tensor(self):
+                return self._saved
+
+        ctx = _Ctx()
+        out = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(out, Tensor)
+        outs = [out] if single else list(out)
+
+        diff_inputs = [
+            a for a in args if isinstance(a, Tensor) and not a.stop_gradient
+        ]
+        if engine.is_grad_enabled() and diff_inputs:
+            n_in = len(diff_inputs)
+
+            def vjp_fn(cotangents):
+                grads = cls.backward(ctx, *[
+                    Tensor(c) if not isinstance(c, Tensor) else c for c in cotangents
+                ])
+                if isinstance(grads, Tensor):
+                    grads = (grads,)
+                vals = [g._value if isinstance(g, Tensor) else g for g in grads]
+                if len(vals) != n_in:
+                    raise ValueError(
+                        "PyLayer.backward returned %d grads for %d differentiable inputs"
+                        % (len(vals), n_in)
+                    )
+                return vals
+
+            out_avals = [(tuple(t.shape), t.dtype) for t in outs]
+            leaves, treedef = jax.tree_util.tree_flatten(list(range(len(outs))))
+            node = engine.GradNode(
+                vjp_fn, diff_inputs, treedef, out_avals, op_name=cls.__name__
+            )
+            for k, t in enumerate(outs):
+                t.stop_gradient = False
+                t._node = node
+                t._leaf_idx = k
+        return out

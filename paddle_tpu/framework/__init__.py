@@ -1,0 +1,8 @@
+"""Framework core: Tensor facade, eager autograd engine, op dispatch.
+
+Reference parity: ``paddle/fluid/imperative/`` (VarBase/Tracer/BasicEngine) —
+see tensor.py / engine.py / dispatch.py docstrings for the mapping.
+"""
+from .tensor import Parameter, Tensor, is_tensor_like  # noqa: F401
+from .engine import backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
+from .dispatch import install_methods, install_ops, make_op  # noqa: F401

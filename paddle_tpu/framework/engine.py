@@ -1,0 +1,268 @@
+"""Eager ("dygraph") autograd engine.
+
+Reference parity: ``paddle/fluid/imperative/basic_engine.cc:39,305`` (BasicEngine:
+reverse topological sweep with gradient accumulation) and
+``partial_grad_engine.cc`` (``paddle.grad`` subgraph backward).
+
+TPU-native design: instead of per-op C++ grad kernels, every eager op records a
+:class:`GradNode` holding the ``jax.vjp`` pullback of the traced jnp
+composition.  ``backward()`` walks nodes in reverse creation order (a valid
+topological order for a tape, mirroring PyTorch's sequence number and paddle's
+dependency-counted queue) and accumulates cotangents.  The jitted/functional
+path (``paddle_tpu.jit``) bypasses this engine entirely and uses ``jax.grad``.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+
+_node_counter = itertools.count()
+
+_tls = threading.local()
+
+
+def is_grad_enabled() -> bool:
+    return getattr(_tls, "grad_enabled", True)
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _tls.grad_enabled = bool(mode)
+
+
+class no_grad:
+    """paddle.no_grad parity: context manager *and* decorator."""
+
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with no_grad():
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = is_grad_enabled()
+        set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class GradNode:
+    """One recorded op: pullback + the inputs it differentiates w.r.t.
+
+    ``out_avals[i]`` is ``(shape, dtype)`` for array output-leaves and ``None``
+    for non-array leaves (python scalars riding along in the output pytree).
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_treedef", "out_avals", "id", "op_name")
+
+    def __init__(self, vjp_fn, inputs, out_treedef, out_avals, op_name=""):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list of Tensor (each with stop_gradient=False at record time)
+        self.out_treedef = out_treedef
+        self.out_avals = out_avals
+        self.id = next(_node_counter)
+        self.op_name = op_name
+
+
+def _zero_cotangent(aval):
+    shape, dtype = aval
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    # Integer/bool outputs take symbolic-zero cotangents of dtype float0.
+    return np.zeros(shape, dtype=jax.dtypes.float0)
+
+
+def _accumulate(a, b):
+    if a is None:
+        return b
+    return a + b
+
+
+def _run_engine(roots, root_grads, sinks: Optional[list], retain_graph: bool):
+    """Shared sweep for ``backward`` and ``grad``.
+
+    roots: output Tensors to seed; root_grads: matching cotangents (raw arrays).
+    sinks: if not None, only accumulate into this list of Tensors and return
+    their grads (partial_grad_engine semantics); otherwise accumulate ``.grad``
+    on every reachable leaf (basic_engine semantics).
+    """
+    from .tensor import Tensor  # local import to avoid cycle
+
+    sink_ids = None if sinks is None else {id(t) for t in sinks}
+    sink_grads: dict = {}
+    leaf_hooks_fired = []
+
+    # node.id -> per-output-leaf cotangent buffers
+    buffers: dict = {}
+    heap: list = []
+    seen_nodes: dict = {}
+
+    def push_node(node, leaf_idx, cot):
+        buf = buffers.setdefault(node.id, [None] * len(node.out_avals))
+        buf[leaf_idx] = _accumulate(buf[leaf_idx], cot)
+        if node.id not in seen_nodes:
+            seen_nodes[node.id] = node
+            heapq.heappush(heap, -node.id)
+
+    def sink_into(tensor, cot):
+        if sink_ids is not None:
+            if id(tensor) in sink_ids:
+                sink_grads[id(tensor)] = _accumulate(sink_grads.get(id(tensor)), cot)
+            elif tensor._node is None and tensor.stop_gradient:
+                pass
+            return
+        if not tensor.stop_gradient:
+            for hook in tensor._grad_hooks:
+                new = hook(tensor._wrap_grad(cot))
+                if new is not None:
+                    cot = new.value if isinstance(new, Tensor) else new
+            tensor._grad_val = _accumulate(tensor._grad_val, cot)
+            leaf_hooks_fired.append(tensor)
+
+    for t, g in zip(roots, root_grads):
+        if t._node is not None:
+            push_node(t._node, t._leaf_idx, g)
+        else:
+            sink_into(t, g)
+
+    while heap:
+        node = seen_nodes.pop(-heapq.heappop(heap))
+        buf = buffers.pop(node.id)
+        if node.vjp_fn is None:
+            raise InvalidArgumentError(
+                "Trying to backward through the graph a second time; the saved "
+                "intermediate results have been freed. Specify retain_graph=True "
+                "on the first backward call (op: %s)." % node.op_name
+            )
+        cots = [
+            b if b is not None else _zero_cotangent(aval)
+            for b, aval in zip(buf, node.out_avals)
+        ]
+        cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cots)
+        in_grads = node.vjp_fn(cot_tree)
+        if not retain_graph:
+            node.vjp_fn = None
+        for inp, g in zip(node.inputs, in_grads):
+            # When a node output is also a sink target we may want its grad too;
+            # partial-grad targets are handled on entry via roots/sinks.
+            if sink_ids is not None and id(inp) in sink_ids:
+                sink_grads[id(inp)] = _accumulate(sink_grads.get(id(inp)), g)
+                # still continue upstream so other sinks get their grads
+            if inp._node is not None:
+                push_node(inp._node, inp._leaf_idx, g)
+            elif sink_ids is None:
+                sink_into(inp, g)
+
+    return sink_grads
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False) -> None:
+    """paddle.autograd.backward parity (basic_engine.cc:305 Execute analog)."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    roots, seeds = [], []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            raise InvalidArgumentError(
+                "backward() called on a tensor with stop_gradient=True and no "
+                "recorded graph; nothing to differentiate"
+            )
+        if g is None:
+            if t.value.size != 1:
+                raise InvalidArgumentError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    "got shape %s. Pass grad_tensors explicitly." % (t.shape,)
+                )
+            g = jnp.ones_like(t.value)
+        else:
+            g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        roots.append(t)
+        seeds.append(g)
+    _run_engine(roots, seeds, sinks=None, retain_graph=retain_graph)
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph: Optional[bool] = None,
+    create_graph: bool = False,
+    only_inputs: bool = True,
+    allow_unused: bool = False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity (partial_grad_engine.cc analog).
+
+    ``create_graph`` (double backward) is not supported on the eager tape; use
+    the functional path (``paddle_tpu.incubate.autograd`` / ``jax.grad`` of a
+    jitted function) for higher-order derivatives.
+    """
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (double grad) is unsupported on the eager tape; "
+            "compose jax.grad via paddle_tpu.jit for higher-order derivatives"
+        )
+    single_out = isinstance(outputs, Tensor)
+    single_in = isinstance(inputs, Tensor)
+    outputs = [outputs] if single_out else list(outputs)
+    inputs = [inputs] if single_in else list(inputs)
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    if retain_graph is None:
+        retain_graph = False
+    roots, seeds = [], []
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            g = jnp.ones_like(t.value)
+        else:
+            g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        roots.append(t)
+        seeds.append(g)
+    sink_grads = _run_engine(roots, seeds, sinks=inputs, retain_graph=retain_graph)
+    results = []
+    for t in inputs:
+        g = sink_grads.get(id(t))
+        if g is None and not allow_unused:
+            raise InvalidArgumentError(
+                "One of the differentiated tensors appears unused in the graph. "
+                "Set allow_unused=True to return None for it."
+            )
+        results.append(None if g is None else t._wrap_grad(g))
+    if single_in:
+        return results[0]
+    return results

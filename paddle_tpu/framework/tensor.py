@@ -1,0 +1,349 @@
+"""``paddle_tpu.Tensor`` — the eager tensor facade over ``jax.Array``.
+
+Reference parity: ``paddle/fluid/imperative/layer.h`` VarBase (value + grad var
++ stop_gradient + hooks) and the Python method surface monkey-patched onto it
+by ``fluid/dygraph/varbase_patch_methods.py`` / ``math_op_patch.py``.
+
+TPU-native design: a thin Python wrapper holding an immutable ``jax.Array``
+(``.value``).  Autograd metadata (``_node``/``_leaf_idx``) points into the
+eager tape (see ``engine.py``).  Inside ``jit``-traced code the same class
+wraps tracers; the tape is not recorded there (``jax.grad`` handles it), so
+the facade is free for compiled code.  ``__jax_array__`` lets raw ``jnp.*``
+calls consume a Tensor transparently.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import convert_dtype
+from ..core.errors import InvalidArgumentError
+from . import engine
+
+_live_parameters: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_node",
+        "_leaf_idx",
+        "_grad_val",
+        "_grad_hooks",
+        "name",
+        "__weakref__",
+    )
+
+    def __init__(self, value, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._node = None
+        self._leaf_idx = 0
+        self._grad_val = None
+        self._grad_hooks = []
+        self.name = name
+
+    # -- value plumbing -------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    def __jax_array__(self):
+        return self._value
+
+    def __array__(self, dtype=None, copy=None):
+        arr = np.asarray(self._value)
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def set_value(self, v) -> None:
+        """In-place value replacement (VarBase copy_ semantics). Severs the tape."""
+        if isinstance(v, Tensor):
+            v = v._value
+        v = jnp.asarray(v)
+        if tuple(v.shape) != tuple(self._value.shape):
+            raise InvalidArgumentError(
+                "set_value shape mismatch: tensor %s vs value %s"
+                % (tuple(self._value.shape), tuple(v.shape))
+            )
+        self._value = v.astype(self._value.dtype)
+        self._node = None
+
+    def _replace_value(self, v) -> None:
+        """Trusted raw replacement used by optimizers/jit writeback (no casts)."""
+        self._value = v
+        self._node = None
+
+    # -- shape / dtype surface -----------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    def dim(self) -> int:
+        return self._value.ndim
+
+    def ndimension(self) -> int:
+        return self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.ndim else 1
+
+    @property
+    def T(self):
+        from .. import tensor as _t
+
+        return _t.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def place(self):
+        from ..core.device import get_device
+
+        devs = getattr(self._value, "devices", None)
+        return list(devs())[0] if callable(devs) else get_device()
+
+    def is_leaf_(self) -> bool:
+        return self._node is None
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    # -- autograd -------------------------------------------------------
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        if self._grad_val is None:
+            return None
+        return self._wrap_grad(self._grad_val)
+
+    @grad.setter
+    def grad(self, g) -> None:
+        if g is None:
+            self._grad_val = None
+        else:
+            self._grad_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+
+    def _wrap_grad(self, g) -> "Tensor":
+        t = Tensor(g, stop_gradient=True)
+        return t
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False) -> None:
+        engine.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self) -> None:
+        self._grad_val = None
+
+    clear_gradient = clear_grad
+
+    def register_hook(self, hook):
+        """Gradient hook (VariableWrapper hook parity): fn(grad)->grad|None."""
+        self._grad_hooks.append(hook)
+
+        class _Removable:
+            def __init__(self, owner, h):
+                self._owner, self._h = owner, h
+
+            def remove(self):
+                try:
+                    self._owner._grad_hooks.remove(self._h)
+                except ValueError:
+                    pass
+
+        return _Removable(self, hook)
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True, name=self.name)
+
+    def clone(self) -> "Tensor":
+        from .. import tensor as _t
+
+        return _t.assign(self)
+
+    # -- host interop ---------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return self._value.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def astype(self, dtype) -> "Tensor":
+        from .. import tensor as _t
+
+        return _t.cast(self, dtype)
+
+    def cast(self, dtype) -> "Tensor":
+        return self.astype(dtype)
+
+    def cpu(self) -> "Tensor":
+        return self
+
+    def cuda(self, *a, **k) -> "Tensor":
+        return self
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        dtype = kwargs.get("dtype")
+        for a in args:
+            if isinstance(a, (str, jnp.dtype)) or a in (
+                jnp.float32,
+                jnp.float16,
+                jnp.bfloat16,
+                jnp.float64,
+            ):
+                try:
+                    dtype = convert_dtype(a)
+                except Exception:
+                    continue
+        if dtype is not None:
+            return self.astype(dtype)
+        return self
+
+    # -- python protocol ------------------------------------------------
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __bool__(self) -> bool:
+        return bool(self._value)
+
+    def __int__(self) -> int:
+        return int(self._value)
+
+    def __float__(self) -> float:
+        return float(self._value)
+
+    def __index__(self) -> int:
+        return int(self._value)
+
+    def __format__(self, spec) -> str:
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return format(str(self), spec)
+
+    def __repr__(self) -> str:
+        return (
+            "Tensor(shape=%s, dtype=%s, stop_gradient=%s,\n       %s)"
+            % (self.shape, self._value.dtype.name, self.stop_gradient,
+               np.array2string(np.asarray(self._value), prefix="       "))
+        )
+
+    __str__ = __repr__
+
+    def __hash__(self) -> int:
+        return id(self)
+
+    def __getitem__(self, idx):
+        from . import dispatch
+
+        return dispatch.getitem(self, idx)
+
+    def __setitem__(self, idx, v):
+        if isinstance(v, Tensor):
+            v = v._value
+        idx = jax.tree_util.tree_map(
+            lambda l: l._value if isinstance(l, Tensor) else l,
+            idx,
+            is_leaf=lambda l: isinstance(l, Tensor),
+        )
+        self._value = self._value.at[idx].set(v)
+        self._node = None
+
+    # Arithmetic dunders are installed by framework.dispatch.install_methods()
+    # so they share the recorded-op path with the function API.
+
+
+class Parameter(Tensor):
+    """Trainable tensor (reference: ParamBase, fluid/framework.py:5443)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "do_model_average", "is_distributed")
+
+    _name_counter = 0
+
+    def __init__(self, value, trainable: bool = True, name: Optional[str] = None):
+        if name is None:
+            name = "param_%d" % Parameter._name_counter
+            Parameter._name_counter += 1
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.do_model_average = None
+        self.is_distributed = False
+        _live_parameters.add(self)
+
+    @property
+    def requires_grad(self) -> bool:
+        return not self.stop_gradient
+
+    def __repr__(self) -> str:
+        return "Parameter(name=%s, shape=%s, dtype=%s, trainable=%s)" % (
+            self.name,
+            self.shape,
+            self._value.dtype.name,
+            self.trainable,
+        )
+
+    __str__ = __repr__
+
+
+def is_tensor_like(x) -> bool:
+    return isinstance(x, (Tensor, jax.Array))
+
+
+# ---------------------------------------------------------------------------
+# Pytree registration: jit/vmap/device_put treat a Tensor as its value, so
+# ``jax.jit(f)(tensor)`` works and inside ``f`` ops see a Tensor wrapping a
+# tracer.  Unflatten bypasses __init__ to avoid Parameter-registry effects.
+# ---------------------------------------------------------------------------
+
+def _tensor_flatten(t):
+    return (t._value,), (type(t), t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    cls, stop_gradient, name = aux
+    obj = object.__new__(cls)
+    obj._value = children[0]
+    obj.stop_gradient = stop_gradient
+    obj._node = None
+    obj._leaf_idx = 0
+    obj._grad_val = None
+    obj._grad_hooks = []
+    obj.name = name
+    if cls is Parameter:
+        obj.trainable = not stop_gradient
+        obj.optimize_attr = {"learning_rate": 1.0}
+        obj.regularizer = None
+        obj.do_model_average = None
+        obj.is_distributed = False
+    return obj
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _tensor_unflatten)

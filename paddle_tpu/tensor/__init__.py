@@ -220,3 +220,29 @@ def _install_name_kwarg():
 
 
 _install_name_kwarg()
+
+
+def _install_dispatch():
+    """Wrap the whole namespace in the Tensor-facade dispatch (see
+    framework/dispatch.py) and attach the paddle.Tensor method surface."""
+    import sys
+
+    from ..framework import dispatch
+    from ..framework.tensor import Tensor as _Tensor
+
+    dispatch.install_ops(globals())
+
+    _raw_to_tensor = creation.to_tensor
+
+    def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True, name=None):
+        """paddle.to_tensor parity — returns a Tensor honoring stop_gradient."""
+        if isinstance(data, _Tensor):
+            data = data.value
+        arr = _raw_to_tensor(data, dtype=dtype, place=place)
+        return _Tensor(arr, stop_gradient=stop_gradient, name=name)
+
+    globals()["to_tensor"] = to_tensor
+    dispatch.install_methods(sys.modules[__name__])
+
+
+_install_dispatch()

@@ -16,7 +16,9 @@ def rank(x):
 
 
 def is_tensor(x) -> bool:
-    return isinstance(x, (jax.Array, jax.core.Tracer))
+    from ..framework.tensor import Tensor
+
+    return isinstance(x, (jax.Array, jax.core.Tracer, Tensor))
 
 
 def is_floating_point(x) -> bool:

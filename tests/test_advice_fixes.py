@@ -22,7 +22,7 @@ class TestMode:
     def test_keepdim_and_jit(self):
         x = p.to_tensor([3.0, 1.0, 1.0, 1.0, 2.0, 2.0])
         v, i = p.mode(x, keepdim=True)
-        assert v.shape == (1,)
+        assert v.shape == [1]
         v2, i2 = jax.jit(lambda t: p.mode(t))(x)
         assert float(v2) == 1.0 and int(i2) == 3
 
@@ -36,12 +36,12 @@ class TestNormalBroadcast:
         p.seed(7)
         out = p.normal(0.0, p.to_tensor([1.0, 1.0, 1.0, 1.0]))
         vals = np.asarray(out)
-        assert out.shape == (4,)
+        assert out.shape == [4]
         assert len(np.unique(vals)) > 1  # independent, not one broadcast sample
 
     def test_broadcast_mean_std(self):
         out = p.normal(p.to_tensor(np.zeros((2, 1))), p.to_tensor(np.ones((1, 3))))
-        assert out.shape == (2, 3)
+        assert out.shape == [2, 3]
 
 
 class TestValidation:
@@ -84,5 +84,5 @@ class TestNewOps:
 
     def test_name_kwarg_accepted(self):
         assert float(p.add(p.to_tensor(1.0), p.to_tensor(2.0), name="out")) == 3.0
-        assert p.reshape(p.ones([4]), [2, 2], name="r").shape == (2, 2)
-        assert p.matmul(p.ones([2, 2]), p.ones([2, 2]), name="m").shape == (2, 2)
+        assert p.reshape(p.ones([4]), [2, 2], name="r").shape == [2, 2]
+        assert p.matmul(p.ones([2, 2]), p.ones([2, 2]), name="m").shape == [2, 2]

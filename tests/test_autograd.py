@@ -1,0 +1,311 @@
+"""Eager autograd engine + Tensor facade tests.
+
+Mirrors the reference's dygraph autograd tests
+(test_imperative_basic.py, test_autograd_functional_dynamic.py) and the
+OpTest.check_grad finite-difference methodology (unittests/op_test.py:1409).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def fd_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar f at numpy x."""
+    g = np.zeros_like(x, dtype=np.float64)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        fp = f(x.copy().astype(np.float32))
+        flat[i] = orig - eps
+        fm = f(x.copy().astype(np.float32))
+        flat[i] = orig
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+class TestTensorFacade:
+    def test_wrap_and_numpy(self):
+        t = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+        assert isinstance(t, paddle.Tensor)
+        assert t.shape == [2, 2]
+        assert t.stop_gradient is True
+        np.testing.assert_allclose(t.numpy(), [[1, 2], [3, 4]])
+
+    def test_methods_and_operators(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        y = paddle.to_tensor([4.0, 5.0, 6.0])
+        np.testing.assert_allclose((x + y).numpy(), [5, 7, 9])
+        np.testing.assert_allclose((x * 2).numpy(), [2, 4, 6])
+        np.testing.assert_allclose((2 * x).numpy(), [2, 4, 6])
+        np.testing.assert_allclose((1 - x).numpy(), [0, -1, -2])
+        np.testing.assert_allclose((x / 2).numpy(), [0.5, 1, 1.5])
+        np.testing.assert_allclose(x.add(y).numpy(), [5, 7, 9])
+        np.testing.assert_allclose(x.sum().item(), 6.0)
+        np.testing.assert_allclose(x.mean().item(), 2.0)
+        np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+        np.testing.assert_allclose(x.abs().numpy(), [1, 2, 3])
+        m = paddle.to_tensor([[1.0, 0.0], [0.0, 1.0]])
+        v = paddle.to_tensor([[2.0], [3.0]])
+        np.testing.assert_allclose((m @ v).numpy(), [[2], [3]])
+
+    def test_comparisons_and_indexing(self):
+        x = paddle.to_tensor([1.0, 2.0, 3.0])
+        assert bool((x > 1.5)[1])
+        np.testing.assert_allclose(x[1:].numpy(), [2, 3])
+        assert x[0].item() == 1.0
+        x[0] = 9.0
+        assert x[0].item() == 9.0
+
+    def test_astype_clone_detach(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        assert x.astype("int32").numpy().dtype == np.int32
+        c = x.clone()
+        c[0] = 7.0
+        assert x[0].item() == 1.5
+        d = x.detach()
+        assert d.stop_gradient
+
+    def test_shape_size_T(self):
+        x = paddle.ones([2, 3])
+        assert isinstance(x, paddle.Tensor)
+        assert x.shape == [2, 3]
+        assert x.size == 6
+        assert x.T.shape == [3, 2]
+        assert len(x) == 2
+        assert x.numel().item() == 6
+
+    def test_repr_runs(self):
+        assert "Tensor" in repr(paddle.to_tensor([1.0]))
+
+
+class TestBackward:
+    def test_scalar_chain(self):
+        x = paddle.to_tensor([2.0, 3.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [4.0, 6.0])
+
+    def test_grad_accumulation_two_backwards(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        (x * x).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [7.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_diamond_graph(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        a = x * 2
+        b = x * 3
+        ((a + b) * 1.0).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+
+    def test_multi_use_accumulation(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * x  # used twice below
+        z = (y + y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.to_tensor([3.0])  # stop_gradient=True
+        z = (x * y).sum()
+        z.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0])
+        assert y.grad is None
+
+    def test_detach_blocks(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * x).detach()
+        with pytest.raises(Exception):
+            y.backward()
+
+    def test_retain_graph(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+    def test_double_backward_without_retain_raises(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(Exception, match="second time|retain"):
+            y.backward()
+
+    def test_non_scalar_needs_grad_tensor(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = x * 2
+        with pytest.raises(Exception):
+            y.backward()
+        y = x * 2
+        y.backward(paddle.to_tensor([1.0, 10.0]))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+    def test_no_grad_context(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * x
+        assert y.stop_gradient
+        assert paddle.is_grad_enabled()
+
+    def test_no_grad_decorator(self):
+        @paddle.no_grad()
+        def f(x):
+            return x * x
+
+        y = f(paddle.to_tensor([2.0], stop_gradient=False))
+        assert y.stop_gradient
+
+    def test_register_hook(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        seen = []
+        h = x.register_hook(lambda g: seen.append(g.numpy().copy()))
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(seen[0], [3.0])
+        h.remove()
+
+    def test_hook_modifies_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        x.register_hook(lambda g: g * 10)
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [30.0])
+
+    def test_multi_output_op_grad(self):
+        # topk returns (values, indices): grads flow through values only
+        x = paddle.to_tensor([1.0, 5.0, 3.0], stop_gradient=False)
+        vals, idx = paddle.topk(x, k=2)
+        vals.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 1.0])
+
+    def test_branch_to_int_output(self):
+        x = paddle.to_tensor([1.0, 5.0, 3.0], stop_gradient=False)
+        i = paddle.argmax(x)  # non-differentiable consumer must not break tape
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2, 2, 2])
+        assert i.item() == 1
+
+    def test_getitem_grad(self):
+        x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+        x[0, 1].backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[0, 1], [0, 0]])
+
+    def test_matmul_check_grad_fd(self):
+        rng = np.random.RandomState(0)
+        a_np = rng.randn(3, 4).astype(np.float32)
+        b_np = rng.randn(4, 2).astype(np.float32)
+
+        a = paddle.to_tensor(a_np, stop_gradient=False)
+        b = paddle.to_tensor(b_np, stop_gradient=False)
+        paddle.matmul(a, b).sum().backward()
+
+        fa = fd_grad(lambda v: float(np.matmul(v, b_np).sum()), a_np.astype(np.float64))
+        fb = fd_grad(lambda v: float(np.matmul(a_np, v).sum()), b_np.astype(np.float64))
+        np.testing.assert_allclose(a.grad.numpy(), fa, rtol=1e-2, atol=1e-2)
+        np.testing.assert_allclose(b.grad.numpy(), fb, rtol=1e-2, atol=1e-2)
+
+    def test_composite_expression_fd(self):
+        rng = np.random.RandomState(1)
+        x_np = rng.rand(5).astype(np.float32) + 0.5
+
+        def f_np(v):
+            return float(np.sum(np.tanh(v) * np.exp(-v) + np.log(v)))
+
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        (paddle.tanh(x) * paddle.exp(-x) + paddle.log(x)).sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), fd_grad(f_np, x_np.astype(np.float64)), rtol=1e-2, atol=1e-2
+        )
+
+
+class TestPartialGrad:
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.to_tensor([3.0], stop_gradient=False)
+        z = x * x * y
+        gx, gy = paddle.grad(z, [x, y])
+        np.testing.assert_allclose(gx.numpy(), [12.0])
+        np.testing.assert_allclose(gy.numpy(), [4.0])
+        # .grad not polluted by paddle.grad
+        assert x.grad is None
+
+    def test_grad_single_tensors(self):
+        x = paddle.to_tensor([4.0], stop_gradient=False)
+        g = paddle.grad(x * x, x)
+        np.testing.assert_allclose(g.numpy(), [8.0])
+
+    def test_grad_unused_raises_and_allow_unused(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.to_tensor([3.0], stop_gradient=False)
+        with pytest.raises(Exception):
+            paddle.grad(x * 2, [y])
+        res = paddle.grad(x * 2, [y], allow_unused=True)
+        assert res[0] is None
+
+    def test_grad_intermediate_target(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = x * 3
+        z = (y * y).sum()
+        gy = paddle.grad(z, [y])[0]
+        np.testing.assert_allclose(gy.numpy(), [12.0])
+
+
+class TestPyLayer:
+    def test_custom_forward_backward(self):
+        class Double(paddle.autograd.PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                return g * 2
+
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = Double.apply(x)
+        np.testing.assert_allclose(y.numpy(), [6.0])
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+
+class TestRawInterop:
+    def test_raw_arrays_passthrough(self):
+        import jax.numpy as jnp
+
+        a = jnp.ones((2, 2))
+        out = paddle.add(a, a)
+        assert not isinstance(out, paddle.Tensor)  # functional path stays raw
+        np.testing.assert_allclose(np.asarray(out), 2 * np.ones((2, 2)))
+
+    def test_jit_through_tensor_ops(self):
+        import jax
+
+        @jax.jit
+        def f(a):
+            return paddle.multiply(a, a)
+
+        out = f(np.ones((2,), np.float32) * 3)
+        np.testing.assert_allclose(np.asarray(out), [9, 9])
+
+    def test_jnp_and_numpy_conversion(self):
+        import jax.numpy as jnp
+
+        t = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose(np.asarray(jnp.sin(jnp.asarray(t))), np.sin([1.0, 2.0]), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(t), [1.0, 2.0])
+
+    def test_jax_grad_through_facade_ops(self):
+        import jax
+
+        def loss(a):
+            return paddle.sum(paddle.square(a))
+
+        g = jax.grad(loss)(np.array([1.0, 2.0], np.float32))
+        np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])

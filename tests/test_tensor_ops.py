@@ -72,19 +72,19 @@ class TestManipulation:
     def test_reshape_flatten_squeeze(self, rng):
         x = rng.randn(2, 3, 4).astype("float32")
         t = pt.to_tensor(x)
-        assert pt.reshape(t, [4, 6]).shape == (4, 6)
-        assert pt.flatten(t, 1, 2).shape == (2, 12)
-        assert pt.unsqueeze(t, [0, 2]).shape == (1, 2, 1, 3, 4)
-        assert pt.squeeze(pt.ones([1, 3, 1]), axis=0).shape == (3, 1)
+        assert pt.reshape(t, [4, 6]).shape == [4, 6]
+        assert pt.flatten(t, 1, 2).shape == [2, 12]
+        assert pt.unsqueeze(t, [0, 2]).shape == [1, 2, 1, 3, 4]
+        assert pt.squeeze(pt.ones([1, 3, 1]), axis=0).shape == [3, 1]
 
     def test_concat_split_stack(self, rng):
         x = rng.randn(4, 6).astype("float32")
         t = pt.to_tensor(x)
         parts = pt.split(t, [2, -1], axis=1)
-        assert parts[0].shape == (4, 2) and parts[1].shape == (4, 4)
+        assert parts[0].shape == [4, 2] and parts[1].shape == [4, 4]
         np.testing.assert_allclose(_np(pt.concat(parts, axis=1)), x)
         s = pt.stack([t, t], axis=0)
-        assert s.shape == (2, 4, 6)
+        assert s.shape == [2, 4, 6]
         us = pt.unstack(s, axis=0)
         np.testing.assert_allclose(_np(us[1]), x)
 
@@ -106,8 +106,8 @@ class TestManipulation:
     def test_tile_expand_transpose(self, rng):
         x = rng.randn(2, 3).astype("float32")
         t = pt.to_tensor(x)
-        assert pt.tile(t, [2, 2]).shape == (4, 6)
-        assert pt.expand(pt.ones([1, 3]), [5, 3]).shape == (5, 3)
+        assert pt.tile(t, [2, 2]).shape == [4, 6]
+        assert pt.expand(pt.ones([1, 3]), [5, 3]).shape == [5, 3]
         np.testing.assert_allclose(_np(pt.transpose(t, [1, 0])), x.T)
 
     def test_take_put_along_axis(self, rng):
@@ -169,7 +169,7 @@ class TestRandomOps:
     def test_shapes_ranges(self):
         pt.seed(0)
         u = pt.tensor.uniform([100], min=2.0, max=3.0)
-        assert u.shape == (100,) and float(u.min()) >= 2.0 and float(u.max()) <= 3.0
+        assert u.shape == [100] and float(u.min()) >= 2.0 and float(u.max()) <= 3.0
         r = pt.tensor.randint(0, 5, [50])
         assert int(_np(r).max()) < 5
         p = pt.tensor.randperm(10)
