@@ -44,6 +44,11 @@ from .framework import Tensor  # noqa: F401
 from .framework.engine import backward, enable_grad, grad, is_grad_enabled, no_grad, set_grad_enabled  # noqa: F401
 from .tensor import *  # noqa: F401,F403
 from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import regularizer  # noqa: F401
+from .framework.tensor import Parameter  # noqa: F401
+from .nn.layer.layers import ParamAttr  # noqa: F401
 from .version import __version__  # noqa: F401
 
 

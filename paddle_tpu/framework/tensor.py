@@ -281,7 +281,7 @@ class Tensor:
 class Parameter(Tensor):
     """Trainable tensor (reference: ParamBase, fluid/framework.py:5443)."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "do_model_average", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "do_model_average", "is_distributed", "need_clip")
 
     _name_counter = 0
 
@@ -295,6 +295,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.do_model_average = None
         self.is_distributed = False
+        self.need_clip = True
         _live_parameters.add(self)
 
     @property
@@ -342,6 +343,7 @@ def _tensor_unflatten(aux, children):
         obj.regularizer = None
         obj.do_model_average = None
         obj.is_distributed = False
+        obj.need_clip = True
     return obj
 
 

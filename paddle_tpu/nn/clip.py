@@ -1,0 +1,70 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByValue:152, ClipGradByNorm:243, ClipGradByGlobalNorm:345).
+
+Clips operate on raw grad arrays (pure, jit-safe) so the same object serves
+the eager optimizer.step() path and the jitted train-step path.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: Sequence[Tuple[object, object]]):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [
+            (p, None if g is None else jnp.clip(g, self.min, self.max))
+            for p, g in params_grads
+        ]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, None))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm: float, group_name: str = "default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def global_norm(self, grads) -> jnp.ndarray:
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads if g is not None]
+        if not sq:
+            return jnp.zeros((), jnp.float32)
+        return jnp.sqrt(sum(sq))
+
+    def __call__(self, params_grads):
+        grads = [g for p, g in params_grads if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return list(params_grads)
+        gnorm = self.global_norm(grads)
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, g * scale.astype(g.dtype)))
+        return out

@@ -1,0 +1,231 @@
+"""Common functionals (reference: python/paddle/nn/functional/common.py +
+input.py + extension ops).
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dtype import convert_dtype
+from ...core.errors import InvalidArgumentError
+from ...core.random import next_key
+
+
+def linear(x, weight, bias=None):
+    """paddle weight layout [in_features, out_features]."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def dropout(
+    x,
+    p: float = 0.5,
+    axis=None,
+    training: bool = True,
+    mode: str = "upscale_in_train",
+):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return x * (1.0 - p)
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    if axis is None:
+        mask_shape = x.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        mask_shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, mask_shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True):
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772848170429916717
+    scale = 1.0507009873554804934193349852946
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, x.shape)
+    a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+    b = -a * alpha_p * p
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+def embedding(x, weight, padding_idx: Optional[int] = None, sparse: bool = False):
+    ids = x.astype(jnp.int32)
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids != padding_idx)[..., None]
+        out = jnp.where(mask, out, 0.0)
+    return out
+
+
+def one_hot(x, num_classes: int):
+    return jax.nn.one_hot(x.astype(jnp.int32), num_classes)
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1.0 - epsilon) * label + epsilon * prior_dist
+    return (1.0 - epsilon) * label + epsilon / n
+
+
+def pad(x, pad, mode: str = "constant", value: float = 0.0, data_format: str = "NCHW"):
+    """paddle.nn.functional.pad: flat pad list is per-spatial-dim, or ndim pairs."""
+    pad = list(pad)
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        cfg = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        n_spatial = len(pad) // 2
+        cfg = [(0, 0)] * nd
+        channel_last = data_format.endswith("C") and nd > 2
+        # paddle flat pads are ordered last-spatial-first? No: [left, right,
+        # top, bottom, front, back] i.e. innermost (W) first.
+        spatial_axes = (
+            list(range(1, 1 + n_spatial)) if channel_last else list(range(2, 2 + n_spatial))
+        )
+        for i, ax in enumerate(reversed(spatial_axes)):
+            cfg[ax] = (pad[2 * i], pad[2 * i + 1])
+    jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    if jmode == "constant":
+        return jnp.pad(x, cfg, mode="constant", constant_values=value)
+    return jnp.pad(x, cfg, mode=jmode)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col (reference: operators/math/im2col) for NCHW input."""
+    from .conv import _normalize_tuple
+
+    k = _normalize_tuple(kernel_sizes, 2, "kernel_sizes")
+    s = _normalize_tuple(strides, 2, "strides")
+    d = _normalize_tuple(dilations, 2, "dilations")
+    if isinstance(paddings, int):
+        p = [(paddings, paddings)] * 2
+    else:
+        p = [(paddings[0], paddings[0]), (paddings[1], paddings[1])] if len(paddings) == 2 else [
+            (paddings[0], paddings[2]), (paddings[1], paddings[3])
+        ]
+    n, c, h, w = x.shape
+    xp = jnp.pad(x, [(0, 0), (0, 0), p[0], p[1]])
+    oh = (xp.shape[2] - (d[0] * (k[0] - 1) + 1)) // s[0] + 1
+    ow = (xp.shape[3] - (d[1] * (k[1] - 1) + 1)) // s[1] + 1
+    patches = []
+    for i in range(k[0]):
+        for j in range(k[1]):
+            patch = xp[:, :, i * d[0] : i * d[0] + oh * s[0] : s[0], j * d[1] : j * d[1] + ow * s[1] : s[1]]
+            patches.append(patch)
+    stacked = jnp.stack(patches, axis=2)  # [N, C, K*K, OH, OW]
+    return stacked.reshape(n, c * k[0] * k[1], oh * ow)
+
+
+def interpolate(
+    x,
+    size=None,
+    scale_factor=None,
+    mode: str = "nearest",
+    align_corners: bool = False,
+    align_mode: int = 0,
+    data_format: str = "NCHW",
+):
+    channel_last = data_format.endswith("C") and x.ndim > 2
+    n_spatial = x.ndim - 2
+    if size is None:
+        if scale_factor is None:
+            raise InvalidArgumentError("one of size/scale_factor is required")
+        factors = (scale_factor,) * n_spatial if isinstance(scale_factor, (int, float)) else tuple(scale_factor)
+        spatial = x.shape[1:-1] if channel_last else x.shape[2:]
+        size = tuple(int(s * f) for s, f in zip(spatial, factors))
+    else:
+        size = (size,) * n_spatial if isinstance(size, int) else tuple(int(v) for v in size)
+    if channel_last:
+        out_shape = (x.shape[0],) + size + (x.shape[-1],)
+    else:
+        out_shape = (x.shape[0], x.shape[1]) + size
+    method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    return jax.image.resize(x, out_shape, method=method)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
+    return interpolate(x, size, scale_factor, mode, align_corners, data_format=data_format)
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = x.transpose(0, 1, 4, 2, 5, 3)
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+def bilinear(x1, x2, weight, bias=None):
+    # weight: [out, in1, in2]
+    out = jnp.einsum("bi,oij,bj->bo", x1, weight, x2)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def scaled_dot_product_attention(
+    query, key, value, attn_mask=None, dropout_p: float = 0.0, is_causal: bool = False, training: bool = True
+):
+    """Batched attention: [B, H, L, D] layout. Fused by XLA; the pallas flash
+    kernel (paddle_tpu.ops.flash_attention) is used by MultiHeadAttention when
+    shapes allow."""
+    d = query.shape[-1]
+    scores = jnp.einsum("...qd,...kd->...qk", query, key) / jnp.sqrt(d).astype(query.dtype)
+    if is_causal:
+        q_len, k_len = scores.shape[-2], scores.shape[-1]
+        causal = jnp.tril(jnp.ones((q_len, k_len), dtype=bool))
+        scores = jnp.where(causal, scores, jnp.finfo(scores.dtype).min)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, jnp.finfo(scores.dtype).min)
+        else:
+            scores = scores + attn_mask
+    weights = jax.nn.softmax(scores, axis=-1)
+    if dropout_p > 0.0 and training:
+        weights = dropout(weights, dropout_p, training=training)
+    return jnp.einsum("...qk,...kd->...qd", weights, value)
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="int64"):
+    if maxlen is None:
+        maxlen = int(jnp.max(lengths))
+    row = jnp.arange(maxlen)
+    mask = row[None, :] < lengths[..., None]
+    return mask.astype(convert_dtype(dtype))
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25, data_format: str = "NCHW"):
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    out = jnp.zeros_like(x)
+    out = out.at[:, :-1, :fold].set(x[:, 1:, :fold])
+    out = out.at[:, 1:, fold : 2 * fold].set(x[:, :-1, fold : 2 * fold])
+    out = out.at[:, :, 2 * fold :].set(x[:, :, 2 * fold :])
+    return out.reshape(nt, c, h, w)

@@ -1,0 +1,152 @@
+"""Convolution functionals (reference: python/paddle/nn/functional/conv.py,
+kernels paddle/fluid/operators/conv_op.cc:790-816 / conv_cudnn_op.cu).
+
+TPU-native: all convs lower to ``lax.conv_general_dilated``, which XLA tiles
+onto the MXU; there is no algo-search cache to manage (the XLA autotuner
+replaces framework/conv_search_cache.h).
+"""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.errors import InvalidArgumentError
+
+
+def _normalize_tuple(v, n, name):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    if len(v) != n:
+        raise InvalidArgumentError("%s must have %d elements, got %r" % (name, n, v))
+    return v
+
+
+def _normalize_padding(padding, n):
+    """paddle padding: int, pair-list, 'SAME'/'VALID', or per-dim pair list."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == n and all(isinstance(p, (list, tuple)) for p in padding):
+        return [tuple(p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    raise InvalidArgumentError("unsupported padding %r" % (padding,))
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, n, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    if channel_last:
+        lhs_spec = "N" + "DHW"[3 - n :] + "C" if n == 3 else ("NHWC" if n == 2 else "NWC")
+    else:
+        lhs_spec = "NC" + ("DHW"[3 - n :] if n == 3 else ("HW" if n == 2 else "W"))
+    spatial = "DHW"[3 - n :] if n == 3 else ("HW" if n == 2 else "W")
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape, (lhs_spec, rhs_spec, out_spec))
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=_normalize_tuple(stride, n, "stride"),
+        padding=_normalize_padding(padding, n),
+        rhs_dilation=_normalize_tuple(dilation, n, "dilation"),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (out.ndim - 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL"):
+    fmt = "NLC" if data_format == "NLC" else "NCL"
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1, fmt)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2, data_format)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW"):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3, data_format)
+
+
+def _conv_transpose_nd(
+    x, weight, bias, stride, padding, output_padding, dilation, groups, n, data_format
+):
+    if groups != 1:
+        raise InvalidArgumentError("conv_transpose with groups>1 is not supported yet")
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[3 - n :] if n == 3 else ("HW" if n == 2 else "W")
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: [in, out, *k] == IO + spatial
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape, (lhs_spec, "IO" + spatial, lhs_spec)
+    )
+    strides = _normalize_tuple(stride, n, "stride")
+    pads = _normalize_padding(padding, n)
+    if isinstance(pads, str):
+        pad_arg = pads
+    else:
+        # convert forward-conv padding semantics to conv_transpose padding
+        k = weight.shape[2:]
+        dil = _normalize_tuple(dilation, n, "dilation")
+        pad_arg = [
+            (dil[i] * (k[i] - 1) - pads[i][0], dil[i] * (k[i] - 1) - pads[i][1])
+            for i in range(n)
+        ]
+    # transpose-conv == lhs-dilated conv with the kernel spatially flipped and
+    # its I/O axes swapped (the IO rhs_spec above does the swap)
+    flipped = jnp.flip(weight, axis=tuple(range(2, 2 + n)))
+    out = lax.conv_general_dilated(
+        x,
+        flipped,
+        window_strides=(1,) * n,
+        padding=pad_arg,
+        lhs_dilation=strides,
+        rhs_dilation=_normalize_tuple(dilation, n, "dilation"),
+        dimension_numbers=dn,
+    )
+    if output_padding:
+        op = _normalize_tuple(output_padding, n, "output_padding")
+        pad_cfg = [(0, 0)] * out.ndim
+        for i in range(n):
+            ax = (i + 1) if channel_last else (i + 2)
+            pad_cfg[ax] = (0, op[i])
+        out = jnp.pad(out, pad_cfg)
+    if bias is not None:
+        if channel_last:
+            out = out + bias.reshape((1,) * (out.ndim - 1) + (-1,))
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
+    return out
+
+
+def conv1d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCL"
+):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 1, data_format)
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCHW"
+):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCDHW"
+):
+    return _conv_transpose_nd(x, weight, bias, stride, padding, output_padding, dilation, groups, 3, data_format)

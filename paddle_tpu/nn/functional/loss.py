@@ -1,0 +1,192 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py; fused
+kernel parity: softmax_with_cross_entropy_op.cc:325 — the log-softmax + gather
+composition here is a single XLA fusion on TPU, which is exactly what the
+reference's fused CUDA kernel hand-writes).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.errors import InvalidArgumentError
+
+
+def _reduce(loss, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "none":
+        return loss
+    raise InvalidArgumentError("reduction must be mean|sum|none, got %r" % reduction)
+
+
+def log_loss(input, label, epsilon: float = 1e-4):
+    return -label * jnp.log(input + epsilon) - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index: int = -100,
+    reduction: str = "mean",
+    soft_label: bool = False,
+    axis: int = -1,
+    use_softmax: bool = True,
+    label_smoothing: float = 0.0,
+):
+    """softmax_with_cross_entropy fused semantics.
+
+    ``input``: logits (or probabilities when use_softmax=False); ``label``:
+    int class ids (or soft distributions when soft_label=True).
+    """
+    if use_softmax:
+        logp = jax.nn.log_softmax(input, axis=axis)
+    else:
+        logp = jnp.log(jnp.clip(input, 1e-10, 1.0))
+    if soft_label or (label.ndim == input.ndim and label.shape == input.shape):
+        soft = label
+        if label_smoothing > 0.0:
+            n = input.shape[axis]
+            soft = soft * (1.0 - label_smoothing) + label_smoothing / n
+        loss = -jnp.sum(soft * logp, axis=axis)
+        valid = None
+    else:
+        lbl = label
+        if lbl.ndim == input.ndim and lbl.shape[axis] == 1:
+            lbl = jnp.squeeze(lbl, axis=axis)
+        lbl = lbl.astype(jnp.int32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, axis), axis=axis)
+        loss = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0.0:
+            n = input.shape[axis]
+            smooth_loss = -jnp.mean(logp, axis=axis)
+            loss = (1.0 - label_smoothing) * loss + label_smoothing * smooth_loss
+        if weight is not None:
+            w = weight[safe]
+            loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            if weight is not None:
+                denom = jnp.sum(jnp.where(valid, weight[safe], 0.0))
+            else:
+                denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+            return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(
+    logits, label, soft_label: bool = False, ignore_index: int = -100,
+    numeric_stable_mode: bool = True, return_softmax: bool = False, axis: int = -1
+):
+    loss = cross_entropy(
+        logits, label, soft_label=soft_label, ignore_index=ignore_index,
+        reduction="none", axis=axis,
+    )
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, jax.nn.softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index: int = -100, reduction: str = "mean"):
+    # input: log-probabilities [N, C, ...]
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, jnp.expand_dims(safe, 1), axis=1)
+    loss = -jnp.squeeze(picked, axis=1)
+    w = None
+    if weight is not None:
+        w = weight[safe]
+        loss = loss * w
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "mean":
+        denom = jnp.sum(w * valid if w is not None else valid.astype(loss.dtype))
+        return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+    return _reduce(loss, reduction)
+
+
+def mse_loss(input, label, reduction: str = "mean"):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+def l1_loss(input, label, reduction: str = "mean"):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def bce_loss(input, label, weight=None, reduction: str = "mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(input + eps) + (1 - label) * jnp.log(1 - input + eps))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction: str = "mean"):
+    return bce_loss(input, label, weight, reduction)
+
+
+def binary_cross_entropy_with_logits(
+    input, label, weight=None, reduction: str = "mean", pos_weight=None
+):
+    if pos_weight is None:
+        # numerically stable: max(x,0) - x*z + log(1 + exp(-|x|))
+        loss = jnp.maximum(input, 0) - input * label + jnp.log1p(jnp.exp(-jnp.abs(input)))
+    else:
+        loss = -(pos_weight * label * jax.nn.log_sigmoid(input)
+                 + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction: str = "mean"):
+    # input: log-probs; label: probs (paddle semantics)
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0, reduction: str = "mean"):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0, reduction: str = "mean"):
+    loss = jnp.where(label == 1.0, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25, gamma: float = 2.0, reduction: str = "sum"):
+    p = jax.nn.sigmoid(logit)
+    ce = binary_cross_entropy_with_logits(logit, label, reduction="none")
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * jnp.power(1 - p_t, gamma) * ce
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)

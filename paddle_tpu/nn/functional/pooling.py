@@ -1,0 +1,141 @@
+"""Pooling functionals (reference: python/paddle/nn/functional/pooling.py,
+paddle/fluid/operators/pool_op.*) — lowered to ``lax.reduce_window``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.errors import InvalidArgumentError
+from .conv import _normalize_padding, _normalize_tuple
+
+
+def _pool(x, kernel_size, stride, padding, n, init_val, reduce_fn, data_format, ceil_mode=False):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    k = _normalize_tuple(kernel_size, n, "kernel_size")
+    s = _normalize_tuple(stride if stride is not None else kernel_size, n, "stride")
+    p = _normalize_padding(padding, n)
+    if isinstance(p, str):
+        pads = p
+    else:
+        pads = list(p)
+        if ceil_mode:
+            new_pads = []
+            for i in range(n):
+                ax = (i + 1) if channel_last else (i + 2)
+                size = x.shape[ax] + pads[i][0] + pads[i][1]
+                rem = (size - k[i]) % s[i]
+                extra = (s[i] - rem) % s[i] if size >= k[i] else 0
+                new_pads.append((pads[i][0], pads[i][1] + extra))
+            pads = new_pads
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pad_cfg = "SAME" if pads == "SAME" else ("VALID" if pads == "VALID" else [(0, 0)] + list(pads) + [(0, 0)])
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pad_cfg = "SAME" if pads == "SAME" else ("VALID" if pads == "VALID" else [(0, 0), (0, 0)] + list(pads))
+    return lax.reduce_window(x, init_val, reduce_fn, window, strides, pad_cfg), k, pads
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL"):
+    out, _, _ = _pool(x, kernel_size, stride, padding, 1, -jnp.inf, lax.max, data_format, ceil_mode)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW"):
+    out, _, _ = _pool(x, kernel_size, stride, padding, 2, -jnp.inf, lax.max, data_format, ceil_mode)
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW"):
+    out, _, _ = _pool(x, kernel_size, stride, padding, 3, -jnp.inf, lax.max, data_format, ceil_mode)
+    return out
+
+
+def _avg_pool(x, kernel_size, stride, padding, n, ceil_mode, exclusive, data_format):
+    summed, k, pads = _pool(x, kernel_size, stride, padding, n, 0.0, lax.add, data_format, ceil_mode)
+    if exclusive and not isinstance(pads, str) and any(p != (0, 0) for p in pads):
+        ones = jnp.ones_like(x)
+        counts, _, _ = _pool(ones, kernel_size, stride, padding, n, 0.0, lax.add, data_format, ceil_mode)
+        return summed / counts
+    return summed / float(np.prod(k))
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, data_format="NCL"):
+    return _avg_pool(x, kernel_size, stride, padding, 1, ceil_mode, exclusive, data_format)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW"):
+    if divisor_override is not None:
+        summed, k, _ = _pool(x, kernel_size, stride, padding, 2, 0.0, lax.add, data_format, ceil_mode)
+        return summed / float(divisor_override)
+    return _avg_pool(x, kernel_size, stride, padding, 2, ceil_mode, exclusive, data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW"):
+    return _avg_pool(x, kernel_size, stride, padding, 3, ceil_mode, exclusive, data_format)
+
+
+def _adaptive_bins(in_size: int, out_size: int):
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def _adaptive_pool_nd(x, output_size, n, mode, data_format):
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    out_sizes = _normalize_tuple(output_size, n, "output_size")
+    spatial_axes = tuple(range(1, 1 + n)) if channel_last else tuple(range(2, 2 + n))
+    # Fast path: evenly divisible -> reshape+reduce (XLA-friendly, static)
+    if all(x.shape[ax] % o == 0 for ax, o in zip(spatial_axes, out_sizes)):
+        y = x
+        for idx, (ax, o) in enumerate(zip(spatial_axes, out_sizes)):
+            ax_shifted = ax + idx  # account for previously inserted axes
+            size = y.shape[ax_shifted]
+            new_shape = y.shape[:ax_shifted] + (o, size // o) + y.shape[ax_shifted + 1 :]
+            y = jnp.reshape(y, new_shape)
+        red_axes = tuple(ax + idx + 1 for idx, ax in enumerate(spatial_axes))
+        if mode == "avg":
+            return jnp.mean(y, axis=red_axes)
+        return jnp.max(y, axis=red_axes)
+    # General path: static python loop over output bins (shapes are static)
+    y = x
+    for idx, (ax, o) in enumerate(zip(spatial_axes, out_sizes)):
+        starts, ends = _adaptive_bins(y.shape[ax], o)
+        slices = []
+        for s, e in zip(starts, ends):
+            sl = [slice(None)] * y.ndim
+            sl[ax] = slice(s, e)
+            seg = y[tuple(sl)]
+            seg = jnp.mean(seg, axis=ax, keepdims=True) if mode == "avg" else jnp.max(seg, axis=ax, keepdims=True)
+            slices.append(seg)
+        y = jnp.concatenate(slices, axis=ax)
+    return y
+
+
+def adaptive_avg_pool1d(x, output_size, data_format="NCL"):
+    return _adaptive_pool_nd(x, output_size, 1, "avg", data_format)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    return _adaptive_pool_nd(x, output_size, 2, "avg", data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    return _adaptive_pool_nd(x, output_size, 3, "avg", data_format)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    return _adaptive_pool_nd(x, output_size, 1, "max", "NCL")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False):
+    return _adaptive_pool_nd(x, output_size, 2, "max", "NCHW")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    return _adaptive_pool_nd(x, output_size, 3, "max", "NCDHW")

@@ -1,0 +1,177 @@
+"""Weight initializers (reference: python/paddle/nn/initializer/ +
+python/paddle/fluid/initializer.py).
+
+Each initializer is a callable ``(shape, dtype) -> jax.Array`` drawing from the
+framework PRNG policy (core/random.py) — the TPU-native analog of the
+reference's init ops writing into startup-program variables.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dtype import convert_dtype, get_default_dtype
+from ...core.random import next_key
+
+
+def _fan_in_out(shape: Sequence[int]):
+    shape = tuple(shape)
+    if len(shape) < 2:
+        fan_in = fan_out = int(shape[0]) if shape else 1
+    else:
+        receptive = int(np.prod(shape[2:])) if len(shape) > 2 else 1
+        # paddle weight layouts: Linear [in, out]; Conv [out, in, *k]
+        if len(shape) == 2:
+            fan_in, fan_out = shape[0], shape[1]
+        else:
+            fan_in = shape[1] * receptive
+            fan_out = shape[0] * receptive
+    return fan_in, fan_out
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        return jnp.full(shape, self.value, dtype=convert_dtype(dtype) or get_default_dtype())
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return self.mean + self.std * jax.random.normal(next_key(), shape, dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return self.mean + self.std * jax.random.truncated_normal(next_key(), -2.0, 2.0, shape, dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return jax.random.uniform(next_key(), shape, dtype, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, fan_out: Optional[float] = None):
+        self._fan_in, self._fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(next_key(), shape, dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, fan_out: Optional[float] = None):
+        self._fan_in, self._fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        fi, fo = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(next_key(), shape, dtype, -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in: Optional[float] = None, negative_slope: float = 0.0, nonlinearity: str = "relu"):
+        self._fan_in = fan_in
+        self.negative_slope = negative_slope
+        self.nonlinearity = nonlinearity
+
+    def _gain(self):
+        if self.nonlinearity == "leaky_relu":
+            return math.sqrt(2.0 / (1 + self.negative_slope**2))
+        return math.sqrt(2.0)
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        std = self._gain() / math.sqrt(fi)
+        return std * jax.random.normal(next_key(), shape, dtype)
+
+
+class KaimingUniform(KaimingNormal):
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        fi, _ = _fan_in_out(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        limit = self._gain() * math.sqrt(3.0 / fi)
+        return jax.random.uniform(next_key(), shape, dtype, -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        arr = jnp.asarray(np.asarray(self.value), dtype=dtype)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError("Assign initializer shape mismatch: %s vs %s" % (arr.shape, shape))
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return self.gain * jax.nn.initializers.orthogonal()(next_key(), shape, dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        w = np.zeros(shape, dtype=np.float32)
+        out_c, in_c = shape[0], shape[1]
+        spatial_center = tuple(s // 2 for s in shape[2:])
+        for i in range(min(out_c, in_c * self.groups)):
+            w[(i, i % in_c) + spatial_center] = 1.0
+        return jnp.asarray(w, dtype=dtype)
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    if nonlinearity in ("sigmoid", "conv1d", "conv2d", "conv3d", "linear"):
+        return 1.0
+    if nonlinearity == "tanh":
+        return 5.0 / 3.0
+    if nonlinearity == "relu":
+        return math.sqrt(2.0)
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a**2))
+    if nonlinearity == "selu":
+        return 3.0 / 4.0
+    raise ValueError("unknown nonlinearity %s" % nonlinearity)

@@ -1,0 +1,383 @@
+"""Transformer layers (reference: python/paddle/nn/layer/transformer.py:
+MultiHeadAttention:109, TransformerEncoderLayer:398, TransformerEncoder:622,
+TransformerDecoderLayer:721, TransformerDecoder:940, Transformer:1112).
+
+TPU-native: attention is a single fused einsum chain
+(``F.scaled_dot_product_attention``), batched [B, H, L, D] for the MXU; masks
+are additive bf16-safe; cache objects are plain tuples for lax.scan-friendly
+incremental decoding.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Optional
+
+import numpy as np
+
+from ...core.errors import InvalidArgumentError
+from .. import functional as F
+from .. import initializer as I
+from .common import Dropout, Linear
+from .layers import Layer
+from .norm import LayerNorm
+
+
+def _convert_attn_mask(mask, dtype):
+    """bool mask (True=keep) -> additive; numeric passes through."""
+    from ... import tensor as T
+
+    if mask is None:
+        return None
+    if mask.dtype == np.bool_ or str(mask.dtype) == "bool":
+        return T.scale(T.cast(T.logical_not(mask), dtype), -1e9)
+    return T.cast(mask, dtype)
+
+
+class MultiHeadAttention(Layer):
+    """paddle.nn.MultiHeadAttention parity (transformer.py:109)."""
+
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
+    def __init__(
+        self,
+        embed_dim: int,
+        num_heads: int,
+        dropout: float = 0.0,
+        kdim: Optional[int] = None,
+        vdim: Optional[int] = None,
+        need_weights: bool = False,
+        weight_attr=None,
+        bias_attr=None,
+    ):
+        super().__init__()
+        self.embed_dim = embed_dim
+        self.kdim = kdim or embed_dim
+        self.vdim = vdim or embed_dim
+        self.num_heads = num_heads
+        self.dropout = dropout
+        self.need_weights = need_weights
+        self.head_dim = embed_dim // num_heads
+        if self.head_dim * num_heads != embed_dim:
+            raise InvalidArgumentError("embed_dim %d not divisible by num_heads %d" % (embed_dim, num_heads))
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def _split_heads(self, x):
+        from ... import tensor as T
+
+        b, l = x.shape[0], x.shape[1]
+        x = T.reshape(x, [b, l, self.num_heads, self.head_dim])
+        return T.transpose(x, [0, 2, 1, 3])  # [B, H, L, D]
+
+    def _merge_heads(self, x):
+        from ... import tensor as T
+
+        b, h, l, d = x.shape
+        return T.reshape(T.transpose(x, [0, 2, 1, 3]), [b, l, h * d])
+
+    def gen_cache(self, key, value=None, type=None):
+        from ... import tensor as T
+
+        if type == MultiHeadAttention.StaticCache:
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(value if value is not None else key))
+            return self.StaticCache(k, v)
+        if value is None:
+            # incremental cache seeded empty: shapes [B, H, 0, D]
+            b = key.shape[0]
+            k = T.zeros([b, self.num_heads, 0, self.head_dim])
+            v = T.zeros([b, self.num_heads, 0, self.head_dim])
+            return self.Cache(k, v)
+        return self.Cache(key, value)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        from ... import tensor as T
+
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split_heads(self.q_proj(query))
+        if isinstance(cache, self.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k = self._split_heads(self.k_proj(key))
+            v = self._split_heads(self.v_proj(value))
+            if isinstance(cache, self.Cache):
+                k = T.concat([cache.k, k], axis=2)
+                v = T.concat([cache.v, v], axis=2)
+                cache = self.Cache(k, v)
+
+        mask = _convert_attn_mask(attn_mask, q.dtype)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, dropout_p=self.dropout, training=self.training
+        )
+        out = self.out_proj(self._merge_heads(out))
+        if isinstance(cache, self.Cache):
+            return (out, cache) if not self.need_weights else (out, None, cache)
+        if self.need_weights:
+            return out, None
+        return out
+
+
+class TransformerEncoderLayer(Layer):
+    """transformer.py:398 parity; post-norm by default (normalize_before=False)."""
+
+    def __init__(
+        self,
+        d_model: int,
+        nhead: int,
+        dim_feedforward: int,
+        dropout: float = 0.1,
+        activation: str = "relu",
+        attn_dropout: Optional[float] = None,
+        act_dropout: Optional[float] = None,
+        normalize_before: bool = False,
+        weight_attr=None,
+        bias_attr=None,
+    ):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout, weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.dropout = Dropout(act_dropout, mode="upscale_in_train")
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout2 = Dropout(dropout, mode="upscale_in_train")
+        self.activation = activation
+
+    def _act(self, x):
+        return getattr(F, self.activation)(x)
+
+    def forward(self, src, src_mask=None, cache=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        if cache is None:
+            src = self.self_attn(src, src, src, src_mask)
+        else:
+            src, cache = self.self_attn(src, src, src, src_mask, cache)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.dropout(self._act(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src if cache is None else (src, cache)
+
+    def gen_cache(self, src):
+        return self.self_attn.gen_cache(src)
+
+
+class TransformerEncoder(Layer):
+    """transformer.py:622 parity."""
+
+    def __init__(self, encoder_layer, num_layers: int, norm=None):
+        super().__init__()
+        from .container import LayerList
+
+        self.layers = LayerList([encoder_layer] + [
+            type(encoder_layer)(**_clone_args(encoder_layer)) for _ in range(num_layers - 1)
+        ])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, src, src_mask=None, cache=None):
+        output = src
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, src_mask)
+            else:
+                output, new_cache = mod(output, src_mask, cache[i])
+                new_caches.append(new_cache)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, src):
+        return [layer.gen_cache(src) for layer in self.layers]
+
+
+class TransformerDecoderLayer(Layer):
+    """transformer.py:721 parity."""
+
+    def __init__(
+        self,
+        d_model: int,
+        nhead: int,
+        dim_feedforward: int,
+        dropout: float = 0.1,
+        activation: str = "relu",
+        attn_dropout: Optional[float] = None,
+        act_dropout: Optional[float] = None,
+        normalize_before: bool = False,
+        weight_attr=None,
+        bias_attr=None,
+    ):
+        super().__init__()
+        attn_dropout = dropout if attn_dropout is None else attn_dropout
+        act_dropout = dropout if act_dropout is None else act_dropout
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(d_model, nhead, attn_dropout, weight_attr=weight_attr, bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, attn_dropout, weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.dropout = Dropout(act_dropout, mode="upscale_in_train")
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout2 = Dropout(dropout, mode="upscale_in_train")
+        self.dropout3 = Dropout(dropout, mode="upscale_in_train")
+        self.activation = activation
+
+    def _act(self, x):
+        return getattr(F, self.activation)(x)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is None:
+            tgt = self.self_attn(tgt, tgt, tgt, tgt_mask)
+            incr_cache = None
+        else:
+            tgt, incr_cache = self.self_attn(tgt, tgt, tgt, tgt_mask, cache[0])
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        if cache is None:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask)
+        else:
+            tgt = self.cross_attn(tgt, memory, memory, memory_mask, cache[1])
+            if isinstance(tgt, tuple):
+                tgt = tgt[0]
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.dropout(self._act(self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        return tgt if cache is None else (tgt, (incr_cache, cache[1]))
+
+    def gen_cache(self, memory):
+        incr = self.self_attn.gen_cache(memory)
+        static = self.cross_attn.gen_cache(memory, memory, type=MultiHeadAttention.StaticCache)
+        return incr, static
+
+
+class TransformerDecoder(Layer):
+    """transformer.py:940 parity."""
+
+    def __init__(self, decoder_layer, num_layers: int, norm=None):
+        super().__init__()
+        from .container import LayerList
+
+        self.layers = LayerList([decoder_layer] + [
+            type(decoder_layer)(**_clone_args(decoder_layer)) for _ in range(num_layers - 1)
+        ])
+        self.num_layers = num_layers
+        self.norm = norm
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None, cache=None):
+        output = tgt
+        new_caches = []
+        for i, mod in enumerate(self.layers):
+            if cache is None:
+                output = mod(output, memory, tgt_mask, memory_mask)
+            else:
+                output, new_cache = mod(output, memory, tgt_mask, memory_mask, cache[i])
+                new_caches.append(new_cache)
+        if self.norm is not None:
+            output = self.norm(output)
+        return output if cache is None else (output, new_caches)
+
+    def gen_cache(self, memory, do_zip: bool = False):
+        cache = [layer.gen_cache(memory) for layer in self.layers]
+        if do_zip:
+            cache = list(zip(*cache))
+        return cache
+
+
+def _clone_args(layer):
+    """Rebuild constructor kwargs from a prototype encoder/decoder layer."""
+    return dict(
+        d_model=layer.norm1._normalized_shape[0],
+        nhead=layer.self_attn.num_heads,
+        dim_feedforward=layer.linear1.out_features,
+        dropout=layer.dropout1.p,
+        activation=layer.activation,
+        attn_dropout=layer.self_attn.dropout,
+        act_dropout=layer.dropout.p,
+        normalize_before=layer.normalize_before,
+    )
+
+
+class Transformer(Layer):
+    """transformer.py:1112 parity."""
+
+    def __init__(
+        self,
+        d_model: int = 512,
+        nhead: int = 8,
+        num_encoder_layers: int = 6,
+        num_decoder_layers: int = 6,
+        dim_feedforward: int = 2048,
+        dropout: float = 0.1,
+        activation: str = "relu",
+        attn_dropout=None,
+        act_dropout=None,
+        normalize_before: bool = False,
+        weight_attr=None,
+        bias_attr=None,
+        custom_encoder=None,
+        custom_decoder=None,
+    ):
+        super().__init__()
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            enc_layer = TransformerEncoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr, bias_attr,
+            )
+            enc_norm = LayerNorm(d_model) if normalize_before else None
+            self.encoder = TransformerEncoder(enc_layer, num_encoder_layers, enc_norm)
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            dec_layer = TransformerDecoderLayer(
+                d_model, nhead, dim_feedforward, dropout, activation,
+                attn_dropout, act_dropout, normalize_before, weight_attr, bias_attr,
+            )
+            dec_norm = LayerNorm(d_model) if normalize_before else None
+            self.decoder = TransformerDecoder(dec_layer, num_decoder_layers, dec_norm)
+        self.d_model = d_model
+        self.nhead = nhead
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None, memory_mask=None):
+        memory = self.encoder(src, src_mask)
+        return self.decoder(tgt, memory, tgt_mask, memory_mask)
+
+    def generate_square_subsequent_mask(self, length: int):
+        from ... import tensor as T
+
+        full = T.full([length, length], -1e9, dtype="float32")
+        return T.triu(full, diagonal=1)

@@ -1,0 +1,456 @@
+"""``paddle_tpu.optimizer`` — optimizers.
+
+Reference parity: ``python/paddle/optimizer/`` (Adam/AdamW/Momentum/Lamb/...)
+and the C++ update kernels ``paddle/fluid/operators/optimizers/*`` (adam_op.cc
+multi-precision master weights, momentum_op, lamb_op, lars_momentum_op).
+
+Design: every optimizer implements a **pure** per-parameter update
+``_apply_one(val, grad, state, lr, p) -> (new_val, new_state)`` over raw
+arrays.  ``step()`` runs it eagerly from ``p.grad``; the jitted train-step
+path (paddle_tpu.jit.TrainStep) traces the very same function, so eager and
+compiled training share one update rule — the TPU-native answer to the
+reference's per-device optimizer kernels.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..framework.tensor import Parameter, Tensor
+from ..regularizer import L1Decay, L2Decay, WeightDecayRegularizer
+from . import lr as lr_sched
+from .lr import LRScheduler
+
+__all__ = [
+    "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
+    "Adamax", "RMSProp", "Lamb", "Lars", "lr",
+]
+
+lr = lr_sched
+
+
+class Optimizer:
+    """Base optimizer (python/paddle/optimizer/optimizer.py parity)."""
+
+    def __init__(
+        self,
+        learning_rate=0.001,
+        parameters: Optional[Sequence[Parameter]] = None,
+        weight_decay=None,
+        grad_clip=None,
+        multi_precision: bool = False,
+        name: Optional[str] = None,
+    ):
+        if parameters is not None:
+            parameters = list(parameters)
+            for p in parameters:
+                if not isinstance(p, Tensor):
+                    raise InvalidArgumentError(
+                        "optimizer parameters must be Tensors, got %r" % type(p)
+                    )
+        self._parameter_list = parameters
+        self._learning_rate = learning_rate
+        if isinstance(weight_decay, float):
+            weight_decay = L2Decay(weight_decay)
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._states: Dict[str, dict] = {}
+        self._name = name or type(self).__name__
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float) -> None:
+        if isinstance(self._learning_rate, LRScheduler):
+            raise InvalidArgumentError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+    # -- state ------------------------------------------------------------
+    def _state_for(self, p: Parameter) -> dict:
+        key = p.name
+        if key not in self._states:
+            self._states[key] = self._init_state(p)
+        return self._states[key]
+
+    def _init_state(self, p: Parameter) -> dict:
+        state: dict = {}
+        if self._multi_precision and p.value.dtype != jnp.float32:
+            state["master_weight"] = p.value.astype(jnp.float32)
+        return state
+
+    def _master(self, val, state):
+        return state.get("master_weight", val)
+
+    def _finish(self, new_master, val_dtype, state):
+        """Write back master weight; return the model-dtype value."""
+        if "master_weight" in state:
+            state = dict(state, master_weight=new_master)
+            return new_master.astype(val_dtype), state
+        return new_master, state
+
+    # -- the update -------------------------------------------------------
+    def _apply_one(self, val, grad, state, lr, p):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _regularized(self, p, val, grad):
+        reg = p.regularizer if getattr(p, "regularizer", None) is not None else self._weight_decay
+        if isinstance(reg, WeightDecayRegularizer):
+            return reg(val.astype(grad.dtype), grad)
+        return grad
+
+    @property
+    def _decoupled_decay(self) -> bool:
+        return False  # AdamW overrides
+
+    def step(self) -> None:
+        if self._parameter_list is None:
+            raise InvalidArgumentError(
+                "this optimizer was constructed without a parameters list; "
+                "pass parameters=model.parameters()"
+            )
+        params_grads = [
+            (p, p._grad_val)
+            for p in self._parameter_list
+            if not p.stop_gradient and p._grad_val is not None
+        ]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        lr_val = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            state = self._state_for(p)
+            if not self._decoupled_decay:
+                g = self._regularized(p, p.value, g)
+            plr = lr_val * p.optimize_attr.get("learning_rate", 1.0)
+            new_val, new_state = self._apply_one(p.value, g, state, plr, p)
+            self._states[p.name] = new_state
+            p._replace_value(new_val)
+
+    def clear_grad(self, set_to_zero: bool = False) -> None:
+        if self._parameter_list is None:
+            return
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        """Dygraph minimize: backward + step (fleet_base.py:1288 single-proc)."""
+        if loss._node is not None:
+            loss.backward()
+        self.step()
+        return None, None
+
+    # -- checkpoint -------------------------------------------------------
+    def state_dict(self) -> dict:
+        sd: dict = {}
+        for pname, state in self._states.items():
+            for k, v in state.items():
+                sd["%s__%s" % (pname, k)] = Tensor(v)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict: dict) -> None:
+        sched = state_dict.get("LR_Scheduler")
+        if sched is not None and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(dict(sched))
+        for key, v in state_dict.items():
+            if key == "LR_Scheduler":
+                continue
+            if "__" not in key:
+                continue
+            pname, slot = key.rsplit("__", 1)
+            val = v.value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+            self._states.setdefault(pname, {})[slot] = val
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None, grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+
+    def _apply_one(self, val, grad, state, lr, p):
+        m = self._master(val, state)
+        new = m - lr * grad.astype(m.dtype)
+        return self._finish(new, val.dtype, state)
+
+
+class Momentum(Optimizer):
+    """operators/optimizers/momentum_op semantics incl. use_nesterov."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        m = state.get("master_weight", p.value)
+        state["velocity"] = jnp.zeros_like(m)
+        return state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        m = self._master(val, state)
+        g = grad.astype(m.dtype)
+        v = self._momentum * state["velocity"] + g
+        if self._use_nesterov:
+            new = m - lr * (g + self._momentum * v)
+        else:
+            new = m - lr * v
+        new_val, state = self._finish(new, val.dtype, state)
+        state = dict(state, velocity=v)
+        return new_val, state
+
+
+class Adam(Optimizer):
+    """operators/optimizers/adam_op.cc:234 semantics (bias-corrected, optional
+    multi-precision master weights)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        m = state.get("master_weight", p.value)
+        state["moment1"] = jnp.zeros_like(m, dtype=jnp.float32)
+        state["moment2"] = jnp.zeros_like(m, dtype=jnp.float32)
+        state["beta1_pow"] = jnp.asarray(1.0, jnp.float32)
+        state["beta2_pow"] = jnp.asarray(1.0, jnp.float32)
+        return state
+
+    def _adam_update(self, m_w, grad, state, lr):
+        g = grad.astype(jnp.float32)
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        mhat = m1 / (1 - b1p)
+        vhat = m2 / (1 - b2p)
+        delta = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        new_state = dict(state, moment1=m1, moment2=m2, beta1_pow=b1p, beta2_pow=b2p)
+        return delta.astype(m_w.dtype), new_state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        m = self._master(val, state)
+        delta, state = self._adam_update(m, grad, state, lr)
+        new = m - delta
+        new_val, state2 = self._finish(new, val.dtype, state)
+        return new_val, state2
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = weight_decay if isinstance(weight_decay, float) else getattr(weight_decay, "coeff", 0.01)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    @property
+    def _decoupled_decay(self):
+        return True
+
+    def _apply_one(self, val, grad, state, lr, p):
+        m = self._master(val, state)
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        delta, state = self._adam_update(m, grad, state, lr)
+        new = m * (1.0 - lr * decay) - delta
+        return self._finish(new, val.dtype, state)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        state["moment"] = jnp.full_like(p.value, self._init_acc, dtype=jnp.float32)
+        return state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        g = grad.astype(jnp.float32)
+        acc = state["moment"] + jnp.square(g)
+        new = val - (lr * g / (jnp.sqrt(acc) + self._epsilon)).astype(val.dtype)
+        return new, dict(state, moment=acc)
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        state["avg_squared_grad"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        state["avg_squared_update"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        return state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        g = grad.astype(jnp.float32)
+        asg = self._rho * state["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        update = -jnp.sqrt((state["avg_squared_update"] + self._epsilon) / (asg + self._epsilon)) * g
+        asu = self._rho * state["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
+        new = val + (lr * update).astype(val.dtype)
+        return new, dict(state, avg_squared_grad=asg, avg_squared_update=asu)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        state["moment"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        state["inf_norm"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        state["beta1_pow"] = jnp.asarray(1.0, jnp.float32)
+        return state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        g = grad.astype(jnp.float32)
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g) + self._epsilon)
+        b1p = state["beta1_pow"] * self._beta1
+        new = val - (lr / (1 - b1p) * m / u).astype(val.dtype)
+        return new, dict(state, moment=m, inf_norm=u, beta1_pow=b1p)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        state["mean_square"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        state["momentum"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        if self._centered:
+            state["mean_grad"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        return state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        g = grad.astype(jnp.float32)
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+            state = dict(state, mean_grad=mg)
+        else:
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        new = val - mom.astype(val.dtype)
+        return new, dict(state, mean_square=ms, momentum=mom)
+
+
+class Lamb(Optimizer):
+    """operators/optimizers/lamb_op semantics (layer-adaptive large batch)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_decay = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        m = state.get("master_weight", p.value)
+        state["moment1"] = jnp.zeros_like(m, dtype=jnp.float32)
+        state["moment2"] = jnp.zeros_like(m, dtype=jnp.float32)
+        state["beta1_pow"] = jnp.asarray(1.0, jnp.float32)
+        state["beta2_pow"] = jnp.asarray(1.0, jnp.float32)
+        return state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        m_w = self._master(val, state).astype(jnp.float32)
+        g = grad.astype(jnp.float32)
+        m1 = self._beta1 * state["moment1"] + (1 - self._beta1) * g
+        m2 = self._beta2 * state["moment2"] + (1 - self._beta2) * jnp.square(g)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        mhat = m1 / (1 - b1p)
+        vhat = m2 / (1 - b2p)
+        decay = self._lamb_decay
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            decay = 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + decay * m_w
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(m_w)))
+        r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        new = m_w - lr * trust * r
+        new_val, state2 = self._finish(new, val.dtype, dict(state, moment1=m1, moment2=m2, beta1_pow=b1p, beta2_pow=b2p))
+        return new_val, state2
+
+
+class Lars(Optimizer):
+    """operators/optimizers/lars_momentum_op semantics."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_decay = lars_weight_decay
+        self._exclude = exclude_from_weight_decay or []
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        m = state.get("master_weight", p.value)
+        state["velocity"] = jnp.zeros_like(m, dtype=jnp.float32)
+        return state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        m_w = self._master(val, state).astype(jnp.float32)
+        g = grad.astype(jnp.float32)
+        decay = self._lars_decay
+        if any(tag in (p.name or "") for tag in self._exclude):
+            decay = 0.0
+        w_norm = jnp.sqrt(jnp.sum(jnp.square(m_w)))
+        g_norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm / (g_norm + decay * w_norm + 1e-12),
+            1.0,
+        )
+        v = self._momentum * state["velocity"] + lr * local_lr * (g + decay * m_w)
+        new = m_w - v
+        new_val, state2 = self._finish(new, val.dtype, dict(state, velocity=v))
+        return new_val, state2

@@ -2,14 +2,22 @@
 
 Multi-"device" SPMD tests run on a virtual 8-device CPU mesh in-process —
 strictly better than the reference's subprocess-localhost harness
-(test_dist_base.py:743), per SURVEY.md §4 note 5.  Env must be set before jax
-initializes its backends, hence module scope here.
+(test_dist_base.py:743), per SURVEY.md §4 note 5.
+
+XLA_FLAGS must be set before jax initializes its backends.  JAX_PLATFORMS is
+forced via jax.config.update because the environment may pre-register a real
+accelerator plugin at interpreter start (sitecustomize), which freezes the
+env-var snapshot before conftest runs.
 """
 import os
 
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
