@@ -47,6 +47,7 @@ from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
+from . import jit  # noqa: F401
 from .framework.tensor import Parameter  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .version import __version__  # noqa: F401

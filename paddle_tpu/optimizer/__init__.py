@@ -138,6 +138,36 @@ class Optimizer:
             self._states[p.name] = new_state
             p._replace_value(new_val)
 
+    def _functional_step(self, params, vals, grads, states, lr_val):
+        """Pure update over raw arrays — the jitted train-step path.
+
+        Same update rule as :meth:`step` (clip → regularize → _apply_one) but
+        with values/grads/states threaded explicitly so ``jax.jit`` can trace
+        and donate them.  Returns (new_vals, new_states).
+
+        Semantics delta vs eager: ``jax.grad`` produces *dense* gradients, so
+        a parameter unused by the loss receives a zero grad and still goes
+        through the update (decay/moment bookkeeping apply), whereas eager
+        ``step()`` skips params whose ``.grad`` is None.  This matches the
+        reference's static-graph/DataParallel behavior, not its dygraph one.
+        """
+        params_grads = list(zip(params, grads))
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        new_vals, new_states = [], []
+        for (p, g), val, state in zip(params_grads, vals, states):
+            if g is None:
+                new_vals.append(val)
+                new_states.append(state)
+                continue
+            if not self._decoupled_decay:
+                g = self._regularized(p, val, g)
+            plr = lr_val * p.optimize_attr.get("learning_rate", 1.0)
+            nv, ns = self._apply_one(val, g, state, plr, p)
+            new_vals.append(nv)
+            new_states.append(ns)
+        return new_vals, new_states
+
     def clear_grad(self, set_to_zero: bool = False) -> None:
         if self._parameter_list is None:
             return
