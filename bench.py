@@ -1,0 +1,95 @@
+"""Benchmark harness: BERT-base fused train step on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Workload: BASELINE.md config #3 (BERT-base pretrain shape, seq 512) through
+the fully-jitted TrainStep (forward + backward + AdamW, donated buffers).
+The reference publishes no absolute numbers (BASELINE.md: "published: {}"),
+so ``vs_baseline`` reports measured model FLOPs utilization (MFU) against the
+0.40 A100-class MFU target named in BASELINE.md's north star.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # pre-registered accelerator plugins ignore the env var; force it
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as pt
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import TransformerLM, TransformerLMCriterion, bert_base_config
+
+    pt.seed(0)
+    on_tpu = jax.default_backend() not in ("cpu",)
+    cfg = bert_base_config()
+    if not on_tpu:  # CPU smoke: shrink so the harness itself stays testable
+        cfg.update(num_layers=2, hidden_size=128, num_heads=2, intermediate_size=512,
+                   vocab_size=1024)
+    batch, seq = (16, 512) if on_tpu else (2, 128)
+
+    model = TransformerLM(**cfg, dropout=0.0)
+    criterion = TransformerLMCriterion(shift_labels=False)
+    opt = pt.optimizer.AdamW(1e-4, parameters=model.parameters())
+
+    def loss_fn(m, ids, labels):
+        return criterion(m(ids), labels)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32")
+
+    # warmup (includes compile)
+    for _ in range(2):
+        loss = step(ids, ids)
+    float(loss)
+
+    iters = 10 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    float(loss)  # block on the last step
+    dt = (time.perf_counter() - t0) / iters
+
+    tokens_per_sec = batch * seq / dt
+    flops_per_step = model.flops_per_token(seq) * batch * seq
+    # per-chip bf16 peak FLOP/s by device generation (standard MFU convention)
+    kind = jax.devices()[0].device_kind.lower() if on_tpu else "cpu"
+    if "v5 lite" in kind or "v5e" in kind:
+        peak = 197e12
+    elif "v5p" in kind or "v5" in kind:
+        peak = 459e12
+    elif "v4" in kind:
+        peak = 275e12
+    elif "v6" in kind or "trillium" in kind:
+        peak = 918e12
+    else:
+        peak = 197e12 if on_tpu else 1e12
+    mfu = flops_per_step / dt / peak
+    print(json.dumps({
+        "metric": "bert_base_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "step_time_s": round(dt, 4),
+            "mfu": round(mfu, 4),
+            "batch": batch,
+            "seq": seq,
+            "backend": jax.default_backend(),
+            "loss": float(loss),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -135,6 +135,27 @@ class StaticFunction:
         self._jitted = None
         functools.update_wrapper(self, self._function)
 
+    def __get__(self, instance, owner=None):
+        """Descriptor protocol so ``@to_static`` works on methods.
+
+        ``class M(Layer): @to_static def forward(self, x)`` — attribute access
+        binds the instance, and each instance gets its own traced cache.
+        """
+        if instance is None:
+            return self
+        cache = instance.__dict__.setdefault("_static_fn_cache", {})
+        key = id(self)
+        if key not in cache:
+            bound = StaticFunction.__new__(StaticFunction)
+            bound._layer = instance if isinstance(instance, Layer) else None
+            bound._function = self._function.__get__(instance, owner)
+            bound._input_spec = self._input_spec
+            bound._binding = None
+            bound._jitted = None
+            functools.update_wrapper(bound, bound._function)
+            cache[key] = bound
+        return cache[key]
+
     # -- trace body -----------------------------------------------------
     def _ensure_binding(self):
         if self._binding is None:
@@ -389,28 +410,45 @@ _META_SUFFIX = ".pdmodel.json"
 def _specs_from_input_spec(input_spec) -> List[jax.ShapeDtypeStruct]:
     from jax import export as jax_export
 
-    specs = []
-    sym_count = [0]
-
-    def one(spec):
+    # Name resolution: a ``None``/-1 at axis 0 is the shared batch symbol "b"
+    # (paddle convention: multiple inputs share the batch dim); elsewhere each
+    # gets a unique symbol.  A *string* dim is an explicit symbol name —
+    # equal names are constrained equal across inputs.
+    shapes_dtypes = []
+    dim_names = []  # per (input, axis): None for static, else symbol name
+    ordered_names: List[str] = []
+    for j, spec in enumerate(input_spec):
         if isinstance(spec, InputSpec):
             shape, dtype = spec.shape, spec.dtype
-        elif isinstance(spec, Tensor):
-            shape, dtype = tuple(spec.shape), spec.dtype
         else:
             shape, dtype = tuple(spec.shape), spec.dtype
-        dims = []
-        for d in shape:
-            if d is None or (isinstance(d, int) and d < 0):
-                name = "b%d" % sym_count[0]
-                sym_count[0] += 1
-                dims.append(jax_export.symbolic_shape(name)[0])
+        names = []
+        for i, d in enumerate(shape):
+            if isinstance(d, str):
+                name = d
+            elif d is None or (isinstance(d, int) and d < 0):
+                name = "b" if i == 0 else "d%d_%d" % (j, i)
             else:
-                dims.append(int(d))
-        return jax.ShapeDtypeStruct(tuple(dims), dtype)
+                name = None
+            names.append(name)
+            if name is not None and name not in ordered_names:
+                ordered_names.append(name)
+        shapes_dtypes.append((shape, dtype))
+        dim_names.append(names)
 
-    for s in input_spec:
-        specs.append(one(s))
+    # all symbolic dims must share ONE export scope
+    sym_by_name = {}
+    if ordered_names:
+        dims = jax_export.symbolic_shape(",".join(ordered_names))
+        sym_by_name = dict(zip(ordered_names, dims))
+
+    specs = []
+    for (shape, dtype), names in zip(shapes_dtypes, dim_names):
+        dims = [
+            sym_by_name[n] if n is not None else int(d)
+            for d, n in zip(shape, names)
+        ]
+        specs.append(jax.ShapeDtypeStruct(tuple(dims), dtype))
     return specs
 
 
@@ -508,16 +546,19 @@ class TranslatedLayer(Layer):
         super().__init__()
         self._exported = exported
         self._meta = meta
-        self._param_vals = [jnp.asarray(v) for v in param_arrays]
-        self._buf_vals = [jnp.asarray(v) for v in buffer_arrays]
-        for name, v in zip(meta["param_names"], self._param_vals):
-            self._parameters[name.replace(".", "__")] = Parameter(v, trainable=False)
-        for name, v in zip(meta["buffer_names"], self._buf_vals):
-            self.register_buffer(name.replace(".", "__"), Tensor(v, stop_gradient=True))
+        self._param_keys = [n.replace(".", "__") for n in meta["param_names"]]
+        self._buffer_keys = [n.replace(".", "__") for n in meta["buffer_names"]]
+        for key, v in zip(self._param_keys, param_arrays):
+            self._parameters[key] = Parameter(jnp.asarray(v), trainable=False)
+        for key, v in zip(self._buffer_keys, buffer_arrays):
+            self.register_buffer(key, Tensor(jnp.asarray(v), stop_gradient=True))
 
     def forward(self, *args):
         raw = [_unwrap(a) for a in args]
-        out = self._exported.call(self._param_vals, self._buf_vals, *raw)
+        # read live state so set_state_dict takes effect
+        param_vals = [self._parameters[k]._value for k in self._param_keys]
+        buf_vals = [self._buffers[k]._value for k in self._buffer_keys]
+        out = self._exported.call(param_vals, buf_vals, *raw)
         return _wrap_outputs(out)
 
 

@@ -1,0 +1,9 @@
+"""``paddle_tpu.models`` — reference model zoo built purely on ``paddle_tpu.nn``.
+
+Reference parity: the BASELINE.md workload ladder (LeNet → ResNet50 →
+BERT-base → ERNIE → GPT-1.3B); the transformer stack mirrors what
+``python/paddle/nn/layer/transformer.py`` (MultiHeadAttention:109,
+TransformerEncoder:622) is used for in the reference's NLP model zoo.
+Vision CNNs live in ``paddle_tpu.vision.models``.
+"""
+from .language_model import TransformerLM, TransformerLMCriterion, bert_base_config, gpt_1p3b_config  # noqa: F401

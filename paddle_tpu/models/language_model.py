@@ -1,0 +1,139 @@
+"""Decoder-only / encoder-only transformer language models — the flagship
+benchmark workloads (BASELINE.md configs #3 BERT-base and #5 GPT-1.3B).
+
+Built entirely from ``paddle_tpu.nn`` blocks (MultiHeadAttention /
+TransformerEncoder — reference ``nn/layer/transformer.py:109,622``) with a
+tied-embedding LM head and fused softmax-cross-entropy loss
+(``operators/softmax_with_cross_entropy_op.cc:325`` semantics).
+
+TPU-native notes: everything is static-shape and MXU-friendly (bf16-ready
+matmuls, no data-dependent control flow); the causal mask is additive and
+broadcast, so XLA fuses it into the attention softmax.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from .. import tensor as T
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.layers import Layer
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import TransformerEncoder, TransformerEncoderLayer
+
+
+def bert_base_config() -> dict:
+    """BERT-base pretrain config (BASELINE.md workload #3)."""
+    return dict(
+        vocab_size=30528,  # 30522 padded to a multiple of 64 for the MXU
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        intermediate_size=3072,
+        max_position=512,
+        causal=False,
+    )
+
+
+def gpt_1p3b_config() -> dict:
+    """GPT-3 1.3B config (BASELINE.md workload #5)."""
+    return dict(
+        vocab_size=50304,  # 50257 padded to a multiple of 64
+        hidden_size=2048,
+        num_layers=24,
+        num_heads=16,
+        intermediate_size=8192,
+        max_position=2048,
+        causal=True,
+    )
+
+
+class TransformerLM(Layer):
+    """Transformer language model with tied input/output embeddings."""
+
+    def __init__(
+        self,
+        vocab_size: int = 30528,
+        hidden_size: int = 768,
+        num_layers: int = 12,
+        num_heads: int = 12,
+        intermediate_size: Optional[int] = None,
+        max_position: int = 512,
+        dropout: float = 0.1,
+        activation: str = "gelu",
+        causal: bool = True,
+        normalize_before: bool = True,
+    ):
+        super().__init__()
+        intermediate_size = intermediate_size or 4 * hidden_size
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.intermediate_size = intermediate_size
+        self.causal = causal
+        self.word_embeddings = Embedding(vocab_size, hidden_size)
+        self.position_embeddings = Embedding(max_position, hidden_size)
+        self.embed_dropout = Dropout(dropout)
+        layer = TransformerEncoderLayer(
+            hidden_size,
+            num_heads,
+            intermediate_size,
+            dropout=dropout,
+            activation=activation,
+            normalize_before=normalize_before,
+        )
+        self.encoder = TransformerEncoder(layer, num_layers)
+        self.final_norm = LayerNorm(hidden_size)
+
+    def _causal_mask(self, seq_len: int, dtype):
+        # additive mask: 0 on/below diagonal, -inf above
+        idx = jnp.arange(seq_len)
+        allow = idx[None, :] <= idx[:, None]
+        return jnp.where(allow, 0.0, jnp.finfo(jnp.float32).min).astype(dtype)
+
+    def forward(self, input_ids, attn_mask=None):
+        seq_len = input_ids.shape[1]
+        pos = T.arange(0, seq_len, dtype="int64")
+        h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        h = self.embed_dropout(h)
+        if attn_mask is None and self.causal:
+            attn_mask = Tensor(
+                self._causal_mask(seq_len, h.value.dtype), stop_gradient=True
+            )
+        h = self.encoder(h, attn_mask)
+        h = self.final_norm(h)
+        # tied LM head: logits = h @ E^T
+        logits = T.matmul(h, self.word_embeddings.weight, transpose_y=True)
+        return logits
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Analytic fwd+bwd FLOPs/token for MFU accounting (PaLM appendix B).
+
+        6 * n_params_matmul + attention term 12 * L * H * seq.
+        """
+        h, l, ff, v = self.hidden_size, self.num_layers, self.intermediate_size, self.vocab_size
+        per_layer = 4 * h * h + 2 * h * ff  # qkvo + mlp matmul params
+        matmul_params = l * per_layer + v * h  # + lm head (tied)
+        attn = 12 * l * h * seq_len  # fwd+bwd qk^T and av matmuls
+        return 6.0 * matmul_params + attn
+
+
+class TransformerLMCriterion(Layer):
+    """Next-token (or masked) LM loss with fused softmax cross-entropy."""
+
+    def __init__(self, shift_labels: bool = True):
+        super().__init__()
+        self.shift_labels = shift_labels
+
+    def forward(self, logits, labels):
+        if self.shift_labels:
+            logits = logits[:, :-1, :]
+            labels = labels[:, 1:]
+        v = logits.shape[-1]
+        return F.cross_entropy(
+            T.reshape(logits, [-1, v]), T.reshape(labels, [-1]), reduction="mean"
+        )

@@ -207,6 +207,64 @@ class TestReviewRegressions:
             rtol=1e-5, atol=1e-6,
         )
 
+    def test_to_static_method_decorator(self):
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            @to_static
+            def forward(self, x):
+                return self.fc(x)
+
+        paddle.seed(0)
+        m = M()
+        x = paddle.to_tensor(np.ones((3, 4), "float32"))
+        out = m(x)
+        assert out.shape == [3, 2]
+        # two instances must not share traced state
+        m2 = M()
+        out2 = m2(x)
+        assert not np.allclose(out.numpy(), out2.numpy())
+
+    def test_save_two_dynamic_inputs(self, tmp_path):
+        class Two(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 2)
+
+            def forward(self, a, b):
+                return self.fc(a) + self.fc(b)
+
+        paddle.seed(0)
+        m = Two()
+        m.eval()
+        path = str(tmp_path / "two")
+        paddle.jit.save(m, path, input_spec=[
+            InputSpec([None, 4], "float32"), InputSpec([None, 4], "float32")])
+        loaded = paddle.jit.load(path)
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(3, 4).astype("float32")
+        np.testing.assert_allclose(
+            loaded(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            m(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_translated_layer_set_state_dict_takes_effect(self, tmp_path):
+        paddle.seed(0)
+        m = MLP()
+        m.eval()
+        path = str(tmp_path / "live")
+        paddle.jit.save(m, path, input_spec=[InputSpec([2, 8], "float32")])
+        loaded = paddle.jit.load(path)
+        x = paddle.to_tensor(np.ones((2, 8), "float32"))
+        before = loaded(x).numpy()
+        zeroed = {k: paddle.to_tensor(np.zeros(v.shape, "float32")) for k, v in loaded.state_dict().items()}
+        loaded.set_state_dict(zeroed)
+        after = loaded(x).numpy()
+        assert not np.allclose(before, after)
+        np.testing.assert_allclose(after, 0.0, atol=1e-6)
+
     def test_translated_layer_exposes_buffers(self, tmp_path):
         paddle.seed(0)
         bn = nn.BatchNorm1D(4)
