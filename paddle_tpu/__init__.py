@@ -48,6 +48,8 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import jit  # noqa: F401
+from . import distributed  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.tensor import Parameter  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .version import __version__  # noqa: F401

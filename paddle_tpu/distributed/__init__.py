@@ -1,0 +1,56 @@
+"""``paddle_tpu.distributed`` — collectives, topology, and parallelism.
+
+Reference parity: ``python/paddle/distributed`` (collective.py, parallel.py,
+fleet/).  TPU-native mapping per SURVEY.md §5.8: named mesh axes replace
+ring_ids, XLA collectives over ICI/DCN replace NCCL, ``jax.distributed``
+replaces TCP-store rendezvous, and the compiler replaces comm-stream fencing.
+"""
+from . import fleet  # noqa: F401
+from .collective import (  # noqa: F401
+    Group,
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    all_to_all,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    irecv,
+    is_initialized,
+    isend,
+    new_group,
+    p2p,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    stream,
+    wait,
+)
+from .parallel import (  # noqa: F401
+    DataParallel,
+    ParallelEnv,
+    scale_loss,
+    shard_batch,
+)
+from .topology import (  # noqa: F401
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    ParallelMode,
+)
+
+__all__ = [
+    "Group", "ReduceOp", "all_gather", "all_reduce", "all_to_all", "alltoall",
+    "barrier", "broadcast", "destroy_process_group", "get_group", "get_rank",
+    "get_world_size", "init_parallel_env", "irecv", "is_initialized", "isend",
+    "new_group", "p2p", "recv", "reduce", "reduce_scatter", "scatter", "send",
+    "stream", "wait", "DataParallel", "ParallelEnv", "scale_loss",
+    "shard_batch", "CommunicateTopology", "HybridCommunicateGroup",
+    "ParallelMode", "fleet",
+]

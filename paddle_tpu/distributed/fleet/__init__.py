@@ -1,0 +1,188 @@
+"""``paddle_tpu.distributed.fleet`` — the unified distributed facade.
+
+Reference parity: ``python/paddle/distributed/fleet/base/fleet_base.py:139``
+(Fleet: init/is_first_worker/worker_index/…/distributed_optimizer),
+``base/distributed_strategy.py`` (DistributedStrategy over
+``distributed_strategy.proto``), ``base/topology.py`` (hybrid_configs).
+
+TPU-native design: ``fleet.init`` builds the HybridCommunicateGroup mesh;
+``distributed_model``/``distributed_optimizer`` return wrappers that place
+state onto the mesh.  The reference's 20+ meta-optimizer program rewriters
+(SURVEY A.1) dissolve: AMP/recompute/grad-merge are function transforms,
+allreduce-fusion/ScheduleIR passes are XLA's job.  The strategy object keeps
+the same knob surface so reference configs port unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...core.errors import InvalidArgumentError
+from ..collective import init_parallel_env
+from ..topology import CommunicateTopology, HybridCommunicateGroup
+
+__all__ = [
+    "DistributedStrategy", "init", "fleet", "get_hybrid_communicate_group",
+    "distributed_model", "distributed_optimizer", "worker_index", "worker_num",
+    "is_first_worker", "barrier_worker",
+]
+
+
+class DistributedStrategy:
+    """distributed_strategy.py parity: the strategy knob bag.
+
+    Only knobs with TPU meaning act; the rest are stored for config
+    compatibility (reading them back returns what was set).
+    """
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True  # XLA always fuses; informational
+        self.nccl_comm_num = 1
+
+    def __repr__(self):
+        on = [k for k, v in vars(self).items()
+              if isinstance(v, bool) and v]
+        return "DistributedStrategy(%s, hybrid=%s)" % (
+            ",".join(on) or "defaults", self.hybrid_configs)
+
+
+class _Fleet:
+    """fleet_base.py:139 Fleet singleton."""
+
+    def __init__(self):
+        self._strategy: Optional[DistributedStrategy] = None
+        self._hcg: Optional[HybridCommunicateGroup] = None
+        self._initialized = False
+
+    # -- init -----------------------------------------------------------
+    def init(self, role_maker=None, is_collective: bool = True, strategy=None):
+        self._strategy = strategy or DistributedStrategy()
+        init_parallel_env()
+        hc = self._strategy.hybrid_configs
+        dims = [
+            int(hc.get("dp_degree", 1) or 1),
+            int(hc.get("pp_degree", 1) or 1),
+            int(hc.get("sharding_degree", 1) or 1),
+            int(hc.get("mp_degree", 1) or 1),
+        ]
+        names = ["data", "pipe", "sharding", "model"]
+        sep = int(hc.get("sep_degree", 1) or 1)
+        if sep > 1:
+            names.append("sep")
+            dims.append(sep)
+        import jax
+
+        ndev = len(jax.devices())
+        prod = 1
+        for d in dims:
+            prod *= d
+        if prod == 1:
+            dims[0] = ndev  # pure DP over all devices by default
+            prod = ndev
+        if prod > ndev:
+            raise InvalidArgumentError(
+                "hybrid_configs ask for %d-way parallelism but only %d "
+                "devices are visible" % (prod, ndev))
+        topo = CommunicateTopology(names, dims)
+        self._hcg = HybridCommunicateGroup(topo)
+        self._initialized = True
+        return self
+
+    @property
+    def is_initialized(self) -> bool:
+        return self._initialized
+
+    def get_hybrid_communicate_group(self) -> HybridCommunicateGroup:
+        if self._hcg is None:
+            raise InvalidArgumentError("call fleet.init() first")
+        return self._hcg
+
+    @property
+    def strategy(self) -> DistributedStrategy:
+        if self._strategy is None:
+            raise InvalidArgumentError("call fleet.init() first")
+        return self._strategy
+
+    # -- identity (fleet_base.py:278-340) -------------------------------
+    def worker_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def worker_num(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    def is_first_worker(self) -> bool:
+        return self.worker_index() == 0
+
+    def is_worker(self) -> bool:
+        return True
+
+    def is_server(self) -> bool:
+        return False  # parameter-server vertical: SURVEY A.7, deferred
+
+    def barrier_worker(self) -> None:
+        from ..collective import barrier
+
+        barrier()
+
+    # -- model/optimizer wrapping (fleet_base.py:900+) ------------------
+    def distributed_model(self, model):
+        """Wrap per the active strategy's dominant axis.
+
+        Pure-DP → DataParallel placement.  mp/pp degrees are honored by the
+        parallel layers themselves (meta_parallel.*) which read the hcg mesh,
+        so the model is returned with parameters placed on the mesh.
+        """
+        from ..parallel import DataParallel
+
+        hcg = self.get_hybrid_communicate_group()
+        if (hcg.get_model_parallel_world_size() == 1
+                and hcg.get_pipe_parallel_world_size() == 1
+                and hcg.get_sharding_parallel_world_size() == 1):
+            return DataParallel(model, group=hcg.get_data_parallel_group())
+        return model
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        if strategy is not None:
+            self._strategy = strategy
+        return optimizer
+
+
+fleet = _Fleet()
+
+# module-level convenience API (paddle.distributed.fleet.init style)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+barrier_worker = fleet.barrier_worker
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group

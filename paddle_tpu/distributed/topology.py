@@ -1,0 +1,282 @@
+"""N-D device topology — the hybrid-parallel mesh.
+
+Reference parity: ``python/paddle/distributed/fleet/base/topology.py:36``
+(CommunicateTopology: named-axis cartesian rank map) and ``:117``
+(HybridCommunicateGroup: per-axis comm groups over [dp, pp, sharding, mp]).
+
+TPU-native design: the topology *is* a ``jax.sharding.Mesh``.  Where the
+reference materializes one NCCL ring per axis-group (``collective.py:208
+new_group`` → ``c_gen_nccl_id``), here an "axis group" is just a named mesh
+axis; XLA lowers collectives over that axis to ICI/DCN rings itself.  The
+rank-enumeration helpers (``get_comm_list``, ``get_rank_from_stage``…) are
+kept host-side with identical semantics, because schedulers (pipeline 1F1B,
+sharding) still need to reason about coordinates.
+
+The axis order extends the reference's 4-axis [dp, pp, sharding, mp] with a
+5th ``sep`` (sequence-parallel) axis per SURVEY.md §5.7 — data-like outermost,
+model-like innermost, so DCN-crossing axes (dp/pp) stay outer and
+ICI-heavy axes (mp/sep) stay inner on real slices.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup", "ParallelMode"]
+
+
+class ParallelMode:
+    """fleet.base.topology.ParallelMode parity."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4  # sequence parallel (new, SURVEY §5.7)
+
+
+class CommunicateTopology:
+    """Named-axis cartesian topology (topology.py:36 parity)."""
+
+    def __init__(
+        self,
+        hybrid_group_names: Sequence[str] = ("data", "pipe", "sharding", "model"),
+        dims: Sequence[int] = (1, 1, 1, 1),
+    ):
+        if len(hybrid_group_names) != len(dims):
+            raise InvalidArgumentError(
+                "topology names %r and dims %r must align"
+                % (list(hybrid_group_names), list(dims))
+            )
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = [int(d) for d in dims]
+        self.coordinate = collections.namedtuple("Coordinate", self._parallel_names)
+        ranges = [range(d) for d in self._dims]
+        all_coords = [self.coordinate(*c) for c in itertools.product(*ranges)]
+        self._coord2rank = dict(zip(all_coords, range(len(all_coords))))
+        self._rank2coord = dict(zip(self._coord2rank.values(), self._coord2rank.keys()))
+        self._world_size = len(all_coords)
+
+    def get_hybrid_group_names(self) -> List[str]:
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return self._world_size
+
+    def get_rank(self, **args) -> int:
+        if len(args) != len(self._dims):
+            raise InvalidArgumentError(
+                "get_rank needs all axes %r, got %r"
+                % (self._parallel_names, sorted(args))
+            )
+        return self._coord2rank[self.coordinate(**args)]
+
+    def get_coord(self, rank: int):
+        if rank not in self._rank2coord:
+            raise InvalidArgumentError("rank %d out of range" % rank)
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        """All ranks whose coordinate on ``axis_name`` equals ``index``."""
+        axis = self._parallel_names.index(axis_name)
+        ranks = [
+            self._coord2rank[c]
+            for c in self._coord2rank
+            if c[axis] == index
+        ]
+        return sorted(ranks)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """Groups of ranks that communicate along ``axis_name``.
+
+        topology.py:84 parity: one group per assignment of the *other* axes.
+        """
+        axis = self._parallel_names.index(axis_name)
+        other_ranges = [
+            range(d) for i, d in enumerate(self._dims) if i != axis
+        ]
+        comm_list = []
+        for other in itertools.product(*other_ranges):
+            group = []
+            for k in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, k)
+                group.append(self._coord2rank[self.coordinate(*coord)])
+            comm_list.append(group)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank: int, **kwargs) -> int:
+        """Rank at the same coordinate except for the overridden axes."""
+        coord = self.get_coord(global_rank)
+        tf = coord._replace(**kwargs)._asdict()
+        return self.get_rank(**tf)
+
+
+# Canonical mesh-axis names for the jax Mesh, by topology axis.
+_MESH_AXIS = {
+    "data": "dp",
+    "pipe": "pp",
+    "sharding": "sharding",
+    "model": "mp",
+    "sep": "sep",
+}
+
+
+class HybridCommunicateGroup:
+    """Per-axis groups over the hybrid mesh (topology.py:117 parity).
+
+    Holds the ``jax.sharding.Mesh`` whose named axes replace the reference's
+    per-axis NCCL rings, plus the host-side coordinate bookkeeping the
+    schedulers use.  ``rank`` defaults to 0 for the single-controller case
+    (the coordinate accessors answer "which stage/segment is rank r" — under
+    SPMD every device's answer is derived from the same mesh).
+    """
+
+    def __init__(
+        self,
+        topology: CommunicateTopology,
+        rank: int = 0,
+        devices: Optional[Sequence] = None,
+    ):
+        import jax
+        from jax.sharding import Mesh
+
+        self._topo = topology
+        self.global_rank = rank
+        self.nranks = topology.world_size()
+
+        names = topology.get_hybrid_group_names()
+        self._dp_degree = topology.get_dim("data") if "data" in names else 1
+        self._pp_degree = topology.get_dim("pipe") if "pipe" in names else 1
+        self._sharding_degree = (
+            topology.get_dim("sharding") if "sharding" in names else 1
+        )
+        self._mp_degree = topology.get_dim("model") if "model" in names else 1
+        self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+
+        if devices is None:
+            devices = jax.devices()
+        if len(devices) < self.nranks:
+            raise InvalidArgumentError(
+                "topology wants %d devices, runtime has %d"
+                % (self.nranks, len(devices))
+            )
+        dims = [topology.get_dim(n) for n in names]
+        axis_names = tuple(_MESH_AXIS.get(n, n) for n in names)
+        dev_array = np.array(devices[: self.nranks]).reshape(dims)
+        self.mesh = Mesh(dev_array, axis_names)
+
+        # parallel-group coordinate of this controller's rank
+        coord = topology.get_coord(rank)
+        self._dp_rank = getattr(coord, "data", 0)
+        self._pp_rank = getattr(coord, "pipe", 0)
+        self._sharding_rank = getattr(coord, "sharding", 0)
+        self._mp_rank = getattr(coord, "model", 0)
+        self._sep_rank = getattr(coord, "sep", 0)
+
+    def __repr__(self):
+        return (
+            "HybridCommunicateGroup(dp=%d, pp=%d, sharding=%d, mp=%d, sep=%d)"
+            % (
+                self._dp_degree,
+                self._pp_degree,
+                self._sharding_degree,
+                self._mp_degree,
+                self._sep_degree,
+            )
+        )
+
+    def get_parallel_mode(self) -> int:
+        # topology.py:160 parity: the "dominant" mode for this config
+        if self._mp_degree > 1:
+            return ParallelMode.TENSOR_PARALLEL
+        if self._pp_degree > 1:
+            return ParallelMode.PIPELINE_PARALLEL
+        if self._sharding_degree > 1:
+            return ParallelMode.SHARDING_PARALLEL
+        if self._sep_degree > 1:
+            return ParallelMode.SEGMENT_PARALLEL
+        return ParallelMode.DATA_PARALLEL
+
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    # -- degrees / ranks per axis ---------------------------------------
+    def get_data_parallel_world_size(self) -> int:
+        return self._dp_degree
+
+    def get_data_parallel_rank(self) -> int:
+        return self._dp_rank
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._mp_degree
+
+    def get_model_parallel_rank(self) -> int:
+        return self._mp_rank
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._pp_degree
+
+    def get_stage_id(self) -> int:
+        return self._pp_rank
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._sharding_degree
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._sharding_rank
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._sep_degree
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._sep_rank
+
+    # -- groups: a Group is a named mesh axis (see collective.Group) ----
+    def _axis_group(self, topo_axis: str, mesh_axis: str):
+        from .collective import Group
+
+        # ranks along this axis holding the current rank's other coords fixed
+        comm_lists = self._topo.get_comm_list(topo_axis)
+        my = self.global_rank
+        ranks = next((g for g in comm_lists if my in g), comm_lists[0])
+        return Group(ranks=ranks, mesh=self.mesh, axis_name=mesh_axis)
+
+    def get_data_parallel_group(self):
+        return self._axis_group("data", "dp")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("model", "mp")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pipe", "pp")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding", "sharding")
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sep", "sep")
+
+    # pipeline neighbors (topology.py get_p2p_groups analog)
+    def get_p2p_next_rank(self) -> int:
+        return self._topo.get_rank_from_stage(
+            self.global_rank, pipe=(self._pp_rank + 1) % self._pp_degree
+        )
+
+    def get_p2p_prev_rank(self) -> int:
+        return self._topo.get_rank_from_stage(
+            self.global_rank, pipe=(self._pp_rank - 1) % self._pp_degree
+        )
