@@ -6,6 +6,7 @@ ring_ids, XLA collectives over ICI/DCN replace NCCL, ``jax.distributed``
 replaces TCP-store rendezvous, and the compiler replaces comm-stream fencing.
 """
 from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
 from .collective import (  # noqa: F401
     Group,
     ReduceOp,

@@ -358,7 +358,8 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
 
     Traced: local ``[n*k, ...]`` in → reduced own chunk ``[k, ...]`` out.
     Eager: stacked ``[nranks, n*k, ...]`` in → ``[nranks, k, ...]`` out
-    (rank i's slot holds the i-th reduced chunk).
+    (rank i's slot holds the i-th reduced chunk); the list form stacks
+    ``nranks`` per-rank tensors into that global view.
     Call as ``reduce_scatter(out, in_)`` (paddle style) or ``out = reduce_scatter(in_)``.
     """
     out_slot = None
@@ -366,11 +367,14 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
     if tensor_or_tensor_list is not None:
         out_slot, src = tensor, tensor_or_tensor_list
     group = group or _get_default_group()
+    template = src
     if isinstance(src, (list, tuple)):
-        src = jnp.concatenate([_unwrap(t) for t in src], axis=0)
-        template = out_slot
-    else:
-        template = src
+        if len(src) != group.nranks:
+            raise InvalidArgumentError(
+                "reduce_scatter list form: need one tensor per rank (%d), "
+                "got %d" % (group.nranks, len(src)))
+        template = src[0]
+        src = jnp.stack([_unwrap(t) for t in src], axis=0)
     raw = _unwrap(src)
     if _in_trace(raw) and _axis_bound(group.axis_name):
         out = lax.psum_scatter(raw, group.axis_name, scatter_dimension=0, tiled=True)
@@ -455,17 +459,22 @@ def alltoall(in_tensor_or_list, out_tensor_or_list=None,
 
     Traced: local ``[n*k, ...]`` in → ``[n*k, ...]`` out where chunk j of the
     output is rank j's chunk i (``lax.all_to_all`` over the group axis).
-    Eager: stacked ``[nranks, n*k, ...]`` → transposed-chunk stacked result.
-    Accepts paddle's list form (list of n chunks per rank).
+    Eager: stacked ``[nranks, n*k, ...]`` → transposed-chunk stacked result;
+    the list form is the same global view as a list of ``nranks`` per-rank
+    tensors (each ``[n*k, ...]``), returning the per-rank result list.
     """
     group = group or _get_default_group()
     n = group.nranks
     was_list = isinstance(in_tensor_or_list, (list, tuple))
     if was_list:
-        raw = jnp.concatenate([_unwrap(t) for t in in_tensor_or_list], axis=0)
+        if len(in_tensor_or_list) != n:
+            raise InvalidArgumentError(
+                "alltoall list form: need one tensor per rank (%d), got %d"
+                % (n, len(in_tensor_or_list)))
+        raw = jnp.stack([_unwrap(t) for t in in_tensor_or_list], axis=0)
     else:
         raw = _unwrap(in_tensor_or_list)
-    if _in_trace(raw) and _axis_bound(group.axis_name):
+    if not was_list and _in_trace(raw) and _axis_bound(group.axis_name):
         out = lax.all_to_all(
             raw, group.axis_name, split_axis=0, concat_axis=0, tiled=True)
     else:
@@ -477,28 +486,23 @@ def alltoall(in_tensor_or_list, out_tensor_or_list=None,
 
         out = _eager_collective(group, per_rank, raw)
     if was_list:
-        k = out.shape[0] // n
-        outs = [
-            _wrap_like(out[i * k:(i + 1) * k], in_tensor_or_list[0])
-            for i in range(n)
-        ]
+        outs = [_wrap_like(out[i], in_tensor_or_list[i]) for i in range(n)]
         if isinstance(out_tensor_or_list, list):
             out_tensor_or_list.extend(outs)
         return outs
-    template = in_tensor_or_list
-    return _wrap_like(out, template)
+    return _wrap_like(out, in_tensor_or_list)
 
 
 all_to_all = alltoall
 
 
 def send(tensor, dst: int = 0, group: Optional[Group] = None, sync_op: bool = True):
-    """collective.py:1515 parity via ``lax.ppermute`` (ICI neighbor push).
+    """collective.py:1515 parity — intentionally unsupported as-is.
 
-    Traced-only: point-to-point has no single-controller eager analog (there
-    is one program). Returns the value that arrived at this rank from the
-    rank for which *it* is ``dst`` — i.e. a pure rotation by (dst - src).
-    Use ``paddle_tpu.distributed.p2p`` helpers in pipeline schedules.
+    Point-to-point with a per-rank ``dst`` has no single-controller SPMD
+    form (there is one program, not per-rank programs); always raises with
+    a pointer to ``distributed.p2p.send_next/send_prev`` (static ppermute
+    shifts), which is the form pipeline schedules actually need.
     """
     raise InvalidArgumentError(
         "send/recv with a per-rank dst is not expressible as one SPMD "

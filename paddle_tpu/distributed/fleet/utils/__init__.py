@@ -1,0 +1,84 @@
+"""``fleet.utils`` — recompute (activation checkpointing).
+
+Reference parity: ``fleet/utils/recompute.py:63`` (RecomputeFunction: a
+PyLayer that reruns forward under saved RNG state in backward) and ``:171``
+(the ``recompute(function, *args)`` entry; ``preserve_rng_state``).
+
+TPU-native design: this is exactly ``jax.checkpoint`` (rematerialization) —
+the compiler replays the forward inside the backward pass, RNG included
+(JAX keys are values, so "preserve_rng_state" is automatic).  The wrapper
+keeps the Tensor facade intact so eager taped autograd records the
+checkpointed vjp; parameters reached through the function's closure (the
+``recompute(lambda x: block(x), x)`` idiom) are discovered and threaded as
+explicit differentiable inputs — the reference gets this for free from
+define-by-run tracking, a functional system must bind them.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import jax
+
+from ....framework.dispatch import make_op
+from ....framework.tensor import Parameter, Tensor
+from ....nn.layer.layers import Layer
+
+__all__ = ["recompute"]
+
+
+def _closure_params(fn: Callable) -> List[Parameter]:
+    """Trainable Parameters reachable from ``fn``'s closure / bound self."""
+    found: List[Parameter] = []
+    seen = set()
+
+    def add_layer(layer: Layer):
+        for p in layer.parameters():
+            if not p.stop_gradient and id(p) not in seen:
+                seen.add(id(p))
+                found.append(p)
+
+    owner = getattr(fn, "__self__", None)
+    if isinstance(owner, Layer):
+        add_layer(owner)
+    if isinstance(fn, Layer):
+        add_layer(fn)
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            v = cell.cell_contents
+        except ValueError:  # pragma: no cover - empty cell
+            continue
+        if isinstance(v, Layer):
+            add_layer(v)
+        elif isinstance(v, Parameter) and not v.stop_gradient and id(v) not in seen:
+            seen.add(id(v))
+            found.append(v)
+    return found
+
+
+def recompute(function: Callable, *args, preserve_rng_state: bool = True, **kwargs):
+    """fleet/utils/recompute.py:171 parity over ``jax.checkpoint``."""
+    params = _closure_params(function)
+    n = len(params)
+
+    def raw_fn(*all_raw):
+        param_vals, raw_args = all_raw[:n], all_raw[n:]
+        saved = [p._value for p in params]
+        for p, v in zip(params, param_vals):
+            p._value = v
+        try:
+            wrapped = [
+                Tensor(a, stop_gradient=False) if isinstance(a, jax.Array) else a
+                for a in raw_args
+            ]
+            out = function(*wrapped, **kwargs)
+            return jax.tree_util.tree_map(
+                lambda t: t.value if isinstance(t, Tensor) else t,
+                out,
+                is_leaf=lambda t: isinstance(t, Tensor),
+            )
+        finally:
+            for p, v in zip(params, saved):
+                p._value = v
+
+    op = make_op(jax.checkpoint(raw_fn), op_name="recompute")
+    return op(*params, *args)

@@ -1,0 +1,21 @@
+"""``paddle_tpu.distributed.meta_parallel`` — hybrid-parallel model layers.
+
+Reference parity: ``python/paddle/distributed/fleet/meta_parallel/`` —
+``parallel_layers/mp_layers.py`` (TP layers), ``parallel_layers/pp_layers.py``
+(LayerDesc/PipelineLayer), ``pipeline_parallel.py`` (schedules),
+``sharding_parallel.py`` (ZeRO).
+"""
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .pipeline_parallel import PipelineParallel  # noqa: F401
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+
+__all__ = [
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer",
+    "PipelineParallel",
+]

@@ -1,0 +1,87 @@
+"""Pipeline-parallel execution engine.
+
+Reference parity: ``fleet/meta_parallel/pipeline_parallel.py:32`` (
+PipelineParallel: micro-batch loop, p2p activation exchange) and the static
+1F1B schedule ``framework/section_worker.cc:104-182`` (warmup F, steady
+1F1B, cooldown B, then one optimizer step).
+
+TPU-native design: under a single controller the whole pipeline is ONE SPMD
+program; stage-to-stage "sends" are just dataflow. What remains semantically
+is micro-batching (gradient accumulation before the step — identical math to
+1F1B, which only reorders it) and stage *placement*. The 1F1B interleave
+itself is an HBM-residency schedule for multi-process runtimes; XLA already
+overlaps compute and communication inside the compiled step, and the
+micro-batch loop here bounds activation memory exactly the way 1F1B's depth
+bound does (one microbatch's activations live at a time + accumulated grads).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...core.errors import InvalidArgumentError
+from ...framework.tensor import Tensor
+from ...nn.layer.layers import Layer
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    """pipeline_parallel.py:32 parity."""
+
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise InvalidArgumentError(
+                "PipelineParallel expects a PipelineLayer, got %r"
+                % type(layers))
+        self._layers = layers
+        self._hcg = hcg
+        cfg = getattr(strategy, "pipeline_configs", None) or {}
+        self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Micro-batched step: split → accumulate grads → one update.
+
+        ``data``: (inputs, labels) with batch divisible by accumulate_steps.
+        Returns the mean micro-batch loss (reference returns train_loss).
+        """
+        x, y = data
+        loss_fn = self._layers._loss_fn
+        if loss_fn is None:
+            raise InvalidArgumentError(
+                "PipelineLayer needs loss_fn= for train_batch")
+        k = self.accumulate_steps
+        xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y.value if isinstance(y, Tensor) else jnp.asarray(y)
+        if xv.shape[0] % k != 0:
+            raise InvalidArgumentError(
+                "batch %d not divisible by accumulate_steps %d"
+                % (xv.shape[0], k))
+        mb = xv.shape[0] // k
+        total = 0.0
+        for i in range(k):
+            mx = Tensor(xv[i * mb:(i + 1) * mb], stop_gradient=True)
+            my = Tensor(yv[i * mb:(i + 1) * mb], stop_gradient=True)
+            out = self._layers(mx)
+            loss = loss_fn(out, my)
+            scaled = loss * (1.0 / k)  # mean over microbatches, 1F1B parity
+            if scaler is not None:
+                scaler.scale(scaled).backward()
+            else:
+                scaled.backward()
+            total += float(loss.value)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(jnp.asarray(total / k), stop_gradient=True)

@@ -102,6 +102,32 @@ def test_alltoall(rng):
             np.testing.assert_allclose(out[i, j], xs[j, i])
 
 
+def test_alltoall_list_form(rng):
+    per_rank = [rng.randn(N * 2, 3).astype(np.float32) for _ in range(N)]
+    outs = dist.alltoall([jnp.asarray(t) for t in per_rank])
+    assert len(outs) == N
+    for i in range(N):
+        for j in range(N):
+            np.testing.assert_allclose(
+                np.asarray(outs[i])[j * 2:(j + 1) * 2],
+                per_rank[j][i * 2:(i + 1) * 2])
+
+
+def test_reduce_scatter_list_form(rng):
+    per_rank = [rng.randn(N * 2, 3).astype(np.float32) for _ in range(N)]
+    out = np.asarray(dist.reduce_scatter(list(map(jnp.asarray, per_rank))))
+    summed = np.stack(per_rank).sum(0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], summed[r * 2:(r + 1) * 2], rtol=1e-5)
+
+
+def test_layer_desc_plain_callable():
+    from paddle_tpu.distributed.meta_parallel import LayerDesc
+
+    d = LayerDesc(lambda: (lambda x: x))
+    assert callable(d.build_layer())
+
+
 def test_barrier_and_wait(rng):
     dist.barrier()
     dist.wait(jnp.ones((3,)))
