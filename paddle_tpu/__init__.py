@@ -49,7 +49,9 @@ from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import jit  # noqa: F401
 from . import distributed  # noqa: F401
+from . import io  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
+from .framework.io import load, save  # noqa: F401
 from .framework.tensor import Parameter  # noqa: F401
 from .nn.layer.layers import ParamAttr  # noqa: F401
 from .version import __version__  # noqa: F401

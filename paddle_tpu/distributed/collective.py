@@ -356,10 +356,11 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
                    group: Optional[Group] = None, sync_op: bool = True):
     """``paddle.distributed.reduce_scatter`` parity (communication/reduce_scatter).
 
-    Traced: local ``[n*k, ...]`` in → reduced own chunk ``[k, ...]`` out.
+    Traced: local ``[n*k, ...]`` in → reduced own chunk ``[k, ...]`` out;
+    the list form is this rank's ``n`` chunks (paddle semantics).
     Eager: stacked ``[nranks, n*k, ...]`` in → ``[nranks, k, ...]`` out
-    (rank i's slot holds the i-th reduced chunk); the list form stacks
-    ``nranks`` per-rank tensors into that global view.
+    (rank i's slot holds the i-th reduced chunk); the list form is the
+    global view — ``nranks`` per-rank tensors.
     Call as ``reduce_scatter(out, in_)`` (paddle style) or ``out = reduce_scatter(in_)``.
     """
     out_slot = None
@@ -367,25 +368,50 @@ def reduce_scatter(tensor, tensor_or_tensor_list=None, op=ReduceOp.SUM,
     if tensor_or_tensor_list is not None:
         out_slot, src = tensor, tensor_or_tensor_list
     group = group or _get_default_group()
-    template = src
+    n = group.nranks
+    template = src[0] if isinstance(src, (list, tuple)) else src
     if isinstance(src, (list, tuple)):
-        if len(src) != group.nranks:
-            raise InvalidArgumentError(
-                "reduce_scatter list form: need one tensor per rank (%d), "
-                "got %d" % (group.nranks, len(src)))
-        template = src[0]
-        src = jnp.stack([_unwrap(t) for t in src], axis=0)
-    raw = _unwrap(src)
-    if _in_trace(raw) and _axis_bound(group.axis_name):
-        out = lax.psum_scatter(raw, group.axis_name, scatter_dimension=0, tiled=True)
+        raws = [_unwrap(t) for t in src]
+        traced = _in_trace(raws[0]) and _axis_bound(group.axis_name)
+        if traced:  # paddle per-rank chunks → concat to [n*k, ...]
+            if len(raws) != n:
+                raise InvalidArgumentError(
+                    "reduce_scatter list form: need %d chunks, got %d"
+                    % (n, len(raws)))
+            raw = jnp.concatenate(raws, axis=0)
+        else:  # global view: one tensor per rank
+            if len(raws) != n:
+                raise InvalidArgumentError(
+                    "reduce_scatter list form: need one tensor per rank "
+                    "(%d), got %d" % (n, len(raws)))
+            raw = jnp.stack(raws, axis=0)
+    else:
+        raw = _unwrap(src)
+        traced = _in_trace(raw) and _axis_bound(group.axis_name)
+
+    def body(local, scatter_dim):
+        if op == ReduceOp.SUM:
+            return lax.psum_scatter(
+                local, group.axis_name, scatter_dimension=scatter_dim,
+                tiled=True)
+        if op == ReduceOp.AVG:
+            return lax.psum_scatter(
+                local, group.axis_name, scatter_dimension=scatter_dim,
+                tiled=True) / n
+        red = {ReduceOp.MAX: jnp.max, ReduceOp.MIN: jnp.min,
+               ReduceOp.PROD: jnp.prod}.get(op)
+        if red is None:
+            raise InvalidArgumentError("unknown ReduceOp %r" % (op,))
+        full = red(lax.all_gather(local, group.axis_name), axis=0)
+        k = full.shape[scatter_dim] // n
+        idx = lax.axis_index(group.axis_name)
+        return lax.dynamic_slice_in_dim(full, idx * k, k, axis=scatter_dim)
+
+    if traced:
+        out = body(raw, 0)
     else:
         _check_rank_axis(raw, group, "reduce_scatter")
-
-        def per_rank(local):
-            return lax.psum_scatter(
-                local, group.axis_name, scatter_dimension=1, tiled=True)
-
-        out = _eager_collective(group, per_rank, raw)
+        out = _eager_collective(group, lambda local: body(local, 1), raw)
     if out_slot is not None and isinstance(out_slot, Tensor):
         out_slot.set_value(out)
         return out_slot
@@ -469,12 +495,18 @@ def alltoall(in_tensor_or_list, out_tensor_or_list=None,
     if was_list:
         if len(in_tensor_or_list) != n:
             raise InvalidArgumentError(
-                "alltoall list form: need one tensor per rank (%d), got %d"
+                "alltoall list form: need %d tensors, got %d"
                 % (n, len(in_tensor_or_list)))
-        raw = jnp.stack([_unwrap(t) for t in in_tensor_or_list], axis=0)
+        raws = [_unwrap(t) for t in in_tensor_or_list]
+        traced = _in_trace(raws[0]) and _axis_bound(group.axis_name)
+        # traced: this rank's n outgoing chunks → concat [n*k, ...];
+        # eager: global view, one [n*k, ...] tensor per rank → stack
+        raw = (jnp.concatenate(raws, axis=0) if traced
+               else jnp.stack(raws, axis=0))
     else:
         raw = _unwrap(in_tensor_or_list)
-    if not was_list and _in_trace(raw) and _axis_bound(group.axis_name):
+        traced = _in_trace(raw) and _axis_bound(group.axis_name)
+    if traced:
         out = lax.all_to_all(
             raw, group.axis_name, split_axis=0, concat_axis=0, tiled=True)
     else:
@@ -486,7 +518,12 @@ def alltoall(in_tensor_or_list, out_tensor_or_list=None,
 
         out = _eager_collective(group, per_rank, raw)
     if was_list:
-        outs = [_wrap_like(out[i], in_tensor_or_list[i]) for i in range(n)]
+        if traced:  # split received [n*k, ...] back into n chunks
+            k = out.shape[0] // n
+            outs = [_wrap_like(out[i * k:(i + 1) * k], in_tensor_or_list[i])
+                    for i in range(n)]
+        else:
+            outs = [_wrap_like(out[i], in_tensor_or_list[i]) for i in range(n)]
         if isinstance(out_tensor_or_list, list):
             out_tensor_or_list.extend(outs)
         return outs

@@ -27,7 +27,10 @@ __all__ = ["recompute"]
 
 
 def _closure_params(fn: Callable) -> List[Parameter]:
-    """Trainable Parameters reachable from ``fn``'s closure / bound self."""
+    """Trainable Parameters reachable from ``fn``: closure cells, bound
+    ``__self__``, Layer instances, and functools.partial args/keywords."""
+    import functools
+
     found: List[Parameter] = []
     seen = set()
 
@@ -37,21 +40,32 @@ def _closure_params(fn: Callable) -> List[Parameter]:
                 seen.add(id(p))
                 found.append(p)
 
-    owner = getattr(fn, "__self__", None)
-    if isinstance(owner, Layer):
-        add_layer(owner)
-    if isinstance(fn, Layer):
-        add_layer(fn)
-    for cell in getattr(fn, "__closure__", None) or ():
-        try:
-            v = cell.cell_contents
-        except ValueError:  # pragma: no cover - empty cell
-            continue
-        if isinstance(v, Layer):
-            add_layer(v)
-        elif isinstance(v, Parameter) and not v.stop_gradient and id(v) not in seen:
-            seen.add(id(v))
-            found.append(v)
+    def visit(obj, depth=0):
+        if depth > 3:
+            return
+        if isinstance(obj, Layer):
+            add_layer(obj)
+        elif isinstance(obj, Parameter):
+            if not obj.stop_gradient and id(obj) not in seen:
+                seen.add(id(obj))
+                found.append(obj)
+        elif isinstance(obj, functools.partial):
+            visit(obj.func, depth + 1)
+            for a in obj.args:
+                visit(a, depth + 1)
+            for a in obj.keywords.values():
+                visit(a, depth + 1)
+        elif callable(obj):
+            owner = getattr(obj, "__self__", None)
+            if isinstance(owner, Layer):
+                add_layer(owner)
+            for cell in getattr(obj, "__closure__", None) or ():
+                try:
+                    visit(cell.cell_contents, depth + 1)
+                except ValueError:  # pragma: no cover - empty cell
+                    continue
+
+    visit(fn)
     return found
 
 

@@ -146,7 +146,7 @@ class ColumnParallelLinear(Layer):
     def forward(self, x):
         ax = self.group.axis_name
         y = F.linear(x, self.weight, self.bias)
-        spec = (P(*([None] * (y.ndim - 1) + [None])) if self.gather_output
+        spec = (P() if self.gather_output
                 else P(*([None] * (y.ndim - 1) + [ax])))
         return _constrain(y, self.group, spec)
 

@@ -1,0 +1,31 @@
+"""``paddle_tpu.io`` — datasets, samplers, DataLoader.
+
+Reference parity: ``python/paddle/io/__init__.py`` (re-exporting
+``fluid/dataloader/*`` + ``fluid/reader.py:146`` DataLoader).
+"""
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .dataset import (  # noqa: F401
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    Dataset,
+    IterableDataset,
+    Subset,
+    TensorDataset,
+    random_split,
+)
+from .sampler import (  # noqa: F401
+    BatchSampler,
+    DistributedBatchSampler,
+    RandomSampler,
+    Sampler,
+    SequenceSampler,
+    WeightedRandomSampler,
+)
+
+__all__ = [
+    "DataLoader", "default_collate_fn", "Dataset", "IterableDataset",
+    "TensorDataset", "ComposeDataset", "ChainDataset", "ConcatDataset",
+    "Subset", "random_split", "Sampler", "SequenceSampler", "RandomSampler",
+    "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+]

@@ -1,0 +1,173 @@
+"""Datasets.
+
+Reference parity: ``python/paddle/io/__init__.py`` re-exports from
+``python/paddle/fluid/dataloader/dataset.py`` — Dataset, IterableDataset,
+TensorDataset, ComposeDataset, ChainDataset, ConcatDataset (absent in
+snapshot; kept for torch-style parity), Subset, random_split.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Iterable, List, Sequence
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+]
+
+
+class Dataset:
+    """Map-style dataset (dataloader/dataset.py Dataset parity)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError(
+            "%s must implement __getitem__" % type(self).__name__)
+
+    def __len__(self):
+        raise NotImplementedError(
+            "%s must implement __len__" % type(self).__name__)
+
+
+class IterableDataset(Dataset):
+    """Stream-style dataset (dataloader/dataset.py IterableDataset parity)."""
+
+    def __iter__(self):
+        raise NotImplementedError(
+            "%s must implement __iter__" % type(self).__name__)
+
+    def __getitem__(self, idx):
+        raise InvalidArgumentError(
+            "IterableDataset is not subscriptable; iterate it")
+
+    def __len__(self):
+        raise InvalidArgumentError(
+            "IterableDataset has no len(); iterate it")
+
+
+class TensorDataset(Dataset):
+    """dataset.py TensorDataset parity: zip of equally-long tensors."""
+
+    def __init__(self, tensors: Sequence):
+        arrays = [
+            t.numpy() if isinstance(t, Tensor) else np.asarray(t)
+            for t in tensors
+        ]
+        if not arrays:
+            raise InvalidArgumentError("TensorDataset needs at least one tensor")
+        n = arrays[0].shape[0]
+        for a in arrays:
+            if a.shape[0] != n:
+                raise InvalidArgumentError(
+                    "TensorDataset tensors must share dim 0: %d vs %d"
+                    % (n, a.shape[0]))
+        self.tensors = arrays
+
+    def __getitem__(self, idx):
+        return tuple(a[idx] for a in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    """dataset.py ComposeDataset parity: fields of several datasets, zipped."""
+
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise InvalidArgumentError("ComposeDataset needs datasets")
+        n = len(self.datasets[0])
+        for d in self.datasets:
+            if len(d) != n:
+                raise InvalidArgumentError(
+                    "ComposeDataset datasets must share length")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out: List = []
+        for d in self.datasets:
+            item = d[idx]
+            out.extend(item if isinstance(item, (tuple, list)) else (item,))
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    """dataset.py ChainDataset parity: concatenation of iterable datasets."""
+
+    def __init__(self, datasets: Sequence[IterableDataset]):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    """Concatenation of map-style datasets (torch-parity convenience)."""
+
+    def __init__(self, datasets: Sequence[Dataset]):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise InvalidArgumentError("ConcatDataset needs datasets")
+        self.cumulative_sizes: List[int] = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        ds_idx = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[ds_idx - 1] if ds_idx else 0
+        return self.datasets[ds_idx][idx - prev]
+
+
+class Subset(Dataset):
+    """dataset.py Subset parity."""
+
+    def __init__(self, dataset: Dataset, indices: Sequence[int]):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset: Dataset, lengths: Sequence[int], generator=None):
+    """dataset.py random_split parity (generator: numpy RandomState or seed)."""
+    total = sum(int(l) for l in lengths)
+    if total != len(dataset):
+        raise InvalidArgumentError(
+            "random_split lengths sum %d != dataset length %d"
+            % (total, len(dataset)))
+    if generator is None:
+        from ..core.random import next_key
+
+        # derive a host seed from the framework RNG stream so paddle.seed()
+        # makes splits reproducible
+        import jax.random as jrandom
+
+        generator = np.random.RandomState(
+            int(np.asarray(jrandom.randint(next_key(), (), 0, 2**31 - 1))))
+    elif isinstance(generator, int):
+        generator = np.random.RandomState(generator)
+    perm = generator.permutation(total)
+    out, offset = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[offset:offset + int(l)].tolist()))
+        offset += int(l)
+    return out

@@ -1,0 +1,205 @@
+"""Samplers.
+
+Reference parity: ``python/paddle/fluid/dataloader/batch_sampler.py``
+(BatchSampler, DistributedBatchSampler at
+``distributed/fleet/dataset/...``/``io/__init__``) and
+``dataloader/sampler.py`` (Sampler, SequenceSampler, RandomSampler,
+WeightedRandomSampler).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = [
+    "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
+    "BatchSampler", "DistributedBatchSampler",
+]
+
+
+class Sampler:
+    """dataloader/sampler.py Sampler parity."""
+
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self) -> Iterator[int]:
+        raise NotImplementedError
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement: bool = False,
+                 num_samples: Optional[int] = None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+        if not replacement and num_samples is not None \
+                and num_samples > len(data_source):
+            raise InvalidArgumentError(
+                "num_samples %d > dataset size %d without replacement"
+                % (num_samples, len(data_source)))
+
+    @property
+    def num_samples(self) -> int:
+        return self._num_samples if self._num_samples is not None \
+            else len(self.data_source)
+
+    def _rng(self) -> np.random.RandomState:
+        if isinstance(self.generator, np.random.RandomState):
+            return self.generator
+        if isinstance(self.generator, int):
+            return np.random.RandomState(self.generator)
+        return np.random.RandomState()
+
+    def __iter__(self):
+        rng = self._rng()
+        n = len(self.data_source)
+        if self.replacement:
+            yield from rng.randint(0, n, self.num_samples).tolist()
+        else:
+            yield from rng.permutation(n)[: self.num_samples].tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights: Sequence[float], num_samples: int,
+                 replacement: bool = True, generator=None):
+        super().__init__(None)
+        self.weights = np.asarray(weights, dtype=np.float64)
+        if (self.weights < 0).any():
+            raise InvalidArgumentError("weights must be non-negative")
+        self.num_samples = int(num_samples)
+        self.replacement = replacement
+        self.generator = generator
+        if not replacement and num_samples > len(self.weights):
+            raise InvalidArgumentError(
+                "num_samples %d > #weights %d without replacement"
+                % (num_samples, len(self.weights)))
+
+    def __iter__(self):
+        rng = (self.generator if isinstance(self.generator, np.random.RandomState)
+               else np.random.RandomState(self.generator)
+               if isinstance(self.generator, int) else np.random.RandomState())
+        p = self.weights / self.weights.sum()
+        idx = rng.choice(len(self.weights), size=self.num_samples,
+                         replace=self.replacement, p=p)
+        yield from idx.tolist()
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """batch_sampler.py BatchSampler parity."""
+
+    def __init__(self, dataset=None, sampler: Optional[Sampler] = None,
+                 shuffle: bool = False, batch_size: int = 1,
+                 drop_last: bool = False):
+        super().__init__(dataset)
+        if (dataset is None) == (sampler is None):
+            raise InvalidArgumentError(
+                "BatchSampler needs exactly one of dataset= or sampler=")
+        if sampler is not None:
+            self.sampler = sampler
+        else:
+            self.sampler = (RandomSampler(dataset) if shuffle
+                            else SequenceSampler(dataset))
+        if batch_size <= 0:
+            raise InvalidArgumentError("batch_size must be positive")
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+
+    def __iter__(self) -> Iterator[List[int]]:
+        batch: List[int] = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """io DistributedBatchSampler parity: shard indices across ranks.
+
+    Under single-controller SPMD the common path is a *global* batch sharded
+    by ``distributed.shard_batch``; this sampler exists for multi-host input
+    pipelines (each controller loads its shard — ``num_replicas`` defaults to
+    ``jax.process_count()``).
+    """
+
+    def __init__(self, dataset, batch_size: int, num_replicas: Optional[int] = None,
+                 rank: Optional[int] = None, shuffle: bool = False,
+                 drop_last: bool = False, seed: int = 0):
+        import jax
+
+        self.num_replicas = (num_replicas if num_replicas is not None
+                             else jax.process_count())
+        self.rank = rank if rank is not None else jax.process_index()
+        if not (0 <= self.rank < self.num_replicas):
+            raise InvalidArgumentError(
+                "rank %d out of range for %d replicas"
+                % (self.rank, self.num_replicas))
+        super().__init__(dataset=dataset, shuffle=shuffle,
+                         batch_size=batch_size, drop_last=drop_last)
+        self.seed = seed
+        self.epoch = 0
+        n = len(dataset)
+        if drop_last:
+            self.num_samples = n // self.num_replicas
+        else:
+            self.num_samples = (n + self.num_replicas - 1) // self.num_replicas
+        self.total_size = self.num_samples * self.num_replicas
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = int(epoch)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            indices = rng.permutation(n).tolist()
+        else:
+            indices = list(range(n))
+        if not self.drop_last and len(indices) < self.total_size:
+            indices += indices[: self.total_size - len(indices)]  # pad-wrap
+        indices = indices[: self.total_size]
+        shard = indices[self.rank::self.num_replicas]
+        batch: List[int] = []
+        for idx in shard:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size

@@ -197,14 +197,46 @@ class Optimizer:
         sched = state_dict.get("LR_Scheduler")
         if sched is not None and isinstance(self._learning_rate, LRScheduler):
             self._learning_rate.set_state_dict(dict(sched))
+        grouped: dict = {}
         for key, v in state_dict.items():
-            if key == "LR_Scheduler":
-                continue
-            if "__" not in key:
+            if key == "LR_Scheduler" or "__" not in key:
                 continue
             pname, slot = key.rsplit("__", 1)
             val = v.value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
-            self._states.setdefault(pname, {})[slot] = val
+            grouped.setdefault(pname, {})[slot] = val
+        # Saved names may come from another process/construction epoch (the
+        # auto name counter keeps counting), so fall back to positional
+        # mapping onto this optimizer's trainable parameters when the name
+        # sets differ — state_dict insertion order tracks parameter order.
+        # Shape-validate every non-scalar slot against its target parameter
+        # so a wrong mapping fails loudly instead of silently corrupting.
+        mapping = {n: n for n in grouped}
+        trainable = [p for p in (self._parameter_list or [])
+                     if not p.stop_gradient]
+        current = [p.name for p in trainable]
+        if current and set(grouped) != set(current):
+            if len(grouped) != len(current):
+                raise InvalidArgumentError(
+                    "optimizer state has %d parameter entries %r but this "
+                    "optimizer tracks %d parameters %r"
+                    % (len(grouped), sorted(grouped), len(current),
+                       sorted(current)))
+            mapping = dict(zip(grouped.keys(), current))
+        by_name = {p.name: p for p in trainable}
+        for pname, slots in grouped.items():
+            tgt = mapping[pname]
+            p = by_name.get(tgt)
+            if p is not None:
+                for slot, val in slots.items():
+                    if getattr(val, "ndim", 0) > 0 \
+                            and tuple(val.shape) != tuple(p.value.shape):
+                        raise InvalidArgumentError(
+                            "optimizer state %r slot %r has shape %s but "
+                            "parameter %r has shape %s — state_dict does "
+                            "not match this optimizer's parameters"
+                            % (pname, slot, tuple(val.shape), tgt,
+                               tuple(p.value.shape)))
+            self._states.setdefault(tgt, {}).update(slots)
 
     set_dict = set_state_dict
 

@@ -121,6 +121,43 @@ def test_reduce_scatter_list_form(rng):
         np.testing.assert_allclose(out[r], summed[r * 2:(r + 1) * 2], rtol=1e-5)
 
 
+def test_traced_list_forms(rng):
+    """paddle list-form alltoall/reduce_scatter inside shard_map."""
+    from paddle_tpu.distributed.collective import shard_map
+
+    g = dist.init_parallel_env()
+    x = _stacked(rng, (N, N * 2, 3))  # per rank: N chunks of [2, 3]
+
+    def body(local):
+        local = local[0]  # [N*2, 3]
+        chunks = [local[i * 2:(i + 1) * 2] for i in range(N)]
+        outs = dist.alltoall(chunks, group=g)
+        rs = dist.reduce_scatter(chunks, group=g)
+        return jnp.concatenate(outs, axis=0)[None], rs[None]
+
+    a2a, rs = shard_map(body, mesh=g.mesh, in_specs=(P("dp"),),
+                        out_specs=(P("dp"), P("dp")))(x)
+    xs = np.asarray(x)
+    a2a = np.asarray(a2a)
+    for i in range(N):
+        for j in range(N):
+            np.testing.assert_allclose(a2a[i, j * 2:(j + 1) * 2],
+                                       xs[j, i * 2:(i + 1) * 2])
+    # reduce_scatter list (chunks) == sum over ranks of chunk r, per rank r
+    rs = np.asarray(rs)  # [N, 2, 3]
+    summed = xs.sum(0)
+    for r in range(N):
+        np.testing.assert_allclose(rs[r], summed[r * 2:(r + 1) * 2], rtol=1e-5)
+
+
+def test_reduce_scatter_max(rng):
+    x = _stacked(rng, (N, N * 2, 3))
+    out = np.asarray(dist.reduce_scatter(x, op=dist.ReduceOp.MAX))
+    mx = np.asarray(x).max(0)
+    for r in range(N):
+        np.testing.assert_allclose(out[r], mx[r * 2:(r + 1) * 2], rtol=1e-6)
+
+
 def test_layer_desc_plain_callable():
     from paddle_tpu.distributed.meta_parallel import LayerDesc
 

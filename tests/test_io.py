@@ -1,0 +1,182 @@
+"""Data pipeline tests.
+
+Mirrors the reference's ``tests/unittests/test_dataloader_*``,
+``test_batch_sampler.py``, ``test_dataset*.py`` coverage, plus the
+buffered_reader.cc overlap property (prefetch faster than sync on a slow
+dataset).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io import (
+    BatchSampler,
+    ChainDataset,
+    ComposeDataset,
+    ConcatDataset,
+    DataLoader,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    SequenceSampler,
+    Subset,
+    TensorDataset,
+    WeightedRandomSampler,
+    random_split,
+)
+
+
+class _Square(Dataset):
+    def __init__(self, n=10):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+
+class _Stream(IterableDataset):
+    def __init__(self, n=7):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+def test_tensor_dataset_and_loader(rng):
+    xs = rng.randn(10, 3).astype(np.float32)
+    ys = rng.randint(0, 2, (10,)).astype(np.int64)
+    ds = TensorDataset([xs, ys])
+    assert len(ds) == 10
+    loader = DataLoader(ds, batch_size=4, drop_last=False)
+    batches = list(loader)
+    assert len(loader) == 3 and len(batches) == 3
+    np.testing.assert_allclose(np.asarray(batches[0][0].value), xs[:4])
+    assert batches[-1][0].shape[0] == 2  # remainder kept
+
+
+def test_loader_drop_last_and_shuffle_reproducible():
+    ds = _Square(10)
+    loader = DataLoader(ds, batch_size=4, drop_last=True)
+    assert len(loader) == 2
+    s1 = BatchSampler(sampler=RandomSampler(ds, generator=3), batch_size=4)
+    s2 = BatchSampler(sampler=RandomSampler(ds, generator=3), batch_size=4)
+    assert [b for b in s1] == [b for b in s2]
+
+
+def test_iterable_dataset_loader():
+    loader = DataLoader(_Stream(7), batch_size=3)
+    batches = [np.asarray(b.value) for b in loader]
+    assert [b.shape[0] for b in batches] == [3, 3, 1]
+    np.testing.assert_allclose(batches[0], [0, 1, 2])
+    with pytest.raises(Exception):
+        len(loader)
+
+
+def test_compose_chain_concat_subset_split(rng):
+    a, b = _Square(6), _Square(6)
+    comp = ComposeDataset([a, b])
+    assert len(comp) == 6 and len(comp[2]) == 4
+    chain = ChainDataset([_Stream(3), _Stream(2)])
+    assert [float(v) for v in chain] == [0, 1, 2, 0, 1]
+    cat = ConcatDataset([a, b])
+    assert len(cat) == 12 and cat[7] == a[1]
+    sub = Subset(a, [5, 0])
+    assert sub[0] == a[5] and len(sub) == 2
+    pt.seed(0)
+    p1, p2 = random_split(a, [4, 2])
+    assert len(p1) == 4 and len(p2) == 2
+    all_idx = sorted(p1.indices + p2.indices)
+    assert all_idx == list(range(6))
+
+
+def test_samplers():
+    ds = _Square(8)
+    assert list(SequenceSampler(ds)) == list(range(8))
+    rs = list(RandomSampler(ds, generator=0))
+    assert sorted(rs) == list(range(8))
+    ws = list(WeightedRandomSampler([0.0, 1.0, 0.0], 5, generator=0))
+    assert ws == [1] * 5
+    with pytest.raises(Exception):
+        WeightedRandomSampler([0.5], 2, replacement=False)
+
+
+def test_distributed_batch_sampler_shards():
+    ds = _Square(16)
+    seen = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4,
+                                    rank=rank)
+        idx = [i for b in s for i in b]
+        assert len(idx) == 4
+        seen.extend(idx)
+    assert sorted(seen) == list(range(16))
+    # shuffling differs by epoch but stays a permutation
+    s = DistributedBatchSampler(ds, batch_size=2, num_replicas=1, rank=0,
+                                shuffle=True, seed=1)
+    s.set_epoch(0)
+    e0 = [i for b in s for i in b]
+    s.set_epoch(1)
+    e1 = [i for b in s for i in b]
+    assert sorted(e0) == sorted(e1) == list(range(16)) and e0 != e1
+
+
+class _Slow(Dataset):
+    """Dataset with measurable per-item latency (host IO stand-in)."""
+
+    def __init__(self, n=8, delay=0.02):
+        self.n, self.delay = n, delay
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        time.sleep(self.delay)
+        return np.full((4,), i, np.float32)
+
+
+def _consume(loader, work=0.02):
+    t0 = time.perf_counter()
+    for batch in loader:
+        time.sleep(work)  # consumer "compute"
+        _ = np.asarray(batch.value)
+    return time.perf_counter() - t0
+
+
+def test_prefetch_overlaps_io():
+    """buffered_reader.cc property: producer IO overlaps consumer compute."""
+    ds = _Slow(n=8, delay=0.02)
+    sync_t = _consume(DataLoader(ds, batch_size=1, num_workers=0))
+    pre_t = _consume(DataLoader(ds, batch_size=1, num_workers=1,
+                                prefetch_factor=4))
+    # sync: 8*(0.02 io + 0.02 work) ≈ 0.32s; prefetch: io hides under work
+    assert pre_t < sync_t * 0.85, (pre_t, sync_t)
+
+
+def test_loader_feeds_training(rng):
+    """VERDICT item 6 'done' check: training consumes a DataLoader."""
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (32,)).astype(np.int32)
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                             pt.nn.Linear(16, 4))
+    opt = pt.optimizer.Adam(0.01, parameters=model.parameters())
+    loader = DataLoader(TensorDataset([xs, ys]), batch_size=8, shuffle=False,
+                        num_workers=1)
+    first = last = None
+    for epoch in range(3):
+        for bx, by in loader:
+            loss = pt.nn.functional.cross_entropy(model(bx), by)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if first is None:
+                first = float(loss.value)
+            last = float(loss.value)
+    assert last < first

@@ -170,6 +170,38 @@ def test_pipeline_train_batch_matches_plain(rng):
     np.testing.assert_allclose(piped_losses, plain_losses, rtol=1e-4, atol=1e-5)
 
 
+def test_recompute_through_partial(rng):
+    import functools
+
+    from paddle_tpu.distributed.fleet.utils import recompute
+
+    pt.seed(0)
+    lin = pt.nn.Linear(8, 8)
+    x = pt.to_tensor(rng.randn(4, 8).astype(np.float32))
+
+    def run_block(block, v):
+        return pt.nn.functional.relu(block(v))
+
+    loss = recompute(functools.partial(run_block, lin), x).sum()
+    loss.backward()
+    assert lin.weight.grad is not None
+    assert float(np.abs(np.asarray(lin.weight.grad.value)).sum()) > 0
+
+
+def test_optimizer_state_dict_shape_mismatch_raises(rng):
+    pt.seed(0)
+    m1 = pt.nn.Linear(4, 4)
+    o1 = pt.optimizer.Adam(0.01, parameters=m1.parameters())
+    loss = m1(pt.to_tensor(rng.randn(2, 4).astype(np.float32))).sum()
+    loss.backward()
+    o1.step()
+    sd = o1.state_dict()
+    m2 = pt.nn.Linear(8, 8)
+    o2 = pt.optimizer.Adam(0.01, parameters=m2.parameters())
+    with pytest.raises(Exception, match="shape"):
+        o2.set_state_dict(sd)
+
+
 def test_recompute_gradients_match(rng):
     from paddle_tpu.distributed.fleet.utils import recompute
 
