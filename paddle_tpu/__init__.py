@@ -48,6 +48,7 @@ from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import jit  # noqa: F401
+from . import amp  # noqa: F401
 from . import distributed  # noqa: F401
 from . import io  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401

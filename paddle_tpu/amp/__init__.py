@@ -1,0 +1,299 @@
+"""``paddle_tpu.amp`` — automatic mixed precision.
+
+Reference parity: ``python/paddle/amp/auto_cast.py`` (auto_cast/decorate),
+``python/paddle/amp/grad_scaler.py:20`` (GradScaler / AmpScaler),
+``fluid/contrib/mixed_precision/fp16_lists.py`` (white/black op lists),
+``imperative/amp_auto_cast.cc`` (per-op cast insertion).
+
+TPU-native design: bf16-first (``FLAGS_amp_dtype`` default) — the MXU's
+native compute type, no loss scaling needed; fp16 + dynamic GradScaler kept
+for parity.  The cast insertion lives in ``framework.dispatch.make_op``
+(every public op consults :mod:`core.amp_state`), so autocast works the same
+in eager taped mode and inside jit traces (the trace bakes the casts, XLA
+fuses them into the surrounding ops — zero-copy in practice).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import amp_state
+from ..core import flags as _flags
+from ..core.errors import InvalidArgumentError
+from ..framework.tensor import Parameter, Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
+           "WHITE_LIST", "BLACK_LIST"]
+
+# fp16_lists.py white_list mapped to this framework's op names
+WHITE_LIST = frozenset({
+    "matmul", "bmm", "mm", "mv", "addmm", "linear", "einsum",
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose",
+})
+
+# fp16_lists.py black_list: numerically sensitive → force fp32
+BLACK_LIST = frozenset({
+    "exp", "square", "log", "log2", "log10", "log1p", "logsumexp",
+    "mean", "sum", "prod", "cumsum", "softmax", "log_softmax",
+    "cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+    "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "sigmoid_cross_entropy_with_logits", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "kl_div", "cosine_similarity", "pow", "rsqrt",
+    "norm", "p_norm", "var", "std",
+})
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list: Optional[Sequence[str]] = None,
+              custom_black_list: Optional[Sequence[str]] = None,
+              level: str = "O1", dtype: Optional[str] = None):
+    """``paddle.amp.auto_cast`` parity (amp/auto_cast.py)."""
+    if level not in ("O0", "O1", "O2"):
+        raise InvalidArgumentError("auto_cast level must be O0/O1/O2, got %r" % level)
+    if dtype is None:
+        dtype = _flags.get_flags(["FLAGS_amp_dtype"])["FLAGS_amp_dtype"]
+    if dtype not in ("bfloat16", "float16"):
+        raise InvalidArgumentError(
+            "auto_cast dtype must be bfloat16/float16, got %r" % dtype)
+    white = set(WHITE_LIST) | set(custom_white_list or ())
+    if level == "O2":
+        # pure-mixed: everything not black runs in amp dtype; implemented as
+        # "inputs already cast by decorate()" + white casts; black still fp32
+        white |= {"add", "subtract", "multiply", "divide"}
+    black = (set(BLACK_LIST) | set(custom_black_list or ())) - set(
+        custom_white_list or ())
+    white -= set(custom_black_list or ())
+    enabled = enable and level != "O0"
+    prev = amp_state.push(amp_state.AmpAttrs(
+        enabled=enabled, dtype=dtype, white=white, black=black, level=level))
+    try:
+        yield
+    finally:
+        amp_state.pop(prev)
+
+
+amp_guard = auto_cast  # fluid.dygraph.amp.amp_guard alias
+
+
+def _cast_model_keep_norms(model, dtype) -> None:
+    """O2 cast that keeps normalization layers in fp32.
+
+    mixed_precision/fp16_utils.py keep_fp32_weight parity: BatchNorm /
+    LayerNorm / GroupNorm / InstanceNorm scales, biases and running stats
+    stay fp32 (fp16 range breaks variance accumulation).
+    """
+    for layer in model.sublayers(include_self=True):
+        if "Norm" in type(layer).__name__:
+            continue
+        for p in layer._parameters.values():
+            if p is not None and jnp.issubdtype(p.value.dtype, jnp.floating):
+                p._replace_value(p.value.astype(dtype))
+        for b in layer._buffers.values():
+            if b is not None and jnp.issubdtype(b.value.dtype, jnp.floating):
+                b._replace_value(b.value.astype(dtype))
+        layer._dtype = dtype
+
+
+def _install_save_dtype(model, save_dtype) -> None:
+    """decorate(save_dtype=...) parity: checkpoints export in save_dtype.
+
+    Shadows the instance's ``state_dict`` with a casting copy (paddle wraps
+    the layer the same way); ``set_state_dict`` resolves targets through the
+    base-class walk, so loading is unaffected.
+    """
+    from ..core.dtype import convert_dtype
+
+    sd_dtype = convert_dtype(save_dtype)
+    orig = model.state_dict
+
+    def casted_state_dict(*args, **kwargs):
+        import collections
+
+        d = orig(*args, **kwargs)
+        out = collections.OrderedDict()
+        for k, v in d.items():
+            if jnp.issubdtype(v.value.dtype, jnp.floating) \
+                    and v.value.dtype != jnp.dtype(sd_dtype):
+                out[k] = Tensor(v.value.astype(sd_dtype), stop_gradient=True,
+                                name=v.name)
+            else:
+                out[k] = v
+        return out
+
+    model.state_dict = casted_state_dict
+
+
+def decorate(models, optimizers=None, level: str = "O2",
+             dtype: Optional[str] = None, master_weight: Optional[bool] = None,
+             save_dtype: Optional[str] = None):
+    """``paddle.amp.decorate`` parity: cast model params for pure-fp16/bf16.
+
+    O2: parameters are cast to the amp dtype; optimizers get master weights
+    (fp32 shadow copies) unless ``master_weight=False``.
+    """
+    if dtype is None:
+        dtype = _flags.get_flags(["FLAGS_amp_dtype"])["FLAGS_amp_dtype"]
+    if level == "O1":
+        return (models, optimizers) if optimizers is not None else models
+    if level != "O2":
+        raise InvalidArgumentError("decorate level must be O1/O2, got %r" % level)
+    models_list = models if isinstance(models, (list, tuple)) else [models]
+    for m in models_list:
+        _cast_model_keep_norms(m, dtype)
+        if save_dtype is not None:
+            _install_save_dtype(m, save_dtype)
+    if optimizers is not None:
+        opt_list = (optimizers if isinstance(optimizers, (list, tuple))
+                    else [optimizers])
+        for o in opt_list:
+            if master_weight is not False:
+                o._multi_precision = True
+        return models, optimizers
+    return models
+
+
+class GradScaler:
+    """``paddle.amp.GradScaler`` parity (amp/grad_scaler.py:20).
+
+    Dynamic loss scaling for fp16; with bf16 the scaler can stay enabled but
+    scaling is typically unnecessary (init_loss_scaling=1 recommended).
+    """
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 1,
+                 use_dynamic_loss_scaling: bool = True):
+        if incr_ratio <= 1.0:
+            raise InvalidArgumentError("incr_ratio must be > 1")
+        if not (0.0 < decr_ratio < 1.0):
+            raise InvalidArgumentError("decr_ratio must be in (0, 1)")
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._incr_count = 0
+        self._decr_count = 0
+        self._found_inf = False
+        self._unscaled = False
+        self._stepped = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    is_enabled = is_enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._use_dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, v: float) -> None:
+        self._scale = float(v)
+
+    def scale(self, var):
+        """Multiply the loss by the live scale (taped, so backward scales)."""
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def _iter_grads(self, optimizer):
+        for p in optimizer._parameter_list or []:
+            if p.stop_gradient or p._grad_val is None:
+                continue
+            yield p
+
+    def unscale_(self, optimizer) -> None:
+        """grad_scaler.py _unscale: divide grads, detect nonfinite.
+
+        One device→host sync total: per-grad finiteness reductions stay on
+        device and combine before the single bool() readback.
+        """
+        if not self._enable or self._unscaled:
+            return
+        inv = 1.0 / self._scale
+        finite = jnp.asarray(True)
+        for p in self._iter_grads(optimizer):
+            g = p._grad_val * inv
+            p._grad_val = g
+            finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+        self._found_inf = not bool(finite)
+        self._unscaled = True
+
+    def step(self, optimizer) -> None:
+        """Skip the update when nonfinite gradients were found."""
+        if not self._enable:
+            optimizer.step()
+            return
+        if self._stepped:
+            raise RuntimeError(
+                "GradScaler.step() has already been called since the last "
+                "update(); call scaler.update() after each step")
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._stepped = True
+
+    def update(self) -> None:
+        """Dynamic loss-scale adjustment (update_loss_scaling op parity)."""
+        self._stepped = False
+        if not (self._enable and self._use_dynamic):
+            self._unscaled = False
+            return
+        if self._found_inf:
+            self._decr_count += 1
+            self._incr_count = 0
+            if self._decr_count >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._decr_count = 0
+        else:
+            self._incr_count += 1
+            self._decr_count = 0
+            if self._incr_count >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._incr_count = 0
+        self._found_inf = False
+        self._unscaled = False
+
+    def minimize(self, optimizer, scaled_loss) -> None:
+        """AmpScaler.minimize parity: backward already done by caller on the
+        scaled loss; unscale → conditional step → update."""
+        self.step(optimizer)
+        self.update()
+
+    def state_dict(self) -> dict:
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "incr_count": self._incr_count,
+            "decr_count": self._decr_count,
+            "use_dynamic_loss_scaling": self._use_dynamic,
+        }
+
+    def load_state_dict(self, sd: dict) -> None:
+        self._scale = float(sd.get("scale", self._scale))
+        self._incr_ratio = float(sd.get("incr_ratio", self._incr_ratio))
+        self._decr_ratio = float(sd.get("decr_ratio", self._decr_ratio))
+        self._incr_every_n_steps = int(sd.get(
+            "incr_every_n_steps", self._incr_every_n_steps))
+        self._decr_every_n_nan_or_inf = int(sd.get(
+            "decr_every_n_nan_or_inf", self._decr_every_n_nan_or_inf))
+        self._incr_count = int(sd.get("incr_count", 0))
+        self._decr_count = int(sd.get("decr_count", 0))
+        self._use_dynamic = bool(sd.get(
+            "use_dynamic_loss_scaling", self._use_dynamic))
+
+
+AmpScaler = GradScaler  # fluid.dygraph.AmpScaler alias

@@ -1,0 +1,47 @@
+"""Thread-local autocast state, read by the op dispatcher.
+
+Reference parity: the C++ global ``AmpOperators`` + tracer amp level
+(``imperative/amp_auto_cast.cc:`` GetCurrentTracer->AMPLevel, allow/block
+op sets).  Lives in ``core`` so ``framework.dispatch`` can consult it
+without importing the user-facing ``paddle_tpu.amp`` package (no cycle).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+_tls = threading.local()
+
+
+class AmpAttrs:
+    __slots__ = ("enabled", "dtype", "white", "black", "level")
+
+    def __init__(self, enabled=False, dtype="bfloat16",
+                 white: Optional[Set[str]] = None,
+                 black: Optional[Set[str]] = None, level: str = "O1"):
+        self.enabled = enabled
+        self.dtype = dtype
+        self.white = white or set()
+        self.black = black or set()
+        self.level = level
+
+
+_DISABLED = AmpAttrs()
+
+
+def current() -> AmpAttrs:
+    return getattr(_tls, "state", _DISABLED)
+
+
+def push(state: AmpAttrs) -> AmpAttrs:
+    prev = current()
+    _tls.state = state
+    return prev
+
+
+def pop(prev: AmpAttrs) -> None:
+    _tls.state = prev
+
+
+def amp_enabled() -> bool:
+    return current().enabled

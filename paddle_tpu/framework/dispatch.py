@@ -24,10 +24,44 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import amp_state
 from . import engine
 from .tensor import Tensor
 
 _tree = jax.tree_util
+
+
+def _amp_apply(fn: Callable, op_name: str) -> Callable:
+    """Autocast shim (imperative/amp_auto_cast.cc CastedOp analog).
+
+    White-listed ops run in the autocast dtype (MXU-friendly bf16/fp16),
+    black-listed ops are forced to float32; everything else runs in the
+    dtype it was given.  The cast sits INSIDE the differentiated function,
+    so vjp transposes it and gradients return in the caller's dtype.
+    """
+    st = amp_state.current()
+    if not st.enabled:
+        return fn
+    if op_name in st.white:
+        tgt = jnp.bfloat16 if st.dtype == "bfloat16" else jnp.float16
+    elif op_name in st.black:
+        tgt = jnp.float32
+    else:
+        return fn
+
+    def _cast(v):
+        if isinstance(v, (jax.Array, np.ndarray)) \
+                and jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != tgt:
+            return jnp.asarray(v).astype(tgt)
+        return v
+
+    @functools.wraps(fn)
+    def casted(*a, **k):
+        a = _tree.tree_map(_cast, a)
+        k = _tree.tree_map(_cast, k)
+        return fn(*a, **k)
+
+    return casted
 
 
 def _is_leaf(x) -> bool:
@@ -76,6 +110,7 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
 
     @functools.wraps(fn)
     def op(*args, **kwargs):
+        run = (_amp_apply(fn, op_name) if amp_state.amp_enabled() else fn)
         leaves, treedef = _tree.tree_flatten((args, kwargs), is_leaf=_is_leaf)
         t_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
         if not t_pos:
@@ -83,9 +118,9 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
             # progress (creation/random ops under jit) => functional
             # passthrough so traced functions never return wrapped values.
             if any(isinstance(l, jax.Array) for l in leaves) or not _trace_clean():
-                return fn(*args, **kwargs)
+                return run(*args, **kwargs)
             # Pure python inputs (creation/random ops): wrap for eager users.
-            return _wrap_outputs(fn(*args, **kwargs))
+            return _wrap_outputs(run(*args, **kwargs))
 
         vals = list(leaves)
         for i in t_pos:
@@ -106,7 +141,7 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
             ]
         if not diff_pos:
             a, k = _tree.tree_unflatten(treedef, vals)
-            return _wrap_outputs(fn(*a, **k))
+            return _wrap_outputs(run(*a, **k))
 
         diff_vals = [vals[i] for i in diff_pos]
 
@@ -115,7 +150,7 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
             for i, v in zip(diff_pos, dv):
                 vv[i] = v
             a, k = _tree.tree_unflatten(treedef, vv)
-            return fn(*a, **k)
+            return run(*a, **k)
 
         out, vjp_fn = jax.vjp(pure, *diff_vals)
         out_leaves, out_treedef = _tree.tree_flatten(out)

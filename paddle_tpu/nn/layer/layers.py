@@ -281,7 +281,9 @@ class Layer:
 
     def set_state_dict(self, state_dict, use_structured_name: bool = True):
         missing, unexpected = [], []
-        own = self.state_dict()
+        # base-class walk on purpose: instance-level state_dict shadows
+        # (amp.decorate save_dtype) must not redirect load targets to copies
+        own = Layer.state_dict(self)
         matched = set()
         for key, value in state_dict.items():
             if key not in own:
