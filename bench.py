@@ -40,9 +40,13 @@ def main():
     model = TransformerLM(**cfg, dropout=0.0)
     criterion = TransformerLMCriterion(shift_labels=False)
     opt = pt.optimizer.AdamW(1e-4, parameters=model.parameters())
+    # bf16 mixed precision: params/activations in bf16 (MXU native), fp32
+    # master weights in the optimizer, loss math fp32 via the amp black list
+    model, opt = pt.amp.decorate(model, opt, level="O2", dtype="bfloat16")
 
     def loss_fn(m, ids, labels):
-        return criterion(m(ids), labels)
+        with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return criterion(m(ids), labels)
 
     step = TrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)

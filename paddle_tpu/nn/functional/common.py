@@ -191,10 +191,31 @@ def bilinear(x1, x2, weight, bias=None):
 def scaled_dot_product_attention(
     query, key, value, attn_mask=None, dropout_p: float = 0.0, is_causal: bool = False, training: bool = True
 ):
-    """Batched attention: [B, H, L, D] layout. Fused by XLA; the pallas flash
-    kernel (paddle_tpu.ops.flash_attention) is used by MultiHeadAttention when
-    shapes allow."""
+    """Batched attention: [B, H, L, D] layout.
+
+    Routed to the pallas flash kernel (``paddle_tpu.ops.flash_attention``)
+    when backend/shape allow — including transparently recognizing a
+    materialized 2-D causal additive mask so paddle-style callers get the
+    kernel's causal fast path.  Falls back to the XLA composition (which XLA
+    still fuses, but with the [L, L] scores in HBM).
+    """
+    from ...ops.flash_attention import (
+        detect_causal_additive_mask,
+        flash_attention,
+        flash_attention_supported,
+    )
+
     d = query.shape[-1]
+    drop_p = dropout_p if training else 0.0
+    if flash_attention_supported(query.shape, query.dtype, drop_p) \
+            and flash_attention_supported(key.shape, key.dtype, drop_p) \
+            and tuple(key.shape) == tuple(value.shape) \
+            and (attn_mask is None or attn_mask.dtype != jnp.bool_):
+        mask = attn_mask
+        causal = is_causal
+        if not causal and detect_causal_additive_mask(mask, query.shape[-2]):
+            causal, mask = True, None
+        return flash_attention(query, key, value, bias=mask, causal=causal)
     scores = jnp.einsum("...qd,...kd->...qk", query, key) / jnp.sqrt(d).astype(query.dtype)
     if is_causal:
         q_len, k_len = scores.shape[-2], scores.shape[-1]

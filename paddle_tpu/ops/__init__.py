@@ -1,0 +1,12 @@
+"""``paddle_tpu.ops`` — fused TPU kernels (pallas).
+
+Reference parity: the reference's hand-fused CUDA ops —
+``operators/fused/fused_attention_op.cu``, ``fused_gate_attention_op`` and the
+``incubate.nn.FusedMultiHeadAttention`` surface.  Here the hot ops are pallas
+TPU kernels (SURVEY §7 MFU target): flash attention keeps the [L, L] score
+matrix out of HBM entirely, which is the bandwidth win that decides MFU at
+long sequence length.
+"""
+from .flash_attention import flash_attention, flash_attention_supported  # noqa: F401
+
+__all__ = ["flash_attention", "flash_attention_supported"]

@@ -1,0 +1,126 @@
+"""Flash attention: tiled online-softmax attention for TPU.
+
+Reference parity: ``paddle.incubate.nn.functional.fused_multi_head_attention``
+/ ``operators/fused/fused_attention_op.cu`` (one fused kernel instead of
+matmul→softmax→matmul round-tripping scores through HBM).
+
+TPU-native design: the pallas flash-attention kernel
+(``jax.experimental.pallas.ops.tpu.flash_attention``) streams K/V blocks
+through VMEM with an online softmax, so HBM traffic is O(L·D) instead of
+O(L²) — the canonical MXU/VMEM blocking from the pallas guide.  Forward and
+backward are both pallas kernels (custom_vjp built in).  ``flash_attention``
+here adds the shape/backend gate and an XLA-composition fallback so the same
+call works on CPU test meshes and odd shapes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["flash_attention", "flash_attention_supported"]
+
+_SUPPORTED_DTYPES = (jnp.float32, jnp.bfloat16)
+
+# Measured crossover on v5e (bf16, head_dim 64, fwd+bwd, tokens held
+# constant): XLA's fused composition wins below ~4k sequence (5.2ms vs 6.7ms
+# at L=512·B=16; 9.2 vs 12.1 at L=2048·B=4), the pallas kernel wins above
+# (22.2 vs 19.4 at L=4096·B=2) where the O(L²) HBM scores dominate.
+FLASH_MIN_SEQ = 4096
+
+
+def flash_attention_supported(q_shape, dtype, dropout_p: float = 0.0) -> bool:
+    """Gate: pallas kernel needs TPU, 4-D [B,H,L,D], MXU-tileable L and D,
+    no attention-weight dropout (the kernel never materializes weights),
+    and a sequence long enough that tiling beats XLA's fused composition."""
+    if jax.default_backend() != "tpu":
+        return False
+    if dropout_p > 0.0:
+        return False
+    if len(q_shape) != 4:
+        return False
+    b, h, l, d = q_shape
+    if l % 128 != 0 or l < FLASH_MIN_SEQ:
+        return False
+    if d not in (64, 128, 256):
+        return False
+    return jnp.dtype(dtype) in _SUPPORTED_DTYPES
+
+
+def _reference_attention(q, k, v, bias, causal, sm_scale):
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(
+        sm_scale, q.dtype)
+    if causal:
+        ql, kl = scores.shape[-2], scores.shape[-1]
+        allow = jnp.tril(jnp.ones((ql, kl), dtype=bool))
+        scores = jnp.where(allow, scores, jnp.finfo(scores.dtype).min)
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", weights, v)
+
+
+def flash_attention(q, k, v, bias=None, causal: bool = False,
+                    sm_scale: Optional[float] = None):
+    """[B, H, L, D] attention; pallas kernel on TPU, XLA fallback elsewhere.
+
+    ``bias``: additive attention bias broadcastable to [B, H, Lq, Lk]
+    (the paddle additive attn_mask convention).
+    """
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    if not flash_attention_supported(q.shape, q.dtype):
+        return _reference_attention(q, k, v, bias, causal, sm_scale)
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        flash_attention as _pallas_flash,
+    )
+
+    ab = None
+    if bias is not None:
+        b_, h_, lq, lk = q.shape[0], q.shape[1], q.shape[2], k.shape[2]
+        ab = jnp.broadcast_to(bias.astype(q.dtype), (b_, h_, lq, lk))
+    return _pallas_flash(q, k, v, ab=ab, causal=causal, sm_scale=float(sm_scale))
+
+
+# id(mask) → (mask, verdict); masks are immutable jax arrays built once per
+# model / per trace, so identity caching removes the repeated device→host
+# readback.  The cached entry holds the mask itself so its id cannot be
+# recycled by a later allocation (id-only keys are unsound).  Cap is small:
+# a training process has O(1) distinct masks.
+_detect_cache: dict = {}
+_DETECT_CACHE_MAX = 16
+
+
+def detect_causal_additive_mask(mask, seq_len: Optional[int] = None) -> bool:
+    """True when ``mask`` is a concrete 2-D additive causal mask (0 on/below
+    the diagonal, strictly large-negative above) matching ``seq_len`` — lets
+    the kernel's causal fast path replace a materialized mask without
+    changing the paddle API.  This also covers jitted callers whose mask is
+    built from static shapes (constant-folded to a concrete array inside the
+    trace, e.g. TransformerLM._causal_mask); masks that are runtime inputs
+    arrive as tracers and safely skip detection."""
+    if mask is None or isinstance(mask, jax.core.Tracer):
+        return False
+    if getattr(mask, "ndim", 0) != 2 or mask.shape[-1] != mask.shape[-2]:
+        return False
+    l = mask.shape[0]
+    if l < 2:  # 1x1 has an empty upper triangle: vacuously "causal"
+        return False
+    if seq_len is not None and l != seq_len:
+        return False  # broadcast-shaped masks keep their loud-error path
+    key = id(mask)
+    hit = _detect_cache.get(key)
+    if hit is not None and hit[0] is mask:
+        return hit[1]
+    m = np.asarray(mask)
+    lower_ok = np.all(m[np.tril_indices(l)] == 0)
+    upper = m[np.triu_indices(l, k=1)]
+    upper_ok = np.all(upper <= np.finfo(np.float32).min / 2)
+    verdict = bool(lower_ok and upper_ok)
+    if len(_detect_cache) >= _DETECT_CACHE_MAX:
+        _detect_cache.clear()
+    _detect_cache[key] = (mask, verdict)
+    return verdict
