@@ -13,9 +13,16 @@ from .mp_layers import (  # noqa: F401
 )
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .sequence_parallel import (  # noqa: F401
+    gather_sequence,
+    ring_attention,
+    split_sequence,
+    ulysses_attention,
+)
 
 __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer",
-    "PipelineParallel",
+    "PipelineParallel", "ring_attention", "ulysses_attention",
+    "split_sequence", "gather_sequence",
 ]

@@ -1,0 +1,150 @@
+"""Sequence parallelism: ring attention + Ulysses head↔seq resharding.
+
+NEW capability (SURVEY §5.7): the reference has **no** sequence/context
+parallelism — its longest-context levers are recompute/offload.  The rebuild
+requirement is SP as a first-class parallel axis (``sep`` in the hybrid
+topology), TPU-native:
+
+- **Ring attention** (``ring_attention``): Q/K/V sharded on the sequence
+  axis; K/V blocks rotate around the ring with ``lax.ppermute`` (ICI
+  neighbor exchange) while each device accumulates its query block's
+  attention with an online softmax — blockwise/flash-style, so no device
+  ever holds the full [L, L] scores or the full K/V.  Communication is
+  overlapped with the block matmuls by XLA's scheduler; per-step traffic is
+  the K/V block, the canonical ring-attention cost model.
+- **Ulysses** (``ulysses_attention``): ``lax.all_to_all`` reshards
+  [B, L/n, H, D] → [B, L, H/n, D] so full-sequence attention runs locally
+  per head group, then reshards back.  Cheaper than the ring when H ≥ n and
+  the alltoall rides ICI.
+
+Both are pure SPMD functions usable inside ``shard_map`` over the ``sep``
+axis and compose with dp/mp via the hybrid mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ...core.errors import InvalidArgumentError
+
+__all__ = ["ring_attention", "ulysses_attention", "split_sequence",
+           "gather_sequence"]
+
+
+def split_sequence(x, axis_name: str, seq_axis: int = 1):
+    """Slice this rank's sequence block out of a replicated tensor (the
+    scatter half of the reference's missing SP; inside shard_map)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    l = x.shape[seq_axis]
+    if l % n != 0:
+        raise InvalidArgumentError(
+            "sequence length %d not divisible by sep degree %d" % (l, n))
+    k = l // n
+    return lax.dynamic_slice_in_dim(x, idx * k, k, axis=seq_axis)
+
+
+def gather_sequence(x, axis_name: str, seq_axis: int = 1):
+    """All-gather sequence blocks back to the full sequence (inside shard_map)."""
+    return lax.all_gather(x, axis_name, axis=seq_axis, tiled=True)
+
+
+def _block_attn(q, k, v, scale, bias):
+    """One [Lq, Lk] block: returns (numerator, denominator, running max)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = s.max(axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v), p.sum(axis=-1, keepdims=True), m
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Blockwise ring attention over the ``axis_name`` mesh axis.
+
+    Call inside ``shard_map``: ``q``/``k``/``v`` are this device's sequence
+    block, [B, H, Lblk, D].  Equivalent to full attention over the gathered
+    sequence (causal uses *global* positions).  The K/V pair rotates
+    ring-wise; the online-softmax state (num, den, max) is rescaled each
+    step exactly as in flash attention's outer loop.
+    """
+    if q.ndim != 4:
+        raise InvalidArgumentError(
+            "ring_attention expects [B, H, Lblk, D], got %s" % (q.shape,))
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    d = q.shape[-1]
+    scale = jnp.asarray(sm_scale if sm_scale is not None else 1.0 / np.sqrt(d),
+                        q.dtype)
+    lq = q.shape[2]
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min / 2, q.dtype)
+    tril = jnp.tril(jnp.ones((lq, lq), dtype=bool))
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # online-softmax accumulators (fp32 for stability over n blocks)
+    o = jnp.zeros(q.shape, jnp.float32)
+    den = jnp.zeros(q.shape[:3] + (1,), jnp.float32)
+    mx = jnp.full(q.shape[:3] + (1,), -jnp.inf, jnp.float32)
+
+    kb, vb = k, v
+    for i in range(n):
+        src = (my - i) % n  # which rank's K/V block we hold this step
+        if causal:
+            # global blocks: src > my → fully masked; src == my → tril;
+            # src < my → unmasked.  src/my are traced, so select via where.
+            block_bias = jnp.where(
+                src > my, neg,
+                jnp.where(src == my, jnp.where(tril, 0, neg).astype(q.dtype),
+                          jnp.zeros((), q.dtype)))
+            block_bias = jnp.broadcast_to(block_bias, (lq, kb.shape[2]))
+        else:
+            block_bias = None
+        num_i, den_i, m_i = _block_attn(q, kb, vb, scale, block_bias)
+        m_i = m_i.astype(jnp.float32)
+        new_m = jnp.maximum(mx, m_i)
+        corr = jnp.exp(mx - new_m)
+        corr_i = jnp.exp(m_i - new_m)
+        o = o * corr + num_i.astype(jnp.float32) * corr_i
+        den = den * corr + den_i.astype(jnp.float32) * corr_i
+        mx = new_m
+        if i + 1 < n:
+            kb = lax.ppermute(kb, axis_name, perm)
+            vb = lax.ppermute(vb, axis_name, perm)
+    return (o / jnp.maximum(den, 1e-30)).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
+                      sm_scale: Optional[float] = None, attn_fn=None):
+    """Ulysses SP: alltoall seq→heads, local full attention, alltoall back.
+
+    Inside ``shard_map``: inputs [B, H, Lblk, D] sequence-sharded; requires
+    H divisible by the axis size.  After the first ``lax.all_to_all`` each
+    device holds H/n heads over the FULL sequence; the attention impl
+    (``attn_fn(q, k, v, causal=..., sm_scale=...)``, default the
+    pallas-routed flash attention) runs unchanged; the second alltoall
+    restores sequence sharding.
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % n != 0:
+        raise InvalidArgumentError(
+            "ulysses needs heads %% sep == 0, got H=%d n=%d" % (h, n))
+
+    def seq2head(x):  # [B, H, Lblk, D] → [B, H/n, L, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def head2seq(x):  # [B, H/n, L, D] → [B, H, Lblk, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qf, kf, vf = seq2head(q), seq2head(k), seq2head(v)
+    if attn_fn is None:
+        from ...ops.flash_attention import flash_attention as attn_fn
+    out = attn_fn(qf, kf, vf, causal=causal, sm_scale=sm_scale)
+    return head2seq(out)

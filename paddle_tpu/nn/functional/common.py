@@ -210,6 +210,7 @@ def scaled_dot_product_attention(
     if flash_attention_supported(query.shape, query.dtype, drop_p) \
             and flash_attention_supported(key.shape, key.dtype, drop_p) \
             and tuple(key.shape) == tuple(value.shape) \
+            and tuple(query.shape[:2]) == tuple(key.shape[:2]) \
             and (attn_mask is None or attn_mask.dtype != jnp.bool_):
         mask = attn_mask
         causal = is_causal

@@ -85,13 +85,13 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     return _pallas_flash(q, k, v, ab=ab, causal=causal, sm_scale=float(sm_scale))
 
 
-# id(mask) → (mask, verdict); masks are immutable jax arrays built once per
-# model / per trace, so identity caching removes the repeated device→host
-# readback.  The cached entry holds the mask itself so its id cannot be
-# recycled by a later allocation (id-only keys are unsound).  Cap is small:
-# a training process has O(1) distinct masks.
+# id(mask) → (weakref(mask), verdict); masks are immutable jax arrays built
+# once per model / per trace, so identity caching removes the repeated
+# device→host readback.  Weakrefs keep the cache from pinning [L, L] masks
+# after their models are freed, and a dead ref also invalidates the entry if
+# a new allocation recycles the id (id-only keys are unsound).
 _detect_cache: dict = {}
-_DETECT_CACHE_MAX = 16
+_DETECT_CACHE_MAX = 64
 
 
 def detect_causal_additive_mask(mask, seq_len: Optional[int] = None) -> bool:
@@ -111,16 +111,27 @@ def detect_causal_additive_mask(mask, seq_len: Optional[int] = None) -> bool:
         return False
     if seq_len is not None and l != seq_len:
         return False  # broadcast-shaped masks keep their loud-error path
+    import weakref
+
     key = id(mask)
     hit = _detect_cache.get(key)
-    if hit is not None and hit[0] is mask:
+    if hit is not None and hit[0]() is mask:
         return hit[1]
     m = np.asarray(mask)
-    lower_ok = np.all(m[np.tril_indices(l)] == 0)
-    upper = m[np.triu_indices(l, k=1)]
-    upper_ok = np.all(upper <= np.finfo(np.float32).min / 2)
+    allow = np.tril(np.ones((l, l), dtype=bool))  # one L*L bool, no indices
+    lower_ok = np.all(np.where(allow, m, 0) == 0)
+    upper_ok = np.all(np.where(allow, np.finfo(np.float32).min, m)
+                      <= np.finfo(np.float32).min / 2)
     verdict = bool(lower_ok and upper_ok)
+    try:
+        ref = weakref.ref(mask)
+    except TypeError:  # pragma: no cover - non-weakrefable array type
+        return verdict
     if len(_detect_cache) >= _DETECT_CACHE_MAX:
-        _detect_cache.clear()
-    _detect_cache[key] = (mask, verdict)
+        dead = [k for k, v in _detect_cache.items() if v[0]() is None]
+        for k in dead:
+            del _detect_cache[k]
+        if len(_detect_cache) >= _DETECT_CACHE_MAX:
+            _detect_cache.clear()
+    _detect_cache[key] = (ref, verdict)
     return verdict
