@@ -19,10 +19,16 @@ from .sequence_parallel import (  # noqa: F401
     split_sequence,
     ulysses_attention,
 )
+from .sharding_parallel import (  # noqa: F401
+    GroupShardedParallel,
+    ShardingOptimizerStage2,
+    group_sharded_parallel,
+)
 
 __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer",
     "PipelineParallel", "ring_attention", "ulysses_attention",
-    "split_sequence", "gather_sequence",
+    "split_sequence", "gather_sequence", "ShardingOptimizerStage2",
+    "GroupShardedParallel", "group_sharded_parallel",
 ]
