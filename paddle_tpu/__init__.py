@@ -50,7 +50,11 @@ from . import regularizer  # noqa: F401
 from . import jit  # noqa: F401
 from . import amp  # noqa: F401
 from . import distributed  # noqa: F401
+from . import hapi  # noqa: F401
 from . import io  # noqa: F401
+from . import metric  # noqa: F401
+from .hapi import Model  # noqa: F401
+from .hapi import callbacks  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401
 from .framework.tensor import Parameter  # noqa: F401

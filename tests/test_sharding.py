@@ -65,17 +65,23 @@ def test_stage2_state_sharded_and_parity(rng):
 
 
 def test_stage2_under_jit_trainstep(rng):
+    from jax.sharding import PartitionSpec
+
     from paddle_tpu.jit import TrainStep
 
     dist.init_parallel_env()
     model, xs, ys = _model_and_data(rng)
     opt = ShardingOptimizerStage2(
         pt.optimizer.Adam(0.01, parameters=model.parameters()))
+    # the wrapper itself goes to TrainStep (delegation via __getattr__)
     step = TrainStep(model, lambda m, x, y: pt.nn.functional.cross_entropy(
-        m(x), y), opt._inner, donate=False)
+        m(x), y), opt, donate=False)
     l0 = float(step(pt.to_tensor(xs), pt.to_tensor(ys)))
     l1 = float(step(pt.to_tensor(xs), pt.to_tensor(ys)))
     assert l1 < l0
+    # placement survives the functional update path
+    w0 = model[0].weight
+    assert opt.state_sharding_of(w0.name)["moment1"] == PartitionSpec("dp")
 
 
 def test_stage3_params_sharded_and_parity(rng):
