@@ -53,6 +53,7 @@ from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
 from . import io  # noqa: F401
 from . import metric  # noqa: F401
+from . import vision  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401

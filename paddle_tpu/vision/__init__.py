@@ -1,0 +1,9 @@
+"""``paddle_tpu.vision`` — models, transforms, datasets.
+
+Reference parity: ``python/paddle/vision/``.
+"""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+
+__all__ = ["models", "transforms", "datasets"]

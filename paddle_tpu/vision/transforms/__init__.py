@@ -1,0 +1,271 @@
+"""``paddle_tpu.vision.transforms`` — image preprocessing.
+
+Reference parity: ``python/paddle/vision/transforms/transforms.py`` (class
+transforms) + ``functional.py``.  Operates on numpy HWC uint8/float arrays
+or PIL Images (host-side preprocessing feeding the DataLoader; device work
+starts at ToTensor).
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ...core.errors import InvalidArgumentError
+from ...framework.tensor import Tensor
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
+    "BrightnessTransform", "to_tensor", "normalize", "resize", "center_crop",
+    "crop", "hflip", "vflip", "pad",
+]
+
+
+def _to_numpy(img) -> np.ndarray:
+    try:
+        from PIL import Image
+
+        if isinstance(img, Image.Image):
+            return np.asarray(img)
+    except ImportError:  # pragma: no cover
+        pass
+    if isinstance(img, Tensor):
+        return np.asarray(img.value)
+    return np.asarray(img)
+
+
+# -- functional (vision/transforms/functional.py parity) --------------------
+
+def to_tensor(pic, data_format: str = "CHW"):
+    """HWC uint8 [0,255] → CHW float32 [0,1] Tensor."""
+    arr = _to_numpy(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr, stop_gradient=True)
+
+
+def normalize(img, mean, std, data_format: str = "CHW", to_rgb: bool = False):
+    arr = img.numpy() if isinstance(img, Tensor) else _to_numpy(img).astype(np.float32)
+    if to_rgb:  # reference semantics: input is BGR, reverse channels first
+        arr = arr[::-1] if data_format == "CHW" else arr[..., ::-1]
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    shape = ([-1, 1, 1] if data_format == "CHW" else [1, 1, -1])
+    out = (arr - mean.reshape(shape)) / std.reshape(shape)
+    return Tensor(out, stop_gradient=True) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation: str = "bilinear"):
+    arr = _to_numpy(img)
+    from PIL import Image
+
+    modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+             "bicubic": Image.BICUBIC, "lanczos": Image.LANCZOS}
+    if interpolation not in modes:
+        raise InvalidArgumentError("unknown interpolation %r" % interpolation)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if w <= h:
+            ow, oh = size, int(size * h / w)
+        else:
+            oh, ow = size, int(size * w / h)
+    else:
+        oh, ow = size
+    squeeze = arr.ndim == 3 and arr.shape[2] == 1
+    pil = Image.fromarray(arr.squeeze(-1) if squeeze else arr)
+    out = np.asarray(pil.resize((ow, oh), modes[interpolation]))
+    if squeeze:
+        out = out[:, :, None]
+    return out
+
+
+def crop(img, top: int, left: int, height: int, width: int):
+    arr = _to_numpy(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _to_numpy(img)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(arr, top, left, th, tw)
+
+
+def hflip(img):
+    return _to_numpy(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    return _to_numpy(img)[::-1].copy()
+
+
+def pad(img, padding, fill=0, padding_mode: str = "constant"):
+    arr = _to_numpy(img)
+    if isinstance(padding, int):
+        padding = (padding,) * 4
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    left, top, right, bottom = padding
+    widths = [(top, bottom), (left, right)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(arr, widths, mode="constant", constant_values=fill)
+    return np.pad(arr, widths, mode=padding_mode)
+
+
+# -- class transforms (vision/transforms/transforms.py parity) --------------
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    """transforms.py BaseTransform (simplified single-input form)."""
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format: str = "CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format: str = "CHW",
+                 to_rgb: bool = False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+        self.to_rgb = to_rgb
+
+    def _apply_image(self, img):
+        return normalize(img, self.mean, self.std, self.data_format,
+                         self.to_rgb)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation: str = "bilinear", keys=None):
+        self.size = size
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed: bool = False,
+                 fill=0, padding_mode: str = "constant", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if self.padding is not None:
+            arr = pad(arr, self.padding, self.fill, self.padding_mode)
+        th, tw = self.size
+        h, w = arr.shape[:2]
+        if self.pad_if_needed and (h < th or w < tw):
+            arr = pad(arr, (max(0, tw - w), max(0, th - h)), self.fill,
+                      self.padding_mode)
+            h, w = arr.shape[:2]
+        if h == th and w == tw:
+            return arr
+        top = random.randint(0, h - th)
+        left = random.randint(0, w - tw)
+        return crop(arr, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return hflip(img)
+        return _to_numpy(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return vflip(img)
+        return _to_numpy(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = tuple(order)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr.transpose(self.order)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode: str = "constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        if value < 0:
+            raise InvalidArgumentError("brightness value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        if self.value == 0:
+            return arr
+        factor = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        if arr.dtype == np.uint8:
+            return np.clip(arr.astype(np.float32) * factor, 0, 255).astype(np.uint8)
+        return (arr * np.asarray(factor, arr.dtype))  # float stays float

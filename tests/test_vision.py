@@ -1,0 +1,180 @@
+"""Vision package tests: models forward/train, transforms, dataset parsers.
+
+Mirrors reference ``tests/unittests/test_vision_models.py`` /
+``test_transforms.py`` / ``test_datasets.py`` (local-file mode).
+"""
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import MNIST, Cifar10
+from paddle_tpu.vision.models import (
+    LeNet,
+    MobileNetV2,
+    resnet18,
+    resnet50,
+    vgg16,
+)
+
+
+def test_lenet_trains(rng):
+    pt.seed(0)
+    model = LeNet()
+    xs = rng.randn(8, 1, 28, 28).astype(np.float32)
+    ys = (np.arange(8) % 10).astype(np.int32)
+    opt = pt.optimizer.Adam(1e-3, parameters=model.parameters())
+    losses = []
+    for _ in range(8):
+        loss = pt.nn.functional.cross_entropy(
+            model(pt.to_tensor(xs)), pt.to_tensor(ys))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.value))
+    assert losses[-1] < losses[0]
+
+
+@pytest.mark.parametrize("ctor,expansion", [(resnet18, 1), (resnet50, 4)])
+def test_resnet_forward(rng, ctor, expansion):
+    pt.seed(0)
+    model = ctor(num_classes=10)
+    model.eval()
+    x = pt.to_tensor(rng.randn(2, 3, 64, 64).astype(np.float32))
+    out = model(x)
+    assert list(out.shape) == [2, 10]
+    feats = ctor(num_classes=0, with_pool=False)
+    feats.eval()
+    fo = feats(x)
+    assert fo.shape[1] == 512 * expansion
+
+
+def test_vgg_and_mobilenet_forward(rng):
+    pt.seed(0)
+    x = pt.to_tensor(rng.randn(1, 3, 64, 64).astype(np.float32))
+    v = vgg16(num_classes=7)
+    v.eval()
+    assert list(v(x).shape) == [1, 7]
+    m = MobileNetV2(num_classes=5)
+    m.eval()
+    assert list(m(x).shape) == [1, 5]
+
+
+def test_pretrained_raises():
+    with pytest.raises(NotImplementedError, match="pretrained"):
+        resnet18(pretrained=True)
+
+
+# -- transforms -------------------------------------------------------------
+
+def test_to_tensor_and_normalize(rng):
+    img = (rng.rand(8, 6, 3) * 255).astype(np.uint8)
+    t = T.ToTensor()(img)
+    assert list(t.shape) == [3, 8, 6]
+    assert float(t.value.max()) <= 1.0
+    n = T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])(t)
+    assert float(n.value.min()) >= -1.0 - 1e-6
+
+
+def test_brightness_preserves_dtype(rng):
+    f = (rng.rand(4, 4, 3)).astype(np.float32)
+    out = T.BrightnessTransform(0.4)(f)
+    assert out.dtype == np.float32 and out.max() > 0.01
+    u = (rng.rand(4, 4, 3) * 255).astype(np.uint8)
+    assert T.BrightnessTransform(0.4)(u).dtype == np.uint8
+
+
+def test_normalize_to_rgb_reverses_channels():
+    img = np.zeros((3, 2, 2), np.float32)
+    img[0] = 1.0  # "B" plane
+    out = T.normalize(img, [0, 0, 0], [1, 1, 1], to_rgb=True)
+    assert out[2].max() == 1.0 and out[0].max() == 0.0
+
+
+def test_cifar_mode_validation(tmp_path):
+    with pytest.raises(Exception, match="mode"):
+        Cifar10(data_file=str(tmp_path / "x.tar"), mode="Train")
+
+
+def test_resnet_depth_validation():
+    from paddle_tpu.vision.models.resnet import BasicBlock, ResNet
+
+    with pytest.raises(ValueError, match="depth"):
+        ResNet(BasicBlock, depth=77)
+    model = ResNet(BasicBlock, num_classes=0, with_pool=False)  # default 50
+    assert model.inplanes == 512
+
+
+def test_resize_crop_flip(rng):
+    img = (rng.rand(10, 8, 3) * 255).astype(np.uint8)
+    r = T.Resize((5, 4))(img)
+    assert r.shape[:2] == (5, 4)
+    c = T.CenterCrop(4)(img)
+    assert c.shape[:2] == (4, 4)
+    rc = T.RandomCrop(6)(img)
+    assert rc.shape[:2] == (6, 6)
+    f = T.RandomHorizontalFlip(prob=1.0)(img)
+    np.testing.assert_array_equal(f, img[:, ::-1])
+    p = T.Pad(2)(img)
+    assert p.shape[:2] == (14, 12)
+    comp = T.Compose([T.Resize(8), T.CenterCrop(6), T.ToTensor()])
+    out = comp(img)
+    assert list(out.shape) == [3, 6, 6]
+
+
+# -- datasets ---------------------------------------------------------------
+
+def _write_idx(tmp_path, n=10):
+    imgs = (np.arange(n * 28 * 28) % 255).astype(np.uint8)
+    ipath = str(tmp_path / "img.idx3.gz")
+    with gzip.open(ipath, "wb") as f:
+        f.write((2051).to_bytes(4, "big") + n.to_bytes(4, "big")
+                + (28).to_bytes(4, "big") + (28).to_bytes(4, "big")
+                + imgs.tobytes())
+    lpath = str(tmp_path / "lab.idx1.gz")
+    with gzip.open(lpath, "wb") as f:
+        f.write((2049).to_bytes(4, "big") + n.to_bytes(4, "big")
+                + bytes(range(n)))
+    return ipath, lpath
+
+
+def test_mnist_local_files(tmp_path, rng):
+    ipath, lpath = _write_idx(tmp_path)
+    ds = MNIST(image_path=ipath, label_path=lpath,
+               transform=T.Compose([T.ToTensor()]))
+    assert len(ds) == 10
+    img, label = ds[3]
+    assert list(img.shape) == [1, 28, 28] and int(label[0]) == 3
+
+
+def test_mnist_needs_paths():
+    with pytest.raises(Exception, match="image_path"):
+        MNIST()
+    with pytest.raises(Exception, match="no-egress"):
+        MNIST(download=True)
+
+
+def test_cifar10_local_tar(tmp_path, rng):
+    path = str(tmp_path / "cifar-10.tar.gz")
+    with tarfile.open(path, "w:gz") as tar:
+        for name in ["data_batch_%d" % i for i in range(1, 6)] + ["test_batch"]:
+            batch = {
+                b"data": (rng.rand(4, 3072) * 255).astype(np.uint8),
+                b"labels": list(rng.randint(0, 10, 4)),
+            }
+            blob = pickle.dumps(batch)
+            import io as _io
+
+            info = tarfile.TarInfo(name="cifar-10-batches-py/" + name)
+            info.size = len(blob)
+            tar.addfile(info, _io.BytesIO(blob))
+    train = Cifar10(data_file=path, mode="train")
+    test = Cifar10(data_file=path, mode="test")
+    assert len(train) == 20 and len(test) == 4
+    img, label = train[0]
+    assert img.shape == (32, 32, 3) and 0 <= int(label[0]) < 10
