@@ -52,7 +52,11 @@ from . import amp  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
 from . import io  # noqa: F401
+from . import distribution  # noqa: F401
+from . import inference  # noqa: F401
 from . import metric  # noqa: F401
+from . import profiler  # noqa: F401
+from . import static  # noqa: F401
 from . import vision  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi import callbacks  # noqa: F401
@@ -75,4 +79,6 @@ def enable_static(*a, **k):
 
 
 def in_dynamic_mode() -> bool:
-    return True
+    from .core.flags import flag as _flag
+
+    return bool(_flag("FLAGS_eager_mode"))

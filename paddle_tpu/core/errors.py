@@ -24,6 +24,15 @@ class EnforceNotMet(RuntimeError):
         full = f"[{self.code}] {message}"
         if hint:
             full += f"\n  [Hint: {hint}]"
+        # FLAGS_log_level >= 1 → append the creating Python frames
+        # (op_call_stack.cc attribution; SURVEY §5.5)
+        try:
+            from .flags import flag as _flag
+
+            if _flag("FLAGS_log_level") >= 1:
+                full += "\n  [Python call stack]\n" + current_python_callstack()
+        except Exception:  # flags not registered yet during bootstrap
+            pass
         super().__init__(full)
 
 

@@ -1,0 +1,158 @@
+"""``paddle_tpu.distribution`` — probability distributions.
+
+Reference parity: ``python/paddle/distribution.py`` — ``Distribution:41``
+(sample/entropy/log_prob/probs/kl_divergence surface), ``Uniform:168``,
+``Normal:390``, ``Categorical:640``.
+
+TPU-native: sampling draws from the framework PRNG stream
+(``core.random.next_key``) so ``paddle_tpu.seed`` reproduces; math is jnp
+compositions on the Tensor facade.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..core.random import next_key
+from ..framework.tensor import Tensor
+
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) else x
+
+
+class Distribution:
+    """distribution.py:41 parity."""
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        raise NotImplementedError
+
+
+class Uniform(Distribution):
+    """distribution.py:168 parity: U[low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _raw(low)
+        self.high = _raw(high)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        base = jnp.broadcast_shapes(jnp.shape(self.low), jnp.shape(self.high))
+        u = jax.random.uniform(next_key(), shape + base, jnp.float32)
+        return Tensor(self.low + u * (self.high - self.low), stop_gradient=True)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        inside = jnp.logical_and(v >= self.low, v < self.high)
+        lp = jnp.where(inside, -jnp.log(self.high - self.low), -jnp.inf)
+        return Tensor(lp, stop_gradient=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_raw(self.log_prob(value))), stop_gradient=True)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low), stop_gradient=True)
+
+
+class Normal(Distribution):
+    """distribution.py:390 parity: N(loc, scale)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        base = jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+        z = jax.random.normal(next_key(), shape + base, jnp.float32)
+        return Tensor(self.loc + z * self.scale, stop_gradient=True)
+
+    def entropy(self):
+        base = jnp.broadcast_shapes(jnp.shape(self.loc), jnp.shape(self.scale))
+        scale = jnp.broadcast_to(self.scale, base)
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale),
+                      stop_gradient=True)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        var = self.scale * self.scale
+        return Tensor(
+            -((v - self.loc) ** 2) / (2 * var)
+            - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi),
+            stop_gradient=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_raw(self.log_prob(value))), stop_gradient=True)
+
+    def kl_divergence(self, other: "Normal"):
+        """distribution.py:604 parity: KL(self || other)."""
+        if not isinstance(other, Normal):
+            raise InvalidArgumentError("kl_divergence expects a Normal")
+        var_ratio = (self.scale / other.scale) ** 2
+        t1 = ((self.loc - other.loc) / other.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1.0 - jnp.log(var_ratio)),
+                      stop_gradient=True)
+
+
+class Categorical(Distribution):
+    """distribution.py:640 parity: unnormalized logits vector."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _raw(logits)
+        if self.logits.ndim < 1:
+            raise InvalidArgumentError("Categorical logits must be >= 1-D")
+
+    def _probs_arr(self):
+        p = self.logits - jax.nn.logsumexp(self.logits, axis=-1, keepdims=True)
+        return jnp.exp(p)
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape)
+        idx = jax.random.categorical(
+            next_key(), self.logits, axis=-1,
+            shape=shape + self.logits.shape[:-1])
+        return Tensor(idx, stop_gradient=True)
+
+    def entropy(self):
+        logp = self.logits - jax.nn.logsumexp(self.logits, axis=-1, keepdims=True)
+        return Tensor(-(jnp.exp(logp) * logp).sum(-1), stop_gradient=True)
+
+    def probs(self, value):
+        v = _raw(value).astype(jnp.int32)
+        p = self._probs_arr()
+        if p.ndim == 1:  # one distribution, arbitrary-shaped value
+            return Tensor(jnp.take(p, v, axis=-1), stop_gradient=True)
+        return Tensor(jnp.take_along_axis(
+            p, v[..., None], axis=-1).squeeze(-1), stop_gradient=True)
+
+    def log_prob(self, value):
+        return Tensor(jnp.log(_raw(self.probs(value))), stop_gradient=True)
+
+    def kl_divergence(self, other: "Categorical"):
+        if not isinstance(other, Categorical):
+            raise InvalidArgumentError("kl_divergence expects a Categorical")
+        logp = self.logits - jax.nn.logsumexp(self.logits, axis=-1, keepdims=True)
+        logq = other.logits - jax.nn.logsumexp(other.logits, axis=-1, keepdims=True)
+        return Tensor((jnp.exp(logp) * (logp - logq)).sum(-1),
+                      stop_gradient=True)

@@ -104,6 +104,42 @@ def _trace_clean() -> bool:
     return _trace_state_clean()
 
 
+def _post_op(out_raw, op_name: str, t0) -> None:
+    """Eager-path op epilogue: profiling timing (FLAGS_benchmark /
+    profiler.start_profiler) and nan/inf scanning (FLAGS_check_nan_inf —
+    ``nan_inf_utils_detail`` parity, raising with the op name)."""
+    if t0 is not None:
+        import time
+
+        from .. import profiler as _prof
+
+        jax.block_until_ready(
+            [l for l in _tree.tree_leaves(out_raw) if isinstance(l, jax.Array)])
+        _prof.record_op_time(op_name, time.perf_counter() - t0)
+    from ..core.flags import flag as _flag
+
+    if _flag("FLAGS_check_nan_inf"):
+        for leaf in _tree.tree_leaves(out_raw):
+            if isinstance(leaf, jax.Array) and not _is_traced(leaf) \
+                    and jnp.issubdtype(leaf.dtype, jnp.inexact):
+                if not bool(jnp.isfinite(leaf).all()):
+                    from ..core.errors import InvalidArgumentError
+
+                    raise InvalidArgumentError(
+                        "nan/inf detected in output of op %r "
+                        "(FLAGS_check_nan_inf)" % op_name)
+
+
+def _maybe_t0():
+    from .. import profiler as _prof
+
+    if _prof.is_profiling():
+        import time
+
+        return time.perf_counter()
+    return None
+
+
 def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Callable:
     """Wrap a raw-array op into the Tensor-facade calling convention."""
     op_name = op_name or getattr(fn, "__name__", "op")
@@ -120,7 +156,10 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
             if any(isinstance(l, jax.Array) for l in leaves) or not _trace_clean():
                 return run(*args, **kwargs)
             # Pure python inputs (creation/random ops): wrap for eager users.
-            return _wrap_outputs(run(*args, **kwargs))
+            t0 = _maybe_t0()
+            out_raw = run(*args, **kwargs)
+            _post_op(out_raw, op_name, t0)
+            return _wrap_outputs(out_raw)
 
         vals = list(leaves)
         for i in t_pos:
@@ -141,7 +180,11 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
             ]
         if not diff_pos:
             a, k = _tree.tree_unflatten(treedef, vals)
-            return _wrap_outputs(run(*a, **k))
+            t0 = _maybe_t0()
+            out_raw = run(*a, **k)
+            if not any(_is_traced(v) for v in vals):
+                _post_op(out_raw, op_name, t0)
+            return _wrap_outputs(out_raw)
 
         diff_vals = [vals[i] for i in diff_pos]
 
@@ -152,7 +195,9 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
             a, k = _tree.tree_unflatten(treedef, vv)
             return run(*a, **k)
 
+        t0 = _maybe_t0()
         out, vjp_fn = jax.vjp(pure, *diff_vals)
+        _post_op(out, op_name, t0)
         out_leaves, out_treedef = _tree.tree_flatten(out)
         out_avals = [
             _aval(l) if isinstance(l, jax.Array) else ((), jnp.float32)

@@ -64,6 +64,18 @@ class RandomSampler(Sampler):
             return self.generator
         if isinstance(self.generator, int):
             return np.random.RandomState(self.generator)
+        from ..core.flags import flag as _flag
+
+        if _flag("FLAGS_deterministic"):
+            # derive the shuffle order from the framework RNG stream so
+            # paddle_tpu.seed() reproduces the data order end to end
+            import jax.random as jrandom
+
+            from ..core.random import next_key
+
+            seed = int(np.asarray(
+                jrandom.randint(next_key(), (), 0, 2**31 - 1)))
+            return np.random.RandomState(seed)
         return np.random.RandomState()
 
     def __iter__(self):

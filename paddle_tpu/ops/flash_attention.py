@@ -75,14 +75,29 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
     if not flash_attention_supported(q.shape, q.dtype):
         return _reference_attention(q, k, v, bias, causal, sm_scale)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
         flash_attention as _pallas_flash,
     )
+
+    from ..core.flags import flag as _flag
 
     ab = None
     if bias is not None:
         b_, h_, lq, lk = q.shape[0], q.shape[1], q.shape[2], k.shape[2]
         ab = jnp.broadcast_to(bias.astype(q.dtype), (b_, h_, lq, lk))
-    return _pallas_flash(q, k, v, ab=ab, causal=causal, sm_scale=float(sm_scale))
+    # FLAGS_seq_block_size bounds the kernel's sequence tiles (VMEM budget
+    # knob for very long sequences); 0/default lets the kernel choose.
+    blk = int(_flag("FLAGS_seq_block_size") or 0)
+    block_sizes = None
+    lq, lk = q.shape[2], k.shape[2]
+    if blk and (blk < min(lq, lk)) and lq % blk == 0 and lk % blk == 0:
+        block_sizes = BlockSizes(
+            block_q=blk, block_k_major=blk, block_k=blk, block_b=1,
+            block_q_major_dkv=blk, block_k_major_dkv=blk, block_k_dkv=blk,
+            block_q_dkv=blk, block_k_major_dq=blk, block_k_dq=blk,
+            block_q_dq=blk)
+    return _pallas_flash(q, k, v, ab=ab, causal=causal,
+                         sm_scale=float(sm_scale), block_sizes=block_sizes)
 
 
 # id(mask) → (weakref(mask), verdict); masks are immutable jax arrays built

@@ -1,0 +1,23 @@
+"""``paddle_tpu.static`` — static-graph compatibility namespace.
+
+Reference parity: ``python/paddle/static/`` re-exports.  There is no
+interpreted Program here (``jit.to_static`` subsumes it); this module maps
+the commonly-ported names onto their trace-to-XLA equivalents so reference
+code imports keep working.
+"""
+from __future__ import annotations
+
+from .jit import InputSpec  # noqa: F401
+from .tensor.control_flow import case, cond, switch_case, while_loop  # noqa: F401
+
+
+class nn:
+    """paddle.static.nn subset: structured control flow."""
+
+    while_loop = staticmethod(while_loop)
+    cond = staticmethod(cond)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)
+
+
+__all__ = ["InputSpec", "nn", "while_loop", "cond", "case", "switch_case"]

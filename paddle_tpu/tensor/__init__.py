@@ -32,6 +32,10 @@ from .creation import (  # noqa: F401
     zeros,
     zeros_like,
 )
+# control-flow cond stays out of this namespace: ``cond`` is linalg's
+# condition number here (paddle parity); structured control flow lives at
+# paddle_tpu.static.nn.* (and .control_flow directly)
+from .control_flow import case, switch_case, while_loop  # noqa: F401
 from .einsum import einsum  # noqa: F401
 from .linalg import (  # noqa: F401
     bmm,

@@ -1,0 +1,193 @@
+"""Control-flow ops.
+
+Reference parity: ``python/paddle/fluid/layers/control_flow.py`` —
+``while_loop:1075``, ``cond:2334``, ``case:2811``, ``switch_case:3035``
+(ConditionalBlock / WhileOp program constructs).
+
+TPU-native: these ARE ``lax.while_loop`` / ``lax.cond`` / ``lax.switch`` —
+compiler-friendly structured control flow that works identically in eager
+and inside jit traces (the reference needs separate interpreter ops).  The
+Tensor facade is unwrapped at the boundary and re-wrapped on return.
+Reverse-mode autograd: ``cond``/``case``/``switch_case`` differentiate
+through ``jax.grad``; ``while_loop`` is forward-only (XLA's loop has no
+reverse-mode — use ``lax.scan``-style fixed-trip loops for trainable
+recurrences, as the framework's layers do).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import InvalidArgumentError
+from ..framework.tensor import Tensor
+
+__all__ = ["while_loop", "cond", "case", "switch_case"]
+
+
+def _unwrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t.value if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _wrap_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor(v, stop_gradient=True)
+        if isinstance(v, jax.Array) else v, tree)
+
+
+def _scalar_pred(p):
+    p = p.value if isinstance(p, Tensor) else p
+    if callable(p):
+        raise InvalidArgumentError(
+            "pred must be a boolean tensor/scalar, got a callable")
+    arr = jnp.asarray(p)
+    if arr.shape not in ((), (1,)):
+        raise InvalidArgumentError(
+            "pred must be a scalar boolean, got shape %s" % (arr.shape,))
+    return arr.reshape(()).astype(bool)
+
+
+def _in_eager(*values) -> bool:
+    """Concrete inputs outside a trace → dygraph semantics (the reference's
+    in_dygraph_mode() branch in control_flow.py): run plain Python, keeping
+    the eager autograd tape connected through the chosen branch."""
+    leaves = jax.tree_util.tree_leaves(_unwrap_tree(list(values)))
+    return not any(isinstance(l, jax.core.Tracer) for l in leaves)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test: bool = False, name: Optional[str] = None) -> List:
+    """control_flow.py:1075 parity over ``lax.while_loop``."""
+    if not callable(cond_fn) or not callable(body_fn):
+        raise InvalidArgumentError("while_loop cond and body must be callable")
+    if not loop_vars:
+        raise InvalidArgumentError("while_loop needs loop_vars")
+    if _in_eager(*loop_vars):
+        vs = list(loop_vars)
+        while bool(_scalar_pred(cond_fn(*vs))):
+            out = body_fn(*vs)
+            vs = list(out) if isinstance(out, (tuple, list)) else [out]
+            if len(vs) != len(loop_vars):
+                raise InvalidArgumentError(
+                    "while_loop body returned %d vars, expected %d"
+                    % (len(vs), len(loop_vars)))
+        return vs
+    raw_vars = tuple(_unwrap_tree(list(loop_vars)))
+
+    def raw_cond(vs):
+        out = cond_fn(*_wrap_tree(list(vs)))
+        return _scalar_pred(out)
+
+    def raw_body(vs):
+        out = body_fn(*_wrap_tree(list(vs)))
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        out_raw = tuple(_unwrap_tree(list(out)))
+        if len(out_raw) != len(vs):
+            raise InvalidArgumentError(
+                "while_loop body returned %d vars, expected %d"
+                % (len(out_raw), len(vs)))
+        return out_raw
+
+    out = lax.while_loop(raw_cond, raw_body, raw_vars)
+    return list(_wrap_tree(list(out)))
+
+
+def cond(pred, true_fn: Optional[Callable] = None,
+         false_fn: Optional[Callable] = None, name: Optional[str] = None):
+    """control_flow.py:2334 parity over ``lax.cond``.
+
+    Both branches are traced (XLA semantics — also how the reference's
+    program-mode ConditionalBlock behaves); they must return matching
+    structures/dtypes.
+    """
+    if true_fn is None or false_fn is None:
+        raise InvalidArgumentError("cond needs both true_fn and false_fn")
+    if _in_eager(pred):
+        return true_fn() if bool(_scalar_pred(pred)) else false_fn()
+    p = _scalar_pred(pred)
+    out = lax.cond(p, lambda _: _unwrap_tree(true_fn()),
+                   lambda _: _unwrap_tree(false_fn()), operand=None)
+    return _wrap_tree(out)
+
+
+def case(pred_fn_pairs: Sequence[Tuple], default: Optional[Callable] = None,
+         name: Optional[str] = None):
+    """control_flow.py:2811 parity: first true predicate wins."""
+    if not pred_fn_pairs:
+        raise InvalidArgumentError("case needs pred_fn_pairs")
+    for pair in pred_fn_pairs:
+        if not (isinstance(pair, (tuple, list)) and len(pair) == 2
+                and callable(pair[1])):
+            raise InvalidArgumentError(
+                "case pairs must be (bool_tensor, callable), got %r" % (pair,))
+    if default is None:
+        default = pred_fn_pairs[-1][1]
+        pred_fn_pairs = pred_fn_pairs[:-1]
+    if _in_eager(*[p for p, _ in pred_fn_pairs]):
+        for pred, fn in pred_fn_pairs:
+            if bool(_scalar_pred(pred)):
+                return fn()
+        return default()
+
+    def build(i):
+        if i == len(pred_fn_pairs):
+            return lambda: _unwrap_tree(default())
+        pred, fn = pred_fn_pairs[i]
+        rest = build(i + 1)
+        return lambda: lax.cond(_scalar_pred(pred),
+                                lambda _: _unwrap_tree(fn()),
+                                lambda _: rest(), operand=None)
+
+    return _wrap_tree(build(0)())
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name: Optional[str] = None):
+    """control_flow.py:3035 parity over ``lax.switch``.
+
+    ``branch_fns``: dict {int: fn} or list of (int, fn) or list of fns.
+    Out-of-range indices dispatch to ``default`` (reference semantics).
+    """
+    idx = branch_index.value if isinstance(branch_index, Tensor) else branch_index
+    idx = jnp.asarray(idx).reshape(()).astype(jnp.int32)
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        pairs = sorted((int(k), f) for k, f in branch_fns)
+    else:
+        pairs = list(enumerate(branch_fns))
+    keys = [k for k, _ in pairs]
+    fns = [f for _, f in pairs]
+    if default is None:
+        default = fns[-1]
+    if _in_eager(branch_index):
+        i = int(idx)
+        table = dict(zip(keys, fns))
+        return table.get(i, default)()
+    if keys != list(range(len(keys))):
+        # sparse keys: map index → dense position, unknown → default slot
+        dense = len(fns)
+        table = jnp.full((max(keys) + 2,), dense, jnp.int32)
+        table = table.at[jnp.asarray(keys)].set(jnp.arange(len(keys)))
+        safe = jnp.clip(idx, 0, max(keys) + 1)
+        pos = jnp.where((idx < 0) | (idx > max(keys)), dense, table[safe])
+        fns = fns + [default]
+        idx = pos
+    else:
+        in_range = (idx >= 0) & (idx < len(fns))
+        fns = fns + [default]
+        idx = jnp.where(in_range, idx, len(fns) - 1)
+    out = lax.switch(idx, [(
+        lambda f: (lambda _: _unwrap_tree(f())))(f) for f in fns], None)
+    return _wrap_tree(out)
+
+
+# these manage their own Tensor (un)wrapping and take callables — opt out of
+# the namespace-wide make_op wrap in tensor/__init__.install_ops
+for _f in (while_loop, cond, case, switch_case):
+    _f.__paddle_tpu_op__ = True  # type: ignore[attr-defined]

