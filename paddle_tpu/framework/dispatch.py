@@ -197,7 +197,11 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
 
         t0 = _maybe_t0()
         out, vjp_fn = jax.vjp(pure, *diff_vals)
-        _post_op(out, op_name, t0)
+        # Same traced-input guard as the non-diff branch: non-Tensor leaves
+        # can still be tracers (e.g. inside jax.checkpoint), and profiling
+        # must not block_until_ready on a tracer.
+        if not any(_is_traced(v) for v in vals):
+            _post_op(out, op_name, t0)
         out_leaves, out_treedef = _tree.tree_flatten(out)
         out_avals = [
             _aval(l) if isinstance(l, jax.Array) else ((), jnp.float32)

@@ -221,7 +221,28 @@ class Optimizer:
                     "optimizer tracks %d parameters %r"
                     % (len(grouped), sorted(grouped), len(current),
                        sorted(current)))
-            mapping = dict(zip(grouped.keys(), current))
+            # Positional fallback is only safe when the names differ by the
+            # auto-name counter alone (same structural stems in the same
+            # order) — shape checks cannot distinguish identically-shaped
+            # parameters, so a looser match could silently swap moments.
+            stem = lambda n: n.rstrip("0123456789")
+            saved_names = list(grouped.keys())
+            if [stem(n) for n in saved_names] != [stem(n) for n in current]:
+                raise InvalidArgumentError(
+                    "optimizer state parameter names %r do not positionally "
+                    "match this optimizer's parameters %r (structural stems "
+                    "differ) — refusing positional state mapping"
+                    % (saved_names, current))
+            for sname, tname in zip(saved_names, current):
+                have = self._states.get(tname)
+                if have and frozenset(have) != frozenset(grouped[sname]):
+                    raise InvalidArgumentError(
+                        "optimizer state entry %r carries slots %r but "
+                        "target parameter %r already has slots %r — "
+                        "refusing positional state mapping"
+                        % (sname, sorted(grouped[sname]), tname,
+                           sorted(have)))
+            mapping = dict(zip(saved_names, current))
         by_name = {p.name: p for p in trainable}
         for pname, slots in grouped.items():
             tgt = mapping[pname]

@@ -108,3 +108,70 @@ def test_rng_state_roundtrip(tmp_path):
     pt.set_rng_state(pt.load(path, return_numpy=True)["rng"])
     b = np.asarray(pt.to_tensor(pt.tensor.randn([4])).value)
     np.testing.assert_allclose(a, b)
+
+
+def test_save_load_bfloat16_roundtrip(tmp_path):
+    """ADVICE r2 high: bf16 arrays must survive save/load (AMP O2 default)."""
+    import ml_dtypes
+    w = jnp.arange(12, dtype=jnp.bfloat16).reshape(3, 4) / 7
+    obj = {"w": pt.to_tensor(w), "raw": np.asarray(w),
+           "arr": jnp.float32(2.5)}
+    path = str(tmp_path / "bf16.pdparams")
+    pt.save(obj, path)
+    back = pt.load(path)
+    assert back["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(back["w"].value).view(np.uint16),
+        np.asarray(w).view(np.uint16))
+    assert back["raw"].value.dtype == jnp.bfloat16
+    back_np = pt.load(path, return_numpy=True)
+    assert back_np["w"].dtype == ml_dtypes.bfloat16
+
+
+def test_sharded_save_uses_index_fragments(tmp_path):
+    """ADVICE r2 medium: chunk keys are namespaced per process and each
+    process writes its own index fragment; load merges and checks coverage."""
+    import json
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    arr = jax.device_put(jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+                         NamedSharding(mesh, P("x", None)))
+    path = str(tmp_path / "shard.pdparams")
+
+    # Force the sharded path by monkeypatching the addressability probe.
+    import paddle_tpu.framework.io as fio
+    orig = fio._is_fully_addressable
+    fio._is_fully_addressable = lambda v: False
+    try:
+        pt.save({"w": arr}, path)
+    finally:
+        fio._is_fully_addressable = orig
+    # fragment layout: .index0.json, keys namespaced by process
+    assert (tmp_path / "shard.pdparams.index0.json").exists()
+    frag = json.loads((tmp_path / "shard.pdparams.index0.json").read_text())
+    for meta in frag["arrays"].values():
+        for chunk in meta["chunks"]:
+            assert "/p0/" in chunk["key"]
+    back = pt.load(path, return_numpy=True)
+    np.testing.assert_array_equal(back["w"], np.asarray(arr))
+
+
+def test_sharded_load_detects_missing_coverage(tmp_path):
+    """Coverage check: deleting a shard file must fail loudly, not zero-fill."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import paddle_tpu.framework.io as fio
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("x",))
+    arr = jax.device_put(jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+                         NamedSharding(mesh, P("x", None)))
+    path = str(tmp_path / "shard2.pdparams")
+    orig = fio._is_fully_addressable
+    fio._is_fully_addressable = lambda v: False
+    try:
+        pt.save({"w": arr}, path)
+    finally:
+        fio._is_fully_addressable = orig
+    (tmp_path / "shard2.pdparams.shard0.npz").unlink()
+    with pytest.raises(Exception, match="missing|cover"):
+        pt.load(path)
