@@ -41,9 +41,71 @@ class PipelineParallel(Layer):
         self._hcg = hcg
         cfg = getattr(strategy, "pipeline_configs", None) or {}
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self._spmd_step = None          # built lazily (needs the optimizer)
+        self._spmd_unavailable = False
 
     def forward(self, x):
+        self._sync_if_needed()
         return self._layers(x)
+
+    # -- SPMD engine plumbing --------------------------------------------
+    def _mesh(self):
+        if self._hcg is not None and getattr(self._hcg, "mesh", None) is not None:
+            return self._hcg.mesh
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        pp = self._layers.get_num_stages()
+        ndev = len(jax.devices())
+        if ndev % pp != 0:
+            return None
+        dp = ndev // pp
+        return Mesh(np.array(jax.devices()).reshape(dp, pp), ("dp", "pp"))
+
+    def _get_spmd_step(self, optimizer):
+        """Build the compiled shard_map pipeline engine, or None when the
+        stages are heterogeneous / the mesh lacks a pp axis (fallback =
+        microbatch gradient accumulation, mathematically identical)."""
+        if self._spmd_unavailable:
+            return None
+        if self._spmd_step is not None:
+            if self._spmd_step.optimizer is optimizer:
+                return self._spmd_step
+            # a different optimizer: sync trained state back to the layer
+            # Parameters and rebuild the engine around the new optimizer
+            self._spmd_step.sync_layers()
+            self._spmd_step = None
+        from .spmd_pipeline import PipelineTrainStep, partition_pipeline
+
+        pp = self._layers.get_num_stages()
+        mesh = self._mesh() if pp > 1 else None
+        if (pp <= 1 or mesh is None
+                or "pp" not in getattr(mesh, "axis_names", ())
+                or mesh.shape.get("pp", 1) != pp
+                or partition_pipeline(self._layers) is None):
+            self._spmd_unavailable = True
+            return None
+        self._spmd_step = PipelineTrainStep(
+            self._layers, optimizer, mesh,
+            microbatches=self.accumulate_steps)
+        return self._spmd_step
+
+    def _sync_if_needed(self):
+        if self._spmd_step is not None:
+            self._spmd_step.sync_layers()
+
+    def state_dict(self, *a, **k):
+        self._sync_if_needed()
+        return super().state_dict(*a, **k)
+
+    def stage_devices(self, s: int):
+        """Devices that hold stage ``s``'s core parameters (SPMD engine)."""
+        if self._spmd_step is None:
+            raise InvalidArgumentError(
+                "stage_devices is available after the first train_batch "
+                "on the SPMD pipeline engine")
+        return self._spmd_step.stage_devices(s)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """Micro-batched step: split → accumulate grads → one update.
@@ -56,6 +118,20 @@ class PipelineParallel(Layer):
         if loss_fn is None:
             raise InvalidArgumentError(
                 "PipelineLayer needs loss_fn= for train_batch")
+        if scaler is None:
+            engine = self._get_spmd_step(optimizer)
+            if engine is not None:
+                loss = engine(x, y)
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return loss
+        elif self._spmd_step is not None:
+            # switching to the scaler (fallback) path: flush the engine's
+            # stacked values into the Parameters and retire it so the two
+            # paths never train diverging copies of the weights
+            self._spmd_step.sync_layers()
+            self._spmd_step = None
+            self._spmd_unavailable = True
         k = self.accumulate_steps
         xv = x.value if isinstance(x, Tensor) else jnp.asarray(x)
         yv = y.value if isinstance(y, Tensor) else jnp.asarray(y)
