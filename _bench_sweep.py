@@ -1,0 +1,38 @@
+import time, numpy as np, jax
+import paddle_tpu as pt
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import TransformerLM, TransformerLMCriterion, bert_base_config
+
+def run(batch, seq=512):
+    pt.seed(0)
+    cfg = bert_base_config()
+    model = TransformerLM(**cfg, dropout=0.0)
+    criterion = TransformerLMCriterion(shift_labels=False)
+    opt = pt.optimizer.AdamW(1e-4, parameters=model.parameters())
+    model, opt = pt.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    def loss_fn(m, ids, labels):
+        with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return criterion(m(ids), labels)
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32")
+    for _ in range(2):
+        loss = step(ids, ids)
+    float(loss)
+    iters = 10
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+    float(loss)
+    dt = (time.perf_counter() - t0) / iters
+    flops = model.flops_per_token(seq) * batch * seq
+    mfu = flops / dt / 197e12
+    print(f"batch={batch} seq={seq}: {dt*1e3:.1f} ms  {batch*seq/dt:,.0f} tok/s  MFU={mfu:.4f}", flush=True)
+    return mfu
+
+import sys
+for b in [int(a) for a in sys.argv[1:]] or [16, 24, 32, 48]:
+    try:
+        run(b)
+    except Exception as e:
+        print(f"batch={b}: FAILED {str(e)[:120]}", flush=True)

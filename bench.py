@@ -1,12 +1,17 @@
-"""Benchmark harness: BERT-base fused train step on one chip.
+"""Benchmark harness: both BASELINE.md headline metrics on one chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
-Workload: BASELINE.md config #3 (BERT-base pretrain shape, seq 512) through
-the fully-jitted TrainStep (forward + backward + AdamW, donated buffers).
+Workloads:
+- **BERT-base pretrain** (BASELINE.md config #3, seq 512) through the
+  fully-jitted TrainStep (forward + backward + AdamW, donated buffers) —
+  the primary metric (tokens/s/chip).
+- **ResNet50 ImageNet** (BASELINE.md config #2: compiled path + AMP) —
+  reported in ``extra`` as imgs/sec/chip with its own MFU.
+
 The reference publishes no absolute numbers (BASELINE.md: "published: {}"),
-so ``vs_baseline`` reports measured model FLOPs utilization (MFU) against the
-0.40 A100-class MFU target named in BASELINE.md's north star.
+so ``vs_baseline`` reports measured model FLOPs utilization (MFU) against
+the 0.40 A100-class MFU target named in BASELINE.md's north star.
 """
 from __future__ import annotations
 
@@ -15,27 +20,48 @@ import time
 
 import numpy as np
 
+# ResNet50 ImageNet-224 analytic forward FLOPs per image (multiply+add = 2
+# FLOPs; conv+fc, the standard 4.09 GFLOP figure); backward ~= 2x forward.
+RESNET50_FWD_FLOPS = 4.089e9
 
-def main():
-    import os
 
-    import jax
+def _peak_flops(jax, on_tpu: bool) -> float:
+    """Per-chip bf16 peak FLOP/s by device generation (MFU convention)."""
+    kind = jax.devices()[0].device_kind.lower() if on_tpu else "cpu"
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind or "trillium" in kind:
+        return 918e12
+    return 197e12 if on_tpu else 1e12
 
-    if os.environ.get("JAX_PLATFORMS") == "cpu":
-        # pre-registered accelerator plugins ignore the env var; force it
-        jax.config.update("jax_platforms", "cpu")
 
-    import paddle_tpu as pt
+def _time_steps(step, args, iters: int) -> float:
+    for _ in range(2):  # warmup (includes compile)
+        loss = step(*args)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(*args)
+    float(loss)  # block on the last step
+    return (time.perf_counter() - t0) / iters, float(loss)
+
+
+def bench_bert(pt, jax, on_tpu: bool):
     from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models import TransformerLM, TransformerLMCriterion, bert_base_config
+    from paddle_tpu.models import (TransformerLM, TransformerLMCriterion,
+                                   bert_base_config)
 
     pt.seed(0)
-    on_tpu = jax.default_backend() not in ("cpu",)
     cfg = bert_base_config()
     if not on_tpu:  # CPU smoke: shrink so the harness itself stays testable
-        cfg.update(num_layers=2, hidden_size=128, num_heads=2, intermediate_size=512,
-                   vocab_size=1024)
-    batch, seq = (16, 512) if on_tpu else (2, 128)
+        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
+                   intermediate_size=512, vocab_size=1024)
+    # batch 40 is the measured v5e throughput knee (0.40+ MFU); 64+ spills
+    batch, seq = (40, 512) if on_tpu else (2, 128)
 
     model = TransformerLM(**cfg, dropout=0.0)
     criterion = TransformerLMCriterion(shift_labels=False)
@@ -52,45 +78,92 @@ def main():
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32")
 
-    # warmup (includes compile)
-    for _ in range(2):
-        loss = step(ids, ids)
-    float(loss)
-
-    iters = 10 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, ids)
-    float(loss)  # block on the last step
-    dt = (time.perf_counter() - t0) / iters
-
-    tokens_per_sec = batch * seq / dt
+    dt, loss = _time_steps(step, (ids, ids), 10 if on_tpu else 3)
     flops_per_step = model.flops_per_token(seq) * batch * seq
-    # per-chip bf16 peak FLOP/s by device generation (standard MFU convention)
-    kind = jax.devices()[0].device_kind.lower() if on_tpu else "cpu"
-    if "v5 lite" in kind or "v5e" in kind:
-        peak = 197e12
-    elif "v5p" in kind or "v5" in kind:
-        peak = 459e12
-    elif "v4" in kind:
-        peak = 275e12
-    elif "v6" in kind or "trillium" in kind:
-        peak = 918e12
+    mfu = flops_per_step / dt / _peak_flops(jax, on_tpu)
+    return {
+        "tokens_per_sec": batch * seq / dt,
+        "step_time_s": dt,
+        "mfu": mfu,
+        "batch": batch,
+        "seq": seq,
+        "loss": loss,
+    }
+
+
+def bench_resnet50(pt, jax, on_tpu: bool):
+    """Config #2: ResNet50, compiled ("static Executor") path + AMP."""
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    pt.seed(0)
+    if on_tpu:
+        batch, hw, classes = 256, 224, 1000
+        flops_fwd = RESNET50_FWD_FLOPS
     else:
-        peak = 197e12 if on_tpu else 1e12
-    mfu = flops_per_step / dt / peak
+        batch, hw, classes = 4, 32, 10
+        flops_fwd = 1e9  # nominal; CPU smoke only checks the harness runs
+
+    model = resnet50(num_classes=classes)
+    criterion = pt.nn.CrossEntropyLoss()
+    opt = pt.optimizer.Momentum(0.1, parameters=model.parameters())
+    model, opt = pt.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def loss_fn(m, x, y):
+        with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return criterion(m(x), y)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(batch, 3, hw, hw).astype("float32")
+    labels = rng.randint(0, classes, (batch,)).astype("int64")
+
+    dt, loss = _time_steps(step, (imgs, labels), 10 if on_tpu else 2)
+    flops_per_step = 3.0 * flops_fwd * batch  # fwd + ~2x bwd
+    mfu = flops_per_step / dt / _peak_flops(jax, on_tpu)
+    return {
+        "imgs_per_sec": batch / dt,
+        "step_time_s": dt,
+        "mfu": mfu,
+        "batch": batch,
+        "loss": loss,
+    }
+
+
+def main():
+    import os
+
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # pre-registered accelerator plugins ignore the env var; force it
+        jax.config.update("jax_platforms", "cpu")
+
+    import paddle_tpu as pt
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    bert = bench_bert(pt, jax, on_tpu)
+    try:
+        resnet = bench_resnet50(pt, jax, on_tpu)
+    except Exception as e:  # keep the primary metric alive
+        resnet = {"error": str(e)[:200]}
+
     print(json.dumps({
         "metric": "bert_base_tokens_per_sec_per_chip",
-        "value": round(tokens_per_sec, 1),
+        "value": round(bert["tokens_per_sec"], 1),
         "unit": "tokens/s",
-        "vs_baseline": round(mfu / 0.40, 4),
+        "vs_baseline": round(bert["mfu"] / 0.40, 4),
         "extra": {
-            "step_time_s": round(dt, 4),
-            "mfu": round(mfu, 4),
-            "batch": batch,
-            "seq": seq,
+            "step_time_s": round(bert["step_time_s"], 4),
+            "mfu": round(bert["mfu"], 4),
+            "batch": bert["batch"],
+            "seq": bert["seq"],
             "backend": jax.default_backend(),
-            "loss": float(loss),
+            "loss": bert["loss"],
+            "resnet50": {
+                k: (round(v, 4) if isinstance(v, float) else v)
+                for k, v in resnet.items()
+            },
         },
     }))
 

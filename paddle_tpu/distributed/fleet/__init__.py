@@ -55,7 +55,9 @@ class DistributedStrategy:
         self.tensor_parallel = False
         self.tensor_parallel_configs = {}
         self.lamb = False
+        self.lamb_configs = {}
         self.lars = False
+        self.lars_configs = {}
         self.dgc = False
         self.localsgd = False
         self.fp16_allreduce = False
@@ -77,6 +79,9 @@ class _Fleet:
         self._strategy: Optional[DistributedStrategy] = None
         self._hcg: Optional[HybridCommunicateGroup] = None
         self._initialized = False
+        self._model = None
+        self._opt = None
+        self._amp_applied = False
 
     # -- init -----------------------------------------------------------
     def init(self, role_maker=None, is_collective: bool = True, strategy=None):
@@ -110,6 +115,9 @@ class _Fleet:
         topo = CommunicateTopology(names, dims)
         self._hcg = HybridCommunicateGroup(topo)
         self._initialized = True
+        self._model = None
+        self._opt = None
+        self._amp_applied = False
         return self
 
     @property
@@ -152,26 +160,121 @@ class _Fleet:
 
         barrier()
 
-    # -- model/optimizer wrapping (fleet_base.py:900+) ------------------
-    def distributed_model(self, model):
-        """Wrap per the active strategy's dominant axis.
+    # -- model/optimizer wrapping (fleet_base.py:783,836,1288) ----------
+    def _maybe_amp_decorate(self):
+        """Apply amp.decorate once both model and optimizer are known
+        (the reference's amp meta-optimizer acts at minimize time, when it
+        sees both the loss and the inner optimizer)."""
+        if not (self._strategy and self._strategy.amp):
+            return
+        if self._amp_applied or self._model is None or self._opt is None:
+            return
+        from ... import amp as _amp
 
-        Pure-DP → DataParallel placement.  mp/pp degrees are honored by the
-        parallel layers themselves (meta_parallel.*) which read the hcg mesh,
-        so the model is returned with parameters placed on the mesh.
+        cfg = self._strategy.amp_configs or {}
+        level = "O2" if cfg.get("use_pure_fp16") or cfg.get("use_pure_bf16") \
+            else cfg.get("level", "O1")
+        dtype = cfg.get("dtype", "bfloat16")
+        inner = self._opt
+        # decorate the innermost real optimizer; wrappers delegate state
+        while hasattr(inner, "_inner"):
+            inner = inner._inner
+        _amp.decorate(models=self._model, optimizers=inner, level=level,
+                      dtype=dtype)
+        self._amp_applied = True
+
+    def _apply_recompute(self, model):
+        """strategy.recompute → wrap the named checkpoint sublayers'
+        forwards in fleet.utils.recompute (recompute_optimizer.py:20
+        semantics: re-run those segments in backward)."""
+        from .utils import recompute as _recompute
+
+        cfg = self._strategy.recompute_configs or {}
+        names = cfg.get("checkpoints") or []
+        wrapped = 0
+        for name, sub in model.named_sublayers():
+            if name in names and not getattr(sub, "_fleet_recompute", False):
+                orig = sub.forward
+
+                def ck_forward(*args, __orig=orig, **kw):
+                    if kw:
+                        return __orig(*args, **kw)
+                    return _recompute(__orig, *args)
+
+                sub.forward = ck_forward
+                sub._fleet_recompute = True
+                wrapped += 1
+        if names and not wrapped and not any(
+                getattr(s, "_fleet_recompute", False)
+                for _, s in model.named_sublayers()):
+            raise InvalidArgumentError(
+                "recompute_configs checkpoints %r match no sublayers of the "
+                "model (available: %r)"
+                % (names, [n for n, _ in model.named_sublayers()][:20]))
+        return model
+
+    def distributed_model(self, model):
+        """Wrap/place per the active strategy (fleet_base.py:836).
+
+        Pure-DP → DataParallel placement.  PipelineLayer → PipelineParallel
+        engine on the hcg mesh.  sharding stage 3 → parameters sharded over
+        the sharding axis.  mp degrees are honored by the parallel layers
+        themselves (meta_parallel.mp_layers) which read the hcg mesh.
+        recompute/amp knobs apply as function transforms.
         """
+        from ..meta_parallel.pipeline_parallel import PipelineParallel
+        from ..meta_parallel.pp_layers import PipelineLayer
+        from ..meta_parallel.sharding_parallel import GroupShardedParallel
         from ..parallel import DataParallel
 
         hcg = self.get_hybrid_communicate_group()
-        if (hcg.get_model_parallel_world_size() == 1
+        st = self.strategy
+        if st.recompute:
+            model = self._apply_recompute(model)
+
+        out = model
+        if isinstance(model, PipelineLayer) \
+                and hcg.get_pipe_parallel_world_size() > 1:
+            out = PipelineParallel(model, hcg=hcg, strategy=st)
+        elif st.sharding and \
+                int((st.sharding_configs or {}).get("stage", 2)) >= 3 \
+                and hcg.get_sharding_parallel_world_size() > 1:
+            out = GroupShardedParallel(
+                model, group=hcg.get_sharding_parallel_group())
+        elif (hcg.get_model_parallel_world_size() == 1
                 and hcg.get_pipe_parallel_world_size() == 1
                 and hcg.get_sharding_parallel_world_size() == 1):
-            return DataParallel(model, group=hcg.get_data_parallel_group())
-        return model
+            out = DataParallel(model, group=hcg.get_data_parallel_group())
+
+        self._model = model
+        self._maybe_amp_decorate()
+        return out
 
     def distributed_optimizer(self, optimizer, strategy=None):
+        """Apply the active strategy's optimizer stack (fleet_base.py:783):
+        lamb/lars class swap → sharding (ZeRO state placement) →
+        gradient merge → amp (with the model, once known)."""
         if strategy is not None:
             self._strategy = strategy
+        st = self.strategy
+        from ..meta_parallel.sharding_parallel import ShardingOptimizerStage2
+        from .meta_optimizers import GradientMergeOptimizer, apply_lamb_lars
+
+        optimizer = apply_lamb_lars(optimizer, st)
+        if st.sharding:
+            hcg = self.get_hybrid_communicate_group()
+            if hcg.get_sharding_parallel_world_size() > 1:
+                cfg = st.sharding_configs or {}
+                optimizer = ShardingOptimizerStage2(
+                    optimizer, group=hcg.get_sharding_parallel_group(),
+                    offload=bool(cfg.get("offload", False)))
+        if st.gradient_merge:
+            cfg = st.gradient_merge_configs or {}
+            optimizer = GradientMergeOptimizer(
+                optimizer, k_steps=int(cfg.get("k_steps", 1)),
+                avg=bool(cfg.get("avg", True)))
+        self._opt = optimizer
+        self._maybe_amp_decorate()
         return optimizer
 
 

@@ -1,0 +1,117 @@
+"""Fleet meta-optimizer equivalents — strategy-driven optimizer wrappers.
+
+Reference parity: ``fleet/meta_optimizers/gradient_merge_optimizer.py:20``
+(accumulate grads over k steps into persistent buffers, conditional update
+block), ``lamb_optimizer.py:22`` / ``lars_optimizer.py:21`` (optimizer-class
+swaps).  The reference implements these as static-graph program rewriters;
+here they are plain wrappers/transforms over the pure ``_apply_one``
+optimizers — same math, no program surgery (SURVEY §7 design stance).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from ...core.errors import InvalidArgumentError
+
+__all__ = ["GradientMergeOptimizer", "apply_lamb_lars"]
+
+
+class GradientMergeOptimizer:
+    """Accumulate-k-steps wrapper (gradient_merge_optimizer.py:20 parity).
+
+    Usable standalone (outside PipelineParallel): call ``backward`` +
+    ``step()`` every micro-step; the wrapper accumulates gradients into
+    persistent buffers and applies the inner optimizer only every
+    ``k_steps``-th call, with the (optionally averaged) merged gradient —
+    the reference's conditional update block, without the program rewrite.
+    """
+
+    def __init__(self, inner, k_steps: int = 1, avg: bool = True):
+        if k_steps < 1:
+            raise InvalidArgumentError("k_steps must be >= 1, got %d" % k_steps)
+        self._inner = inner
+        self.k_steps = int(k_steps)
+        self.avg = bool(avg)
+        self._acc: Dict[str, jnp.ndarray] = {}
+        self._count = 0
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def step(self) -> None:
+        params = self._inner._parameter_list
+        if params is None:
+            raise InvalidArgumentError(
+                "GradientMergeOptimizer needs an inner optimizer constructed "
+                "with parameters=")
+        self._count += 1
+        apply_now = self._count >= self.k_steps
+        for p in params:
+            if p.stop_gradient or p._grad_val is None:
+                continue
+            acc = self._acc.get(p.name)
+            g = p._grad_val
+            acc = g if acc is None else acc + g
+            if apply_now:
+                p._grad_val = acc / self.k_steps if self.avg else acc
+                self._acc.pop(p.name, None)
+            else:
+                self._acc[p.name] = acc
+                p._grad_val = None  # consumed into the merge buffer
+        if apply_now:
+            self._inner.step()
+            self._count = 0
+
+    def clear_grad(self, *a, **k) -> None:
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, *a, **k):
+        if loss._node is not None:
+            loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self) -> dict:
+        return self._inner.state_dict()
+
+    def set_state_dict(self, sd: dict) -> None:
+        self._inner.set_state_dict(sd)
+
+    def __getattr__(self, name):
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+
+def apply_lamb_lars(optimizer, strategy):
+    """Swap the optimizer class per strategy.lamb/lars flags —
+    ``lamb_optimizer.py``/``lars_optimizer.py`` `_can_apply` semantics:
+    lamb applies over Adam-family inners, lars over Momentum; anything else
+    is left untouched (the reference disables the meta-optimizer)."""
+    from ...optimizer import Adam, AdamW, Lamb, Lars, Momentum
+
+    if getattr(strategy, "lamb", False) and type(optimizer) in (Adam, AdamW):
+        cfg = getattr(strategy, "lamb_configs", None) or {}
+        return Lamb(
+            learning_rate=optimizer._learning_rate,
+            lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+            beta1=optimizer._beta1, beta2=optimizer._beta2,
+            epsilon=optimizer._epsilon,
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip)
+    if getattr(strategy, "lars", False) and type(optimizer) is Momentum:
+        cfg = getattr(strategy, "lars_configs", None) or {}
+        return Lars(
+            learning_rate=optimizer._learning_rate,
+            momentum=optimizer._momentum,
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            parameters=optimizer._parameter_list,
+            grad_clip=optimizer._grad_clip)
+    return optimizer

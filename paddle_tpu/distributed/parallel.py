@@ -130,14 +130,20 @@ class DataParallel(Layer):
             b._replace_value(jax.device_put(b.value, repl))
 
     def forward(self, *inputs, **kwargs):
-        placed = [
-            shard_batch(x, self.group)
-            if isinstance(x, (Tensor, jax.Array)) and not isinstance(x, jax.core.Tracer)
-            and getattr(x, "ndim", 0) >= 1
-            and (x.shape[0] % self.group.nranks == 0)
-            else x
-            for x in inputs
-        ]
+        placed = []
+        for x in inputs:
+            shardable = (isinstance(x, (Tensor, jax.Array))
+                         and not isinstance(x, jax.core.Tracer)
+                         and getattr(x, "ndim", 0) >= 1)
+            if shardable and x.shape[0] % self.group.nranks != 0:
+                # Loud, like the reference's Reducer: a silently replicated
+                # batch would forfeit the dp speedup without any signal.
+                raise InvalidArgumentError(
+                    "DataParallel: batch dim %d is not divisible by the "
+                    "data-parallel degree %d; pad the batch or use "
+                    "DistributedBatchSampler(drop_last=True)"
+                    % (x.shape[0], self.group.nranks))
+            placed.append(shard_batch(x, self.group) if shardable else x)
         return self._layers(*placed, **kwargs)
 
     # delegate the Layer surface to the wrapped module ------------------
@@ -157,9 +163,15 @@ class DataParallel(Layer):
         return scale_loss(loss, self.group)
 
     def no_sync(self):
-        """Context manager parity: gradient sync is part of the compiled
-        step on TPU, so no_sync is the degenerate context (gradient
-        accumulation happens functionally — see distributed.fleet grad merge)."""
+        """Context manager parity (parallel.py:xxx no_sync): on TPU the
+        gradient all-reduce is part of the compiled step, so "skipping sync"
+        is expressed as gradient accumulation instead: wrap the optimizer in
+        :class:`paddle_tpu.distributed.fleet.meta_optimizers.GradientMergeOptimizer`
+        (or set ``strategy.gradient_merge`` and use
+        ``fleet.distributed_optimizer``) — its merge buffers accumulate
+        k micro-steps before the single synchronized update, which is
+        exactly what no_sync+step achieves in the reference.  The context
+        itself is a no-op so existing call sites keep working."""
         import contextlib
 
         return contextlib.nullcontext()

