@@ -10,6 +10,16 @@ A ground-up rebuild of the PaddlePaddle capability surface (reference mounted at
 - ``distributed`` maps fleet/collective semantics onto named mesh axes with
   ``shard_map``/pjit and XLA collectives over ICI/DCN.
 """
+import os as _os
+
+if _os.environ.get("JAX_PLATFORMS"):
+    # accelerator plugins pre-registered at interpreter start (sitecustomize)
+    # freeze jax's env snapshot before user code runs; honor the env var
+    # explicitly so JAX_PLATFORMS=cpu really selects cpu
+    import jax as _jax
+
+    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+
 from . import core  # noqa: F401
 from . import tensor  # noqa: F401
 from .core import (  # noqa: F401

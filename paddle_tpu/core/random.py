@@ -25,15 +25,25 @@ class Generator:
     """Stateful key source (framework/generator.cc analog)."""
 
     def __init__(self, seed: int = 0):
+        # key creation is deferred: building a jax key initializes the XLA
+        # backend, and `import paddle_tpu` must stay backend-free (the
+        # launcher parent, spawn children pre-rendezvous, and CLI tools all
+        # import the package before choosing a platform)
         self._seed = seed
-        self._key = jax.random.key(seed)
+        self._key_cache: Optional[jax.Array] = None
         self._counter = 0
         self._lock = threading.Lock()
+
+    @property
+    def _key(self) -> jax.Array:
+        if self._key_cache is None:
+            self._key_cache = jax.random.key(self._seed)
+        return self._key_cache
 
     def manual_seed(self, seed: int) -> "Generator":
         with self._lock:
             self._seed = seed
-            self._key = jax.random.key(seed)
+            self._key_cache = jax.random.key(seed)
             self._counter = 0
         return self
 
@@ -58,7 +68,7 @@ class Generator:
     def set_state(self, state) -> None:
         with self._lock:
             self._seed = state["seed"]
-            self._key = jax.random.key(state["seed"])
+            self._key_cache = jax.random.key(state["seed"])
             self._counter = state["counter"]
 
 

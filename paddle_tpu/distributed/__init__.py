@@ -34,12 +34,14 @@ from .collective import (  # noqa: F401
     stream,
     wait,
 )
+from . import launch  # noqa: F401
 from .parallel import (  # noqa: F401
     DataParallel,
     ParallelEnv,
     scale_loss,
     shard_batch,
 )
+from .spawn import spawn  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology,
     HybridCommunicateGroup,
@@ -53,5 +55,5 @@ __all__ = [
     "new_group", "p2p", "recv", "reduce", "reduce_scatter", "scatter", "send",
     "stream", "wait", "DataParallel", "ParallelEnv", "scale_loss",
     "shard_batch", "CommunicateTopology", "HybridCommunicateGroup",
-    "ParallelMode", "fleet",
+    "ParallelMode", "fleet", "launch", "spawn",
 ]

@@ -132,15 +132,54 @@ def _build_world_group() -> Group:
     return Group(ranks=list(range(len(devices))), mesh=mesh, axis_name="dp", gid=0)
 
 
+def _bootstrap_multihost() -> None:
+    """Rendezvous via ``jax.distributed.initialize`` from PADDLE_TRAINER_* env.
+
+    Reference parity: ``fleet/launch.py`` sets PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ENDPOINTS and ``parallel.py:49``
+    rendezvouses over a TCP store + NCCL id broadcast.  TPU-native: the same
+    env (synthesized by ``paddle_tpu.distributed.launch``) feeds JAX's
+    coordination service — coordinator is rank 0's endpoint (PADDLE_MASTER).
+
+    No-op when the env says single-process, or when the JAX backend/runtime
+    is already initialized (e.g. the TPU runtime rendezvoused at import).
+    """
+    import os
+
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or "1")
+    if nranks <= 1:
+        return
+    try:
+        if jax._src.distributed.global_state.client is not None:
+            return  # already rendezvoused (runtime or a prior call)
+    except AttributeError:  # private API moved: fall through and attempt
+        pass
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    coordinator = os.environ.get("PADDLE_MASTER") or \
+        os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+    # accelerator plugins pre-register and ignore the JAX_PLATFORMS env var;
+    # honor it explicitly so CPU gangs really run on cpu (bench.py does same)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+        # cross-process CPU collectives need the gloo implementation
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
+    jax.distributed.initialize(
+        coordinator_address=coordinator, num_processes=nranks, process_id=rank)
+
+
 def init_parallel_env() -> "Group":
     """``paddle.distributed.init_parallel_env`` parity (parallel.py:49).
 
     Reference: rendezvous via TCP store + NCCL id broadcast.  TPU-native:
-    ``jax.distributed.initialize`` (done by the runtime on multi-host) already
-    rendezvoused; here we just build the world mesh over visible devices.
+    ``jax.distributed.initialize`` from the launcher's PADDLE_TRAINER_* env
+    (multi-host controllers), then build the world mesh over global devices.
     """
     global _default_group
     if _default_group is None:
+        _bootstrap_multihost()
         _default_group = _build_world_group()
         _group_map[0] = _default_group
     return _default_group

@@ -53,11 +53,7 @@ class ShardingOptimizerStage2:
 
         self._inner = optimizer
         self.group = group or _get_default_group()
-        if offload:
-            raise NotImplementedError(
-                "sharding offload (host-staged optimizer states) is not "
-                "implemented yet; states stay in HBM — drop offload=True")
-        self.offload = offload
+        self.offload = bool(offload)
         if optimizer._parameter_list is None:
             raise InvalidArgumentError(
                 "ShardingOptimizerStage2 needs an optimizer constructed with "
@@ -68,15 +64,21 @@ class ShardingOptimizerStage2:
         self._reshard_states()
 
     def _reshard_states(self) -> None:
+        """Place every state tensor sharded on the group axis; with
+        ``offload=True`` the shards live in host memory (ZeRO-offload:
+        ``sharding/offload_helper.py`` moves fp32 states/master weights to
+        host — here it is a ``memory_kind='pinned_host'`` placement and XLA
+        streams the shards over PCIe at update time)."""
         ax = self.group.axis_name
         n = self.group.nranks
+        kind = "pinned_host" if self.offload else None
         for pname, state in self._inner._states.items():
             for k, v in state.items():
                 if not isinstance(v, jax.Array) or v.ndim == 0:
                     continue
                 spec = _dim0_spec(v.shape, n, ax)
                 state[k] = jax.device_put(
-                    v, NamedSharding(self.group.mesh, spec))
+                    v, NamedSharding(self.group.mesh, spec, memory_kind=kind))
 
     # optimizer surface delegation -------------------------------------
     def step(self) -> None:
@@ -131,10 +133,9 @@ class GroupShardedParallel:
 
         self.model = model
         self.group = group or _get_default_group()
-        if offload:
-            raise NotImplementedError(
-                "sharding offload (host-staged optimizer states) is not "
-                "implemented yet; states stay in HBM — drop offload=True")
+        # offload moves optimizer states (incl. fp32 masters) to host like
+        # offload_helper.py; parameters stay in HBM — offloading them would
+        # put a PCIe transfer in every forward
         ax = self.group.axis_name
         n = self.group.nranks
         for p in model.parameters():
@@ -142,8 +143,9 @@ class GroupShardedParallel:
             p._replace_value(jax.device_put(
                 p.value, NamedSharding(self.group.mesh, spec)))
             p.is_distributed = True
-        self.optimizer = (ShardingOptimizerStage2(optimizer, self.group)
-                          if optimizer is not None else None)
+        self.optimizer = (
+            ShardingOptimizerStage2(optimizer, self.group, offload=offload)
+            if optimizer is not None else None)
 
     def __call__(self, *a, **k):
         return self.model(*a, **k)

@@ -335,9 +335,23 @@ class TrainStep:
             )
         for p in self._opt_params:
             optimizer._state_for(p)
+        # ZeRO-offload support: states that live in host memory (sharding
+        # memory_kind='pinned_host') are streamed to device for the update
+        # inside the trace and streamed back after — XLA turns these
+        # device_puts into async PCIe copies overlapping the step
+        self._state_host_shardings = None
         if donate is None:
             donate = _flags.get_flags(["FLAGS_use_donated_buffers"])["FLAGS_use_donated_buffers"]
-        donate_argnums = (0, 1, 2) if donate else ()
+        # offloaded (host-resident) states are excluded from donation: they
+        # hold no HBM, and PjRt aborts on aliasing a pinned_host input buffer
+        # into the device-space update dataflow
+        states_offloaded = any(
+            getattr(getattr(v, "sharding", None), "memory_kind", None)
+            == "pinned_host"
+            for p in self._opt_params
+            for v in jax.tree.leaves(optimizer._states[p.name]))
+        donate_argnums = ((0, 2) if states_offloaded else (0, 1, 2)) \
+            if donate else ()
         self._jitted = jax.jit(self._step, static_argnums=(5,), donate_argnums=donate_argnums)
 
     def _step(self, param_vals, opt_states, buf_vals, key, lr, mode, batch_leaves):
@@ -368,9 +382,18 @@ class TrainStep:
         (loss, new_bufs), grads = jax.value_and_grad(forward, has_aux=True)(diff_vals)
 
         diff_params = [params[i] for i in diff_idx]
+        host_sh = self._state_host_shardings
+        if host_sh is not None:
+            opt_states = jax.tree.map(
+                lambda x, s: x if s is False else jax.device_put(
+                    x, s.with_memory_kind("device")),
+                opt_states, host_sh)
         new_diff_vals, new_states = opt._functional_step(
             diff_params, diff_vals, grads, opt_states, lr
         )
+        # (transfer back to host happens outside the jit boundary in
+        # __call__ — in-trace device_put-to-host is not reliably reflected
+        # in the executable's output memory space)
         new_param_vals = list(param_vals)
         for i, v in zip(diff_idx, new_diff_vals):
             new_param_vals[i] = v
@@ -382,6 +405,17 @@ class TrainStep:
         param_vals = [p._value for p in binding.params]
         buf_vals = [b._value for b in binding.buffers]
         opt_states = [opt._states[p.name] for p in self._opt_params]
+
+        def _host_sharding(x):
+            # False (a pytree leaf, unlike None) marks device-resident states
+            sh = getattr(x, "sharding", None)
+            return sh if getattr(sh, "memory_kind", None) == "pinned_host" \
+                else False
+
+        host_sh = jax.tree.map(_host_sharding, opt_states)
+        self._state_host_shardings = (
+            host_sh if any(s is not False for s in jax.tree.leaves(host_sh))
+            else None)
         key = next_key()
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         mode = binding.mode_token()
@@ -391,7 +425,12 @@ class TrainStep:
         )
         for p, v in zip(binding.params, new_param_vals):
             p._replace_value(v)
-        for p, s in zip(self._opt_params, new_states):
+        host_flags = self._state_host_shardings
+        for i, (p, s) in enumerate(zip(self._opt_params, new_states)):
+            if host_flags is not None:
+                s = jax.tree.map(
+                    lambda x, hs: x if hs is False else jax.device_put(x, hs),
+                    s, host_flags[i])
             opt._states[p.name] = s
         for b, v in zip(binding.buffers, new_bufs):
             b._replace_value(v)

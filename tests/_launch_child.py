@@ -1,0 +1,44 @@
+"""Child script for the launcher smoke test (run under launch.py).
+
+Rendezvouses via init_parallel_env (jax.distributed.initialize from the
+PADDLE_TRAINER_* env the launcher set), then runs a cross-process psum over
+the world mesh and checks it sees every process's devices.
+"""
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    import paddle_tpu.distributed as dist
+
+    group = dist.init_parallel_env()
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    nranks = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert jax.process_count() == nranks, (jax.process_count(), nranks)
+
+    mesh = group.mesh
+    local = np.ones((len(jax.local_devices()),), np.float32)
+    arr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local)
+    total = jax.jit(lambda a: jnp.sum(a),
+                    out_shardings=NamedSharding(mesh, P()))(arr)
+    assert float(total) == jax.device_count(), float(total)
+    print("LAUNCH_OK rank=%d world=%d devices=%d"
+          % (dist.get_rank(), jax.process_count(), jax.device_count()),
+          flush=True)
+
+
+if __name__ == "__main__":
+    if "--fail-once" in sys.argv:
+        sentinel = sys.argv[sys.argv.index("--fail-once") + 1]
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        if not os.path.exists(sentinel):
+            if rank == "0":
+                open(sentinel, "w").close()
+            sys.exit(1)  # first attempt: the whole gang fails
+    main()

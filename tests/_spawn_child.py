@@ -1,0 +1,18 @@
+"""Module-level target for distributed.spawn tests (must be picklable)."""
+import os
+
+
+def check_world(expected: int, out_dir: str):
+    import paddle_tpu.distributed as dist
+
+    dist.init_parallel_env()
+    import jax
+
+    assert jax.process_count() == expected
+    rank = dist.get_rank()
+    with open(os.path.join(out_dir, "rank%d.ok" % rank), "w") as f:
+        f.write(str(jax.device_count()))
+
+
+def boom(_unused: int, _out: str):
+    raise RuntimeError("intentional child failure")
