@@ -66,6 +66,10 @@ def build_child_env(rank: int, world_size: int, endpoints: List[str],
     return env
 
 
+# the currently-running gang, for signal-time teardown (see main)
+_live_gang: List = []
+
+
 def _spawn_gang(args, endpoints: List[str], log_dir: Optional[str]):
     procs = []
     nproc = args.nproc_per_node
@@ -84,6 +88,7 @@ def _spawn_gang(args, endpoints: List[str], log_dir: Optional[str]):
         procs.append((rank, subprocess.Popen(
             cmd, env=env, stdout=out, stderr=subprocess.STDOUT if out else None),
             out))
+    _live_gang[:] = procs
     return procs
 
 
@@ -171,7 +176,14 @@ def _parse(argv):
 
 def main(argv=None) -> int:
     args = _parse(sys.argv[1:] if argv is None else argv)
-    # forward SIGTERM/SIGINT to the gang via normal teardown
+    # SIGTERM/SIGINT (scheduler preemption, ^C) must tear the gang down —
+    # a dead launcher must not orphan trainers holding ports and chips
+    def _on_signal(signum, frame):
+        _kill_gang(_live_gang)
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
     code = launch(args)
     return code
 

@@ -39,13 +39,27 @@ class ParallelContext:
         self._err_queue = err_queue
 
     def join(self, timeout: Optional[float] = None) -> bool:
-        for p in self.processes:
-            p.join(timeout)
-        failed = [p for p in self.processes if p.exitcode not in (0, None)]
+        """Wait for the gang, reacting to the FIRST failure: a child that
+        dies pre-rendezvous would otherwise leave its peers blocked inside
+        ``jax.distributed.initialize`` forever (reference spawn.py tears the
+        rest down on first exit too)."""
+        import time as _time
+
+        deadline = None if timeout is None else _time.monotonic() + timeout
+        while True:
+            codes = [p.exitcode for p in self.processes]
+            failed = [c for c in codes if c not in (0, None)]
+            done = all(c is not None for c in codes)
+            timed_out = deadline is not None and _time.monotonic() > deadline
+            if failed or done or timed_out:
+                break
+            _time.sleep(0.05)
         if failed:
             for p in self.processes:
                 if p.is_alive():
                     p.terminate()
+            for p in self.processes:
+                p.join(10)
             msgs = []
             while not self._err_queue.empty():
                 rank, tb = self._err_queue.get()

@@ -229,6 +229,9 @@ NON_DIFFERENTIABLE: Set[str] = {
     "logical_not", "logical_xor", "isfinite", "isinf", "isnan", "allclose",
     "isclose", "bernoulli", "multinomial", "poisson", "randint", "randperm",
     "unique", "sign", "floor_divide", "mod", "remainder",
+    # host-boundary / integer-metadata ragged ops (tensor/segment.py)
+    "sequence_pad", "sequence_unpad", "sequence_mask",
+    "lengths_to_segment_ids",
 }
 
 

@@ -88,6 +88,22 @@ class TransformerLM(Layer):
         )
         self.encoder = TransformerEncoder(layer, num_layers)
         self.final_norm = LayerNorm(hidden_size)
+        self._sequence_parallel = False
+
+    def enable_sequence_parallel(self, group=None, mode: str = "ring"):
+        """Train with the sequence dim sharded over the ``sep`` mesh axis.
+
+        Every attention block switches to ring/Ulysses attention
+        (``meta_parallel/sequence_parallel.py``); causality moves from the
+        materialized additive mask into the SP kernel, so no [L, L] mask is
+        ever built.  Activations between blocks are per-position math that
+        GSPMD shards along the sequence automatically.
+        """
+        for enc_layer in self.encoder.layers:
+            enc_layer.self_attn.enable_sequence_parallel(
+                group, mode=mode, causal=self.causal)
+        self._sequence_parallel = True
+        return self
 
     def _causal_mask(self, seq_len: int, dtype):
         # additive mask: 0 on/below diagonal, -inf above
@@ -100,7 +116,7 @@ class TransformerLM(Layer):
         pos = T.arange(0, seq_len, dtype="int64")
         h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         h = self.embed_dropout(h)
-        if attn_mask is None and self.causal:
+        if attn_mask is None and self.causal and not self._sequence_parallel:
             attn_mask = Tensor(
                 self._causal_mask(seq_len, h.value.dtype), stop_gradient=True
             )

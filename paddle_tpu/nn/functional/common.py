@@ -201,6 +201,7 @@ def scaled_dot_product_attention(
     """
     from ...ops.flash_attention import (
         detect_causal_additive_mask,
+        detect_padding_additive_mask,
         flash_attention,
         flash_attention_supported,
     )
@@ -216,7 +217,14 @@ def scaled_dot_product_attention(
         causal = is_causal
         if not causal and detect_causal_additive_mask(mask, query.shape[-2]):
             causal, mask = True, None
-        return flash_attention(query, key, value, bias=mask, causal=causal)
+        key_mask = None
+        if mask is not None:
+            pad_valid = detect_padding_additive_mask(mask)
+            if pad_valid is not None and \
+                    pad_valid.shape[-1] == key.shape[-2]:
+                key_mask, mask = jnp.asarray(pad_valid), None
+        return flash_attention(query, key, value, bias=mask, causal=causal,
+                               key_padding_mask=key_mask)
     scores = jnp.einsum("...qd,...kd->...qk", query, key) / jnp.sqrt(d).astype(query.dtype)
     if is_causal:
         q_len, k_len = scores.shape[-2], scores.shape[-1]
@@ -234,11 +242,11 @@ def scaled_dot_product_attention(
 
 
 def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="int64"):
-    if maxlen is None:
-        maxlen = int(jnp.max(lengths))
-    row = jnp.arange(maxlen)
-    mask = row[None, :] < lengths[..., None]
-    return mask.astype(convert_dtype(dtype))
+    """Delegates to ``tensor.segment.sequence_mask`` (single implementation;
+    the int64 default is this API's paddle-parity surface)."""
+    from ...tensor.segment import sequence_mask as _impl
+
+    return _impl(lengths, maxlen=maxlen, dtype=dtype)
 
 
 def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25, data_format: str = "NCHW"):

@@ -64,6 +64,58 @@ class MultiHeadAttention(Layer):
         self.k_proj = Linear(self.kdim, embed_dim, weight_attr, bias_attr)
         self.v_proj = Linear(self.vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self._sep_attn = None  # set by enable_sequence_parallel
+
+    def enable_sequence_parallel(self, group=None, mode: str = "ring",
+                                 causal: bool = False):
+        """Sequence-parallel attention over the ``sep`` mesh axis (SURVEY §5.7).
+
+        Activations stay global-view; the attention inner product runs inside
+        ``shard_map`` with the sequence dim sharded on the sep axis:
+        ``mode='ring'`` rotates K/V blocks with ``lax.ppermute`` (ICI
+        neighbor exchange + online softmax), ``mode='ulysses'`` reshards
+        seq→heads with ``lax.all_to_all``.  GSPMD propagates the sequence
+        sharding through the surrounding per-position layers, so the rest of
+        the block parallelizes for free.
+
+        Constraints (flash-style kernels): no attention-prob dropout, no
+        arbitrary additive masks — causality is expressed via ``causal``.
+        """
+        from jax.sharding import PartitionSpec as P
+
+        from ...core.errors import InvalidArgumentError
+        from ...distributed.collective import shard_map
+        from ...distributed.meta_parallel.sequence_parallel import (
+            ring_attention, ulysses_attention)
+        from ...framework.dispatch import make_op
+
+        if self.dropout:
+            raise InvalidArgumentError(
+                "sequence-parallel attention has no prob-dropout path; "
+                "construct the layer with dropout=0.0")
+        if mode not in ("ring", "ulysses"):
+            raise InvalidArgumentError(
+                "sequence_parallel mode must be 'ring' or 'ulysses', got %r"
+                % mode)
+        if group is None:
+            from ...distributed.fleet import fleet
+
+            group = fleet.get_hybrid_communicate_group() \
+                .get_sep_parallel_group()
+        ax = group.axis_name
+        if mode == "ulysses" and self.num_heads % group.nranks != 0:
+            raise InvalidArgumentError(
+                "ulysses needs num_heads %% sep_degree == 0, got H=%d n=%d"
+                % (self.num_heads, group.nranks))
+        inner = ring_attention if mode == "ring" else ulysses_attention
+
+        spec = P(None, None, ax, None)
+        sep_attn = shard_map(
+            lambda qq, kk, vv: inner(qq, kk, vv, ax, causal=causal),
+            mesh=group.mesh, in_specs=(spec, spec, spec), out_specs=spec)
+        self._sep_attn = make_op(sep_attn, op_name="sep_attention_" + mode)
+        self._sep_causal = causal
+        return self
 
     def _split_heads(self, x):
         from ... import tensor as T
@@ -109,10 +161,22 @@ class MultiHeadAttention(Layer):
                 v = T.concat([cache.v, v], axis=2)
                 cache = self.Cache(k, v)
 
-        mask = _convert_attn_mask(attn_mask, q.dtype)
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=mask, dropout_p=self.dropout, training=self.training
-        )
+        if self._sep_attn is not None:
+            if attn_mask is not None:
+                raise InvalidArgumentError(
+                    "sequence-parallel attention supports causality via "
+                    "enable_sequence_parallel(causal=True), not additive "
+                    "masks; pass attn_mask=None")
+            if cache is not None:
+                raise InvalidArgumentError(
+                    "sequence-parallel attention does not support decode "
+                    "caches; disable SP for incremental decoding")
+            out = self._sep_attn(q, k, v)
+        else:
+            mask = _convert_attn_mask(attn_mask, q.dtype)
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=mask, dropout_p=self.dropout, training=self.training
+            )
         out = self.out_proj(self._merge_heads(out))
         if isinstance(cache, self.Cache):
             return (out, cache) if not self.need_weights else (out, None, cache)

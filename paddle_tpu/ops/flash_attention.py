@@ -49,13 +49,17 @@ def flash_attention_supported(q_shape, dtype, dropout_p: float = 0.0) -> bool:
     return jnp.dtype(dtype) in _SUPPORTED_DTYPES
 
 
-def _reference_attention(q, k, v, bias, causal, sm_scale):
+def _reference_attention(q, k, v, bias, causal, sm_scale, segment_ids=None):
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(
         sm_scale, q.dtype)
     if causal:
         ql, kl = scores.shape[-2], scores.shape[-1]
         allow = jnp.tril(jnp.ones((ql, kl), dtype=bool))
         scores = jnp.where(allow, scores, jnp.finfo(scores.dtype).min)
+    if segment_ids is not None:
+        q_seg, kv_seg = segment_ids
+        same = q_seg[:, None, :, None] == kv_seg[:, None, None, :]
+        scores = jnp.where(same, scores, jnp.finfo(scores.dtype).min)
     if bias is not None:
         scores = scores + bias.astype(scores.dtype)
     weights = jax.nn.softmax(scores, axis=-1)
@@ -63,19 +67,44 @@ def _reference_attention(q, k, v, bias, causal, sm_scale):
 
 
 def flash_attention(q, k, v, bias=None, causal: bool = False,
-                    sm_scale: Optional[float] = None):
+                    sm_scale: Optional[float] = None,
+                    key_padding_mask=None, segment_ids=None):
     """[B, H, L, D] attention; pallas kernel on TPU, XLA fallback elsewhere.
 
     ``bias``: additive attention bias broadcastable to [B, H, Lq, Lk]
-    (the paddle additive attn_mask convention).
+    (the paddle additive attn_mask convention).  Prefer the O(L) forms for
+    ragged batches — they never materialize an [L, L] mask:
+
+    ``key_padding_mask``: [B, Lk] bool, True = real token (from
+    ``tensor.sequence_mask``); padded keys are excluded from every softmax.
+    ``segment_ids``: ([B, Lq], [B, Lk]) int pair — attention is confined to
+    positions with equal ids (packed-sequence / LoD batches, from
+    ``tensor.lengths_to_segment_ids``); maps directly onto the pallas
+    kernel's SegmentIds lanes.
     """
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
+    if key_padding_mask is not None:
+        if segment_ids is not None:
+            raise ValueError(
+                "pass either key_padding_mask or segment_ids, not both")
+        # valid keys → segment 0; pads → 1.  Queries are all segment 0 (their
+        # pad rows are ignored downstream), so every softmax sees only real
+        # keys.  [B, L] ints instead of an [L, L] mask.
+        kv_seg = jnp.where(jnp.asarray(key_padding_mask, bool), 0, 1) \
+            .astype(jnp.int32)
+        q_seg = jnp.zeros((q.shape[0], q.shape[2]), jnp.int32)
+        segment_ids = (q_seg, kv_seg)
+    elif segment_ids is not None:
+        segment_ids = (jnp.asarray(segment_ids[0], jnp.int32),
+                       jnp.asarray(segment_ids[1], jnp.int32))
     if not flash_attention_supported(q.shape, q.dtype):
-        return _reference_attention(q, k, v, bias, causal, sm_scale)
+        return _reference_attention(q, k, v, bias, causal, sm_scale,
+                                    segment_ids)
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
+        SegmentIds,
         flash_attention as _pallas_flash,
     )
 
@@ -96,7 +125,10 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
             block_q_major_dkv=blk, block_k_major_dkv=blk, block_k_dkv=blk,
             block_q_dkv=blk, block_k_major_dq=blk, block_k_dq=blk,
             block_q_dq=blk)
-    return _pallas_flash(q, k, v, ab=ab, causal=causal,
+    return _pallas_flash(q, k, v, ab=ab,
+                         segment_ids=(SegmentIds(*segment_ids)
+                                      if segment_ids is not None else None),
+                         causal=causal,
                          sm_scale=float(sm_scale), block_sizes=block_sizes)
 
 
@@ -107,6 +139,53 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
 # a new allocation recycles the id (id-only keys are unsound).
 _detect_cache: dict = {}
 _DETECT_CACHE_MAX = 64
+
+
+_pad_detect_cache: dict = {}
+
+
+def detect_padding_additive_mask(mask):
+    """[B, 1, 1, Lk] additive padding mask → [B, Lk] bool validity, else
+    None.  Catches the standard paddle convention (0 = keep, big-negative =
+    pad) so the flash path can use O(L) segment lanes instead of
+    broadcasting the bias to [B, H, Lq, Lk] — the exact O(L²·H) HBM
+    materialization the kernel exists to avoid.  Only the [B, 1, 1, Lk]
+    layout is claimed: a 2-D additive mask means [Lq, Lk] in paddle, which
+    is per-query, not key padding.  Concrete masks only; traced masks go
+    down the general bias path.  Verdicts are identity-cached like
+    ``detect_causal_additive_mask`` — masks are typically built once per
+    model, and the readback is a blocking device→host copy."""
+    if mask is None or isinstance(mask, jax.core.Tracer):
+        return None
+    shape = getattr(mask, "shape", None)
+    if shape is None or len(shape) != 4 or shape[1] != 1 or shape[2] != 1:
+        return None
+    import weakref
+
+    key = id(mask)
+    hit = _pad_detect_cache.get(key)
+    if hit is not None and hit[0]() is mask:
+        return hit[1]
+    m = np.asarray(mask)[:, 0, 0, :]
+    if m.dtype == np.bool_:
+        valid = m
+    else:
+        neg = np.finfo(np.float32).min / 2
+        ok = m == 0
+        pad = m <= neg
+        valid = None if not np.all(ok | pad) else ok  # else: general bias
+    try:
+        ref = weakref.ref(mask)
+    except TypeError:  # pragma: no cover - non-weakrefable array type
+        return valid
+    if len(_pad_detect_cache) >= _DETECT_CACHE_MAX:
+        dead = [k for k, v in _pad_detect_cache.items() if v[0]() is None]
+        for k in dead:
+            del _pad_detect_cache[k]
+        if len(_pad_detect_cache) >= _DETECT_CACHE_MAX:
+            _pad_detect_cache.clear()
+    _pad_detect_cache[key] = (ref, valid)
+    return valid
 
 
 def detect_causal_additive_mask(mask, seq_len: Optional[int] = None) -> bool:

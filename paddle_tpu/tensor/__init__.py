@@ -212,13 +212,27 @@ from .random import (  # noqa: F401
     uniform,
 )
 from .search import argmax, argmin, argsort, index_sample, kthvalue, masked_select, mode, nonzero, searchsorted, sort, topk, where  # noqa: F401
+from .segment import (  # noqa: F401
+    lengths_to_segment_ids,
+    masked_mean,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_softmax,
+    segment_sum,
+    sequence_mask,
+    sequence_pad,
+    sequence_unpad,
+)
 from .stat import median, nanmedian, quantile  # noqa: F401
 
 
 def _install_name_kwarg():
-    from . import _compat, attribute, creation, einsum, linalg, logic, manipulation, math, random, search, stat
+    from . import (_compat, attribute, creation, einsum, linalg, logic,
+                   manipulation, math, random, search, segment, stat)
 
-    for mod in (attribute, creation, einsum, linalg, logic, manipulation, math, random, search, stat):
+    for mod in (attribute, creation, einsum, linalg, logic, manipulation,
+                math, random, search, segment, stat):
         _compat.install_name_kwarg(vars(mod))
     _compat.install_name_kwarg(globals())
 

@@ -1,0 +1,188 @@
+"""Ragged/variable-length utilities: the LoD story, densified.
+
+Reference parity: LoDTensor (``paddle/fluid/framework/lod_tensor.h``) carries
+ragged batches as flat data + level-of-detail offsets, with sequence ops
+(``fluid/layers/sequence_lod.py``: sequence_pad/sequence_unpad/sequence_mask)
+and segment pooling (``python/paddle/incubate/tensor/math.py``:
+segment_sum/mean/max/min, ``paddle/geometric`` segment_softmax) consuming it.
+
+TPU-native design (SURVEY §7 hard parts): ragged shapes are hostile to XLA —
+every distinct LoD would retrace.  The rebuild keeps **dense padded tensors +
+integer metadata** (lengths / segment ids), both static-shaped: pad once at
+the host boundary, express all ragged math with masks and segment reductions
+that compile to fixed-shape scatter/gather on device, and unpad only when
+leaving the device.  ``num_segments`` is a static int under jit for the same
+reason.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import canonicalize
+from ..core.errors import InvalidArgumentError
+
+__all__ = [
+    "sequence_mask", "sequence_pad", "sequence_unpad", "lengths_to_segment_ids",
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "segment_softmax", "masked_mean",
+]
+
+
+def sequence_mask(lengths, maxlen: Optional[int] = None, dtype="bool"):
+    """[B] lengths → [B, maxlen] validity mask (sequence_lod.py parity).
+
+    ``maxlen`` must be static under jit (it is a shape); defaults to
+    ``max(lengths)`` eagerly.
+    """
+    if maxlen is None:
+        if isinstance(lengths, jax.core.Tracer):
+            raise InvalidArgumentError(
+                "sequence_mask under jit needs an explicit maxlen (shapes "
+                "are static under XLA); pass maxlen=")
+        maxlen = int(np.max(np.asarray(lengths))) if np.size(
+            np.asarray(lengths)) else 0
+    pos = jnp.arange(int(maxlen))
+    mask = pos < jnp.asarray(lengths)[..., None]
+    return mask if dtype in ("bool", jnp.bool_) else mask.astype(
+        canonicalize(dtype))
+
+
+def sequence_pad(sequences: Sequence, pad_value=0.0,
+                 maxlen: Optional[int] = None):
+    """List of [Li, ...] arrays → ([B, maxlen, ...] padded, [B] lengths).
+
+    The host-boundary half of the LoD replacement: ragged data enters the
+    device exactly once, as one static-shaped tensor (sequence_pad op
+    parity, ``fluid/layers/sequence_lod.py:sequence_pad``).
+    """
+    if not len(sequences):
+        raise InvalidArgumentError("sequence_pad needs at least one sequence")
+    arrs = [np.asarray(s) for s in sequences]
+    lengths = np.asarray([a.shape[0] for a in arrs], np.int32)
+    cap = int(maxlen) if maxlen is not None else int(lengths.max())
+    if maxlen is not None and int(lengths.max()) > cap:
+        raise InvalidArgumentError(
+            "sequence_pad: a sequence of length %d exceeds maxlen=%d"
+            % (int(lengths.max()), cap))
+    tail = arrs[0].shape[1:]
+    out = np.full((len(arrs), cap) + tail, pad_value, dtype=arrs[0].dtype)
+    for i, a in enumerate(arrs):
+        out[i, :a.shape[0]] = a
+    return jnp.asarray(out), jnp.asarray(lengths)
+
+
+def sequence_unpad(x, length) -> List:
+    """[B, L, ...] + [B] lengths → list of [Li, ...] (sequence_unpad parity).
+
+    Host-boundary op: ragged output shapes cannot live on device.
+    """
+    xs = np.asarray(x)
+    ls = np.asarray(length)
+    return [jnp.asarray(xs[i, :int(ls[i])]) for i in range(xs.shape[0])]
+
+
+def lengths_to_segment_ids(lengths, maxlen: Optional[int] = None):
+    """[B] lengths → [B, maxlen] int32 ids: row index where valid, -1 on pad.
+
+    Feeds the flash-attention segment path and the segment_* reductions:
+    ragged batch-of-sequences becomes one flat segmented axis.
+    """
+    mask = sequence_mask(lengths, maxlen=maxlen)
+    b = mask.shape[0]
+    rows = jnp.broadcast_to(jnp.arange(b, dtype=jnp.int32)[:, None],
+                            mask.shape)
+    return jnp.where(mask, rows, jnp.int32(-1))
+
+
+def _num_segments(segment_ids, num_segments: Optional[int]) -> int:
+    if num_segments is not None:
+        return int(num_segments)
+    if isinstance(segment_ids, jax.core.Tracer):
+        raise InvalidArgumentError(
+            "segment ops under jit need static num_segments= (XLA shapes "
+            "are static)")
+    ids = np.asarray(segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def segment_sum(data, segment_ids, num_segments: Optional[int] = None):
+    """Per-segment sum (incubate segment_sum parity); ids < 0 are dropped
+    (padding).  Compiles to one static-shape scatter-add."""
+    n = _num_segments(segment_ids, num_segments)
+    ids = jnp.asarray(segment_ids)
+    flat_ids = ids.reshape(-1)
+    flat = jnp.asarray(data).reshape((flat_ids.shape[0],) +
+                                     jnp.shape(data)[ids.ndim:])
+    return jax.ops.segment_sum(
+        jnp.where((flat_ids >= 0)[(...,) + (None,) * (flat.ndim - 1)],
+                  flat, 0),
+        jnp.where(flat_ids >= 0, flat_ids, n), num_segments=n + 1)[:n]
+
+
+def segment_mean(data, segment_ids, num_segments: Optional[int] = None):
+    n = _num_segments(segment_ids, num_segments)
+    total = segment_sum(data, segment_ids, n)
+    ids = jnp.asarray(segment_ids)
+    counts = segment_sum(jnp.ones(ids.shape, total.dtype), ids, n)
+    counts = counts.reshape(counts.shape + (1,) * (total.ndim - counts.ndim))
+    return total / jnp.maximum(counts, 1)
+
+
+def _segment_extreme(data, segment_ids, num_segments, minimum, op):
+    n = _num_segments(segment_ids, num_segments)
+    ids = jnp.asarray(segment_ids).reshape(-1)
+    flat = jnp.asarray(data).reshape(
+        (ids.shape[0],) + jnp.shape(data)[jnp.asarray(segment_ids).ndim:])
+    safe_ids = jnp.where(ids >= 0, ids, n)
+    if jnp.issubdtype(flat.dtype, jnp.integer):
+        info = jnp.iinfo(flat.dtype)
+        init = info.min if minimum else info.max
+    else:
+        init = -jnp.inf if minimum else jnp.inf
+    out = jnp.full((n + 1,) + flat.shape[1:], init, flat.dtype)
+    out = op(out.at[safe_ids], flat)[:n]
+    # empty segments report 0, matching the reference's segment pool ops;
+    # detected by count, which is dtype-agnostic (isfinite is vacuous on ints)
+    counts = jax.ops.segment_sum(
+        jnp.where(ids >= 0, 1, 0), safe_ids, num_segments=n + 1)[:n]
+    counts = counts.reshape(counts.shape + (1,) * (out.ndim - 1))
+    return jnp.where(counts > 0, out, jnp.zeros((), out.dtype))
+
+
+def segment_max(data, segment_ids, num_segments: Optional[int] = None):
+    return _segment_extreme(data, segment_ids, num_segments,
+                            True, lambda ref, v: ref.max(v))
+
+
+def segment_min(data, segment_ids, num_segments: Optional[int] = None):
+    return _segment_extreme(data, segment_ids, num_segments,
+                            False, lambda ref, v: ref.min(v))
+
+
+def segment_softmax(data, segment_ids, num_segments: Optional[int] = None):
+    """Softmax normalized within each segment (paddle.geometric parity) —
+    the ragged-attention primitive, expressed as two segment reductions."""
+    n = _num_segments(segment_ids, num_segments)
+    ids = jnp.asarray(segment_ids)
+    mx = segment_max(data, ids, n)
+    mx_full = jnp.where(jnp.isfinite(mx), mx, 0)[ids]
+    e = jnp.where(ids >= 0, jnp.exp(jnp.asarray(data) - mx_full), 0)
+    den = segment_sum(e, ids, n)[jnp.where(ids >= 0, ids, 0)]
+    return jnp.where(ids >= 0, e / jnp.maximum(den, 1e-30), 0)
+
+
+def masked_mean(x, mask, axis=None):
+    """Mean over positions where ``mask`` is true — the masked-loss reducer
+    for variable-length batches."""
+    m = jnp.asarray(mask)
+    if m.dtype != jnp.bool_:
+        m = m.astype(bool)
+    x = jnp.asarray(x)
+    m = jnp.broadcast_to(m, x.shape)
+    total = jnp.sum(jnp.where(m, x, 0), axis=axis)
+    count = jnp.sum(m, axis=axis)
+    return total / jnp.maximum(count, 1)

@@ -39,10 +39,9 @@ def _run_launch(extra_args, env, timeout=240):
 def test_launch_two_process_psum():
     r = _run_launch(["--nproc_per_node", "2", CHILD], _clean_env(2))
     assert r.returncode == 0, r.stdout + r.stderr
-    oks = [l for l in r.stdout.splitlines() if l.startswith("LAUNCH_OK")]
-    assert len(oks) == 2, r.stdout + r.stderr
+    assert r.stdout.count("LAUNCH_OK") == 2, r.stdout + r.stderr
     # each rank saw the full 4-device world (2 procs x 2 local devices)
-    assert all("world=2 devices=4" in l for l in oks), oks
+    assert r.stdout.count("world=2 devices=4") == 2, r.stdout
 
 
 @pytest.mark.slow
@@ -55,8 +54,8 @@ def test_launch_elastic_relaunch(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     assert os.path.exists(sentinel)  # first attempt really did fail
     assert "relaunching gang" in r.stderr
-    assert len([l for l in r.stdout.splitlines()
-                if l.startswith("LAUNCH_OK")]) == 2
+    # count occurrences, not lines: concurrent children may interleave writes
+    assert r.stdout.count("LAUNCH_OK") == 2
 
 
 @pytest.mark.slow

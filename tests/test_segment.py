@@ -1,0 +1,198 @@
+"""Ragged/LoD story tests (VERDICT r2 #8): padded + segment-id utilities and
+the O(L) padding path through attention.
+
+Reference behaviors matched: LoDTensor sequence ops
+(``fluid/layers/sequence_lod.py``), ``paddle.incubate.segment_*`` pooling,
+``paddle.geometric.segment_softmax`` — expressed dense+static for XLA.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_sequence_mask_values():
+    m = pt.sequence_mask(pt.to_tensor(np.array([2, 0, 3], np.int32)),
+                         maxlen=4)
+    np.testing.assert_array_equal(
+        np.asarray(m.value),
+        [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+    mf = pt.sequence_mask(np.array([1], np.int32), maxlen=2, dtype="float32")
+    assert str(mf.value.dtype) == "float32"
+
+
+def test_sequence_pad_unpad_roundtrip():
+    seqs = [np.arange(3, dtype=np.float32).reshape(3, 1),
+            np.arange(5, dtype=np.float32).reshape(5, 1)]
+    padded, lengths = pt.sequence_pad(seqs, pad_value=-1.0)
+    assert padded.shape == [2, 5, 1]
+    np.testing.assert_array_equal(np.asarray(lengths.value), [3, 5])
+    assert float(np.asarray(padded.value)[0, 4, 0]) == -1.0
+    out = pt.sequence_unpad(padded, lengths)
+    for o, s in zip(out, seqs):
+        np.testing.assert_array_equal(np.asarray(o.value), s)
+    with pytest.raises(Exception, match="maxlen"):
+        pt.sequence_pad(seqs, maxlen=4)
+
+
+def test_segment_reductions_match_loop():
+    rng = np.random.RandomState(0)
+    data = rng.randn(10, 3).astype(np.float32)
+    ids = np.array([0, 0, 1, 1, 1, 3, 3, -1, -1, 0], np.int32)  # -1 = pad
+    n = 4
+    s = np.asarray(pt.segment_sum(pt.to_tensor(data), pt.to_tensor(ids),
+                                  num_segments=n).value)
+    m = np.asarray(pt.segment_mean(pt.to_tensor(data), pt.to_tensor(ids),
+                                   num_segments=n).value)
+    mx = np.asarray(pt.segment_max(pt.to_tensor(data), pt.to_tensor(ids),
+                                   num_segments=n).value)
+    mn = np.asarray(pt.segment_min(pt.to_tensor(data), pt.to_tensor(ids),
+                                   num_segments=n).value)
+    for seg in range(n):
+        rows = data[ids == seg]
+        if len(rows):
+            np.testing.assert_allclose(s[seg], rows.sum(0), rtol=1e-6)
+            np.testing.assert_allclose(m[seg], rows.mean(0), rtol=1e-6)
+            np.testing.assert_allclose(mx[seg], rows.max(0), rtol=1e-6)
+            np.testing.assert_allclose(mn[seg], rows.min(0), rtol=1e-6)
+        else:  # empty segment (id 2) reports zeros like the reference
+            np.testing.assert_array_equal(s[seg], np.zeros(3))
+            np.testing.assert_array_equal(mx[seg], np.zeros(3))
+
+
+def test_segment_softmax_matches_loop():
+    rng = np.random.RandomState(1)
+    data = rng.randn(8).astype(np.float32)
+    ids = np.array([0, 0, 0, 1, 1, -1, 2, 2], np.int32)
+    out = np.asarray(pt.segment_softmax(
+        pt.to_tensor(data), pt.to_tensor(ids), num_segments=3).value)
+    for seg in range(3):
+        sel = ids == seg
+        e = np.exp(data[sel] - data[sel].max())
+        np.testing.assert_allclose(out[sel], e / e.sum(), rtol=1e-5)
+    np.testing.assert_array_equal(out[ids == -1], [0.0])
+
+
+def test_segment_sum_grad_flows():
+    data = pt.to_tensor(np.ones((4, 2), np.float32))
+    data.stop_gradient = False
+    ids = pt.to_tensor(np.array([0, 1, 1, -1], np.int32))
+    out = pt.segment_sum(data, ids, num_segments=2)
+    out.sum().backward()
+    g = np.asarray(data.grad.value)
+    np.testing.assert_array_equal(g, [[1, 1], [1, 1], [1, 1], [0, 0]])
+
+
+def test_masked_mean():
+    x = np.array([[1.0, 2.0, 30.0], [4.0, 50.0, 60.0]], np.float32)
+    mask = np.array([[1, 1, 0], [1, 0, 0]], bool)
+    out = float(pt.masked_mean(pt.to_tensor(x), pt.to_tensor(mask)).value)
+    assert out == pytest.approx((1 + 2 + 4) / 3)
+
+
+def test_lengths_to_segment_ids():
+    ids = np.asarray(pt.lengths_to_segment_ids(
+        np.array([2, 1], np.int32), maxlen=3).value)
+    np.testing.assert_array_equal(ids, [[0, 0, -1], [1, -1, -1]])
+
+
+def test_reference_attention_segment_ids_match_dense_mask():
+    """The segment-id path (what the pallas kernel consumes on TPU) equals
+    explicit dense masking — validated on the XLA fallback."""
+    from paddle_tpu.ops.flash_attention import _reference_attention
+
+    rng = np.random.RandomState(2)
+    B, H, L, D = 2, 2, 8, 4
+    q, k, v = (rng.randn(B, H, L, D).astype(np.float32) for _ in range(3))
+    lengths = np.array([5, 8], np.int32)
+    valid = np.arange(L)[None, :] < lengths[:, None]
+
+    kv_seg = np.where(valid, 0, 1).astype(np.int32)
+    q_seg = np.zeros((B, L), np.int32)
+    out_seg = _reference_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), None, False,
+        1 / np.sqrt(D), (jnp.asarray(q_seg), jnp.asarray(kv_seg)))
+
+    bias = np.where(valid, 0, np.finfo(np.float32).min)[:, None, None, :]
+    out_bias = _reference_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), jnp.asarray(bias),
+        False, 1 / np.sqrt(D))
+    np.testing.assert_allclose(
+        np.asarray(out_seg)[valid[:, None, :, None].repeat(H, 1)
+                            .repeat(D, 3)],
+        np.asarray(out_bias)[valid[:, None, :, None].repeat(H, 1)
+                             .repeat(D, 3)], rtol=1e-5, atol=1e-6)
+
+
+def test_detect_padding_additive_mask():
+    from paddle_tpu.ops.flash_attention import detect_padding_additive_mask
+
+    valid = np.array([[True, True, False], [True, False, False]])
+    add = np.where(valid, 0, np.finfo(np.float32).min)[:, None, None, :]
+    got = detect_padding_additive_mask(jnp.asarray(add))
+    np.testing.assert_array_equal(got, valid)
+    # a general bias is not claimed to be padding
+    assert detect_padding_additive_mask(jnp.asarray(
+        add + np.float32(0.5))) is None
+    assert detect_padding_additive_mask(None) is None
+    # 2-D additive masks are [Lq, Lk] (per-query) in paddle — never claimed
+    two_d = np.where(valid, 0, np.finfo(np.float32).min).astype(np.float32)
+    assert detect_padding_additive_mask(jnp.asarray(two_d)) is None
+    # verdicts are identity-cached (second call hits the cache)
+    m = jnp.asarray(add)
+    first = detect_padding_additive_mask(m)
+    second = detect_padding_additive_mask(m)
+    assert first is second
+
+
+def test_segment_extremes_int_dtype_empty_segment():
+    data = pt.to_tensor(np.array([5, 3], np.int32))
+    ids = pt.to_tensor(np.array([0, 0], np.int32))
+    mx = pt.segment_max(data, ids, num_segments=2)
+    mn = pt.segment_min(data, ids, num_segments=2)
+    np.testing.assert_array_equal(np.asarray(mx.value), [5, 0])
+    np.testing.assert_array_equal(np.asarray(mn.value), [3, 0])
+
+
+def test_variable_length_lm_matches_per_example_loop():
+    """A padded variable-length batch through TransformerLM (additive padding
+    mask + masked loss) equals running each sequence unpadded — the LoD
+    workload expressed dense."""
+    from paddle_tpu.models import TransformerLM
+
+    def make_model():
+        pt.seed(0)
+        return TransformerLM(vocab_size=32, hidden_size=16, num_layers=2,
+                             num_heads=2, intermediate_size=32,
+                             max_position=16, dropout=0.0, causal=False)
+
+    rng = np.random.RandomState(3)
+    lengths = np.array([4, 7], np.int32)
+    L = 8
+    ids = rng.randint(0, 32, (2, L)).astype("int64")
+
+    model = make_model()
+    model.eval()
+    valid = np.asarray(pt.sequence_mask(lengths, maxlen=L).value)
+    mask = np.where(valid, 0, np.finfo(np.float32).min)[:, None, None, :] \
+        .astype(np.float32)
+    logits = model(pt.to_tensor(ids), attn_mask=pt.to_tensor(mask))
+
+    model2 = make_model()
+    model2.eval()
+    for b in range(2):
+        lb = int(lengths[b])
+        solo = model2(pt.to_tensor(ids[b:b + 1, :lb]))
+        np.testing.assert_allclose(
+            np.asarray(logits.value)[b, :lb], np.asarray(solo.value)[0],
+            rtol=2e-4, atol=2e-5)
+
+    # masked loss: per-token CE averaged over valid positions only
+    labels = pt.to_tensor(ids)
+    per_tok = pt.nn.functional.cross_entropy(
+        pt.reshape(logits, [-1, 32]), pt.reshape(labels, [-1]),
+        reduction="none")
+    masked = pt.masked_mean(pt.reshape(per_tok, [2, L]),
+                            pt.to_tensor(valid))
+    assert np.isfinite(float(masked.value))

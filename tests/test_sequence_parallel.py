@@ -139,3 +139,82 @@ def test_sep_axis_in_hybrid_mesh():
     assert hcg.mesh.shape["sep"] == 2
     sep_group = hcg.get_sep_parallel_group()
     assert sep_group.axis_name == "sep" and sep_group.nranks == 2
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: SP wired into the model stack (VERDICT r2 #5)
+# ---------------------------------------------------------------------------
+
+def _sep_group(sep_degree=4, dp_degree=2):
+    from paddle_tpu.distributed import fleet
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp_degree, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": sep_degree}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group().get_sep_parallel_group()
+
+
+def _tiny_lm():
+    from paddle_tpu.models import TransformerLM
+
+    pt.seed(0)
+    return TransformerLM(vocab_size=64, hidden_size=32, num_layers=2,
+                         num_heads=4, intermediate_size=64, max_position=32,
+                         dropout=0.0, causal=True)
+
+
+def _train_lm(model, steps=3):
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import TransformerLMCriterion
+
+    crit = TransformerLMCriterion(shift_labels=False)
+    opt = pt.optimizer.Adam(1e-3, parameters=model.parameters())
+    step = TrainStep(model, lambda m, x, y: crit(m(x), y), opt, donate=False)
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, 64, (2, 16)).astype("int32")
+    return [float(step(pt.to_tensor(ids), pt.to_tensor(ids)))
+            for _ in range(steps)]
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_transformer_lm_sequence_parallel_parity(mode):
+    """sep=4 TransformerLM trains through a full TrainStep with loss parity
+    vs the unsharded model — SP is placement/communication, not math."""
+    group = _sep_group()
+    sp_losses = _train_lm(_tiny_lm().enable_sequence_parallel(group, mode))
+    dense_losses = _train_lm(_tiny_lm())
+    np.testing.assert_allclose(sp_losses, dense_losses, rtol=2e-4, atol=1e-5)
+    assert sp_losses[-1] < sp_losses[0]
+
+
+def test_mha_sequence_parallel_eager_backward():
+    """Eager tape flows through the shard_map'd ring attention."""
+    group = _sep_group()
+    pt.seed(0)
+    mha = pt.nn.MultiHeadAttention(32, 4, dropout=0.0)
+    mha.enable_sequence_parallel(group, mode="ring", causal=True)
+    x = pt.to_tensor(np.random.RandomState(0).randn(2, 16, 32)
+                     .astype("float32"))
+    out = mha(x)
+    loss = out.sum()
+    loss.backward()
+    g = mha.q_proj.weight.grad
+    assert g is not None and float(np.abs(np.asarray(g.value)).sum()) > 0
+
+
+def test_mha_sequence_parallel_rejects_bad_config():
+    group = _sep_group()
+    mha_drop = pt.nn.MultiHeadAttention(32, 4, dropout=0.1)
+    with pytest.raises(Exception, match="dropout"):
+        mha_drop.enable_sequence_parallel(group)
+    mha = pt.nn.MultiHeadAttention(32, 2, dropout=0.0)  # 2 heads < sep=4
+    with pytest.raises(Exception, match="ulysses"):
+        mha.enable_sequence_parallel(group, mode="ulysses")
+    mha2 = pt.nn.MultiHeadAttention(32, 4, dropout=0.0)
+    mha2.enable_sequence_parallel(group, causal=False)
+    x = pt.to_tensor(np.zeros((2, 16, 32), "float32"))
+    mask = pt.to_tensor(np.zeros((16, 16), "float32"))
+    with pytest.raises(Exception, match="mask"):
+        mha2(x, attn_mask=mask)
