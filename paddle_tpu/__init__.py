@@ -61,12 +61,15 @@ from . import jit  # noqa: F401
 from . import amp  # noqa: F401
 from . import distributed  # noqa: F401
 from . import hapi  # noqa: F401
+from . import incubate  # noqa: F401
 from . import io  # noqa: F401
 from . import distribution  # noqa: F401
 from . import inference  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
+from . import quantization  # noqa: F401
 from . import static  # noqa: F401
+from . import text  # noqa: F401
 from . import vision  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi import callbacks  # noqa: F401

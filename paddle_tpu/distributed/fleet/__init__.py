@@ -252,15 +252,33 @@ class _Fleet:
 
     def distributed_optimizer(self, optimizer, strategy=None):
         """Apply the active strategy's optimizer stack (fleet_base.py:783):
-        lamb/lars class swap → sharding (ZeRO state placement) →
-        gradient merge → amp (with the model, once known)."""
+        lamb/lars class swap → dgc/fp16-allreduce grad transforms →
+        sharding (ZeRO state placement) → local-sgd → gradient merge →
+        amp (with the model, once known)."""
         if strategy is not None:
             self._strategy = strategy
         st = self.strategy
         from ..meta_parallel.sharding_parallel import ShardingOptimizerStage2
-        from .meta_optimizers import GradientMergeOptimizer, apply_lamb_lars
+        from .meta_optimizers import (
+            DGCOptimizer,
+            FP16AllreduceOptimizer,
+            GradientMergeOptimizer,
+            LocalSGDOptimizer,
+            apply_lamb_lars,
+        )
 
         optimizer = apply_lamb_lars(optimizer, st)
+        if getattr(st, "dgc", False):
+            cfg = getattr(st, "dgc_configs", None) or {}
+            optimizer = DGCOptimizer(
+                optimizer,
+                momentum=float(cfg.get("momentum", 0.9)),
+                sparsity=float((cfg.get("sparsity") or [0.999])[0]
+                               if isinstance(cfg.get("sparsity"), (list, tuple))
+                               else cfg.get("sparsity", 0.999)),
+                rampup_begin_step=int(cfg.get("rampup_begin_step", 0)))
+        if getattr(st, "fp16_allreduce", False):
+            optimizer = FP16AllreduceOptimizer(optimizer)
         if st.sharding:
             hcg = self.get_hybrid_communicate_group()
             if hcg.get_sharding_parallel_world_size() > 1:
@@ -268,6 +286,10 @@ class _Fleet:
                 optimizer = ShardingOptimizerStage2(
                     optimizer, group=hcg.get_sharding_parallel_group(),
                     offload=bool(cfg.get("offload", False)))
+        if getattr(st, "localsgd", False):
+            cfg = getattr(st, "localsgd_configs", None) or {}
+            optimizer = LocalSGDOptimizer(
+                optimizer, k_steps=int(cfg.get("k_steps", 1)))
         if st.gradient_merge:
             cfg = st.gradient_merge_configs or {}
             optimizer = GradientMergeOptimizer(

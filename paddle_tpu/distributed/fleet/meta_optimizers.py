@@ -11,11 +11,13 @@ from __future__ import annotations
 
 from typing import Dict, Optional
 
+import jax
 import jax.numpy as jnp
 
 from ...core.errors import InvalidArgumentError
 
-__all__ = ["GradientMergeOptimizer", "apply_lamb_lars"]
+__all__ = ["GradientMergeOptimizer", "apply_lamb_lars", "DGCOptimizer",
+           "FP16AllreduceOptimizer", "LocalSGDOptimizer"]
 
 
 class GradientMergeOptimizer:
@@ -115,3 +117,169 @@ def apply_lamb_lars(optimizer, strategy):
             parameters=optimizer._parameter_list,
             grad_clip=optimizer._grad_clip)
     return optimizer
+
+
+class DGCOptimizer:
+    """Deep gradient compression (dgc_optimizer.py / dgc_op.cc parity).
+
+    Per parameter: error-feedback residual + momentum correction (DGC paper
+    §3), then top-``rampup`` fraction of entries by magnitude form the
+    "communicated" gradient; the rest stays in the residual for later steps.
+
+    TPU mapping: GSPMD reduces dense tensors, so the bandwidth saving does
+    not materialize on ICI — what this preserves is DGC's *training
+    semantics* (sparsified updates with error feedback), which is the part
+    that affects convergence and the part a user ports.  The sparsity knob
+    ``sparsity`` follows dgc_configs.rampup_begin_step semantics loosely:
+    compression activates after ``rampup_begin_step`` steps.
+    """
+
+    def __init__(self, inner, momentum: float = 0.9, sparsity: float = 0.999,
+                 rampup_begin_step: int = 0):
+        self._inner = inner
+        self.momentum = float(momentum)
+        self.sparsity = float(sparsity)
+        self.rampup_begin_step = int(rampup_begin_step)
+        self._u: Dict[str, jnp.ndarray] = {}  # momentum correction
+        self._v: Dict[str, jnp.ndarray] = {}  # error feedback residual
+        self._step_count = 0
+
+    def _compress(self, g, pname):
+        u = self._u.get(pname)
+        u = self.momentum * u + g if u is not None else g
+        v = self._v.get(pname)
+        v = v + u if v is not None else u
+        k = max(1, int(round(v.size * (1.0 - self.sparsity))))
+        flat = v.reshape(-1)
+        # top_k threshold: O(n log k), vs a full O(n log n) sort per
+        # parameter per step on the training hot path
+        thresh = jax.lax.top_k(jnp.abs(flat), k)[0][-1]
+        mask = jnp.abs(v) >= thresh
+        sent = jnp.where(mask, v, 0)
+        self._u[pname] = jnp.where(mask, 0, u)
+        self._v[pname] = jnp.where(mask, 0, v)
+        return sent
+
+    def step(self) -> None:
+        self._step_count += 1
+        params = self._inner._parameter_list or []
+        if self._step_count > self.rampup_begin_step:
+            for p in params:
+                if p.stop_gradient or p._grad_val is None:
+                    continue
+                p._grad_val = self._compress(p._grad_val, p.name)
+        self._inner.step()
+
+    def clear_grad(self, *a, **k) -> None:
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+
+class FP16AllreduceOptimizer:
+    """fp16_allreduce_optimizer.py parity: gradients cross the wire in
+    fp16.  GSPMD emits the collectives, so the knob is expressed as a
+    cast-down/cast-up at the optimizer boundary — reproducing the numerics
+    (fp16 rounding of the reduced gradient) that the reference's rewritten
+    program produces."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def step(self) -> None:
+        for p in (self._inner._parameter_list or []):
+            if p.stop_gradient or p._grad_val is None:
+                continue
+            g = p._grad_val
+            if g.dtype == jnp.float32:
+                p._grad_val = g.astype(jnp.float16).astype(jnp.float32)
+        self._inner.step()
+
+    def clear_grad(self, *a, **k) -> None:
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+
+class LocalSGDOptimizer:
+    """localsgd_optimizer.py parity: step locally, average parameters every
+    ``k_steps``.
+
+    Single-controller SPMD keeps parameters consistent by construction, so
+    local divergence only exists across *processes* (multi-host launcher
+    path): there, every rank steps its own replica and the k-step sync is a
+    cross-process mean (``c_allreduce_sum`` + scale in the reference).  On
+    one process the sync is the identity and this degenerates to the inner
+    optimizer — same contract, loudly documented instead of silently wrong.
+    """
+
+    def __init__(self, inner, k_steps: int = 1):
+        if k_steps < 1:
+            raise InvalidArgumentError("k_steps must be >= 1")
+        self._inner = inner
+        self.k_steps = int(k_steps)
+        self._since_sync = 0
+
+    def step(self) -> None:
+        self._inner.step()
+        self._since_sync += 1
+        if self._since_sync >= self.k_steps:
+            self._since_sync = 0
+            self._sync_params()
+
+    def _sync_params(self) -> None:
+        """Cross-process mean of each parameter replica.
+
+        LocalSGD's divergent replicas only exist across *processes* (each
+        rank trains its own local arrays between syncs), so the sync builds
+        a [nprocs, ...] global array from the per-process local values and
+        jit-means over the process axis — the c_allreduce_sum + scale pair,
+        expressed through the coordination the launcher already set up.
+        """
+        import jax as _jax
+
+        n = _jax.process_count()
+        if n <= 1:
+            return
+        import numpy as _np
+        from jax.sharding import (Mesh, NamedSharding,
+                                  PartitionSpec as _P)
+
+        devs = _np.array(_jax.devices()[:n]).reshape(n)
+        mesh = Mesh(devs, ("proc",))
+
+        @_jax.jit
+        def mean0(a):
+            import jax.numpy as _jnp
+
+            return _jax.lax.with_sharding_constraint(
+                _jnp.mean(a, axis=0), NamedSharding(mesh, _P()))
+
+        for p in (self._inner._parameter_list or []):
+            local = _np.asarray(p._value)[None]  # [1, ...] this rank's copy
+            stacked = _jax.make_array_from_process_local_data(
+                NamedSharding(mesh, _P("proc")), local)
+            p._replace_value(mean0(stacked))
+
+    def clear_grad(self, *a, **k) -> None:
+        self._inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, name):
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)

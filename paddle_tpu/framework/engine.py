@@ -233,7 +233,8 @@ def grad(
     if create_graph:
         raise NotImplementedError(
             "create_graph=True (double grad) is unsupported on the eager tape; "
-            "compose jax.grad via paddle_tpu.jit for higher-order derivatives"
+            "use paddle_tpu.incubate.autograd (grad/hvp/Hessian compose to "
+            "any order) for higher-order derivatives"
         )
     single_out = isinstance(outputs, Tensor)
     single_in = isinstance(inputs, Tensor)

@@ -1,0 +1,23 @@
+"""``paddle_tpu.incubate`` — functional autodiff + custom (pallas) ops.
+
+Reference parity: ``python/paddle/incubate/autograd/`` (jvp/vjp/Jacobian/
+Hessian over the primitive-transform "prim" machinery) and the custom-op
+extension ABI (``paddle/fluid/framework/custom_operator.cc`` +
+``paddle/extension.h``: user kernels registered into the op library with
+hand-written gradients).
+
+TPU-native design: higher-order autodiff is *free* in JAX — ``jax.grad``
+composes — so this package is a thin Tensor-facade adapter, not a prim
+rewriter.  Custom ops are pallas kernels (or any raw-jnp callables) given an
+optional hand-written vjp and entered into the SAME dispatch layer as every
+built-in op, so they are taped in eager, differentiable, and jittable.
+"""
+from . import autograd  # noqa: F401
+from .custom_op import (  # noqa: F401
+    get_custom_op,
+    register_custom_op,
+    registered_custom_ops,
+)
+
+__all__ = ["autograd", "get_custom_op", "register_custom_op",
+           "registered_custom_ops"]

@@ -1,0 +1,114 @@
+"""ASP — automatic structured (n:m) sparsity.
+
+Reference parity: ``python/paddle/fluid/contrib/sparsity/asp.py``
+(``prune_model`` computes n:m masks over FC/conv weights,
+``decorate(optimizer)`` re-applies masks after every step so pruned slots
+stay zero through training — OptimizerWithSparsityGuarantee).
+
+TPU note: the MXU has no 2:4 sparse unit (that is an Ampere tensor-core
+feature), so ASP here is the *model-compression / parity* capability: same
+masks, same training semantics, dense execution.  The masks still matter for
+export to sparse-capable targets and for accuracy studies.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..framework.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["compute_nm_mask", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers", "check_sparsity"]
+
+_masks: Dict[str, jnp.ndarray] = {}
+_excluded: set = set()
+
+
+def compute_nm_mask(w: np.ndarray, n: int = 2, m: int = 4,
+                    axis: int = 0) -> np.ndarray:
+    """Keep the ``n`` largest-|.| entries of every ``m``-group along
+    ``axis`` (mask_1d algorithm).  ``axis`` defaults to the reduction dim of
+    a Linear weight ([in, out] → groups along in)."""
+    w = np.asarray(w)
+    if w.shape[axis] % m != 0:
+        raise InvalidArgumentError(
+            "ASP %d:%d needs dim %d (size %d) divisible by %d"
+            % (n, m, axis, w.shape[axis], m))
+    moved = np.moveaxis(w, axis, -1)
+    shape = moved.shape
+    groups = moved.reshape(-1, m)
+    order = np.argsort(np.abs(groups), axis=1)  # ascending
+    mask = np.ones_like(groups, dtype=bool)
+    np.put_along_axis(mask, order[:, : m - n], False, axis=1)
+    return np.moveaxis(mask.reshape(shape), -1, axis)
+
+
+def set_excluded_layers(param_names):
+    _excluded.update(param_names)
+
+
+def reset_excluded_layers():
+    _excluded.clear()
+
+
+def _prunable(model: Layer):
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+
+    for _, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, (Linear, Conv2D)):
+            p = sub.weight
+            if p.name not in _excluded:
+                yield p
+
+
+def prune_model(model: Layer, n: int = 2, m: int = 4) -> Dict[str, np.ndarray]:
+    """asp.py:prune_model parity: mask every FC/conv weight in place and
+    remember the masks for :func:`decorate`'s step guarantee."""
+    out = {}
+    for p in _prunable(model):
+        w = np.asarray(p.value)
+        axis = 0 if w.ndim == 2 else 1  # Linear [in,out]; Conv [o,i,kh,kw]
+        if w.shape[axis] % m != 0:
+            continue  # reference skips non-divisible layers
+        mask = compute_nm_mask(w, n, m, axis=axis)
+        _masks[p.name] = jnp.asarray(mask)
+        p._replace_value(jnp.asarray(w * mask))
+        out[p.name] = mask
+    return out
+
+
+def check_sparsity(w, n: int = 2, m: int = 4, axis: int = 0) -> bool:
+    """True when every m-group along axis has at most n nonzeros."""
+    w = np.asarray(w)
+    moved = np.moveaxis(w, axis, -1).reshape(-1, m)
+    return bool(((moved != 0).sum(axis=1) <= n).all())
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies ASP masks after every update (asp.py decorate analog)."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def step(self):
+        self._inner.step()
+        params = self._inner._parameter_list or []
+        for p in params:
+            mask = _masks.get(p.name)
+            if mask is not None:
+                p._replace_value(p._value * mask)
+
+    def __getattr__(self, name):
+        inner = self.__dict__.get("_inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)
+
+
+def decorate(optimizer) -> OptimizerWithSparsityGuarantee:
+    return OptimizerWithSparsityGuarantee(optimizer)

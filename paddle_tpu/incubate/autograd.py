@@ -1,0 +1,127 @@
+"""Functional higher-order autodiff (incubate/autograd parity).
+
+Reference: ``python/paddle/incubate/autograd/functional.py`` (jvp/vjp/
+Jacobian/Hessian) and ``paddle/fluid/imperative/partial_grad_engine.cc``'s
+``create_graph`` double backward.  There the engine replays a recorded graph
+to differentiate again; here derivatives are *function transforms* —
+``jax.grad`` composes to any order, which is the TPU-native answer to
+double backward (the eager tape deliberately stays first-order,
+``framework/engine.py:grad``).
+
+Functions passed in are written against the Tensor facade; inputs arrive as
+raw tracers (the dispatch layer passes tracers through untouched), so any
+framework op composition works unchanged.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "grad", "Jacobian", "Hessian", "hvp"]
+
+
+def _unwrap(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, (tuple, list)):
+        return tuple(_unwrap(v) for v in x)
+    return jnp.asarray(x)
+
+
+def _wrap(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(_wrap(v) for v in x)
+    return Tensor(x, stop_gradient=True)
+
+
+def _as_tuple(x) -> Tuple:
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
+
+
+def _raw_fn(func: Callable):
+    """Adapt a Tensor-facade function to raw arrays for jax transforms."""
+
+    def raw(*xs):
+        out = func(*xs)
+        return _unwrap(out)
+
+    return raw
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: (outputs, J·v).  functional.py:jvp parity."""
+    xs_t = _as_tuple(xs)
+    raw = _raw_fn(func)
+    primals = tuple(_unwrap(x) for x in xs_t)
+    tangents = tuple(_unwrap(t) for t in _as_tuple(v)) if v is not None \
+        else tuple(jnp.ones_like(p) for p in primals)
+    out, jv = jax.jvp(raw, primals, tangents)
+    return _wrap(out), _wrap(jv)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: (outputs, vᵀ·J).  functional.py:vjp parity."""
+    xs_t = _as_tuple(xs)
+    raw = _raw_fn(func)
+    primals = tuple(_unwrap(x) for x in xs_t)
+    out, pullback = jax.vjp(raw, *primals)
+    cot = _unwrap(v) if v is not None else jax.tree.map(jnp.ones_like, out)
+    grads = pullback(cot)
+    grads = grads[0] if len(xs_t) == 1 else grads
+    return _wrap(out), _wrap(grads)
+
+
+def grad(func: Callable, argnums: Union[int, Sequence[int]] = 0,
+         has_aux: bool = False) -> Callable:
+    """``jax.grad`` over a Tensor-facade function — composes to any order
+    (``grad(grad(f))`` is the double backward the eager tape refuses)."""
+    g = jax.grad(lambda *xs: _unwrap(func(*xs)), argnums=argnums,
+                 has_aux=has_aux)
+
+    def wrapped(*xs):
+        return _wrap(g(*(_unwrap(x) for x in xs)))
+
+    return wrapped
+
+
+def hvp(func: Callable, x, v):
+    """Hessian-vector product via grad-of-grad (one forward-over-reverse
+    sweep; never materializes the Hessian)."""
+    raw = lambda a: _unwrap(func(a))  # noqa: E731
+    primal = _unwrap(x)
+    tangent = _unwrap(v)
+    out, jv = jax.jvp(jax.grad(raw), (primal,), (tangent,))
+    return _wrap(jv)
+
+
+class Jacobian:
+    """Lazy full Jacobian (functional.py:Jacobian parity): index [i, j]
+    or materialize via ``.values``."""
+
+    def __init__(self, func: Callable, xs):
+        self._mat = jax.jacobian(lambda a: _unwrap(func(a)))(_unwrap(xs))
+
+    @property
+    def values(self):
+        return _wrap(self._mat)
+
+    def __getitem__(self, idx):
+        return _wrap(self._mat[idx])
+
+
+class Hessian:
+    """Full Hessian via forward-over-reverse (functional.py:Hessian)."""
+
+    def __init__(self, func: Callable, xs):
+        self._mat = jax.hessian(lambda a: _unwrap(func(a)))(_unwrap(xs))
+
+    @property
+    def values(self):
+        return _wrap(self._mat)
+
+    def __getitem__(self, idx):
+        return _wrap(self._mat[idx])

@@ -1,0 +1,96 @@
+"""Custom-op registration: user (pallas) kernels entering the framework.
+
+Reference parity: ``paddle/fluid/framework/custom_operator.cc`` +
+``paddle/extension.h`` — user C++/CUDA kernels registered with optional
+hand-written gradients, then dispatched like built-in ops.
+
+TPU-native design: the user kernel is a **pallas kernel** (or any raw-jnp
+callable).  ``register_custom_op`` wraps it with
+
+- ``jax.custom_vjp`` when a hand-written backward is supplied (pallas
+  kernels are usually paired with a backward kernel — autodiff cannot see
+  through ``pallas_call``'s side-effecting memory refs the way it sees jnp),
+- the dispatch layer's ``make_op`` — so the result is taped in eager mode,
+  transparent under ``jit.to_static``/``TrainStep``, and callable with
+  Tensors or raw arrays exactly like built-in ops.
+
+The registry is inspectable (``get_custom_op``), mirroring the reference's
+``OpInfoMap`` registration effect.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+
+from ..core.errors import InvalidArgumentError
+from ..framework.dispatch import make_op
+
+__all__ = ["register_custom_op", "get_custom_op", "registered_custom_ops"]
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None,
+                       num_diff_args: Optional[int] = None) -> Callable:
+    """Register ``forward`` as a framework op named ``name``.
+
+    ``forward(*arrays) -> array`` — raw-array kernel (pallas_call or jnp).
+    ``backward(residuals, cotangent) -> tuple(input_cotangents)`` — optional
+    hand-written vjp; ``residuals`` is whatever ``forward`` needs saved,
+    here the primal inputs tuple (custom_operator.cc's grad-op convention:
+    grad kernels receive forward inputs + output grad).
+    ``num_diff_args``: how many leading args are differentiable (defaults to
+    all when a backward is given).
+
+    Returns the wrapped op; also retrievable via :func:`get_custom_op`.
+    """
+    if not name or not isinstance(name, str):
+        raise InvalidArgumentError("custom op needs a non-empty string name")
+    if name in _REGISTRY:
+        raise InvalidArgumentError(
+            "custom op %r already registered; names are unique like the "
+            "reference's OpInfoMap" % name)
+
+    kernel = forward
+    if backward is not None:
+        n = num_diff_args
+
+        @jax.custom_vjp
+        def kernel(*args):  # noqa: F811 - intentional rebind
+            return forward(*args)
+
+        def fwd(*args):
+            return forward(*args), args
+
+        def bwd(residuals, cot):
+            grads = tuple(backward(residuals, cot))
+            expect = n if n is not None else len(residuals)
+            if len(grads) != expect:
+                raise InvalidArgumentError(
+                    "custom op %r backward returned %d cotangents, expected "
+                    "%d" % (name, len(grads), expect))
+            if n is not None:
+                grads = grads + tuple(
+                    jax.numpy.zeros_like(r) for r in residuals[n:])
+            return grads
+
+        kernel.defvjp(fwd, bwd)
+
+    op = make_op(kernel, differentiable=backward is not None, op_name=name)
+    _REGISTRY[name] = op
+    return op
+
+
+def get_custom_op(name: str) -> Callable:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise InvalidArgumentError(
+            "no custom op named %r; registered: %s"
+            % (name, sorted(_REGISTRY))) from None
+
+
+def registered_custom_ops():
+    return dict(_REGISTRY)

@@ -1,19 +1,29 @@
-"""DataLoader with background host→device prefetch.
+"""DataLoader with multiprocess workers and background host→device prefetch.
 
 Reference parity: ``python/paddle/fluid/reader.py:146`` (DataLoader:
-batch_sampler/collate/num_workers/places) and the C++ double-buffer
+batch_sampler/collate/num_workers/places),
+``fluid/dataloader/dataloader_iter.py:248`` (real worker processes with
+shared-memory batch transfer) and the C++ double-buffer
 ``paddle/fluid/operators/reader/buffered_reader.cc`` (async device staging,
 depth-2 queue).
 
-TPU-native design: worker threads (not processes — the collate path is
-numpy/jax which releases the GIL for the heavy parts) pull batches ahead of
-the consumer into a bounded queue of **already-device-put** arrays.
-``jax.device_put`` is async: the transfer overlaps the consumer's compute,
-which is exactly buffered_reader.cc's cudaMemcpyAsync staging.  Queue depth
-comes from ``FLAGS_prefetch_depth``.
+TPU-native design, two stages like the reference's worker→blocking-queue→
+buffered-reader pipeline:
+
+- ``num_workers`` **forked worker processes** run dataset indexing +
+  transforms + collate (the GIL-bound Python work) and ship the collated
+  numpy batches through POSIX shared memory (one memcpy, no pickle of the
+  payload).  Workers never touch JAX — fork safety — and results are
+  re-ordered to the sampler's order like ``_DataLoaderIterMultiProcess``.
+- the parent's producer stage ``jax.device_put``s each batch into a bounded
+  prefetch queue; the transfer is async, overlapping the consumer's compute,
+  which is exactly buffered_reader.cc's cudaMemcpyAsync staging.  Queue
+  depth comes from ``FLAGS_prefetch_depth``.
 """
 from __future__ import annotations
 
+import itertools
+import multiprocessing as mp
 import queue
 import threading
 from typing import Any, Callable, List, Optional, Sequence
@@ -59,6 +69,286 @@ def _to_device(x, device_put: bool):
     if isinstance(x, np.ndarray):
         return Tensor(x, stop_gradient=True)
     return x
+
+
+# ---------------------------------------------------------------------------
+# Multiprocess workers (dataloader_iter.py:248 analog)
+# ---------------------------------------------------------------------------
+
+def _shm_encode(obj, segments: List):
+    """Replace large ndarrays in a collated tree with shared-memory refs."""
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, (tuple, list)):
+        return tuple(_shm_encode(v, segments) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _shm_encode(v, segments) for k, v in obj.items()}
+    if isinstance(obj, np.ndarray) and obj.nbytes >= 1 << 14:  # 16 KiB
+        shm = shared_memory.SharedMemory(create=True, size=obj.nbytes)
+        np.ndarray(obj.shape, obj.dtype, buffer=shm.buf)[...] = obj
+        segments.append(shm)
+        return ("__shm__", shm.name, obj.shape, str(obj.dtype))
+    return obj
+
+
+def _shm_decode(obj, opened: List):
+    from multiprocessing import shared_memory
+
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__shm__":
+        shm = shared_memory.SharedMemory(name=obj[1])
+        opened.append(shm)
+        # copy out: the segment is freed as soon as decode returns, and
+        # device_put would otherwise race the unlink
+        return np.array(np.ndarray(obj[2], obj[3], buffer=shm.buf))
+    if isinstance(obj, (tuple, list)):
+        return tuple(_shm_decode(v, opened) for v in obj)
+    if isinstance(obj, dict):
+        return {k: _shm_decode(v, opened) for k, v in obj.items()}
+    return obj
+
+
+def _put_batch(result_q, batch_idx, out, use_shm: bool):
+    if use_shm:
+        segments: List = []
+        enc = _shm_encode(out, segments)
+        result_q.put((batch_idx, "ok", enc))
+        for s in segments:  # parent unlinks; worker just closes
+            s.close()
+    else:
+        result_q.put((batch_idx, "ok", out))
+
+
+def _worker_loop(dataset, collate_fn, task_q, result_q, use_shm: bool,
+                 worker_id: int, worker_init_fn, iterable_cfg):
+    """Worker process body.
+
+    Map-style (``iterable_cfg is None``): pull (batch_idx, indices) tasks,
+    push collated batches keyed by batch_idx so the parent can restore
+    sampler order.  Iterable: stream this worker's round-robin slice
+    ``(start, step, batch_size, drop_last)`` in batches with no task queue —
+    order across workers is unordered by contract.
+    """
+    try:
+        try:
+            if worker_init_fn is not None:
+                worker_init_fn(worker_id)
+        except Exception:
+            import traceback
+
+            # -1: pre-task failure — parent raises it regardless of order
+            result_q.put((-1, "error", traceback.format_exc()))
+            return
+        if iterable_cfg is not None:
+            start, step, bs, drop_last = iterable_cfg
+            try:
+                it = itertools.islice(iter(dataset), start, None, step)
+                batch: List = []
+                for sample in it:
+                    if task_q.qsize() and task_q.get_nowait() is None:
+                        return  # early shutdown
+                    batch.append(sample)
+                    if len(batch) == bs:
+                        _put_batch(result_q, worker_id, collate_fn(batch),
+                                   use_shm)
+                        batch = []
+                if batch and not drop_last:
+                    _put_batch(result_q, worker_id, collate_fn(batch),
+                               use_shm)
+            except Exception:
+                import traceback
+
+                result_q.put((worker_id, "error", traceback.format_exc()))
+            result_q.put((worker_id, "__end__", None))
+            return
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            batch_idx, indices = task
+            try:
+                _put_batch(result_q, batch_idx,
+                           collate_fn([dataset[i] for i in indices]),
+                           use_shm)
+            except Exception:
+                import traceback
+
+                result_q.put((batch_idx, "error", traceback.format_exc()))
+    except KeyboardInterrupt:  # parent teardown
+        pass
+
+
+class _MultiprocessIterator:
+    """Ordered fan-out over worker processes (_DataLoaderIterMultiProcess).
+
+    Map-style: batch index lists round-robin onto workers; results are
+    re-ordered so iteration order matches the sampler.  In-flight work is
+    bounded by ``num_workers * depth`` batches.
+    """
+
+    def __init__(self, loader, depth: int):
+        ctx = mp.get_context("fork")  # workers inherit the dataset w/o pickle
+        self._loader = loader
+        self._use_shm = loader.use_shared_memory
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._next_out = 0
+        self._next_in = 0
+        self._buffer: dict = {}
+        self._shutdown_done = False
+        self._iterable = loader._iterable_mode
+        if self._iterable:
+            self._tasks = iter(())
+        else:
+            self._tasks = iter(enumerate(loader.batch_sampler))
+        self._exhausted = False
+        self._n_workers = loader.num_workers
+        self._live_ends = set(range(self._n_workers))
+        self._workers = []
+        for wid in range(self._n_workers):
+            iter_cfg = None
+            if self._iterable:
+                iter_cfg = (wid, self._n_workers, loader.batch_size or 1,
+                            loader.drop_last)
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, loader.collate_fn, self._task_q,
+                      self._result_q, self._use_shm, wid,
+                      loader.worker_init_fn, iter_cfg),
+                daemon=True)
+            w.start()
+            self._workers.append(w)
+        if not self._iterable:
+            for _ in range(self._n_workers * max(1, depth)):
+                self._dispatch_one()
+
+    def _dispatch_one(self):
+        if self._exhausted:
+            return
+        try:
+            self._task_q.put(next(self._tasks))
+            self._next_in += 1
+        except StopIteration:
+            self._exhausted = True
+
+    def __iter__(self):
+        return self
+
+    def _pull(self):
+        while self._next_out not in self._buffer:
+            try:
+                idx, status, payload = self._result_q.get(timeout=5.0)
+                if idx == -1:  # pre-task worker failure: raise with detail
+                    self.shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker failed during init:\n%s" % payload)
+            except queue.Empty:
+                dead = [w for w in self._workers if not w.is_alive()]
+                if dead:
+                    self.shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker(s) %s died unexpectedly"
+                        % [w.pid for w in dead])
+                continue
+            self._buffer[idx] = (status, payload)
+        return self._buffer.pop(self._next_out)
+
+    def _decode(self, payload):
+        if self._use_shm:
+            opened: List = []
+            payload = _shm_decode(payload, opened)
+            for s in opened:
+                s.close()
+                try:
+                    s.unlink()
+                except FileNotFoundError:  # pragma: no cover
+                    pass
+        return payload
+
+    def _next_iterable(self):
+        while True:
+            if not self._live_ends:
+                self.shutdown()
+                raise StopIteration
+            try:
+                wid, status, payload = self._result_q.get(timeout=5.0)
+            except queue.Empty:
+                dead = [w for w in self._workers
+                        if not w.is_alive() and
+                        w.pid is not None]
+                alive_pending = [w for wid2, w in enumerate(self._workers)
+                                 if wid2 in self._live_ends and w.is_alive()]
+                if not alive_pending:
+                    self.shutdown()
+                    raise RuntimeError(
+                        "DataLoader worker(s) died unexpectedly "
+                        "(pids %s)" % [w.pid for w in dead])
+                continue
+            if status == "__end__":
+                self._live_ends.discard(wid)
+                continue
+            if status == "error":
+                self.shutdown()
+                raise RuntimeError("DataLoader worker failed:\n%s" % payload)
+            return self._decode(payload)
+
+    def __next__(self):
+        if self._iterable:
+            return self._next_iterable()
+        if self._next_out >= self._next_in and self._exhausted:
+            self.shutdown()
+            raise StopIteration
+        status, payload = self._pull()
+        self._next_out += 1
+        self._dispatch_one()
+        if status == "error":
+            self.shutdown()
+            raise RuntimeError("DataLoader worker failed:\n%s" % payload)
+        return self._decode(payload)
+
+    def shutdown(self):
+        if self._shutdown_done:
+            return
+        self._shutdown_done = True
+        for _ in self._workers:
+            try:
+                self._task_q.put_nowait(None)
+            except Exception:  # pragma: no cover
+                pass
+        for w in self._workers:
+            w.join(timeout=2)
+            if w.is_alive():
+                w.terminate()
+        # drain + free in-flight shm segments: both the queue AND the
+        # reorder buffer (out-of-order batches parked there still hold
+        # encoded segment refs)
+        def _free(payload):
+            if self._use_shm:
+                opened: List = []
+                _shm_decode(payload, opened)
+                for s in opened:
+                    s.close()
+                    try:
+                        s.unlink()
+                    except FileNotFoundError:
+                        pass
+
+        for status, payload in self._buffer.values():
+            if status == "ok":
+                _free(payload)
+        self._buffer.clear()
+        try:
+            while True:
+                _, status, payload = self._result_q.get_nowait()
+                if status == "ok":
+                    _free(payload)
+        except queue.Empty:
+            pass
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:  # pragma: no cover
+            pass
 
 
 class _PrefetchIterator:
@@ -111,9 +401,14 @@ class _PrefetchIterator:
 class DataLoader:
     """reader.py:146 DataLoader parity.
 
-    ``num_workers=0`` → synchronous; ``num_workers>0`` → one background
-    producer thread with a prefetch queue (depth = FLAGS_prefetch_depth).
-    ``return_list`` is accepted for parity (always list-style here).
+    ``num_workers=0`` → synchronous; ``num_workers>0`` → that many worker
+    **processes** (transforms/collate off the main interpreter, shared-memory
+    batch transfer) feeding a device-staging prefetch thread (depth =
+    FLAGS_prefetch_depth).  ``use_shared_memory=False`` falls back to pickled
+    queue transfer.  ``return_list`` is accepted for parity (always
+    list-style here).  IterableDataset + workers: each worker reads a
+    round-robin slice, so cross-worker batch order is not the serial order
+    (same contract as the reference's worker split).
     """
 
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
@@ -127,6 +422,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.use_buffer_reader = use_buffer_reader
+        self.use_shared_memory = bool(use_shared_memory)
         self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if prefetch_factor is None:
@@ -178,8 +474,16 @@ class DataLoader:
             yield _to_device(self.collate_fn(samples), True)
 
     def __iter__(self):
-        if self.num_workers > 0 and self.use_buffer_reader:
-            return _PrefetchIterator(self._produce, self.prefetch_factor)
+        if self.num_workers > 0:
+            mp_iter = _MultiprocessIterator(self, self.prefetch_factor)
+
+            def produce():
+                for batch in mp_iter:
+                    yield _to_device(batch, True)
+
+            if self.use_buffer_reader:
+                return _PrefetchIterator(produce, self.prefetch_factor)
+            return produce()
         return self._produce()
 
     def __call__(self):

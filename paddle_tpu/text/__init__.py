@@ -1,0 +1,16 @@
+"""``paddle_tpu.text`` — text datasets + native tokenization.
+
+Reference parity: ``python/paddle/text/`` (dataset classes over the
+standard corpora) plus a C++ tokenizer core in the spirit of the
+reference ecosystem's faster_tokenizer (``text/fast_tokenizer.cpp``,
+ctypes-loaded, Python parity fallback).
+"""
+from .datasets import Imdb, Imikolov, Movielens, UCIHousing  # noqa: F401
+from .tokenizer import (  # noqa: F401
+    WordpieceTokenizer,
+    load_vocab,
+    native_available,
+)
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WordpieceTokenizer", "load_vocab", "native_available"]

@@ -1,0 +1,228 @@
+"""Text datasets (reference ``python/paddle/text/datasets/``).
+
+Same dataset classes, same on-disk corpus formats, same sample schemas —
+minus the downloader: this environment has no egress, so every class takes
+``data_file=`` pointing at the already-fetched corpus (the reference's
+``download=False`` path).  Parsers accept the exact archive layouts the
+reference consumes (aclImdb tar.gz, ptb.*.txt, housing.data, ml-1m
+ratings.dat), so corpora fetched for the reference work unchanged.
+"""
+from __future__ import annotations
+
+import re
+import tarfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens"]
+
+
+def _require(data_file: Optional[str], what: str) -> str:
+    if not data_file:
+        raise InvalidArgumentError(
+            "%s needs data_file= (no downloader in this build: fetch the "
+            "corpus the reference uses and pass its path)" % what)
+    return data_file
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (imdb.py:33 parity): aclImdb tar, pos/neg dirs.
+
+    Samples: (int64 word-id sequence, int64 label) with a frequency-cutoff
+    vocabulary built from the train split — the reference's schema.
+    """
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150):
+        self.data_file = _require(data_file, "Imdb")
+        if mode not in ("train", "test"):
+            raise InvalidArgumentError("mode must be train|test")
+        self.mode = mode
+        self.word_idx = self._build_word_dict(cutoff)
+        self.docs, self.labels = self._load(mode)
+
+    def _iter_texts(self, pattern: "re.Pattern"):
+        with tarfile.open(self.data_file) as tf:
+            for member in tf.getmembers():
+                if pattern.match(member.name):
+                    f = tf.extractfile(member)
+                    if f is not None:
+                        yield member.name, f.read().decode(
+                            "utf-8", errors="ignore")
+
+    def _build_word_dict(self, cutoff: int) -> Dict[str, int]:
+        pattern = re.compile(r"aclImdb/train/((pos)|(neg))/.*\.txt$")
+        freq: Dict[str, int] = {}
+        for _, text in self._iter_texts(pattern):
+            for w in text.lower().split():
+                freq[w] = freq.get(w, 0) + 1
+        # frequency cutoff, then rank by (-freq, word); <unk> is last
+        kept = sorted((w for w, c in freq.items() if c >= cutoff),
+                      key=lambda w: (-freq[w], w))
+        word_idx = {w: i for i, w in enumerate(kept)}
+        word_idx["<unk>"] = len(kept)
+        return word_idx
+
+    def _load(self, mode: str) -> Tuple[List[np.ndarray], List[int]]:
+        unk = self.word_idx["<unk>"]
+        docs, labels = [], []
+        for label, name in ((0, "neg"), (1, "pos")):
+            pattern = re.compile(
+                r"aclImdb/%s/%s/.*\.txt$" % (mode, name))
+            for _, text in self._iter_texts(pattern):
+                ids = [self.word_idx.get(w, unk)
+                       for w in text.lower().split()]
+                docs.append(np.asarray(ids, np.int64))
+                labels.append(label)
+        return docs, labels
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, i):
+        return self.docs[i], np.int64(self.labels[i])
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-grams (imikolov.py:31 parity).
+
+    ``type='ngram'`` yields N-token windows; ``type='seq'`` yields
+    <s> … </s> wrapped id sequences.  Vocabulary: words with freq >=
+    ``min_word_freq`` from train, plus <s>, </s>, <unk>.
+    """
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 data_type: str = "ngram", window_size: int = 5,
+                 min_word_freq: int = 50):
+        self.data_file = _require(data_file, "Imikolov")
+        if data_type not in ("ngram", "seq"):
+            raise InvalidArgumentError("data_type must be ngram|seq")
+        self.window_size = window_size
+        self.word_idx = self._build_dict(min_word_freq)
+        self.data = self._load(mode, data_type)
+
+    def _read_lines(self, split: str) -> List[List[str]]:
+        member = "./simple-examples/data/ptb.%s.txt" % split
+        with tarfile.open(self.data_file) as tf:
+            names = tf.getnames()
+            target = member if member in names else member[2:]
+            f = tf.extractfile(target)
+            return [l.strip().split()
+                    for l in f.read().decode("utf-8").splitlines()]
+
+    def _build_dict(self, min_freq: int) -> Dict[str, int]:
+        freq: Dict[str, int] = {}
+        for words in self._read_lines("train"):
+            for w in words:
+                freq[w] = freq.get(w, 0) + 1
+        freq.pop("<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items() if c >= min_freq),
+                      key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        word_idx["<s>"] = len(word_idx)
+        word_idx["<e>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, mode: str, data_type: str) -> List[np.ndarray]:
+        unk = self.word_idx["<unk>"]
+        s, e = self.word_idx["<s>"], self.word_idx["<e>"]
+        out = []
+        for words in self._read_lines(mode):
+            ids = [s] + [self.word_idx.get(w, unk) for w in words] + [e]
+            if data_type == "seq":
+                out.append(np.asarray(ids, np.int64))
+            else:
+                n = self.window_size
+                for i in range(len(ids) - n + 1):
+                    out.append(np.asarray(ids[i:i + n], np.int64))
+        return out
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        return self.data[i]
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (uci_housing.py parity): housing.data,
+    14 whitespace columns, feature-wise max-min normalization from the full
+    file, 80/20 train/test split — the reference's exact recipe."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 feature_num: int = 14, ratio: float = 0.8):
+        path = _require(data_file, "UCIHousing")
+        raw = np.fromfile(path, sep=" ", dtype=np.float32)
+        if raw.size % feature_num:
+            raise InvalidArgumentError(
+                "housing.data size %d not divisible by %d columns"
+                % (raw.size, feature_num))
+        data = raw.reshape(-1, feature_num)
+        maxs, mins = data.max(axis=0), data.min(axis=0)
+        avgs = data.mean(axis=0)
+        span = np.where(maxs > mins, maxs - mins, 1.0)
+        data[:, :-1] = (data[:, :-1] - avgs[:-1]) / span[:-1]
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if mode == "train" else data[offset:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+
+class Movielens(Dataset):
+    """MovieLens ratings (movielens.py parity): ml-1m archive with
+    ``ratings.dat`` (user::movie::rating::ts), ``users.dat``,
+    ``movies.dat``.  Samples: (user_id, gender, age, job, movie_id,
+    rating) int/float arrays — the reference's feature tuple, flattened."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0):
+        self.data_file = _require(data_file, "Movielens")
+        users, movies, ratings = self._parse()
+        rng = np.random.RandomState(rand_seed)
+        keep_test = rng.rand(len(ratings)) < test_ratio
+        sel = keep_test if mode == "test" else ~keep_test
+        self.samples = [r for r, k in zip(ratings, sel) if k]
+        self.users, self.movies = users, movies
+
+    def _read(self, name: str) -> List[str]:
+        with tarfile.open(self.data_file) as tf:
+            for n in tf.getnames():
+                if n.endswith(name):
+                    return tf.extractfile(n).read().decode(
+                        "latin1").splitlines()
+        raise InvalidArgumentError("archive lacks %s" % name)
+
+    def _parse(self):
+        users = {}
+        for line in self._read("users.dat"):
+            uid, gender, age, job, _zip = line.split("::")
+            users[int(uid)] = (0 if gender == "M" else 1, int(age), int(job))
+        movies = {}
+        for line in self._read("movies.dat"):
+            mid, title, genres = line.split("::")
+            movies[int(mid)] = (title, genres.split("|"))
+        ratings = []
+        for line in self._read("ratings.dat"):
+            uid, mid, rating, _ts = line.split("::")
+            uid, mid = int(uid), int(mid)
+            g, a, j = users[uid]
+            ratings.append((uid, g, a, j, mid, float(rating)))
+        return users, movies, ratings
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, i):
+        uid, g, a, j, mid, r = self.samples[i]
+        return (np.int64(uid), np.int64(g), np.int64(a), np.int64(j),
+                np.int64(mid), np.float32(r))

@@ -16,3 +16,26 @@ def check_world(expected: int, out_dir: str):
 
 def boom(_unused: int, _out: str):
     raise RuntimeError("intentional child failure")
+
+
+def localsgd_sync(out_dir: str):
+    """Each rank diverges its weight, then LocalSGD syncs to the mean."""
+    import numpy as np
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet.meta_optimizers import LocalSGDOptimizer
+
+    dist.init_parallel_env()
+    rank = dist.get_rank()
+    m = pt.nn.Linear(2, 2)
+    # divergent replicas: rank r holds all-(r+1) weights
+    m.weight.set_value(pt.to_tensor(
+        np.full((2, 2), float(rank + 1), np.float32)))
+    opt = LocalSGDOptimizer(
+        pt.optimizer.SGD(0.1, parameters=m.parameters()), k_steps=1)
+    opt._sync_params()
+    w = np.asarray(m.weight.value)
+    with open(os.path.join(out_dir, "w%d.txt" % rank), "w") as f:
+        f.write(repr(w.tolist()))
+    assert np.allclose(w, 1.5), w  # mean of 1 and 2

@@ -1,0 +1,148 @@
+"""ASP (2:4 sparsity) + DGC / fp16-allreduce / LocalSGD tests
+(SURVEY §2 rows 39-42).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.meta_optimizers import (
+    DGCOptimizer,
+    FP16AllreduceOptimizer,
+    LocalSGDOptimizer,
+)
+from paddle_tpu.incubate import asp
+
+
+def _model():
+    pt.seed(0)
+    return pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                            pt.nn.Linear(16, 4))
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (rng.randn(16, 8).astype(np.float32),
+            rng.randint(0, 4, (16,)).astype(np.int32))
+
+
+def _train(model, opt, steps=4):
+    x, y = _data()
+    losses = []
+    for _ in range(steps):
+        loss = pt.nn.functional.cross_entropy(
+            model(pt.to_tensor(x)), pt.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.value))
+    return losses
+
+
+# --------------------------------------------------------------------- ASP
+
+def test_compute_nm_mask():
+    w = np.array([[4.0, 1.0, -3.0, 0.5]], np.float32).T  # groups along ax 0
+    mask = asp.compute_nm_mask(w, 2, 4, axis=0)
+    np.testing.assert_array_equal(mask[:, 0], [True, False, True, False])
+
+
+def test_prune_model_and_sparsity_guarantee():
+    model = _model()
+    masks = asp.prune_model(model)
+    assert len(masks) == 2
+    w0 = np.asarray(model[0].weight.value)
+    assert asp.check_sparsity(w0, 2, 4, axis=0)
+
+    opt = asp.decorate(pt.optimizer.Adam(0.01,
+                                         parameters=model.parameters()))
+    losses = _train(model, opt)
+    assert losses[-1] < losses[0]
+    # pruned slots stayed zero through every update
+    w0 = np.asarray(model[0].weight.value)
+    assert asp.check_sparsity(w0, 2, 4, axis=0)
+
+
+def test_asp_excluded_layers():
+    model = _model()
+    asp.set_excluded_layers([model[0].weight.name])
+    try:
+        masks = asp.prune_model(model)
+        assert model[0].weight.name not in masks
+        assert model[2].weight.name in masks
+    finally:
+        asp.reset_excluded_layers()
+
+
+# --------------------------------------------------------------------- DGC
+
+def test_dgc_sparsifies_with_error_feedback():
+    model = _model()
+    inner = pt.optimizer.SGD(0.05, parameters=model.parameters())
+    opt = DGCOptimizer(inner, momentum=0.0, sparsity=0.75)
+    x, y = _data()
+    loss = pt.nn.functional.cross_entropy(
+        model(pt.to_tensor(x)), pt.to_tensor(y))
+    loss.backward()
+    g_before = np.asarray(model[0].weight._grad_val)
+    opt.step()
+    # residual holds the unsent mass: where nonzero it equals the gradient
+    # (momentum=0 ⇒ v == g), and ~75% of entries were held back
+    res = np.asarray(opt._v[model[0].weight.name])
+    held = res != 0
+    assert held.any()
+    np.testing.assert_allclose(res[held], g_before[held], rtol=1e-6)
+    frac_held = held.mean()
+    assert 0.5 < frac_held <= 0.8  # sparsity=0.75 keeps ~25% of entries
+    opt.clear_grad()
+    losses = _train(model, opt, steps=4)
+    assert losses[-1] < losses[0]  # converges despite 75% sparsification
+
+
+def test_dgc_rampup_defers_compression():
+    model = _model()
+    opt = DGCOptimizer(pt.optimizer.SGD(0.05,
+                                        parameters=model.parameters()),
+                       sparsity=0.9, rampup_begin_step=100)
+    _train(model, opt, steps=2)
+    assert not opt._v  # compression never engaged before the rampup step
+
+
+# ------------------------------------------------------- fp16 allreduce
+
+def test_fp16_allreduce_rounds_grads():
+    model = _model()
+    opt = FP16AllreduceOptimizer(
+        pt.optimizer.SGD(0.05, parameters=model.parameters()))
+    losses = _train(model, opt)
+    assert losses[-1] < losses[0]
+
+
+# ------------------------------------------------------------ LocalSGD
+
+def test_localsgd_single_process_degenerates():
+    model = _model()
+    opt = LocalSGDOptimizer(
+        pt.optimizer.SGD(0.05, parameters=model.parameters()), k_steps=2)
+    losses = _train(model, opt)
+    assert losses[-1] < losses[0]
+    assert opt._since_sync == 0  # synced on the even step
+
+
+# ------------------------------------------------------- fleet wiring
+
+def test_fleet_strategy_builds_the_stack():
+    strategy = fleet.DistributedStrategy()
+    strategy.dgc = True
+    strategy.fp16_allreduce = True
+    strategy.localsgd = True
+    fleet.init(is_collective=True, strategy=strategy)
+    model = _model()
+    opt = fleet.distributed_optimizer(
+        pt.optimizer.SGD(0.05, parameters=model.parameters()))
+    # stack order: localsgd(fp16(dgc(sgd)))
+    assert isinstance(opt, LocalSGDOptimizer)
+    assert isinstance(opt._inner, FP16AllreduceOptimizer)
+    assert isinstance(opt._inner._inner, DGCOptimizer)
+    losses = _train(model, opt)
+    assert losses[-1] < losses[0]

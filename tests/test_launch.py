@@ -112,3 +112,20 @@ def test_build_child_env_contract():
     assert env["PADDLE_CURRENT_ENDPOINT"] == "h1:2"
     assert env["PADDLE_MASTER"] == "h0:1"
     assert env["PADDLE_TRAINER_ENDPOINTS"] == "h0:1,h1:2,h2:3"
+
+
+@pytest.mark.slow
+def test_localsgd_cross_process_sync(tmp_path):
+    """LocalSGD parameter averaging across two real processes."""
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "from tests._spawn_child import localsgd_sync\n"
+        "import paddle_tpu.distributed as dist\n"
+        "dist.spawn(localsgd_sync, args=(%r,), nprocs=2)\n"
+        "print('LOCALSGD_OK')\n" % (REPO, str(tmp_path)))
+    r = subprocess.run([sys.executable, "-c", code], env=_clean_env(1),
+                       cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "LOCALSGD_OK" in r.stdout
+    assert sorted(p.name for p in tmp_path.glob("w*.txt")) == \
+        ["w0.txt", "w1.txt"]

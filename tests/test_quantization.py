@@ -1,0 +1,142 @@
+"""Quantization tests (SURVEY §2 row 58).
+
+Reference behaviors matched: imperative QAT layer swap + fake-quant STE
+training (slim/quantization/imperative/qat.py), PTQ hook calibration +
+convert (imperative/ptq.py), int8 deployment matmul.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.quantization import (
+    ImperativePTQ,
+    ImperativeQuantAware,
+    Int8Linear,
+    QuantedConv2D,
+    QuantedLinear,
+    dequant,
+    fake_quant_dequant_abs_max,
+    quant_abs_max,
+)
+
+
+def test_fake_qdq_roundtrip_error_bound():
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 64).astype(np.float32)
+    out = np.asarray(fake_quant_dequant_abs_max(pt.to_tensor(x), 8).value)
+    # 8-bit abs-max: error <= scale/127 per element
+    assert np.max(np.abs(out - x)) <= np.abs(x).max() / 127 + 1e-6
+
+
+def test_fake_qdq_straight_through_gradient():
+    x = pt.to_tensor(np.array([0.5, -0.2, 0.9], np.float32))
+    x.stop_gradient = False
+    fake_quant_dequant_abs_max(x, 8).sum().backward()
+    # in-range values pass the cotangent straight through
+    np.testing.assert_allclose(np.asarray(x.grad.value), [1, 1, 1])
+
+
+def test_quant_dequant_int8():
+    x = np.array([[1.0, -2.0], [0.5, 2.0]], np.float32)
+    q, s = quant_abs_max(x)
+    assert q.dtype == np.int8 and s == pytest.approx(2.0)
+    back = np.asarray(dequant(q, s))
+    np.testing.assert_allclose(back, x, atol=2.0 / 127)
+
+
+def test_qat_swaps_layers_and_trains():
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                             pt.nn.Linear(16, 4))
+    ImperativeQuantAware().quantize(model)
+    assert isinstance(model[0], QuantedLinear)
+    assert isinstance(model[2], QuantedLinear)
+
+    opt = pt.optimizer.Adam(0.01, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 8).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.int32)
+    losses = []
+    for _ in range(5):
+        loss = pt.nn.functional.cross_entropy(
+            model(pt.to_tensor(x)), pt.to_tensor(y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.value))
+    assert losses[-1] < losses[0]  # STE lets grads through the quant
+
+
+def test_qat_moving_average_buffer_and_jit():
+    """Activation scale is a Layer buffer updated by the moving-average rule
+    — functional under TrainStep (no host syncs, no tracer leaks), used at
+    eval time (moving_average_abs_max semantics)."""
+    from paddle_tpu.jit import TrainStep
+
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(8, 4))
+    ImperativeQuantAware().quantize(model)
+    q = model[0]
+    assert float(q._act_scale.value) == -1.0  # uncalibrated sentinel
+
+    opt = pt.optimizer.Adam(0.01, parameters=model.parameters())
+    step = TrainStep(model, lambda m, x, y: pt.nn.functional.cross_entropy(
+        m(x), y), opt, donate=False)
+    rng = np.random.RandomState(0)
+    x1 = (2.0 * rng.randn(16, 8)).astype(np.float32)
+    y = rng.randint(0, 4, (16,)).astype(np.int32)
+    step(pt.to_tensor(x1), pt.to_tensor(y))
+    s1 = float(q._act_scale.value)
+    assert s1 == pytest.approx(np.abs(x1).max(), rel=1e-5)  # first: adopt
+    step(pt.to_tensor(0.5 * x1), pt.to_tensor(y))
+    s2 = float(q._act_scale.value)
+    expected = 0.9 * s1 + 0.1 * np.abs(0.5 * x1).max()
+    assert s2 == pytest.approx(expected, rel=1e-4)  # moving-average rule
+
+    model.eval()
+    out = model(pt.to_tensor(x1))  # eval path uses the calibrated scale
+    assert np.isfinite(np.asarray(out.value)).all()
+
+
+def test_qat_conv2d():
+    pt.seed(0)
+    conv = pt.nn.Conv2D(3, 4, 3, padding=1)
+    q = QuantedConv2D(conv)
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(2, 3, 8, 8).astype(np.float32))
+    out = q(x)
+    assert list(out.shape) == [2, 4, 8, 8]
+    ref = conv(x)
+    # 8-bit fake quant stays close to the fp32 conv
+    err = np.abs(np.asarray(out.value) - np.asarray(ref.value)).max()
+    assert err < 0.2
+
+
+def test_ptq_calibrate_convert_int8_close_to_fp32():
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.ReLU(),
+                             pt.nn.Linear(16, 4))
+    rng = np.random.RandomState(1)
+    calib = [rng.randn(8, 8).astype(np.float32) for _ in range(4)]
+    ref_out = np.asarray(model(pt.to_tensor(calib[0])).value)
+
+    ptq = ImperativePTQ()
+    ptq.quantize(model)
+    for batch in calib:
+        model(pt.to_tensor(batch))
+    ptq.convert(model)
+    assert isinstance(model[0], Int8Linear)
+    assert model[0].w_int8.dtype == np.int8
+
+    out = np.asarray(model(pt.to_tensor(calib[0])).value)
+    # int8 per-tensor PTQ on a 2-layer MLP: close, not exact
+    assert np.abs(out - ref_out).max() < 0.15 * np.abs(ref_out).max() + 0.05
+
+
+def test_int8_linear_math():
+    w = np.array([[1.0, -1.0], [0.5, 2.0]], np.float32)
+    q, s = quant_abs_max(w)
+    lin = Int8Linear(q, s, None, act_scale=4.0)
+    x = np.array([[2.0, -4.0]], np.float32)
+    out = np.asarray(lin(pt.to_tensor(x)).value)
+    np.testing.assert_allclose(out, x @ w, atol=0.1)

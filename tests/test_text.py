@@ -1,0 +1,167 @@
+"""Text package tests (SURVEY §2 row 56): dataset parsers over the
+reference's corpus formats (synthesized locally — no egress) and the native
+C++ tokenizer vs the Python parity implementation.
+"""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.text import (
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WordpieceTokenizer,
+    load_vocab,
+    native_available,
+)
+
+
+def _add_text(tf, name, text):
+    data = text.encode("utf-8")
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+@pytest.fixture(scope="module")
+def imdb_tar(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("imdb") / "aclImdb.tar.gz")
+    with tarfile.open(path, "w:gz") as tf:
+        for i in range(3):
+            _add_text(tf, "aclImdb/train/pos/%d.txt" % i,
+                      "great movie really great fun")
+            _add_text(tf, "aclImdb/train/neg/%d.txt" % i,
+                      "bad movie really bad boring")
+            _add_text(tf, "aclImdb/test/pos/%d.txt" % i, "great fun")
+            _add_text(tf, "aclImdb/test/neg/%d.txt" % i, "boring bad")
+    return path
+
+
+def test_imdb_parses_acl_format(imdb_tar):
+    ds = Imdb(data_file=imdb_tar, mode="train", cutoff=2)
+    assert len(ds) == 6
+    doc, label = ds[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    # vocab built from train split with cutoff: all repeated words present
+    for w in ("great", "bad", "movie", "really"):
+        assert w in ds.word_idx
+    test = Imdb(data_file=imdb_tar, mode="test", cutoff=2)
+    assert len(test) == 6
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    path = str(tmp_path / "simple-examples.tgz")
+    lines = ["the cat sat on the mat", "the dog sat on the log"] * 30
+    with tarfile.open(path, "w:gz") as tf:
+        for split in ("train", "valid", "test"):
+            _add_text(tf, "./simple-examples/data/ptb.%s.txt" % split,
+                      "\n".join(lines))
+    ds = Imikolov(data_file=path, mode="train", data_type="ngram",
+                  window_size=3, min_word_freq=10)
+    gram = ds[0]
+    assert gram.shape == (3,) and gram.dtype == np.int64
+    seq = Imikolov(data_file=path, mode="train", data_type="seq",
+                   min_word_freq=10)
+    s = seq[0]
+    assert s[0] == seq.word_idx["<s>"] and s[-1] == seq.word_idx["<e>"]
+
+
+def test_uci_housing(tmp_path):
+    rng = np.random.RandomState(0)
+    data = rng.rand(50, 14).astype(np.float32)
+    path = str(tmp_path / "housing.data")
+    with open(path, "w") as f:
+        for row in data:
+            f.write(" ".join("%.6f" % v for v in row) + "\n")
+    train = UCIHousing(data_file=path, mode="train")
+    test = UCIHousing(data_file=path, mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # features normalized: centred-ish within [-1, 1]
+    assert np.abs(x).max() <= 1.0 + 1e-5
+
+
+def test_movielens(tmp_path):
+    path = str(tmp_path / "ml-1m.tar.gz")
+    with tarfile.open(path, "w:gz") as tf:
+        _add_text(tf, "ml-1m/users.dat",
+                  "1::M::25::4::00000\n2::F::35::7::11111")
+        _add_text(tf, "ml-1m/movies.dat",
+                  "10::Toy Story (1995)::Animation|Comedy\n"
+                  "20::Heat (1995)::Action")
+        _add_text(tf, "ml-1m/ratings.dat",
+                  "\n".join("%d::%d::%d::97" % (u, m, r)
+                            for u, m, r in [(1, 10, 5), (1, 20, 3),
+                                            (2, 10, 4), (2, 20, 2)] * 5))
+    train = Movielens(data_file=path, mode="train", test_ratio=0.25)
+    test = Movielens(data_file=path, mode="test", test_ratio=0.25)
+    assert len(train) + len(test) == 20
+    uid, g, a, j, mid, r = train[0]
+    assert uid in (1, 2) and mid in (10, 20) and 1 <= r <= 5
+
+
+VOCAB = ["[PAD]", "[UNK]", "the", "quick", "brown", "fox", "jump",
+         "##ed", "##s", "over", "lazy", "dog", ",", "."]
+
+
+@pytest.fixture()
+def vocab(tmp_path):
+    path = str(tmp_path / "vocab.txt")
+    with open(path, "w") as f:
+        f.write("\n".join(VOCAB))
+    return load_vocab(path)
+
+
+def test_native_tokenizer_builds():
+    # g++ is baked into the image: the native path must actually build
+    assert native_available()
+
+
+def test_wordpiece_python_reference(vocab):
+    tok = WordpieceTokenizer(vocab, unk_token="[UNK]", use_native=False)
+    ids = tok.tokenize("The quick brown fox jumped over the lazy dog.")
+    words = [VOCAB[i] for i in ids]
+    assert words == ["the", "quick", "brown", "fox", "jump", "##ed",
+                     "over", "the", "lazy", "dog", "."]
+    assert tok.tokenize("zebra")[0] == vocab["[UNK]"]
+
+
+def test_native_matches_python(vocab):
+    if not native_available():
+        pytest.skip("no toolchain")
+    py = WordpieceTokenizer(vocab, use_native=False)
+    cc = WordpieceTokenizer(vocab, use_native=True)
+    for text in ("The quick brown fox jumped over the lazy dog.",
+                 "jumps, jumped. THE LAZY dog",
+                 "unknownword fox", "", "  ,  . ", "fox" * 60):
+        np.testing.assert_array_equal(py.tokenize(text), cc.tokenize(text),
+                                      err_msg=repr(text))
+
+
+def test_tokenizer_in_dataloader_workers(vocab):
+    """Native tokenizer inside multiprocess DataLoader workers — the
+    intended pipeline (tokenization off the main process)."""
+    from paddle_tpu.io import DataLoader, Dataset
+
+    tok = WordpieceTokenizer(vocab)
+    texts = ["the quick brown fox"] * 8 + ["lazy dog jumps"] * 8
+
+    class TextDs(Dataset):
+        def __len__(self):
+            return len(texts)
+
+        def __getitem__(self, i):
+            ids = tok.tokenize(texts[i])
+            out = np.zeros(8, np.int32)
+            out[:len(ids)] = ids[:8]
+            return out
+
+    batches = [np.asarray(b.value)
+               for b in DataLoader(TextDs(), batch_size=4, num_workers=2)]
+    assert len(batches) == 4 and batches[0].shape == (4, 8)
