@@ -66,6 +66,7 @@ from . import io  # noqa: F401
 from . import distribution  # noqa: F401
 from . import inference  # noqa: F401
 from . import metric  # noqa: F401
+from . import onnx  # noqa: F401
 from . import profiler  # noqa: F401
 from . import quantization  # noqa: F401
 from . import static  # noqa: F401

@@ -19,11 +19,12 @@ from typing import Optional
 from ...core.errors import InvalidArgumentError
 from ..collective import init_parallel_env
 from ..topology import CommunicateTopology, HybridCommunicateGroup
+from . import elastic  # noqa: F401
 
 __all__ = [
     "DistributedStrategy", "init", "fleet", "get_hybrid_communicate_group",
     "distributed_model", "distributed_optimizer", "worker_index", "worker_num",
-    "is_first_worker", "barrier_worker",
+    "is_first_worker", "barrier_worker", "elastic",
 ]
 
 

@@ -141,7 +141,24 @@ def launch(args) -> int:
                      if args.trainer_endpoints else
                      ["127.0.0.1:%d" % p
                       for p in _free_port_block(args.world_size)])
-        code = _watch_gang(_spawn_gang(args, endpoints, args.log_dir))
+        manager = None
+        if args.elastic_dir:
+            from .fleet.elastic import ElasticManager
+
+            manager = ElasticManager(args.elastic_dir, args.world_size,
+                                     heartbeat_timeout=args.elastic_timeout)
+            # a relaunched gang must not be judged by the dead gang's stale
+            # registrations (faulted_ranks only flags registered ranks)
+            manager.clear()
+        procs = _spawn_gang(args, endpoints, args.log_dir)
+        if manager is not None:
+            manager.watch(lambda faults: (
+                sys.stderr.write("[launch.elastic] rank(s) %s heartbeat "
+                                 "stale — killing gang\n" % faults),
+                _kill_gang(procs)))
+        code = _watch_gang(procs)
+        if manager is not None:
+            manager.stop()
         if code == 0:
             return 0
         if attempt + 1 < attempts:
@@ -164,6 +181,11 @@ def _parse(argv):
     p.add_argument("--log_dir", type=str, default=None)
     p.add_argument("--max_restarts", type=int, default=0,
                    help="elastic: relaunch the gang up to N times on failure")
+    p.add_argument("--elastic_dir", type=str, default=None,
+                   help="shared dir for heartbeat fault detection: a rank "
+                        "whose heartbeat goes stale gets the gang killed "
+                        "(then relaunched per --max_restarts)")
+    p.add_argument("--elastic_timeout", type=float, default=10.0)
     p.add_argument("--restart_delay", type=float, default=1.0)
     p.add_argument("--module", action="store_true",
                    help="run training_script as a python module (-m)")

@@ -1,0 +1,213 @@
+"""Detection ops (reference ``python/paddle/vision/ops.py`` +
+``fluid/layers/detection.py``: yolo_box, nms/multiclass_nms, box_coder,
+box IoU, roi_align).
+
+TPU-native design: everything is static-shape.  NMS — inherently a
+sequential suppression — is expressed as a fixed-trip ``lax.scan`` over a
+score-sorted candidate list with a suppression mask (no dynamic output
+size: callers get ``max_out`` indices + a validity count, the standard XLA
+detection formulation).  ``roi_align`` is gather + bilinear weights, which
+XLA fuses into a few dense ops rather than the reference's custom CUDA
+kernel (``roi_align_op.cu``).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["box_iou", "nms", "box_coder", "yolo_box", "roi_align"]
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU for [N,4] / [M,4] xyxy boxes → [N,M]."""
+    b1 = jnp.asarray(boxes1)[:, None, :]
+    b2 = jnp.asarray(boxes2)[None, :, :]
+    lt = jnp.maximum(b1[..., :2], b2[..., :2])
+    rb = jnp.minimum(b1[..., 2:], b2[..., 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    a1 = (b1[..., 2] - b1[..., 0]) * (b1[..., 3] - b1[..., 1])
+    a2 = (b2[..., 2] - b2[..., 0]) * (b2[..., 3] - b2[..., 1])
+    return inter / jnp.maximum(a1 + a2 - inter, 1e-9)
+
+
+def nms(boxes, scores, iou_threshold: float = 0.5,
+        max_out: Optional[int] = None,
+        score_threshold: Optional[float] = None) -> Tuple:
+    """Greedy hard NMS (``nms_op.cc`` semantics, static shapes).
+
+    Returns ``(indices[max_out] int32, count int32)``: the first ``count``
+    entries of ``indices`` select kept boxes in descending-score order;
+    the tail is padded with -1.  Fixed trip count = max_out scan steps, so
+    one compilation serves every input.
+    """
+    boxes = jnp.asarray(boxes)
+    scores = jnp.asarray(scores)
+    n = boxes.shape[0]
+    if max_out is None:
+        max_out = n
+    order = jnp.argsort(-scores)
+    sorted_boxes = boxes[order]
+    iou = box_iou(sorted_boxes, sorted_boxes)
+    alive = jnp.ones((n,), bool)
+    if score_threshold is not None:
+        alive = alive & (scores[order] > score_threshold)
+
+    def body(state, _):
+        alive, count, out = state
+        # highest-score still-alive candidate (n = none left)
+        cand = jnp.argmax(alive)  # first True (argmax of bool)
+        any_alive = alive.any()
+        out = out.at[count].set(jnp.where(any_alive, order[cand], -1))
+        suppress = iou[cand] > iou_threshold
+        alive = alive & ~suppress & (jnp.arange(n) != cand)
+        alive = jnp.where(any_alive, alive, jnp.zeros_like(alive))
+        count = count + jnp.where(any_alive, 1, 0)
+        return (alive, count, out), None
+
+    init = (alive, jnp.int32(0), jnp.full((max_out,), -1, jnp.int32))
+    (alive, count, out), _ = lax.scan(body, init, None, length=max_out)
+    return out, count
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True):
+    """box_coder_op.cc parity: encode/decode boxes against priors.
+
+    priors/targets: [N, 4] xyxy.  ``decode_center_size`` treats target_box
+    as deltas [N, 4] (dx, dy, dw, dh).
+    """
+    pb = jnp.asarray(prior_box, jnp.float32)
+    pv = jnp.asarray(prior_box_var, jnp.float32)
+    tb = jnp.asarray(target_box, jnp.float32)
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[:, 2] - tb[:, 0] + norm
+        th = tb[:, 3] - tb[:, 1] + norm
+        tcx = tb[:, 0] + tw * 0.5
+        tcy = tb[:, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(tw / pw), jnp.log(th / ph)], axis=1)
+        return out / pv
+    if code_type == "decode_center_size":
+        d = tb * pv
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        w = jnp.exp(d[:, 2]) * pw
+        h = jnp.exp(d[:, 3]) * ph
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=1)
+    raise InvalidArgumentError("code_type must be encode/decode_center_size")
+
+
+def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float,
+             downsample_ratio: int = 32, clip_bbox: bool = True,
+             scale_x_y: float = 1.0):
+    """yolo_box_op.cc parity: decode one YOLO head.
+
+    ``x``: [N, len(anchors)/2*(5+class_num), H, W]; returns
+    (boxes [N, H*W*A, 4] xyxy in image coords, scores [N, H*W*A, classes]).
+    Low-confidence boxes get zeroed scores (the reference zeroes the box;
+    zero scores is the mask-friendly equivalent for static shapes).
+    """
+    x = jnp.asarray(x)
+    n, c, h, w = x.shape
+    na = len(anchors) // 2
+    if c != na * (5 + class_num):
+        raise InvalidArgumentError(
+            "yolo_box channel mismatch: %d != %d*(5+%d)"
+            % (c, na, class_num))
+    anchors = np.asarray(anchors, np.float32).reshape(na, 2)
+    feats = x.reshape(n, na, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (jax.nn.sigmoid(feats[:, :, 0]) * alpha + beta + grid_x) / w
+    by = (jax.nn.sigmoid(feats[:, :, 1]) * alpha + beta + grid_y) / h
+    input_w = w * downsample_ratio
+    input_h = h * downsample_ratio
+    bw = jnp.exp(feats[:, :, 2]) * anchors[None, :, 0, None, None] / input_w
+    bh = jnp.exp(feats[:, :, 3]) * anchors[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(feats[:, :, 4])
+    probs = jax.nn.sigmoid(feats[:, :, 5:]) * conf[:, :, None]
+    img_size = jnp.asarray(img_size, jnp.float32)  # [N, 2] (h, w)
+    img_h = img_size[:, 0][:, None, None, None]
+    img_w = img_size[:, 1][:, None, None, None]
+    x0 = (bx - bw * 0.5) * img_w
+    y0 = (by - bh * 0.5) * img_h
+    x1 = (bx + bw * 0.5) * img_w
+    y1 = (by + bh * 0.5) * img_h
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0, img_w - 1)
+        y0 = jnp.clip(y0, 0, img_h - 1)
+        x1 = jnp.clip(x1, 0, img_w - 1)
+        y1 = jnp.clip(y1, 0, img_h - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(n, -1, 4)
+    keep = (conf > conf_thresh)[..., None]
+    scores = jnp.where(keep, probs.transpose(0, 1, 3, 4, 2),
+                       0.0).reshape(n, -1, class_num)
+    return boxes, scores
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
+              sampling_ratio: int = -1, aligned: bool = True):
+    """roi_align_op parity: [N,C,H,W] + [R,4] xyxy rois → [R,C,oh,ow].
+
+    Bilinear sampling as dense gathers; ``boxes_num`` [N] maps each roi to
+    its batch image (the LoD replacement, consistent with tensor.segment).
+    """
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    r = boxes.shape[0]
+    batch_idx = jnp.repeat(jnp.arange(n), jnp.asarray(boxes_num),
+                           total_repeat_length=r)
+    offset = 0.5 if aligned else 0.0
+    x0 = boxes[:, 0] * spatial_scale - offset
+    y0 = boxes[:, 1] * spatial_scale - offset
+    x1 = boxes[:, 2] * spatial_scale - offset
+    y1 = boxes[:, 3] * spatial_scale - offset
+    rw = jnp.maximum(x1 - x0, 1e-3 if aligned else 1.0)
+    rh = jnp.maximum(y1 - y0, 1e-3 if aligned else 1.0)
+    s = sampling_ratio if sampling_ratio > 0 else 2
+    # sample grid: [R, oh*s] y coords, [R, ow*s] x coords
+    ys = y0[:, None] + rh[:, None] * (
+        (jnp.arange(oh * s) + 0.5) / (oh * s))
+    xs = x0[:, None] + rw[:, None] * (
+        (jnp.arange(ow * s) + 0.5) / (ow * s))
+
+    def bilinear(img, yy, xx):
+        yy = jnp.clip(yy, 0, h - 1)
+        xx = jnp.clip(xx, 0, w - 1)
+        yf = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+        xf = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+        yc = jnp.minimum(yf + 1, h - 1)
+        xc = jnp.minimum(xf + 1, w - 1)
+        wy = yy - yf
+        wx = xx - xf
+        g = lambda iy, ix: img[:, iy[:, None], ix[None, :]]  # noqa: E731
+        val = (g(yf, xf) * ((1 - wy)[:, None] * (1 - wx)[None, :])[None]
+               + g(yf, xc) * ((1 - wy)[:, None] * wx[None, :])[None]
+               + g(yc, xf) * (wy[:, None] * (1 - wx)[None, :])[None]
+               + g(yc, xc) * (wy[:, None] * wx[None, :])[None])
+        return val  # [C, oh*s, ow*s]
+
+    def per_roi(bi, yy, xx):
+        samp = bilinear(x[bi], yy, xx)  # [C, oh*s, ow*s]
+        return samp.reshape(c, oh, s, ow, s).mean(axis=(2, 4))
+
+    return jax.vmap(per_roi)(batch_idx, ys, xs)

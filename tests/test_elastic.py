@@ -1,0 +1,87 @@
+"""Elastic fault-detection tests (SURVEY §2 row 44, fleet/elastic.py:90
+analog): membership, heartbeat staleness, watch trigger, launcher kill+
+relaunch integration.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import ElasticManager, start_heartbeat
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_membership_and_heartbeats(tmp_path):
+    m = ElasticManager(str(tmp_path), world_size=2, heartbeat_timeout=5.0)
+    assert not m.all_healthy()
+    m.register(0, "h0:1")
+    m.register(1, "h1:2")
+    assert m.registered_ranks() == [0, 1]
+    assert m.alive_ranks() == [0, 1]
+    assert m.all_healthy() and m.faulted_ranks() == []
+
+
+def test_stale_heartbeat_detected(tmp_path):
+    m = ElasticManager(str(tmp_path), world_size=2, heartbeat_timeout=0.2)
+    m.register(0)
+    m.register(1)
+    # age rank 1's heartbeat artificially
+    old = time.time() - 60
+    os.utime(os.path.join(str(tmp_path), "rank1.hb"), (old, old))
+    assert m.faulted_ranks() == [1]
+    assert not m.all_healthy()
+
+
+def test_watch_triggers_on_fault(tmp_path):
+    m = ElasticManager(str(tmp_path), world_size=1, heartbeat_timeout=0.2)
+    m.register(0)
+    seen = []
+    m.watch(lambda faults: seen.append(faults), interval=0.05)
+    stop = start_heartbeat(m, 0, interval=0.05)
+    time.sleep(0.4)
+    assert seen == []  # heartbeats flowing: no fault
+    stop.set()
+    deadline = time.time() + 5
+    while not seen and time.time() < deadline:
+        time.sleep(0.05)
+    m.stop()
+    assert seen == [[0]]
+
+
+@pytest.mark.slow
+def test_launcher_kills_gang_on_stale_heartbeat(tmp_path):
+    """A rank that hangs (heartbeat stops, process alive) gets the gang
+    killed by the launcher's elastic watcher — hung-rank detection the
+    plain exit-code watch cannot do."""
+    child = str(tmp_path / "child.py")
+    with open(child, "w") as f:
+        f.write(
+            "import os, sys, time\n"
+            "sys.path.insert(0, %r)\n"
+            "from paddle_tpu.distributed.fleet.elastic import ElasticManager\n"
+            "rank = int(os.environ['PADDLE_TRAINER_ID'])\n"
+            "m = ElasticManager(%r, 2, heartbeat_timeout=1.0)\n"
+            "m.register(rank)\n"
+            "for step in range(600):\n"
+            "    if rank == 1 and step == 3:\n"
+            "        time.sleep(600)  # hang without exiting\n"
+            "    m.heartbeat(rank)\n"
+            "    time.sleep(0.1)\n" % (REPO, str(tmp_path / "store")))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in list(env):
+        if k.startswith("PADDLE_TRAINER") or k == "PADDLE_MASTER":
+            del env[k]
+    t0 = time.time()
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--nproc_per_node", "2", "--elastic_dir", str(tmp_path / "store"),
+         "--elastic_timeout", "1.0", child],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240)
+    took = time.time() - t0
+    assert r.returncode != 0
+    assert "heartbeat stale" in r.stderr, r.stderr
+    assert took < 120  # killed long before the 60 s hang would finish

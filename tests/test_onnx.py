@@ -1,0 +1,103 @@
+"""ONNX export tests (SURVEY §2 row 59): export traced models to the ONNX
+wire format, parse them back, and execute with the numpy runtime — output
+parity against the live model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.onnx import export
+from paddle_tpu.onnx import runtime as ort
+
+
+def _check_roundtrip(model, xs, rtol=1e-5, atol=1e-6):
+    ref = model(*[pt.to_tensor(x) for x in xs])
+    path = export(model, "/tmp/_onnx_test_model", input_spec=xs)
+    got = ort.run(path, list(xs))[0]
+    np.testing.assert_allclose(got, np.asarray(ref.value),
+                               rtol=rtol, atol=atol)
+    return path
+
+
+def test_export_mlp_softmax(tmp_path):
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                             pt.nn.Linear(8, 3), pt.nn.Softmax())
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    path = _check_roundtrip(model, (x,))
+    nodes, inits, inputs, outputs = ort.load(path)
+    ops = {n["op"] for n in nodes}
+    assert "MatMul" in ops and "Max" in ops and "Exp" in ops
+    assert inputs == ["input_0"] and len(outputs) == 1
+    # weights became initializers with real values
+    assert any(v.shape == (4, 8) for v in inits.values())
+
+
+def test_export_deeper_activations():
+    pt.seed(1)
+    model = pt.nn.Sequential(pt.nn.Linear(6, 6), pt.nn.Sigmoid(),
+                             pt.nn.Linear(6, 6), pt.nn.Tanh(),
+                             pt.nn.Linear(6, 2))
+    x = np.random.RandomState(1).randn(3, 6).astype(np.float32)
+    _check_roundtrip(model, (x,))
+
+
+def test_export_layernorm():
+    pt.seed(2)
+    model = pt.nn.Sequential(pt.nn.Linear(8, 8), pt.nn.LayerNorm(8))
+    x = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+    _check_roundtrip(model, (x,), rtol=1e-4, atol=1e-5)
+
+
+def test_export_conv2d():
+    pt.seed(3)
+    model = pt.nn.Sequential(pt.nn.Conv2D(3, 4, 3, padding=1), pt.nn.ReLU())
+    x = np.random.RandomState(3).randn(2, 3, 8, 8).astype(np.float32)
+    _check_roundtrip(model, (x,), rtol=1e-4, atol=1e-5)
+
+
+def test_export_grouped_dilated_conv():
+    pt.seed(4)
+    model = pt.nn.Sequential(
+        pt.nn.Conv2D(4, 4, 3, padding=2, dilation=2, groups=2))
+    x = np.random.RandomState(4).randn(1, 4, 8, 8).astype(np.float32)
+    _check_roundtrip(model, (x,), rtol=1e-4, atol=1e-5)
+
+
+def test_export_conv_transpose_is_loud():
+    pt.seed(5)
+    model = pt.nn.Conv2DTranspose(2, 2, 3, stride=2)
+    x = np.random.RandomState(5).randn(1, 2, 4, 4).astype(np.float32)
+    # loud either way: the kernel flip ('rev') or the lhs_dilation guard
+    with pytest.raises(Exception,
+                       match="rev|lhs_dilation|ConvTranspose"):
+        export(model, "/tmp/_onnx_convT", input_spec=(x,))
+
+
+def test_export_reduce_max_axes_attribute():
+    class MaxPoolish(pt.nn.Layer):
+        def forward(self, x):
+            return pt.max(x, axis=1)
+
+    x = np.random.RandomState(6).randn(3, 5).astype(np.float32)
+    path = _check_roundtrip(MaxPoolish(), (x,))
+    nodes, _, _, _ = ort.load(path)
+    rmax = [n for n in nodes if n["op"] == "ReduceMax"]
+    # axes as attribute (opset 17 validity), single data input
+    assert rmax and rmax[0]["attrs"].get("axes") == [1]
+    assert len(rmax[0]["inputs"]) == 1
+
+
+def test_export_unsupported_primitive_is_loud():
+    class Sorter(pt.nn.Layer):
+        def forward(self, x):
+            return pt.sort(x, axis=-1)
+
+    x = np.random.RandomState(0).randn(2, 4).astype(np.float32)
+    with pytest.raises(Exception, match="no ONNX mapping"):
+        export(Sorter(), "/tmp/_onnx_bad", input_spec=(x,))
+
+
+def test_export_requires_input_spec():
+    with pytest.raises(Exception, match="input_spec"):
+        export(pt.nn.Linear(2, 2), "/tmp/_onnx_nospec")

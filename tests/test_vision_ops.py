@@ -1,0 +1,109 @@
+"""Detection op tests (SURVEY §2 row 28 long tail): nms / box_coder /
+yolo_box / roi_align vs naive numpy references.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.vision.ops import box_coder, box_iou, nms, roi_align, yolo_box
+
+
+def _naive_nms(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    alive = np.ones(len(boxes), bool)
+    for i in order:
+        if not alive[i]:
+            continue
+        keep.append(i)
+        for j in order:
+            if alive[j] and j != i:
+                iou = np.asarray(box_iou(boxes[i:i + 1], boxes[j:j + 1]))[0, 0]
+                if iou > thresh:
+                    alive[j] = False
+        alive[i] = False
+    return keep
+
+
+def test_box_iou():
+    a = np.array([[0, 0, 2, 2]], np.float32)
+    b = np.array([[1, 1, 3, 3], [4, 4, 5, 5]], np.float32)
+    iou = np.asarray(box_iou(a, b))
+    assert iou[0, 0] == pytest.approx(1 / 7)
+    assert iou[0, 1] == 0.0
+
+
+def test_nms_matches_naive():
+    rng = np.random.RandomState(0)
+    centers = rng.rand(20, 2) * 10
+    wh = rng.rand(20, 2) * 3 + 0.5
+    boxes = np.concatenate([centers - wh / 2, centers + wh / 2],
+                           axis=1).astype(np.float32)
+    scores = rng.rand(20).astype(np.float32)
+    idx, count = nms(boxes, scores, iou_threshold=0.3)
+    got = np.asarray(idx)[:int(count)].tolist()
+    assert got == _naive_nms(boxes, scores, 0.3)
+    # padding tail is -1
+    assert all(v == -1 for v in np.asarray(idx)[int(count):])
+
+
+def test_nms_jit_and_score_threshold():
+    boxes = np.array([[0, 0, 1, 1], [0, 0, 1.01, 1.01], [5, 5, 6, 6]],
+                     np.float32)
+    scores = np.array([0.9, 0.8, 0.05], np.float32)
+    jitted = jax.jit(lambda b, s: nms(b, s, 0.5, max_out=3,
+                                      score_threshold=0.1))
+    idx, count = jitted(boxes, scores)
+    assert int(count) == 1 and int(idx[0]) == 0  # overlap + low score culled
+
+
+def test_box_coder_roundtrip():
+    priors = np.array([[0, 0, 4, 4], [2, 2, 8, 10]], np.float32)
+    var = np.ones((2, 4), np.float32) * 0.1
+    targets = np.array([[1, 1, 5, 5], [0, 0, 6, 12]], np.float32)
+    enc = box_coder(priors, var, targets, "encode_center_size")
+    dec = np.asarray(box_coder(priors, var, np.asarray(enc),
+                               "decode_center_size"))
+    np.testing.assert_allclose(dec, targets, rtol=1e-4, atol=1e-4)
+
+
+def test_yolo_box_shapes_and_confidence_mask():
+    rng = np.random.RandomState(1)
+    n, classes, h, w = 2, 3, 4, 4
+    anchors = [10, 13, 16, 30]
+    x = rng.randn(n, 2 * (5 + classes), h, w).astype(np.float32)
+    img_size = np.array([[128, 128], [256, 192]], np.float32)
+    boxes, scores = yolo_box(x, img_size, anchors, classes,
+                             conf_thresh=0.5, downsample_ratio=32)
+    assert boxes.shape == (n, h * w * 2, 4)
+    assert scores.shape == (n, h * w * 2, classes)
+    # boxes clipped into their image
+    assert float(jnp.max(boxes[0])) <= 127.0 + 1e-3
+    sig = 1 / (1 + np.exp(-x.reshape(n, 2, 5 + classes, h, w)[:, :, 4]))
+    frac_zero = float((np.asarray(scores) == 0).mean())
+    assert frac_zero >= float((sig <= 0.5).mean()) * 0.99  # masked out
+
+
+def test_roi_align_constant_field():
+    # constant feature map: every aligned output equals that constant
+    x = np.full((1, 2, 8, 8), 3.5, np.float32)
+    rois = np.array([[1, 1, 5, 5], [0, 0, 7.5, 7.5]], np.float32)
+    out = np.asarray(roi_align(x, rois, boxes_num=[2], output_size=4))
+    assert out.shape == (2, 2, 4, 4)
+    np.testing.assert_allclose(out, 3.5, rtol=1e-5)
+
+
+def test_roi_align_linear_field_center_exact():
+    # linear ramp f(y, x) = x: horizontal average over a roi column equals
+    # the column's center x coordinate (bilinear is exact on linear fields)
+    w = 16
+    x = np.tile(np.arange(w, dtype=np.float32), (1, 1, w, 1))
+    rois = np.array([[2, 2, 10, 10]], np.float32)
+    out = np.asarray(roi_align(x, rois, boxes_num=[1], output_size=4,
+                               sampling_ratio=2))
+    # roi spans x in [2,10]; output col j is centered at 2+2j+1 in
+    # continuous coords, which reads index center-0.5 under the
+    # aligned=True half-pixel convention → 2.5 + 2j
+    expected = np.array([2.5, 4.5, 6.5, 8.5], np.float32)
+    np.testing.assert_allclose(out[0, 0].mean(axis=0), expected, atol=1e-4)
