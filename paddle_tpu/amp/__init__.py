@@ -220,12 +220,15 @@ class GradScaler:
         """
         if not self._enable or self._unscaled:
             return
+        from ..framework.sparse import SparseGrad
+
         inv = 1.0 / self._scale
         finite = jnp.asarray(True)
         for p in self._iter_grads(optimizer):
-            g = p._grad_val * inv
+            g = p._grad_val * inv  # SparseGrad scales row values in place
             p._grad_val = g
-            finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+            vals = g.values if isinstance(g, SparseGrad) else g
+            finite = jnp.logical_and(finite, jnp.isfinite(vals).all())
         self._found_inf = not bool(finite)
         self._unscaled = True
 

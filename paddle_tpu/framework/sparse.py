@@ -1,0 +1,92 @@
+"""SelectedRows analog: row-sparse gradients for embedding tables.
+
+Reference parity: ``paddle/fluid/framework/selected_rows.h`` (rows+value
+gradient representation emitted by ``lookup_table_op`` when ``is_sparse``)
+and its optimizer consumers (``adam_op`` lazy_mode, sgd_op's SelectedRows
+branch).
+
+TPU-native design: XLA gradients are dense by construction, so the sparse
+representation lives only on the EAGER tape — the embedding op's recorded
+pullback emits ``SparseGrad(rows, values)`` instead of scattering into a
+[vocab, dim] zeros (which for a 100k+ vocab dominates the backward).  Lazy
+optimizers consume it with row-slice updates; everything else densifies
+loudly at the accumulation boundary.  Under ``jit``/``TrainStep`` the dense
+path is used (XLA fuses the scatter efficiently there).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SparseGrad"]
+
+
+class SparseGrad:
+    """rows+values gradient: ``dense[rows[i]] += values[i]``."""
+
+    __slots__ = ("indices", "values", "dense_shape")
+
+    def __init__(self, indices, values, dense_shape):
+        self.indices = jnp.asarray(indices).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.dense_shape = tuple(dense_shape)
+        if self.values.shape[0] != self.indices.shape[0]:
+            raise ValueError("SparseGrad rows/values mismatch: %s vs %s"
+                             % (self.indices.shape, self.values.shape))
+
+    # -- arithmetic used by the engine's accumulation ------------------
+    def __add__(self, other):
+        if other is None:
+            return self
+        if isinstance(other, SparseGrad):
+            if other.dense_shape != self.dense_shape:
+                raise ValueError("SparseGrad shape mismatch")
+            return SparseGrad(
+                jnp.concatenate([self.indices, other.indices]),
+                jnp.concatenate([self.values, other.values]),
+                self.dense_shape)
+        return self.to_dense() + other  # mixed: densify
+
+    __radd__ = __add__
+
+    def __mul__(self, other):
+        # scalar scaling (GradScaler.unscale_, loss scaling): stays sparse
+        if np.ndim(other) == 0:
+            return SparseGrad(self.indices, self.values * other,
+                              self.dense_shape)
+        return self.to_dense() * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if np.ndim(other) == 0:
+            return SparseGrad(self.indices, self.values / other,
+                              self.dense_shape)
+        return self.to_dense() / other
+
+    def coalesce(self) -> "SparseGrad":
+        """Merge duplicate rows (host-side unique; eager tape only)."""
+        idx = np.asarray(self.indices)
+        uniq, inv = np.unique(idx, return_inverse=True)
+        import jax
+
+        summed = jax.ops.segment_sum(self.values, jnp.asarray(inv),
+                                     num_segments=len(uniq))
+        return SparseGrad(jnp.asarray(uniq), summed, self.dense_shape)
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    # tensor-facade niceties so debugging prints don't explode
+    @property
+    def shape(self):
+        return self.dense_shape
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def __repr__(self):
+        return "SparseGrad(rows=%d, dense_shape=%s)" % (
+            int(self.indices.shape[0]), (self.dense_shape,))

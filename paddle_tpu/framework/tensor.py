@@ -140,6 +140,13 @@ class Tensor:
             self._grad_val = g._value if isinstance(g, Tensor) else jnp.asarray(g)
 
     def _wrap_grad(self, g) -> "Tensor":
+        from .sparse import SparseGrad
+
+        if isinstance(g, SparseGrad):
+            # the public .grad view densifies (lookup_table sparse grads in
+            # the reference also read back dense); optimizers consume the
+            # sparse form directly from _grad_val
+            g = g.to_dense()
         t = Tensor(g, stop_gradient=True)
         return t
 

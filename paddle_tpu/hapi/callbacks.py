@@ -224,3 +224,49 @@ class EarlyStopping(Callback):
                 if self.verbose:
                     print("Early stopping: %s did not improve beyond %.5f"
                           % (self.monitor, self.best))
+
+
+class VisualDL(Callback):
+    """paddle.callbacks.VisualDL parity: stream train/eval metrics to a
+    ``profiler.LogWriter`` logdir (JSONL scalars instead of VisualDL's
+    binary records; read back with ``LogWriter.read``)."""
+
+    def __init__(self, log_dir: str):
+        super().__init__()
+        self.log_dir = log_dir
+        self._writer = None
+        self._step = 0
+
+    def _w(self):
+        if self._writer is None:
+            from ..profiler import LogWriter
+
+            self._writer = LogWriter(self.log_dir)
+        return self._writer
+
+    def _emit(self, prefix, logs):
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)):  # hapi convention: loss as list
+                v = v[0] if len(v) == 1 else None
+            try:
+                self._w().add_scalar("%s/%s" % (prefix, k), float(v),
+                                     self._step)
+            except (TypeError, ValueError):
+                continue  # non-scalar entries are skipped
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._emit("train", logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._emit("train_epoch", logs)
+
+    def on_eval_end(self, logs=None):
+        self._emit("eval", logs)
+
+    def on_train_end(self, logs=None):
+        if self._writer is not None:
+            self._writer.close()
+
+
+__all__.append("VisualDL")

@@ -71,6 +71,48 @@ class AlphaDropout(Layer):
         return F.alpha_dropout(x, self.p, training=self.training)
 
 
+class _SparseEmbeddingOp:
+    """Recorded embedding op whose weight-pullback is a SparseGrad
+    (lookup_table_op is_sparse semantics: backward never materializes the
+    [vocab, dim] dense gradient)."""
+
+    @classmethod
+    def apply(cls, ids, weight, padding_idx=None):
+        import jax.numpy as jnp
+
+        from ...autograd import PyLayer
+        from ...framework.sparse import SparseGrad
+
+        class _Op(PyLayer):
+            @staticmethod
+            def forward(ctx, w):
+                from .. import functional as F_
+                from ...framework.tensor import Tensor
+
+                raw_ids = (ids._value if hasattr(ids, "_value")
+                           else jnp.asarray(ids)).astype(jnp.int32)
+                ctx.ids = raw_ids
+                ctx.vocab = w.shape[0]
+                # same forward math as the dense path — only the recorded
+                # backward differs
+                out = F_.common.embedding(raw_ids, w._value,
+                                          padding_idx=padding_idx)
+                return Tensor(out, stop_gradient=w.stop_gradient)
+
+            @staticmethod
+            def backward(ctx, cot):
+                c = cot._value if hasattr(cot, "_value") else jnp.asarray(cot)
+                dim = c.shape[-1]
+                rows = ctx.ids.reshape(-1)
+                vals = c.reshape(-1, dim)
+                if padding_idx is not None:
+                    keep = rows != padding_idx
+                    vals = jnp.where(keep[:, None], vals, 0.0)
+                return (SparseGrad(rows, vals, (ctx.vocab, dim)),)
+
+        return _Op.apply(weight)
+
+
 class Embedding(Layer):
     """paddle.nn.Embedding: weight [num_embeddings, embedding_dim]."""
 
@@ -87,6 +129,7 @@ class Embedding(Layer):
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
+        self._sparse = bool(sparse)
         self.weight = self.create_parameter(
             [num_embeddings, embedding_dim],
             attr=weight_attr,
@@ -94,6 +137,15 @@ class Embedding(Layer):
         )
 
     def forward(self, x):
+        if self._sparse:
+            from ...framework.dispatch import _is_traced
+
+            if not _is_traced(self.weight._value):
+                # eager tape: rows+values gradient (SelectedRows analog);
+                # traced mode falls through to the dense take (XLA fuses
+                # the scatter there)
+                return _SparseEmbeddingOp.apply(
+                    x, self.weight, padding_idx=self._padding_idx)
         return F.embedding(x, self.weight, padding_idx=self._padding_idx)
 
     def extra_repr(self):

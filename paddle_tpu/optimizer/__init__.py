@@ -119,24 +119,48 @@ class Optimizer:
                 "this optimizer was constructed without a parameters list; "
                 "pass parameters=model.parameters()"
             )
+        from ..framework.sparse import SparseGrad
+
         params_grads = [
             (p, p._grad_val)
             for p in self._parameter_list
             if not p.stop_gradient and p._grad_val is not None
         ]
         if self._grad_clip is not None:
+            # norm-based clipping needs real norms: densify sparse grads
+            params_grads = [
+                (p, g.to_dense() if isinstance(g, SparseGrad) else g)
+                for p, g in params_grads]
             params_grads = self._grad_clip(params_grads)
         lr_val = self.get_lr()
         for p, g in params_grads:
             if g is None:
                 continue
             state = self._state_for(p)
+            plr = lr_val * p.optimize_attr.get("learning_rate", 1.0)
+            if isinstance(g, SparseGrad):
+                # SelectedRows consumer (adam_op lazy_mode / sgd_op
+                # SelectedRows branch): row-slice update when the optimizer
+                # supports it, dense scatter otherwise
+                if self._supports_sparse(p, state):
+                    g = g.coalesce()
+                    new_val, new_state = self._apply_one_sparse(
+                        p.value, g, state, plr, p)
+                    self._states[p.name] = new_state
+                    p._replace_value(new_val)
+                    continue
+                g = g.to_dense()
             if not self._decoupled_decay:
                 g = self._regularized(p, p.value, g)
-            plr = lr_val * p.optimize_attr.get("learning_rate", 1.0)
             new_val, new_state = self._apply_one(p.value, g, state, plr, p)
             self._states[p.name] = new_state
             p._replace_value(new_val)
+
+    def _supports_sparse(self, p, state) -> bool:
+        return False
+
+    def _apply_one_sparse(self, val, grad, state, lr, p):
+        raise NotImplementedError  # pragma: no cover - gated by _supports_sparse
 
     def _functional_step(self, params, vals, grads, states, lr_val):
         """Pure update over raw arrays — the jitted train-step path.
@@ -271,6 +295,16 @@ class SGD(Optimizer):
         new = m - lr * grad.astype(m.dtype)
         return self._finish(new, val.dtype, state)
 
+    def _supports_sparse(self, p, state) -> bool:
+        # sgd_op's SelectedRows branch: plain row subtraction
+        return ("master_weight" not in state
+                and getattr(p, "regularizer", None) is None
+                and self._weight_decay is None)
+
+    def _apply_one_sparse(self, val, grad, state, lr, p):
+        delta = (lr * grad.values.astype(val.dtype))
+        return val.at[grad.indices].add(-delta), state
+
 
 class Momentum(Optimizer):
     """operators/optimizers/momentum_op semantics incl. use_nesterov."""
@@ -312,6 +346,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = bool(lazy_mode)
 
     def _init_state(self, p):
         state = super()._init_state(p)
@@ -341,6 +376,31 @@ class Adam(Optimizer):
         new_val, state2 = self._finish(new, val.dtype, state)
         return new_val, state2
 
+    def _supports_sparse(self, p, state) -> bool:
+        # adam_op.cc lazy_mode: only rows present in the SelectedRows grad
+        # get moment/param updates (beta pows still advance globally)
+        return (self._lazy_mode and "master_weight" not in state
+                and getattr(p, "regularizer", None) is None
+                and self._weight_decay is None)
+
+    def _apply_one_sparse(self, val, grad, state, lr, p):
+        rows = grad.indices
+        g = grad.values.astype(jnp.float32)
+        m1r = self._beta1 * state["moment1"][rows] + (1 - self._beta1) * g
+        m2r = self._beta2 * state["moment2"][rows] + \
+            (1 - self._beta2) * jnp.square(g)
+        b1p = state["beta1_pow"] * self._beta1
+        b2p = state["beta2_pow"] * self._beta2
+        mhat = m1r / (1 - b1p)
+        vhat = m2r / (1 - b2p)
+        delta = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        new_val = val.at[rows].add(-delta.astype(val.dtype))
+        new_state = dict(state,
+                         moment1=state["moment1"].at[rows].set(m1r),
+                         moment2=state["moment2"].at[rows].set(m2r),
+                         beta1_pow=b1p, beta2_pow=b2p)
+        return new_val, new_state
+
 
 class AdamW(Adam):
     """Decoupled weight decay (python/paddle/optimizer/adamw.py)."""
@@ -368,6 +428,20 @@ class AdamW(Adam):
         delta, state = self._adam_update(m, grad, state, lr)
         new = m * (1.0 - lr * decay) - delta
         return self._finish(new, val.dtype, state)
+
+    def _supports_sparse(self, p, state) -> bool:
+        # decoupled decay touches EVERY row each step — incompatible with
+        # lazy row updates unless the decay is zero for this parameter
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        return decay == 0.0 and super()._supports_sparse(p, state)
+
+    def _apply_one_sparse(self, val, grad, state, lr, p):
+        if self._lr_ratio is not None:  # same lr scaling as the dense path
+            lr = lr * self._lr_ratio(p)
+        return super()._apply_one_sparse(val, grad, state, lr, p)
 
 
 class Adagrad(Optimizer):

@@ -147,3 +147,8 @@ def device_peak_flops() -> float:
     if "v6" in kind or "trillium" in kind:
         return 918e12
     return 1e12
+
+
+from .visual import LogWriter, export_chrome_tracing  # noqa: E402,F401
+
+__all__ += ["LogWriter", "export_chrome_tracing"]

@@ -1,0 +1,95 @@
+"""Training observability: scalar logging + chrome-trace export.
+
+Reference parity: VisualDL's ``LogWriter.add_scalar`` surface (the
+reference's standard training dashboard) and the profiler's
+``chrome_tracing`` export (``paddle/fluid/platform/profiler.cc`` writes
+chrome://tracing JSON).
+
+TPU-native notes: scalars append to a JSONL file (one line per point —
+greppable, tail-able, no binary format to version) and the trace exporter
+converts the op-time table the dispatch profiler already collects into the
+standard chrome trace-event format, so ``chrome://tracing`` / Perfetto
+loads it directly.  For deep XLA-level traces, ``profiler.xla_trace``
+(TensorBoard protocol) remains the heavyweight option.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+__all__ = ["LogWriter", "export_chrome_tracing"]
+
+
+class LogWriter:
+    """VisualDL LogWriter parity (scalars; JSONL storage)."""
+
+    def __init__(self, logdir: str, file_name: str = "scalars.jsonl"):
+        os.makedirs(logdir, exist_ok=True)
+        self.logdir = logdir
+        self._path = os.path.join(logdir, file_name)
+        self._f = open(self._path, "a", buffering=1)
+
+    def add_scalar(self, tag: str, value, step: int) -> None:
+        self._f.write(json.dumps(
+            {"tag": tag, "value": float(value), "step": int(step),
+             "wall": time.time()}) + "\n")
+
+    def add_scalars(self, main_tag: str, tag_value: Dict, step: int) -> None:
+        for k, v in tag_value.items():
+            self.add_scalar("%s/%s" % (main_tag, k), v, step)
+
+    def close(self) -> None:
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    @staticmethod
+    def read(logdir: str, tag: Optional[str] = None,
+             file_name: str = "scalars.jsonl") -> List[dict]:
+        """Load points back (the dashboard-side read path)."""
+        out = []
+        with open(os.path.join(logdir, file_name)) as f:
+            for line in f:
+                rec = json.loads(line)
+                if tag is None or rec["tag"] == tag:
+                    out.append(rec)
+        return out
+
+
+def export_chrome_tracing(path: str, op_times: Optional[List] = None) -> str:
+    """Write the collected op-time table as chrome trace events.
+
+    ``op_times``: list of (name, seconds[, start_seconds]).  Defaults to the
+    dispatch profiler's accumulated per-op totals (``start_profiler`` must
+    have been active) laid out sequentially — a visual cost breakdown, not
+    a wall-clock timeline (the dispatch table keeps totals, not
+    timestamps).  Loadable in chrome://tracing or Perfetto.
+    """
+    if op_times is None:
+        from . import _events
+
+        op_times = [(name, total) for name, (_cnt, total) in _events.items()]
+    events = []
+    cursor = 0.0
+    for rec in op_times:
+        name, dur = rec[0], float(rec[1])
+        start = float(rec[2]) if len(rec) > 2 else cursor
+        cursor = start + dur
+        events.append({
+            "name": name, "ph": "X", "pid": 0, "tid": 0,
+            "ts": start * 1e6, "dur": dur * 1e6,
+            "cat": "op",
+        })
+    out = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if not path.endswith(".json"):
+        path += ".json"
+    with open(path, "w") as f:
+        json.dump(out, f)
+    return path

@@ -1,0 +1,66 @@
+"""Observability tests (SURVEY §5.5 / VERDICT row 66): scalar LogWriter +
+chrome-trace export."""
+import json
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.profiler import (LogWriter, export_chrome_tracing,
+                                 start_profiler, stop_profiler)
+
+
+def test_logwriter_roundtrip(tmp_path):
+    d = str(tmp_path / "logs")
+    with LogWriter(d) as w:
+        for step in range(5):
+            w.add_scalar("train/loss", 1.0 / (step + 1), step)
+        w.add_scalars("eval", {"acc": 0.5, "f1": 0.25}, 0)
+    pts = LogWriter.read(d, tag="train/loss")
+    assert [p["step"] for p in pts] == list(range(5))
+    assert pts[0]["value"] == 1.0
+    assert len(LogWriter.read(d)) == 7
+
+
+def test_chrome_tracing_from_profiler(tmp_path):
+    start_profiler()
+    x = pt.to_tensor(np.ones((32, 32), np.float32))
+    for _ in range(3):
+        y = pt.matmul(x, x)
+    _ = float(y.value.sum())
+    stop_profiler(profile_path=str(tmp_path / "table.txt"))
+    path = export_chrome_tracing(str(tmp_path / "trace"))
+    with open(path) as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+    assert any(e["name"] == "matmul" for e in events)
+    assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
+
+
+def test_chrome_tracing_explicit_events(tmp_path):
+    path = export_chrome_tracing(
+        str(tmp_path / "t"), op_times=[("a", 0.001), ("b", 0.002, 0.005)])
+    trace = json.load(open(path))
+    a, b = trace["traceEvents"]
+    assert a["ts"] == 0.0 and a["dur"] == 1000.0
+    assert b["ts"] == 5000.0 and b["dur"] == 2000.0
+
+
+def test_visualdl_callback_in_fit(tmp_path):
+    from paddle_tpu.hapi.callbacks import VisualDL
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.ReLU(),
+                           pt.nn.Linear(8, 2))
+    model = pt.Model(net)
+    model.prepare(pt.optimizer.Adam(0.01, parameters=net.parameters()),
+                  pt.nn.CrossEntropyLoss(), pt.metric.Accuracy())
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 4).astype(np.float32)
+    y = rng.randint(0, 2, (32, 1)).astype(np.int64)
+    d = str(tmp_path / "vdl")
+    model.fit((x, y), batch_size=8, epochs=2, verbose=0,
+              callbacks=[VisualDL(d)])
+    from paddle_tpu.profiler import LogWriter
+
+    pts = [p for p in LogWriter.read(d) if p["tag"] == "train/loss"]
+    assert len(pts) == 8  # 4 batches x 2 epochs

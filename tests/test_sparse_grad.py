@@ -1,0 +1,163 @@
+"""SelectedRows analog tests (SURVEY §2 row 8): sparse embedding gradients
+on the eager tape + lazy optimizer consumers (adam_op lazy_mode / sgd_op
+SelectedRows semantics).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.framework.sparse import SparseGrad
+
+
+def test_sparse_grad_algebra():
+    g1 = SparseGrad([0, 2], np.ones((2, 3), np.float32), (4, 3))
+    g2 = SparseGrad([2, 3], np.ones((2, 3), np.float32) * 2, (4, 3))
+    s = (g1 + g2).coalesce()
+    dense = np.asarray(s.to_dense())
+    expected = np.zeros((4, 3), np.float32)
+    expected[0] = 1
+    expected[2] = 3
+    expected[3] = 2
+    np.testing.assert_array_equal(dense, expected)
+    assert None .__class__ is type(None) and (g1 + None) is g1  # engine accumulation
+
+
+def test_sparse_embedding_backward_is_sparse():
+    pt.seed(0)
+    emb = pt.nn.Embedding(1000, 8, sparse=True)
+    ids = pt.to_tensor(np.array([[1, 5, 5], [7, 1, 3]], np.int64))
+    out = emb(ids)
+    out.sum().backward()
+    g = emb.weight._grad_val
+    assert isinstance(g, SparseGrad)
+    assert g.values.shape == (6, 8) and g.dense_shape == (1000, 8)
+    # same math as the dense path
+    pt.seed(0)
+    emb_d = pt.nn.Embedding(1000, 8, sparse=False)
+    out_d = emb_d(ids)
+    out_d.sum().backward()
+    np.testing.assert_allclose(np.asarray(g.to_dense()),
+                               np.asarray(emb_d.weight.grad.value),
+                               rtol=1e-6)
+
+
+def test_sparse_embedding_padding_idx():
+    pt.seed(0)
+    emb = pt.nn.Embedding(50, 4, padding_idx=0, sparse=True)
+    ids = pt.to_tensor(np.array([[0, 3]], np.int64))
+    out = emb(ids)
+    np.testing.assert_array_equal(np.asarray(out.value)[0, 0], np.zeros(4))
+    out.sum().backward()
+    g = emb.weight._grad_val
+    dense = np.asarray(g.to_dense())
+    np.testing.assert_array_equal(dense[0], np.zeros(4))  # pad row: no grad
+
+
+@pytest.mark.parametrize("opt_cls,kwargs", [
+    (pt.optimizer.SGD, {}),
+    (pt.optimizer.Adam, {"lazy_mode": True}),
+])
+def test_lazy_update_touches_only_seen_rows(opt_cls, kwargs):
+    pt.seed(0)
+    emb = pt.nn.Embedding(100, 4, sparse=True)
+    w_before = np.asarray(emb.weight.value).copy()
+    opt = opt_cls(0.1, parameters=emb.parameters(), **kwargs)
+    ids = pt.to_tensor(np.array([[2, 7]], np.int64))
+    emb(ids).sum().backward()
+    opt.step()
+    w_after = np.asarray(emb.weight.value)
+    changed = np.abs(w_after - w_before).sum(axis=1) > 0
+    assert changed[2] and changed[7]
+    assert changed.sum() == 2  # every other row untouched (lazy semantics)
+
+
+def test_lazy_adam_matches_dense_adam_on_touched_rows():
+    def run(sparse, lazy):
+        pt.seed(0)
+        emb = pt.nn.Embedding(60, 4, sparse=sparse)
+        opt = pt.optimizer.Adam(0.05, parameters=emb.parameters(),
+                                lazy_mode=lazy)
+        ids = pt.to_tensor(np.array([[4, 9, 4]], np.int64))
+        for _ in range(3):
+            emb(ids).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        return np.asarray(emb.weight.value)
+
+    w_lazy = run(True, True)
+    w_dense = run(False, False)
+    # touched rows follow identical adam math (incl. duplicate-row coalesce)
+    np.testing.assert_allclose(w_lazy[[4, 9]], w_dense[[4, 9]], rtol=1e-5)
+
+
+def test_sparse_densifies_under_clip_and_nonlazy():
+    pt.seed(0)
+    emb = pt.nn.Embedding(40, 4, sparse=True)
+    opt = pt.optimizer.Adam(
+        0.05, parameters=emb.parameters(),  # lazy_mode=False → dense path
+        grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
+    ids = pt.to_tensor(np.array([[1, 2]], np.int64))
+    emb(ids).sum().backward()
+    opt.step()  # must not raise: SparseGrad densified for clip + update
+    assert np.isfinite(np.asarray(emb.weight.value)).all()
+
+
+def test_sparse_embedding_under_trainstep_falls_back_dense():
+    from paddle_tpu.jit import TrainStep
+
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Embedding(30, 4, sparse=True),
+                             pt.nn.Flatten(), pt.nn.Linear(8, 2))
+    opt = pt.optimizer.Adam(0.05, parameters=model.parameters())
+    step = TrainStep(model, lambda m, x, y: pt.nn.functional.cross_entropy(
+        m(x), y), opt, donate=False)
+    ids = pt.to_tensor(np.array([[1, 2], [3, 4]], np.int64))
+    y = pt.to_tensor(np.array([0, 1], np.int32))
+    l0 = float(step(ids, y))
+    l1 = float(step(ids, y))
+    assert l1 < l0  # traced path silently uses the dense grad (documented)
+
+
+def test_public_grad_view_densifies():
+    pt.seed(0)
+    emb = pt.nn.Embedding(30, 4, sparse=True)
+    emb(pt.to_tensor(np.array([[1, 2]], np.int64))).sum().backward()
+    g = emb.weight.grad  # public surface must not crash on SparseGrad
+    assert list(g.shape) == [30, 4]
+    assert np.abs(np.asarray(g.value)).sum() > 0
+
+
+def test_sparse_with_grad_scaler():
+    pt.seed(0)
+    emb = pt.nn.Embedding(30, 4, sparse=True)
+    opt = pt.optimizer.Adam(0.05, parameters=emb.parameters(),
+                            lazy_mode=True)
+    scaler = pt.amp.GradScaler(init_loss_scaling=2.0**10)
+    ids = pt.to_tensor(np.array([[1, 2]], np.int64))
+    w_before = np.asarray(emb.weight.value).copy()
+    loss = emb(ids).sum()
+    scaler.scale(loss).backward()
+    scaler.step(opt)
+    scaler.update()
+    w_after = np.asarray(emb.weight.value)
+    changed = np.abs(w_after - w_before).sum(axis=1) > 0
+    assert changed[1] and changed[2] and changed.sum() == 2
+
+
+def test_adamw_sparse_respects_lr_ratio():
+    def run(ratio):
+        pt.seed(0)
+        emb = pt.nn.Embedding(30, 4, sparse=True)
+        opt = pt.optimizer.AdamW(
+            0.05, parameters=emb.parameters(), lazy_mode=True,
+            weight_decay=0.0, lr_ratio=(lambda p: ratio))
+        emb(pt.to_tensor(np.array([[3]], np.int64))).sum().backward()
+        opt.step()
+        return np.asarray(emb.weight.value)
+
+    w1 = run(1.0)
+    w0 = run(0.0)  # zero ratio: no update at all
+    pt.seed(0)
+    ref = pt.nn.Embedding(30, 4, sparse=True)
+    assert not np.allclose(w1[3], np.asarray(ref.weight.value)[3])
+    np.testing.assert_allclose(w0, np.asarray(ref.weight.value))
