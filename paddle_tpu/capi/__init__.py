@@ -1,0 +1,47 @@
+"""C inference API build helper (capi_exp analog; see paddle_tpu_c.cpp).
+
+``build()`` compiles ``libpaddle_tpu_c.so`` with the system toolchain and
+returns its path; C hosts link against it (header surface: PD_Init,
+PD_GetVersion, PD_PredictorCreate/RunFloat/Destroy, PD_Finalize).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import threading
+
+__all__ = ["build", "so_path"]
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_HERE, "_build", "libpaddle_tpu_c.so")
+_build_lock = threading.Lock()
+
+
+def so_path() -> str:
+    return _SO
+
+
+def build(force: bool = False) -> str:
+    """Compile the C API shared library (lazy, mtime-aware).
+
+    Thread/process safe: in-process builders serialize on a lock, and the
+    compiler writes to a pid-unique temp file promoted with an atomic
+    ``os.replace`` — a concurrent process never dlopens a half-written .so.
+    """
+    src = os.path.join(_HERE, "paddle_tpu_c.cpp")
+    with _build_lock:
+        if not force and os.path.exists(_SO) \
+                and os.path.getmtime(_SO) >= os.path.getmtime(src):
+            return _SO
+        os.makedirs(os.path.dirname(_SO), exist_ok=True)
+        include = sysconfig.get_path("include")
+        libdir = sysconfig.get_config_var("LIBDIR")
+        version = sysconfig.get_config_var("LDVERSION")
+        tmp = _SO + ".%d.tmp" % os.getpid()
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src,
+               "-I" + include, "-L" + libdir, "-lpython" + version,
+               "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, _SO)
+    return _SO

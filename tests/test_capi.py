@@ -1,0 +1,105 @@
+"""C inference API tests (SURVEY §2 row 62, capi_exp analog): build the
+shared library, compile a real C host program against it, and check its
+output against the Python predictor on the same exported artifact.
+"""
+import os
+import subprocess
+import sys
+import sysconfig
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+C_DRIVER = r"""
+#include <stdio.h>
+#include <stdlib.h>
+
+extern int PD_Init(const char*);
+extern const char* PD_GetVersion(void);
+extern void* PD_PredictorCreate(const char*);
+extern long long PD_PredictorRunFloat(void*, const float*, const long long*,
+                                      int, float*, long long, long long*,
+                                      int*);
+extern void PD_PredictorDestroy(void*);
+
+int main(int argc, char** argv) {
+  if (PD_Init(argv[1]) != 0) return 2;
+  printf("version=%s\n", PD_GetVersion());
+  void* pred = PD_PredictorCreate(argv[2]);
+  if (!pred) return 3;
+  float in[8];
+  for (int i = 0; i < 8; ++i) in[i] = (float)i * 0.25f - 1.0f;
+  long long shape[2] = {2, 4};
+  float out[64];
+  long long out_shape[8];
+  int out_ndim = 0;
+  long long rc = PD_PredictorRunFloat(pred, in, shape, 2, out, 64,
+                                      out_shape, &out_ndim);
+  if (rc != 0) return 4;
+  printf("out_ndim=%d shape=%lld,%lld\n", out_ndim, out_shape[0],
+         out_shape[1]);
+  long long n = out_shape[0] * out_shape[1];
+  for (long long i = 0; i < n; ++i) printf("%.6f\n", out[i]);
+  PD_PredictorDestroy(pred);
+  return 0;
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    from paddle_tpu.jit import InputSpec, save as jit_save
+
+    pt.seed(0)
+    net = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.Tanh(),
+                           pt.nn.Linear(8, 3))
+    prefix = str(tmp_path_factory.mktemp("capi") / "model")
+    jit_save(net, prefix, input_spec=[InputSpec([None, 4], "float32")])
+    x = (np.arange(8, dtype=np.float32) * 0.25 - 1.0).reshape(2, 4)
+    expected = np.asarray(net(pt.to_tensor(x)).value)
+    return prefix, expected
+
+
+def test_capi_builds():
+    from paddle_tpu.capi import build
+
+    so = build()
+    assert os.path.exists(so)
+
+
+@pytest.mark.slow
+def test_capi_c_host_matches_python(artifact, tmp_path):
+    from paddle_tpu.capi import build
+
+    prefix, expected = artifact
+    so = build()
+    c_src = str(tmp_path / "driver.c")
+    with open(c_src, "w") as f:
+        f.write(C_DRIVER)
+    exe = str(tmp_path / "driver")
+    subprocess.run(
+        ["gcc", c_src, "-o", exe, so,
+         "-Wl,-rpath," + os.path.dirname(so),
+         "-L" + sysconfig.get_config_var("LIBDIR"),
+         "-lpython" + sysconfig.get_config_var("LDVERSION")],
+        check=True, capture_output=True)
+    # the embedded interpreter needs the venv + repo on sys.path
+    site = [p for p in sys.path if p.endswith("site-packages")]
+    sys_paths = ":".join([REPO] + site)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in list(env):
+        if k.startswith("PADDLE_TRAINER"):
+            del env[k]
+    r = subprocess.run([exe, sys_paths, prefix], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.strip()]
+    assert lines[0].startswith("version=paddle_tpu-capi")
+    assert lines[1] == "out_ndim=2 shape=2,3"
+    got = np.asarray([float(v) for v in lines[2:]]).reshape(2, 3)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-6)
