@@ -5,12 +5,19 @@ standard corpora) plus a C++ tokenizer core in the spirit of the
 reference ecosystem's faster_tokenizer (``text/fast_tokenizer.cpp``,
 ctypes-loaded, Python parity fallback).
 """
-from .datasets import Imdb, Imikolov, Movielens, UCIHousing  # noqa: F401
+from .datasets import (  # noqa: F401
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
 from .tokenizer import (  # noqa: F401
     WordpieceTokenizer,
     load_vocab,
     native_available,
 )
 
-__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing",
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
            "WordpieceTokenizer", "load_vocab", "native_available"]

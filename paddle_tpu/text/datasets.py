@@ -18,7 +18,7 @@ import numpy as np
 from ..core.errors import InvalidArgumentError
 from ..io.dataset import Dataset
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens"]
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "WMT14", "WMT16"]
 
 
 def _require(data_file: Optional[str], what: str) -> str:
@@ -226,3 +226,151 @@ class Movielens(Dataset):
         uid, g, a, j, mid, r = self.samples[i]
         return (np.int64(uid), np.int64(g), np.int64(a), np.int64(j),
                 np.int64(mid), np.float32(r))
+
+
+_WMT_START, _WMT_END, _WMT_UNK = "<s>", "<e>", "<unk>"
+_WMT_UNK_IDX = 2
+
+
+class WMT14(Dataset):
+    """WMT14 en→fr translation (wmt14.py parity).
+
+    Archive layout (the reference's preprocessed wmt14 tar): ``*src.dict`` /
+    ``*trg.dict`` (one token per line, rank = id) and ``<mode>/<mode>``
+    files of tab-separated "source<TAB>target" sentence pairs.  Samples:
+    (src_ids, trg_ids, trg_ids_next) int64 arrays with <s>/<e> framing;
+    pairs longer than 80 tokens are dropped, as in the reference.
+    """
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = -1):
+        self.data_file = _require(data_file, "WMT14")
+        if mode not in ("train", "test", "gen"):
+            raise InvalidArgumentError("mode must be train|test|gen")
+        self.mode = mode
+        self.dict_size = dict_size if dict_size > 0 else 2 ** 31
+        self.src_ids: List[np.ndarray] = []
+        self.trg_ids: List[np.ndarray] = []
+        self.trg_ids_next: List[np.ndarray] = []
+        self._load()
+
+    def _to_dict(self, f, size: int) -> Dict[str, int]:
+        out = {}
+        for i, line in enumerate(f.read().decode("utf-8").splitlines()):
+            if i >= size:
+                break
+            out[line.strip()] = i
+        return out
+
+    def _load(self) -> None:
+        with tarfile.open(self.data_file) as tf:
+            names = tf.getnames()
+            src_dict_name = [n for n in names if n.endswith("src.dict")]
+            trg_dict_name = [n for n in names if n.endswith("trg.dict")]
+            if len(src_dict_name) != 1 or len(trg_dict_name) != 1:
+                raise InvalidArgumentError(
+                    "archive must carry exactly one src.dict and trg.dict")
+            self.src_dict = self._to_dict(
+                tf.extractfile(src_dict_name[0]), self.dict_size)
+            self.trg_dict = self._to_dict(
+                tf.extractfile(trg_dict_name[0]), self.dict_size)
+            data_suffix = "%s/%s" % (self.mode, self.mode)
+            for name in (n for n in names if n.endswith(data_suffix)):
+                for line in tf.extractfile(name).read() \
+                        .decode("utf-8").splitlines():
+                    parts = line.strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, _WMT_UNK_IDX)
+                           for w in [_WMT_START] + parts[0].split()
+                           + [_WMT_END]]
+                    trg = [self.trg_dict.get(w, _WMT_UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.src_ids.append(np.asarray(src, np.int64))
+                    self.trg_ids.append(np.asarray(
+                        [self.trg_dict[_WMT_START]] + trg, np.int64))
+                    self.trg_ids_next.append(np.asarray(
+                        trg + [self.trg_dict[_WMT_END]], np.int64))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def __getitem__(self, i):
+        return self.src_ids[i], self.trg_ids[i], self.trg_ids_next[i]
+
+
+class WMT16(Dataset):
+    """WMT16 en↔de translation (wmt16.py parity).
+
+    Archive layout (the reference's wmt16.tar.gz): ``wmt16/train``,
+    ``wmt16/test``, ``wmt16/val`` files of tab-separated "en<TAB>de"
+    sentence pairs — no bundled dictionaries; vocabularies are built from
+    the train split at load time: <s>/<e>/<unk> first, then words by
+    descending train frequency, truncated to ``src/trg_dict_size``.
+    ``lang`` selects the source column ('en' or 'de').  Samples:
+    (src_ids, trg_ids, trg_ids_next), <s>/<e>-framed like the reference.
+    """
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 src_dict_size: int = -1, trg_dict_size: int = -1,
+                 lang: str = "en"):
+        self.data_file = _require(data_file, "WMT16")
+        if mode.lower() not in ("train", "test", "val"):
+            raise InvalidArgumentError("mode should be train|test|val")
+        if lang not in ("en", "de"):
+            raise InvalidArgumentError("lang should be en|de")
+        self.mode = mode.lower()
+        self.lang = lang
+        self.src_dict = self._build_dict(
+            0 if lang == "en" else 1, src_dict_size)
+        self.trg_dict = self._build_dict(
+            1 if lang == "en" else 0, trg_dict_size)
+        self.src_ids: List[np.ndarray] = []
+        self.trg_ids: List[np.ndarray] = []
+        self.trg_ids_next: List[np.ndarray] = []
+        self._load()
+
+    def _pairs(self, split: str):
+        with tarfile.open(self.data_file) as tf:
+            data = tf.extractfile("wmt16/%s" % split).read().decode("utf-8")
+        for line in data.splitlines():
+            parts = line.strip().split("\t")
+            if len(parts) == 2:
+                yield parts
+
+    def _build_dict(self, col: int, dict_size: int) -> Dict[str, int]:
+        freq: Dict[str, int] = {}
+        for parts in self._pairs("train"):
+            for w in parts[col].split():
+                freq[w] = freq.get(w, 0) + 1
+        vocab = {_WMT_START: 0, _WMT_END: 1, _WMT_UNK: 2}
+        cap = dict_size if dict_size > 0 else len(freq) + 3
+        for w, _c in sorted(freq.items(), key=lambda kv: kv[1],
+                            reverse=True):
+            if len(vocab) >= cap:
+                break
+            vocab[w] = len(vocab)
+        return vocab
+
+    def _load(self) -> None:
+        start, end, unk = 0, 1, _WMT_UNK_IDX
+        src_col = 0 if self.lang == "en" else 1
+        for parts in self._pairs(self.mode):
+            src = [start] + [self.src_dict.get(w, unk)
+                             for w in parts[src_col].split()] + [end]
+            trg = [self.trg_dict.get(w, unk)
+                   for w in parts[1 - src_col].split()]
+            self.src_ids.append(np.asarray(src, np.int64))
+            self.trg_ids.append(np.asarray([start] + trg, np.int64))
+            self.trg_ids_next.append(np.asarray(trg + [end], np.int64))
+
+    def get_dict(self, lang: str = "en"):
+        return self.src_dict if lang == self.lang else self.trg_dict
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def __getitem__(self, i):
+        return self.src_ids[i], self.trg_ids[i], self.trg_ids_next[i]

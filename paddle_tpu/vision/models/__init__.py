@@ -1,6 +1,25 @@
 """``paddle_tpu.vision.models`` (reference: python/paddle/vision/models/)."""
+from .alexnet import AlexNet, alexnet  # noqa: F401
+from .densenet import (  # noqa: F401
+    DenseNet,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    densenet264,
+)
+from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .lenet import LeNet  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+from .shufflenetv2 import (  # noqa: F401
+    ShuffleNetV2,
+    shufflenet_v2_x0_25,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+    shufflenet_v2_x1_5,
+    shufflenet_v2_x2_0,
+)
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1  # noqa: F401
 from .resnet import (  # noqa: F401
     ResNet,
     resnet18,
@@ -17,4 +36,9 @@ __all__ = [
     "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
     "resnet152", "wide_resnet50_2", "wide_resnet101_2", "VGG", "vgg11",
     "vgg13", "vgg16", "vgg19", "MobileNetV2", "mobilenet_v2",
+    "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264", "GoogLeNet", "googlenet", "ShuffleNetV2",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+    "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
 ]

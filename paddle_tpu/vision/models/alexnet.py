@@ -1,0 +1,52 @@
+"""AlexNet.
+
+The mounted reference snapshot's zoo carries lenet/mobilenet/resnet/vgg;
+this model is part of the upstream paddle.vision surface the framework
+targets — architecture per the original paper, API in the paddle zoo
+style."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["AlexNet", "alexnet"]
+
+
+class AlexNet(nn.Layer):
+    """AlexNet for 3x224x224 inputs (vision/models/alexnet.py parity)."""
+
+    def __init__(self, num_classes: int = 1000, dropout: float = 0.5):
+        super().__init__()
+        self.num_classes = num_classes
+        self.features = nn.Sequential(
+            nn.Conv2D(3, 64, 11, stride=4, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(64, 192, 5, padding=2), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+            nn.Conv2D(192, 384, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(384, 256, 3, padding=1), nn.ReLU(),
+            nn.Conv2D(256, 256, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2),
+        )
+        self.avgpool = nn.AdaptiveAvgPool2D((6, 6))
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(dropout), nn.Linear(256 * 6 * 6, 4096), nn.ReLU(),
+                nn.Dropout(dropout), nn.Linear(4096, 4096), nn.ReLU(),
+                nn.Linear(4096, num_classes),
+            )
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        x = self.avgpool(self.features(x))
+        if self.num_classes > 0:
+            x = self.classifier(T.flatten(x, 1))
+        return x
+
+
+def alexnet(pretrained: bool = False, **kwargs) -> AlexNet:
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights are not bundled (no downloader in this "
+            "build); load a converted state_dict with set_state_dict")
+    return AlexNet(**kwargs)

@@ -1,0 +1,113 @@
+"""DenseNet-121/161/169/201/264.
+
+The mounted reference snapshot's zoo carries lenet/mobilenet/resnet/vgg;
+this model is part of the upstream paddle.vision surface the framework
+targets — architecture per the original paper, API in the paddle zoo
+style."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, (6, 12, 24, 16)),
+    161: (96, 48, (6, 12, 36, 24)),
+    169: (64, 32, (6, 12, 32, 32)),
+    201: (64, 32, (6, 12, 48, 32)),
+    264: (64, 32, (6, 12, 64, 48)),
+}
+
+
+class _DenseLayer(nn.Layer):
+    """BN→ReLU→1x1(bn_size*k)→BN→ReLU→3x3(k), output concatenated."""
+
+    def __init__(self, in_c, growth, bn_size=4):
+        super().__init__()
+        mid = bn_size * growth
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.conv1 = nn.Conv2D(in_c, mid, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(mid)
+        self.conv2 = nn.Conv2D(mid, growth, 3, padding=1, bias_attr=False)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        return T.concat([x, out], axis=1)
+
+
+class _Transition(nn.Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(
+            nn.BatchNorm2D(in_c), nn.ReLU(),
+            nn.Conv2D(in_c, out_c, 1, bias_attr=False),
+            nn.AvgPool2D(2, 2),
+        )
+
+
+class DenseNet(nn.Layer):
+    """vision/models/densenet.py parity (layers selects the config)."""
+
+    def __init__(self, layers: int = 121, num_classes: int = 1000,
+                 bn_size: int = 4, block_config=None, growth_rate=None):
+        """``layers`` picks a standard config; ``block_config``/``growth_rate``
+        override it for custom/small variants (CIFAR-style DenseNets)."""
+        super().__init__()
+        if layers not in _CFG:
+            from ...core.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "DenseNet layers must be one of %s" % sorted(_CFG))
+        init_c, growth, blocks = _CFG[layers]
+        if block_config is not None:
+            blocks = tuple(block_config)
+        if growth_rate is not None:
+            growth = int(growth_rate)
+            init_c = 2 * growth
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1))
+        feats = []
+        c = init_c
+        for i, n in enumerate(blocks):
+            for _ in range(n):
+                feats.append(_DenseLayer(c, growth, bn_size))
+                c += growth
+            if i + 1 < len(blocks):
+                feats.append(_Transition(c, c // 2))
+                c //= 2
+        self.features = nn.Sequential(*feats)
+        self.norm = nn.BatchNorm2D(c)
+        self.relu = nn.ReLU()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        x = self.pool(self.relu(self.norm(self.features(self.stem(x)))))
+        return self.classifier(T.flatten(x, 1))
+
+
+def densenet121(**kw):
+    return DenseNet(121, **kw)
+
+
+def densenet161(**kw):
+    return DenseNet(161, **kw)
+
+
+def densenet169(**kw):
+    return DenseNet(169, **kw)
+
+
+def densenet201(**kw):
+    return DenseNet(201, **kw)
+
+
+def densenet264(**kw):
+    return DenseNet(264, **kw)

@@ -1,0 +1,123 @@
+"""ShuffleNetV2.
+
+The mounted reference snapshot's zoo carries lenet/mobilenet/resnet/vgg;
+this model is part of the upstream paddle.vision surface the framework
+targets — architecture per the original paper, API in the paddle zoo
+style."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_5",
+           "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+_STAGE_OUT = {
+    0.25: (24, 24, 48, 96, 512),
+    0.5: (24, 48, 96, 192, 1024),
+    1.0: (24, 116, 232, 464, 1024),
+    1.5: (24, 176, 352, 704, 1024),
+    2.0: (24, 244, 488, 976, 2048),
+}
+
+
+def _channel_shuffle(x, groups: int):
+    from ... import tensor as T
+
+    n, c, h, w = x.shape
+    x = T.reshape(x, [n, groups, c // groups, h, w])
+    x = T.transpose(x, [0, 2, 1, 3, 4])
+    return T.reshape(x, [n, c, h, w])
+
+
+class _Unit(nn.Layer):
+    """Stride-1 split unit / stride-2 downsample unit + channel shuffle."""
+
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            main_in = in_c // 2
+        else:
+            main_in = in_c
+            self.short = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=2, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), nn.ReLU(),
+            )
+        self.main = nn.Sequential(
+            nn.Conv2D(main_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), nn.ReLU(),
+        )
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            a, b = x[:, :c], x[:, c:]
+            out = T.concat([a, self.main(b)], axis=1)
+        else:
+            out = T.concat([self.short(x), self.main(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """vision/models/shufflenetv2.py parity (scale selects widths)."""
+
+    def __init__(self, scale: float = 1.0, num_classes: int = 1000):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            from ...core.errors import InvalidArgumentError
+
+            raise InvalidArgumentError(
+                "scale must be one of %s" % sorted(_STAGE_OUT))
+        c0, c1, c2, c3, c4 = _STAGE_OUT[scale]
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c0, 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c0), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1))
+        stages = []
+        in_c = c0
+        for out_c, repeats in ((c1, 4), (c2, 8), (c3, 4)):
+            stages.append(_Unit(in_c, out_c, stride=2))
+            for _ in range(repeats - 1):
+                stages.append(_Unit(out_c, out_c, stride=1))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.head = nn.Sequential(
+            nn.Conv2D(in_c, c4, 1, bias_attr=False),
+            nn.BatchNorm2D(c4), nn.ReLU(), nn.AdaptiveAvgPool2D(1))
+        self.classifier = nn.Linear(c4, num_classes)
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        x = self.head(self.stages(self.stem(x)))
+        return self.classifier(T.flatten(x, 1))
+
+
+def shufflenet_v2_x0_25(**kw):
+    return ShuffleNetV2(0.25, **kw)
+
+
+def shufflenet_v2_x0_5(**kw):
+    return ShuffleNetV2(0.5, **kw)
+
+
+def shufflenet_v2_x1_0(**kw):
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x1_5(**kw):
+    return ShuffleNetV2(1.5, **kw)
+
+
+def shufflenet_v2_x2_0(**kw):
+    return ShuffleNetV2(2.0, **kw)

@@ -1,0 +1,83 @@
+"""SqueezeNet 1.0/1.1.
+
+The mounted reference snapshot's zoo carries lenet/mobilenet/resnet/vgg;
+this model is part of the upstream paddle.vision surface the framework
+targets — architecture per the original paper, API in the paddle zoo
+style."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class _Fire(nn.Layer):
+    """squeeze 1x1 → expand 1x1 + 3x3, channel-concatenated."""
+
+    def __init__(self, in_c, squeeze_c, e1_c, e3_c):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.expand1 = nn.Conv2D(squeeze_c, e1_c, 1)
+        self.expand3 = nn.Conv2D(squeeze_c, e3_c, 3, padding=1)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        s = self.relu(self.squeeze(x))
+        return T.concat([self.relu(self.expand1(s)),
+                         self.relu(self.expand3(s))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """vision/models/squeezenet.py parity (version '1.0' or '1.1')."""
+
+    def __init__(self, version: str = "1.0", num_classes: int = 1000):
+        super().__init__()
+        self.num_classes = num_classes
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2),
+                _Fire(512, 64, 256, 256),
+            )
+        elif version == "1.1":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(),
+                nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256),
+            )
+        else:
+            from ...core.errors import InvalidArgumentError
+
+            raise InvalidArgumentError("version must be '1.0' or '1.1'")
+        self.classifier = nn.Sequential(
+            nn.Dropout(0.5),
+            nn.Conv2D(512, num_classes, 1), nn.ReLU(),
+            nn.AdaptiveAvgPool2D(1),
+        )
+
+    def forward(self, x):
+        from ... import tensor as T
+
+        x = self.classifier(self.features(x))
+        return T.flatten(x, 1)
+
+
+def squeezenet1_0(**kwargs) -> SqueezeNet:
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(**kwargs) -> SqueezeNet:
+    return SqueezeNet("1.1", **kwargs)

@@ -165,3 +165,59 @@ def test_tokenizer_in_dataloader_workers(vocab):
     batches = [np.asarray(b.value)
                for b in DataLoader(TextDs(), batch_size=4, num_workers=2)]
     assert len(batches) == 4 and batches[0].shape == (4, 8)
+
+
+def test_wmt14_parses_preprocessed_archive(tmp_path):
+    from paddle_tpu.text import WMT14, WMT16
+
+    path = str(tmp_path / "wmt14.tgz")
+    src_dict = "\n".join(["<s>", "<e>", "<unk>", "hello", "world", "cat"])
+    trg_dict = "\n".join(["<s>", "<e>", "<unk>", "bonjour", "monde", "chat"])
+    pairs = ["hello world\tbonjour monde", "cat\tchat",
+             "hello zebra\tbonjour zebre",
+             "malformed line with no tab"]
+    with tarfile.open(path, "w:gz") as tf:
+        _add_text(tf, "wmt14/src.dict", src_dict)
+        _add_text(tf, "wmt14/trg.dict", trg_dict)
+        _add_text(tf, "wmt14/train/train", "\n".join(pairs))
+        _add_text(tf, "wmt14/test/test", pairs[0])
+    ds = WMT14(data_file=path, mode="train")
+    assert len(ds) == 3  # malformed line dropped, unks kept
+    s, t, tn = ds[0]
+    # <s> hello world <e>
+    np.testing.assert_array_equal(s, [0, 3, 4, 1])
+    np.testing.assert_array_equal(t, [0, 3, 4])   # <s> bonjour monde
+    np.testing.assert_array_equal(tn, [3, 4, 1])  # bonjour monde <e>
+    unk_s, _, _ = ds[2]
+    assert unk_s[2] == 2  # zebra → <unk> idx
+    test = WMT14(data_file=path, mode="test")
+    assert len(test) == 1
+
+
+def test_wmt16_builds_dicts_from_train(tmp_path):
+    from paddle_tpu.text import WMT16
+
+    path = str(tmp_path / "wmt16.tar.gz")
+    train = ["hello world\thallo welt", "hello cat\thallo katze",
+             "not a pair"]
+    with tarfile.open(path, "w:gz") as tf:
+        _add_text(tf, "wmt16/train", "\n".join(train))
+        _add_text(tf, "wmt16/val", train[0])
+        _add_text(tf, "wmt16/test", train[1])
+    ds = WMT16(data_file=path, mode="train")
+    # dict: <s>=0 <e>=1 <unk>=2, then train words by frequency
+    assert ds.src_dict["<s>"] == 0 and ds.src_dict["hello"] == 3
+    assert "hallo" in ds.trg_dict
+    assert len(ds) == 2  # malformed line dropped
+    s, t, tn = ds[0]
+    np.testing.assert_array_equal(
+        s, [0, ds.src_dict["hello"], ds.src_dict["world"], 1])
+    np.testing.assert_array_equal(tn[-1:], [1])  # <e>-terminated next-ids
+    val = WMT16(data_file=path, mode="val")  # reference's third mode
+    assert len(val) == 1
+    # lang='de' flips source/target columns
+    de = WMT16(data_file=path, mode="train", lang="de")
+    assert "hallo" in de.src_dict and "hello" in de.trg_dict
+    # dict_size truncation keeps the 3 specials + top words
+    small = WMT16(data_file=path, mode="train", src_dict_size=4)
+    assert len(small.src_dict) == 4 and "hello" in small.src_dict

@@ -178,3 +178,64 @@ def test_cifar10_local_tar(tmp_path, rng):
     assert len(train) == 20 and len(test) == 4
     img, label = train[0]
     assert img.shape == (32, 32, 3) and 0 <= int(label[0]) < 10
+
+
+# ------------------------------------------------------------------ new zoo
+from paddle_tpu.vision import models  # noqa: E402
+
+# forwards run on reduced spatial sizes: shape/wiring coverage at seconds
+# instead of minutes (224px eager on one CPU core costs ~30-130s per model)
+@pytest.mark.parametrize("ctor,out_dim,in_hw", [
+    (lambda: models.alexnet(num_classes=7), 7, 128),
+    (lambda: models.squeezenet1_0(num_classes=6), 6, 96),
+    (lambda: models.squeezenet1_1(num_classes=6), 6, 96),
+    (lambda: models.shufflenet_v2_x0_25(num_classes=4), 4, 64),
+])
+def test_new_zoo_forward_shapes(ctor, out_dim, in_hw):
+    pt.seed(0)
+    m = ctor()
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(2, 3, in_hw, in_hw).astype("float32"))
+    out = m(x)
+    assert list(out.shape) == [2, out_dim]
+    assert np.isfinite(np.asarray(out.value)).all()
+
+
+def test_googlenet_triple_output():
+    """Upstream GoogLeNet contract: (out, aux1, aux2) in train AND eval."""
+    pt.seed(0)
+    m = models.googlenet(num_classes=5)
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(1, 3, 64, 64).astype("float32"))
+    out, aux1, aux2 = m(x)
+    for o in (out, aux1, aux2):
+        assert list(o.shape) == [1, 5]
+        assert np.isfinite(np.asarray(o.value)).all()
+
+
+def test_densenet_forward_and_grad():
+    pt.seed(0)
+    # tiny block config: same wiring as densenet121, test-speed sized
+    m = models.DenseNet(121, num_classes=4, block_config=(2, 2),
+                        growth_rate=8)
+    m.train()
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(2, 3, 32, 32).astype("float32"))
+    y = pt.to_tensor(np.array([0, 1], np.int64))
+    loss = pt.nn.functional.cross_entropy(m(x), y)
+    loss.backward()
+    g = m.classifier.weight.grad
+    assert g is not None and float(np.abs(np.asarray(g.value)).sum()) > 0
+    # standard configs still construct with the right head width
+    assert models.densenet121(num_classes=10).classifier.weight.shape[0] \
+        == 1024
+
+
+def test_shufflenet_channel_shuffle_math():
+    from paddle_tpu.vision.models.shufflenetv2 import _channel_shuffle
+
+    x = pt.to_tensor(np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1))
+    out = np.asarray(_channel_shuffle(x, 2).value).reshape(-1)
+    np.testing.assert_array_equal(out, [0, 4, 1, 5, 2, 6, 3, 7])
