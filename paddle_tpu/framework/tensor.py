@@ -207,7 +207,32 @@ class Tensor:
         return self
 
     def pin_memory(self) -> "Tensor":
-        return self
+        """CUDAPinnedPlace analog: place the value in pinned host memory
+        (``memory_kind='pinned_host'``) — the staging residence async
+        host→device copies and the ZeRO offload path use.
+
+        Only graph-free tensors (data/staging buffers, the actual pinning
+        use case) change residence; a tensor recorded on the tape returns
+        itself unchanged, because its consumers' vjps are typed for the
+        original memory space and a silent residence switch would either
+        break the backward or sever it.  Also a no-op under tracing or on
+        backends without a host memory space."""
+        import jax as _jax
+
+        v = self._value
+        sh = getattr(v, "sharding", None)
+        if sh is None or isinstance(v, _jax.core.Tracer):
+            return self
+        if self._node is not None and not self.stop_gradient:
+            return self  # on-tape: residence is part of the recorded types
+        if getattr(sh, "memory_kind", None) == "pinned_host":
+            return self
+        try:
+            pinned = _jax.device_put(v, sh.with_memory_kind("pinned_host"))
+        except Exception:
+            return self  # backend lacks pinned_host: keep no-op parity
+        return Tensor(pinned, stop_gradient=self.stop_gradient,
+                      name=self.name)
 
     def to(self, *args, **kwargs) -> "Tensor":
         dtype = kwargs.get("dtype")

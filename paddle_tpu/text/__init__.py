@@ -6,6 +6,7 @@ reference ecosystem's faster_tokenizer (``text/fast_tokenizer.cpp``,
 ctypes-loaded, Python parity fallback).
 """
 from .datasets import (  # noqa: F401
+    Conll05st,
     Imdb,
     Imikolov,
     Movielens,
@@ -19,5 +20,5 @@ from .tokenizer import (  # noqa: F401
     native_available,
 )
 
-__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
            "WordpieceTokenizer", "load_vocab", "native_available"]

@@ -18,7 +18,8 @@ import numpy as np
 from ..core.errors import InvalidArgumentError
 from ..io.dataset import Dataset
 
-__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "WMT14", "WMT16"]
+__all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "WMT14",
+           "WMT16", "Conll05st"]
 
 
 def _require(data_file: Optional[str], what: str) -> str:
@@ -374,3 +375,155 @@ class WMT16(Dataset):
 
     def __getitem__(self, i):
         return self.src_ids[i], self.trg_ids[i], self.trg_ids_next[i]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (conll05.py parity).
+
+    Inputs: the conll05st-release tar (``.../test.wsj/words/*.words.gz`` +
+    ``.../props/*.props.gz``) and plain word/verb/target dict files, all
+    passed by path (no downloader).  Each proposition becomes one sample:
+    the 9-tuple (word_idx, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2, pred_idx,
+    mark, label_idx) the reference emits — predicate context windows
+    broadcast over the sentence, BIO labels decoded from the bracketed
+    props columns.  Delta vs the reference: label ids are assigned in
+    sorted tag order (its set iteration order is interpreter-dependent).
+    """
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file: Optional[str] = None,
+                 word_dict_file: Optional[str] = None,
+                 verb_dict_file: Optional[str] = None,
+                 target_dict_file: Optional[str] = None,
+                 section: str = "test.wsj"):
+        import gzip
+
+        self.data_file = _require(data_file, "Conll05st")
+        self.section = section
+        self.word_dict = self._load_plain_dict(
+            _require(word_dict_file, "Conll05st(word_dict_file)"))
+        self.predicate_dict = self._load_plain_dict(
+            _require(verb_dict_file, "Conll05st(verb_dict_file)"))
+        self.label_dict = self._load_label_dict(
+            _require(target_dict_file, "Conll05st(target_dict_file)"))
+        self.sentences: List[List[str]] = []
+        self.predicates: List[str] = []
+        self.labels: List[List[str]] = []
+        with tarfile.open(self.data_file) as tf:
+            names = tf.getnames()
+
+            def member(sub):
+                # both streams must come from the SAME section: the release
+                # tar carries train/devel/test.brown/test.wsj side by side,
+                # and words/props line streams are zipped positionally
+                for n in names:
+                    if self.section in n and sub in n and n.endswith(".gz"):
+                        return tf.extractfile(n).read()
+                raise InvalidArgumentError(
+                    "archive lacks a %s%s*.gz member" % (self.section, sub))
+
+            words = gzip.decompress(member("/words/")).decode("utf-8")
+            props = gzip.decompress(member("/props/")).decode("utf-8")
+        self._parse(words.splitlines(), props.splitlines())
+
+    @staticmethod
+    def _load_plain_dict(path: str) -> Dict[str, int]:
+        with open(path, encoding="utf-8") as f:
+            return {line.strip(): i for i, line in enumerate(f)}
+
+    @staticmethod
+    def _load_label_dict(path: str) -> Dict[str, int]:
+        tags = set()
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith(("B-", "I-")):
+                    tags.add(line[2:])
+        d: Dict[str, int] = {}
+        for tag in sorted(tags):
+            d["B-" + tag] = len(d)
+            d["I-" + tag] = len(d)
+        d["O"] = len(d)
+        return d
+
+    @staticmethod
+    def _decode_bio(col: List[str]) -> List[str]:
+        out, cur, inside = [], "O", False
+        for l in col:
+            if l == "*":
+                out.append("I-" + cur if inside else "O")
+            elif l == "*)":
+                out.append("I-" + cur)
+                inside = False
+            elif "(" in l and ")" in l:
+                cur = l[1:l.find("*")]
+                out.append("B-" + cur)
+                inside = False
+            elif "(" in l:
+                cur = l[1:l.find("*")]
+                out.append("B-" + cur)
+                inside = True
+            else:
+                raise InvalidArgumentError("unexpected props label %r" % l)
+        return out
+
+    def _parse(self, word_lines, prop_lines) -> None:
+        sentence: List[str] = []
+        seg: List[List[str]] = []
+        for word, prop in zip(word_lines, prop_lines):
+            cols = prop.strip().split()
+            if not cols:  # sentence boundary
+                self._flush(sentence, seg)
+                sentence, seg = [], []
+            else:
+                sentence.append(word.strip())
+                seg.append(cols)
+        self._flush(sentence, seg)
+
+    def _flush(self, sentence, seg) -> None:
+        if not seg:
+            return
+        columns = [[row[i] for row in seg] for i in range(len(seg[0]))]
+        verbs = [v for v in columns[0] if v != "-"]
+        for i, col in enumerate(columns[1:]):
+            self.sentences.append(list(sentence))
+            self.predicates.append(verbs[i])
+            self.labels.append(self._decode_bio(col))
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, key, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                              (0, "0", None), (1, "p1", "eos"),
+                              (2, "p2", "eos")):
+            j = v + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[key] = sentence[j]
+            else:
+                ctx[key] = pad
+        wd = self.word_dict
+        unk = self.UNK_IDX
+        word_idx = [wd.get(w, unk) for w in sentence]
+
+        def bcast(tok):
+            return [wd.get(tok, unk)] * n
+
+        return (np.asarray(word_idx), np.asarray(bcast(ctx["n2"])),
+                np.asarray(bcast(ctx["n1"])), np.asarray(bcast(ctx["0"])),
+                np.asarray(bcast(ctx["p1"])), np.asarray(bcast(ctx["p2"])),
+                np.asarray([self.predicate_dict.get(self.predicates[idx])]
+                           * n),
+                np.asarray(mark),
+                np.asarray([self.label_dict.get(l) for l in labels]))

@@ -10,6 +10,7 @@ from .densenet import (  # noqa: F401
 )
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .lenet import LeNet  # noqa: F401
+from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
 from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
 from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2,
@@ -35,7 +36,7 @@ from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 __all__ = [
     "LeNet", "ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
     "resnet152", "wide_resnet50_2", "wide_resnet101_2", "VGG", "vgg11",
-    "vgg13", "vgg16", "vgg19", "MobileNetV2", "mobilenet_v2",
+    "vgg13", "vgg16", "vgg19", "MobileNetV2", "mobilenet_v2", "MobileNetV1", "mobilenet_v1",
     "AlexNet", "alexnet", "SqueezeNet", "squeezenet1_0", "squeezenet1_1",
     "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
     "densenet264", "GoogLeNet", "googlenet", "ShuffleNetV2",

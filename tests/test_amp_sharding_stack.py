@@ -1,0 +1,139 @@
+"""Config-#4 stack composition (VERDICT r2 weak #9): AMP O2 master weights
+× ZeRO sharding × global-norm clip × jitted TrainStep × GradScaler, together.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.meta_parallel import ShardingOptimizerStage2
+from paddle_tpu.jit import TrainStep
+
+
+def _stack(dtype="bfloat16", offload=False):
+    pt.seed(0)
+    model = pt.nn.Sequential(pt.nn.Linear(16, 32), pt.nn.ReLU(),
+                             pt.nn.Linear(32, 8))
+    opt = pt.optimizer.AdamW(
+        1e-2, parameters=model.parameters(),
+        grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
+    model, opt = pt.amp.decorate(model, opt, level="O2", dtype=dtype)
+    sopt = ShardingOptimizerStage2(opt, offload=offload)
+    return model, sopt
+
+
+def _data():
+    rng = np.random.RandomState(0)
+    return (rng.randn(16, 16).astype("float32"),
+            rng.randint(0, 8, (16,)).astype("int32"))
+
+
+def test_o2_sharding_clip_trainstep_composition():
+    dist.init_parallel_env()
+    model, sopt = _stack()
+    x, y = _data()
+
+    def loss_fn(m, a, b):
+        with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return pt.nn.functional.cross_entropy(m(a), b)
+
+    step = TrainStep(model, loss_fn, sopt, donate=False)
+    losses = [float(step(pt.to_tensor(x), pt.to_tensor(y)))
+              for _ in range(4)]
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+
+    # O2 invariants through the full stack: params stay bf16, fp32 masters
+    # live in the sharded optimizer state with ZeRO placement
+    w0 = model[0].weight
+    assert str(w0.value.dtype) == "bfloat16"
+    st = sopt._inner._states[w0.name]
+    assert "master_weight" in st
+    assert str(st["master_weight"].dtype) == "float32"
+    from jax.sharding import PartitionSpec as P
+
+    assert st["master_weight"].sharding.spec == P("dp")
+    # master tracks the bf16 param (round-trip within bf16 resolution)
+    np.testing.assert_allclose(
+        np.asarray(st["master_weight"], dtype=np.float32),
+        np.asarray(w0.value, dtype=np.float32), rtol=1e-2, atol=1e-2)
+
+
+def test_o2_sharding_checkpoint_roundtrip(tmp_path):
+    """Masters survive save → load → continue training on a fresh stack."""
+    dist.init_parallel_env()
+    model, sopt = _stack()
+    x, y = _data()
+
+    def loss_fn(m, a, b):
+        with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return pt.nn.functional.cross_entropy(m(a), b)
+
+    step = TrainStep(model, loss_fn, sopt, donate=False)
+    for _ in range(2):
+        step(pt.to_tensor(x), pt.to_tensor(y))
+    path = str(tmp_path / "ckpt")
+    pt.save({"model": model.state_dict(), "opt": sopt.state_dict()},
+            path + ".pdparams")
+
+    model2, sopt2 = _stack()
+    blob = pt.load(path + ".pdparams")
+    model2.set_state_dict(blob["model"])
+    sopt2.set_state_dict(blob["opt"])
+    w0, w0b = model[0].weight, model2[0].weight
+    np.testing.assert_allclose(np.asarray(w0.value, dtype=np.float32),
+                               np.asarray(w0b.value, dtype=np.float32))
+    m1 = sopt._inner._states[w0.name]["master_weight"]
+    m2 = sopt2._inner._states[w0b.name]["master_weight"]
+    np.testing.assert_allclose(np.asarray(m1), np.asarray(m2))
+    step2 = TrainStep(model2, loss_fn, sopt2, donate=False)
+    l_resumed = float(step2(pt.to_tensor(x), pt.to_tensor(y)))
+    assert np.isfinite(l_resumed)
+
+
+def test_fp16_scaler_sharding_clip_eager():
+    """float16 + dynamic loss scaling through the same eager stack."""
+    dist.init_parallel_env()
+    model, sopt = _stack(dtype="float16")
+    scaler = pt.amp.GradScaler(init_loss_scaling=2.0 ** 8)
+    x, y = _data()
+    losses = []
+    for _ in range(4):
+        with pt.amp.auto_cast(level="O1", dtype="float16"):
+            loss = pt.nn.functional.cross_entropy(
+                model(pt.to_tensor(x)), pt.to_tensor(y))
+        scaler.scale(loss).backward()
+        scaler.step(sopt)
+        scaler.update()
+        sopt.clear_grad()
+        losses.append(float(loss.value))
+    assert all(np.isfinite(losses)) and losses[-1] < losses[0]
+    st = sopt._inner._states[model[0].weight.name]
+    assert str(st["master_weight"].dtype) == "float32"
+
+
+def test_pin_memory_places_host_resident():
+    """Tensor.pin_memory (CUDAPinnedPlace analog): pinned_host residence,
+    values intact, device math still works on the pinned source."""
+    x = pt.to_tensor(np.arange(8, dtype=np.float32))
+    p = x.pin_memory()
+    assert p.value.sharding.memory_kind == "pinned_host"
+    np.testing.assert_array_equal(np.asarray(p.value), np.asarray(x.value))
+    assert p.pin_memory() is p  # idempotent
+    y = p + 1.0  # compute consumes the host-resident source
+    np.testing.assert_array_equal(np.asarray(y.value), np.arange(8) + 1)
+
+
+def test_pin_memory_tape_safety_and_name():
+    # an on-tape tensor is returned unchanged — never silently severed
+    w = pt.to_tensor(np.ones(4, np.float32))
+    w.stop_gradient = False
+    y = w * 2.0
+    p = y.pin_memory()
+    assert p is y  # no residence change for recorded tensors
+    p.sum().backward()
+    np.testing.assert_array_equal(np.asarray(w.grad.value), [2, 2, 2, 2])
+    # graph-free tensors really pin, and keep their name
+    d = pt.to_tensor(np.ones(4, np.float32))
+    pd = d.pin_memory()
+    assert pd.value.sharding.memory_kind == "pinned_host"
+    assert pd.name == d.name

@@ -221,3 +221,82 @@ def test_wmt16_builds_dicts_from_train(tmp_path):
     # dict_size truncation keeps the 3 specials + top words
     small = WMT16(data_file=path, mode="train", src_dict_size=4)
     assert len(small.src_dict) == 4 and "hello" in small.src_dict
+
+
+def test_conll05st_srl_samples(tmp_path):
+    import gzip as _gzip
+
+    from paddle_tpu.text import Conll05st
+
+    # two sentences; first has two propositions (columns), second has one
+    words = ["The", "cat", "sat", "", "Dogs", "bark", ""]
+    props = ["-\t(A0*", "-\t*)", "sat\t(V*)", "",
+             "-\t(A0*)", "bark\t(V*)", ""]
+    # re-split into whitespace columns (verb col + one prop col)
+    props = [p.replace("\t", " ") for p in props]
+
+    tar_path = str(tmp_path / "conll05st.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for sub, lines in (("words/test.wsj.words.gz", words),
+                           ("props/test.wsj.props.gz", props)):
+            blob = _gzip.compress("\n".join(lines).encode())
+            info = tarfile.TarInfo("conll05st-release/test.wsj/" + sub)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+
+    wd = str(tmp_path / "word.dict")
+    open(wd, "w").write("\n".join(
+        ["<unk>", "the", "The", "cat", "sat", "Dogs", "bark", "bos", "eos"]))
+    vd = str(tmp_path / "verb.dict")
+    open(vd, "w").write("sat\nbark")
+    td = str(tmp_path / "target.dict")
+    open(td, "w").write("\n".join(["B-A0", "I-A0", "B-V", "I-V", "O"]))
+
+    ds = Conll05st(data_file=tar_path, word_dict_file=wd,
+                   verb_dict_file=vd, target_dict_file=td)
+    assert len(ds) == 2  # one proposition per sentence here
+    (word_idx, c_n2, c_n1, c_0, c_p1, c_p2, pred, mark, label) = ds[0]
+    assert len(word_idx) == 3 and pred[0] == 0  # 'sat' verb id
+    # labels: (A0* *) (V*) → B-A0 I-A0 B-V
+    ld = ds.label_dict
+    np.testing.assert_array_equal(
+        label, [ld["B-A0"], ld["I-A0"], ld["B-V"]])
+    # verb at index 2: mark covers window, ctx_0 is the verb token
+    np.testing.assert_array_equal(mark, [1, 1, 1])
+    assert c_0[0] == ds.word_dict["sat"]
+    assert c_p1[0] == ds.word_dict["eos"]  # right context off the edge
+    w2, _, _, c0_2, _, _, pred2, mark2, label2 = ds[1]
+    assert pred2[0] == 1 and len(w2) == 2
+    np.testing.assert_array_equal(label2, [ld["B-A0"], ld["B-V"]])
+
+
+def test_conll05st_section_isolation(tmp_path):
+    """words/props must come from the SAME release section."""
+    import gzip as _gzip
+
+    from paddle_tpu.text import Conll05st
+
+    tar_path = str(tmp_path / "c.tar.gz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        # a decoy section that would misalign if matched
+        for sec, words, props in (
+                ("test.brown", ["x", ""], ["x (V*)", ""]),
+                ("test.wsj", ["Dogs", "bark", ""],
+                 ["- (A0*)", "bark (V*)", ""])):
+            for sub, lines in (("words/%s.words.gz" % sec, words),
+                               ("props/%s.props.gz" % sec, props)):
+                blob = _gzip.compress("\n".join(lines).encode())
+                info = tarfile.TarInfo(
+                    "conll05st-release/%s/%s" % (sec, sub))
+                info.size = len(blob)
+                tf.addfile(info, io.BytesIO(blob))
+    wd = str(tmp_path / "w.dict")
+    open(wd, "w").write("<unk>\nDogs\nbark\nbos\neos")
+    vd = str(tmp_path / "v.dict")
+    open(vd, "w").write("bark")
+    td = str(tmp_path / "t.dict")
+    open(td, "w").write("B-A0\nI-A0\nB-V\nO")
+    ds = Conll05st(data_file=tar_path, word_dict_file=wd, verb_dict_file=vd,
+                   target_dict_file=td)  # default section test.wsj
+    assert len(ds) == 1
+    assert ds.sentences[0] == ["Dogs", "bark"]

@@ -239,3 +239,15 @@ def test_shufflenet_channel_shuffle_math():
     x = pt.to_tensor(np.arange(8, dtype=np.float32).reshape(1, 8, 1, 1))
     out = np.asarray(_channel_shuffle(x, 2).value).reshape(-1)
     np.testing.assert_array_equal(out, [0, 4, 1, 5, 2, 6, 3, 7])
+
+
+def test_mobilenet_v1_forward_scaled():
+    pt.seed(0)
+    m = models.mobilenet_v1(scale=0.25, num_classes=5)
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(1, 3, 64, 64).astype("float32"))
+    out = m(x)
+    assert list(out.shape) == [1, 5]
+    # scale=0.25 narrows every stage
+    assert m.fc.weight.shape[0] == 256
