@@ -32,10 +32,12 @@ class _SlowDataset(Dataset):
     being measured — valid even on a single-core host)."""
 
     def __len__(self):
-        return 192
+        return 256
 
     def __getitem__(self, i):
-        time.sleep(0.004)
+        # sleep = blocking IO stand-in; large enough that worker overlap
+        # dominates fork/queue overhead even on a loaded single-core box
+        time.sleep(0.008)
         return np.float32(i), np.int64(i)
 
 
@@ -113,6 +115,6 @@ def test_mp_throughput_beats_serial():
     t0 = time.perf_counter()
     n_mp = sum(1 for _ in DataLoader(ds, batch_size=8, num_workers=4))
     mp_s = time.perf_counter() - t0
-    assert n_serial == n_mp == 24
+    assert n_serial == n_mp == 32
     # conservative: require any real win so CI-load noise can't flake it
     assert mp_s < serial_s * 0.8, (serial_s, mp_s)

@@ -251,3 +251,35 @@ def test_mobilenet_v1_forward_scaled():
     assert list(out.shape) == [1, 5]
     # scale=0.25 narrows every stage
     assert m.fc.weight.shape[0] == 256
+
+
+@pytest.mark.parametrize("ctor,head,hidden", [
+    (models.mobilenet_v3_small, 576, 1024),
+    (models.mobilenet_v3_large, 960, 1280),
+])
+def test_mobilenet_v3_forward(ctor, head, hidden):
+    pt.seed(0)
+    m = ctor(num_classes=6)
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(1, 3, 64, 64).astype("float32"))
+    out = m(x)
+    assert list(out.shape) == [1, 6]
+    assert np.isfinite(np.asarray(out.value)).all()
+    # upstream-compatible widths: head conv + classifier hidden layer
+    assert m.head_conv[0].weight.shape[0] == head
+    assert m.classifier[0].weight.shape == [head, hidden]
+
+
+def test_inception_v3_forward():
+    pt.seed(0)
+    m = models.inception_v3(num_classes=4)
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(0)
+                     .randn(1, 3, 128, 128).astype("float32"))
+    out = m(x)
+    assert list(out.shape) == [1, 4]
+    assert np.isfinite(np.asarray(out.value)).all()
+    feats = models.inception_v3(num_classes=0, with_pool=False)
+    feats.eval()
+    assert feats(x).shape[1] == 2048
