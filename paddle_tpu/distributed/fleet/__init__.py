@@ -53,6 +53,7 @@ class DistributedStrategy:
         }
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1}
+        self.sep_configs = {}
         self.tensor_parallel = False
         self.tensor_parallel_configs = {}
         self.lamb = False
@@ -60,7 +61,9 @@ class DistributedStrategy:
         self.lars = False
         self.lars_configs = {}
         self.dgc = False
+        self.dgc_configs = {}
         self.localsgd = False
+        self.localsgd_configs = {}
         self.fp16_allreduce = False
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True  # XLA always fuses; informational
@@ -232,6 +235,21 @@ class _Fleet:
         st = self.strategy
         if st.recompute:
             model = self._apply_recompute(model)
+        if hcg.get_sep_parallel_world_size() > 1:
+            if not hasattr(model, "enable_sequence_parallel"):
+                raise InvalidArgumentError(
+                    "hybrid_configs sep_degree > 1 but the model has no "
+                    "enable_sequence_parallel hook — the sep mesh axis "
+                    "would silently waste %d-way devices"
+                    % hcg.get_sep_parallel_world_size())
+            if not getattr(model, "_sequence_parallel", False):
+                # sep axis active + SP-capable model: switch attention to
+                # ring/Ulysses over the sep group (a user's own
+                # enable_sequence_parallel call wins — never overwritten)
+                cfg = getattr(st, "sep_configs", None) or {}
+                model.enable_sequence_parallel(
+                    hcg.get_sep_parallel_group(),
+                    mode=cfg.get("mode", "ring"))
 
         out = model
         if isinstance(model, PipelineLayer) \

@@ -202,3 +202,57 @@ def test_data_parallel_indivisible_batch_raises(rng):
                      .get_data_parallel_group())
     with pytest.raises(Exception, match="not divisible"):
         m(pt.to_tensor(rng.randn(5, 8).astype(np.float32)))  # 5 % 8 != 0
+
+
+def test_distributed_model_enables_sequence_parallel():
+    """sep_degree>1 + SP-capable model → fleet wires ring attention in."""
+    from paddle_tpu.models import TransformerLM
+
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    pt.seed(0)
+    lm = TransformerLM(vocab_size=64, hidden_size=32, num_layers=2,
+                       num_heads=4, intermediate_size=64, max_position=32,
+                       dropout=0.0, causal=True)
+    out = fleet.distributed_model(lm)
+    assert lm._sequence_parallel
+    assert lm.encoder.layers[0].self_attn._sep_attn is not None
+    ids = pt.to_tensor(np.random.RandomState(0)
+                       .randint(0, 64, (2, 16)).astype("int32"))
+    logits = out(ids)
+    assert list(logits.shape) == [2, 16, 64]
+
+
+def test_distributed_model_sep_rejects_incapable_model():
+    strategy = DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    with pytest.raises(Exception, match="enable_sequence_parallel"):
+        fleet.distributed_model(pt.nn.Linear(4, 4))
+
+
+def test_distributed_model_sep_preserves_user_choice():
+    from paddle_tpu.models import TransformerLM
+
+    strategy = DistributedStrategy()
+    strategy.sep_configs["mode"] = "ring"  # in-place knob mutation works
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 4}
+    fleet.init(is_collective=True, strategy=strategy)
+    pt.seed(0)
+    lm = TransformerLM(
+        vocab_size=64, hidden_size=32, num_layers=1, num_heads=4,
+        intermediate_size=64, max_position=32, dropout=0.0, causal=True)
+    hcg = fleet.get_hybrid_communicate_group()
+    lm.enable_sequence_parallel(hcg.get_sep_parallel_group(),
+                                mode="ulysses")
+    marker = lm.encoder.layers[0].self_attn._sep_attn
+    fleet.distributed_model(lm)
+    # the user's ulysses choice survives (not rebuilt as strategy ring)
+    assert lm.encoder.layers[0].self_attn._sep_attn is marker
