@@ -41,6 +41,7 @@ class PipelineParallel(Layer):
         self._hcg = hcg
         cfg = getattr(strategy, "pipeline_configs", None) or {}
         self.accumulate_steps = int(cfg.get("accumulate_steps", 1))
+        self._mp_param_spec = cfg.get("mp_param_spec")
         self._spmd_step = None          # built lazily (needs the optimizer)
         self._spmd_unavailable = False
 
@@ -86,9 +87,13 @@ class PipelineParallel(Layer):
                 or partition_pipeline(self._layers) is None):
             self._spmd_unavailable = True
             return None
+        # pipeline_configs["mp_param_spec"]: optional (name, ndim) -> dims
+        # callable placing stage parameters over an mp mesh axis (tensor
+        # parallelism inside pipeline stages — the pp×mp hybrid)
         self._spmd_step = PipelineTrainStep(
             self._layers, optimizer, mesh,
-            microbatches=self.accumulate_steps)
+            microbatches=self.accumulate_steps,
+            mp_param_spec=self._mp_param_spec)
         return self._spmd_step
 
     def _sync_if_needed(self):

@@ -232,6 +232,37 @@ def _unwrap(v):
 # the compiled pipeline train step
 # ---------------------------------------------------------------------------
 
+def megatron_param_spec(core_stage, mp_axis: str = "mp",
+                        column=("q_proj.weight", "k_proj.weight",
+                                "v_proj.weight", "linear1.weight"),
+                        row=("out_proj.weight", "linear2.weight")):
+    """Build an ``mp_param_spec`` callable for a partitioned core stage.
+
+    ``core_stage``: one entry of ``partition_pipeline``'s core list
+    ([(obj, fn), ...]).  Attribute paths matching ``column`` shard the last
+    dim over ``mp_axis`` (column parallel), ``row`` shard the first
+    (row parallel); everything else replicates — the Megatron transformer
+    placement, shared by tests/dryrun/users of
+    ``pipeline_configs['mp_param_spec']``.
+    """
+    from ...nn import Sequential
+
+    spec_map = {}
+    probe = Sequential(*[obj for obj, _f in core_stage])
+    for attr, p in probe.named_parameters():
+        if p.value.ndim != 2:
+            continue
+        if any(k in attr for k in column):
+            spec_map[p.name] = (None, mp_axis)
+        elif any(k in attr for k in row):
+            spec_map[p.name] = (mp_axis, None)
+
+    def spec(name, ndim):
+        return spec_map.get(name, (None,) * ndim)
+
+    return spec if spec_map else None
+
+
 class PipelineTrainStep:
     """One-compile pipeline training step over a (dp, pp) mesh.
 
@@ -245,7 +276,14 @@ class PipelineTrainStep:
 
     def __init__(self, pipeline_layer, optimizer, mesh: Mesh,
                  microbatches: int, dp_axis: str = "dp", pp_axis: str = "pp",
-                 recompute: bool = True):
+                 recompute: bool = True, mp_param_spec=None):
+        """``mp_param_spec``: optional ``(param_name, ndim) -> tuple`` giving
+        a PartitionSpec entry per parameter dim (e.g. ``(None, 'mp')`` for a
+        column-parallel weight) — tensor parallelism INSIDE pipeline stages
+        (BASELINE config #5's pp×mp shape).  The pp schedule stays manual
+        (ppermute rotation); axes named by these specs stay GSPMD-managed
+        inside the region (partial-manual shard_map), so XLA derives the TP
+        collectives exactly as in the non-pipelined mp path."""
         parts = partition_pipeline(pipeline_layer)
         if parts is None:
             raise InvalidArgumentError(
@@ -282,10 +320,21 @@ class PipelineTrainStep:
                     zip(leaves, self._template)):
                 raise InvalidArgumentError(
                     "stage %d parameter structure mismatch" % s)
-        rest = lambda v: (None,) * v.ndim
+        self._mp_param_spec = mp_param_spec
+
+        def rest(v, name=None):
+            if mp_param_spec is not None and name is not None:
+                dims = tuple(mp_param_spec(name, v.ndim))
+                if len(dims) != v.ndim:
+                    raise InvalidArgumentError(
+                        "mp_param_spec(%r, %d) returned %d dims"
+                        % (name, v.ndim, len(dims)))
+                return dims
+            return (None,) * v.ndim
+
         self._core_shardings = [
-            NamedSharding(mesh, P(pp_axis, *rest(l)))
-            for l in per_stage[0]
+            NamedSharding(mesh, P(pp_axis, *rest(l, p.name)))
+            for l, p in zip(per_stage[0], self._template)
         ]
         self._stacked = [
             jax.device_put(jnp.stack([st[j] for st in per_stage]), sh)
@@ -312,9 +361,19 @@ class PipelineTrainStep:
             st = jax.tree_util.tree_map(
                 lambda *ls: jnp.stack([jnp.asarray(l) for l in ls]),
                 *per_stage_state)
+            tmpl_dims = rest(tmpl.value, tmpl.name)
+
+            def _state_spec(l, _dims=tmpl_dims, _pshape=tmpl.value.shape):
+                # param-shaped slots (moments, master weights) follow the
+                # parameter's mp placement — TP's state-memory saving;
+                # scalars/odd shapes replicate on the non-stage dims
+                if l.shape[1:] == _pshape:
+                    return P(pp_axis, *_dims)
+                return P(pp_axis, *((None,) * (l.ndim - 1)))
+
             st = jax.tree_util.tree_map(
                 lambda l: jax.device_put(
-                    l, NamedSharding(mesh, P(pp_axis, *rest(l)[:-1]))),
+                    l, NamedSharding(mesh, _state_spec(l))),
                 st,
             )
             self._stacked_states.append(st)
@@ -443,9 +502,29 @@ class PipelineTrainStep:
             [P(*((None,) * p._value.ndim)) for p in self._outer_params],
             P(),
         )
-        sharded_core = _shard_map(
-            pipe_core, mesh=mesh, in_specs=in_specs, out_specs=P(),
-            check_rep=False)
+        manual = {pp_axis} | ({dp_axis} if dp_axis else set())
+        # partial-manual ONLY when specs actually name extra axes: fleet
+        # meshes always carry degree-1 mp/sharding axes, and plain pipeline
+        # runs must keep the proven full-manual lowering
+        spec_axes = set()
+        if self._mp_param_spec is not None:
+            for sh in self._core_shardings:
+                for entry in sh.spec:
+                    if entry is not None and entry not in manual:
+                        spec_axes.add(entry)
+        extra = spec_axes - manual
+        if extra:
+            # partial-manual: pp/dp stay manual (the ppermute schedule),
+            # every other axis (mp, ...) remains GSPMD-managed inside the
+            # region so stage math gets its TP collectives from the
+            # parameter shardings — the pp×mp hybrid
+            sharded_core = jax.shard_map(
+                pipe_core, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                axis_names=frozenset(manual), check_vma=False)
+        else:
+            sharded_core = _shard_map(
+                pipe_core, mesh=mesh, in_specs=in_specs, out_specs=P(),
+                check_rep=False)
 
         n_outer = len(self._outer_params)
 

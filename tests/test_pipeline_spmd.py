@@ -302,3 +302,58 @@ def test_pipeline_rank_preserving_prefix_remainder():
     loss = engine.train_batch((pt.to_tensor(x), pt.to_tensor(t)), opt)
     assert engine._spmd_step is not None
     assert np.isfinite(float(loss.value))
+
+
+def test_pipeline_with_tensor_parallel_stages():
+    """BASELINE config #5 shape: pp x mp (x dp) in ONE compiled step —
+    stage rotation manual (ppermute), tensor parallelism inside stages
+    GSPMD-managed via partial-manual shard_map.  Loss parity with plain
+    single-device microbatch training proves the composition is placement,
+    not math."""
+    from jax.sharding import Mesh
+
+    from paddle_tpu.distributed.meta_parallel.spmd_pipeline import (
+        PipelineTrainStep)
+
+    steps, M, B = 3, 2, 8
+    xs, ys = _make_data(steps, B)
+    ref_layers = _build_layers(4)
+    pipe_layers = _build_layers(4)
+    _copy_weights(ref_layers, pipe_layers)
+    ref_losses = _train_ref(ref_layers, xs, ys, M, steps)
+
+    pl = PipelineLayer(pipe_layers, num_stages=2, loss_fn=loss_fn)
+    parts = partition_pipeline(pl)
+    assert parts is not None
+    _, core, _ = parts
+
+    # Megatron placement for the stage template (shared library helper)
+    from paddle_tpu.distributed.meta_parallel.spmd_pipeline import (
+        megatron_param_spec)
+
+    mp_spec = megatron_param_spec(core[0])
+    assert mp_spec is not None
+
+    devices = np.array(jax.devices()[:8]).reshape(2, 2, 2)
+    mesh = Mesh(devices, ("dp", "pp", "mp"))
+    opt = pt.optimizer.AdamW(1e-3, parameters=pl.parameters())
+    engine = PipelineTrainStep(pl, opt, mesh, microbatches=M,
+                               recompute=False, mp_param_spec=mp_spec)
+
+    # placement check: a column-parallel stacked weight is sharded pp x mp
+    from jax.sharding import PartitionSpec as P
+
+    col = next((sh for sh in engine._core_shardings
+                if sh.spec == P("pp", None, "mp")), None)
+    assert col is not None, [sh.spec for sh in engine._core_shardings]
+    # param-shaped optimizer slots follow the mp placement (memory claim)
+    mstate = next(
+        (st for st in engine._stacked_states
+         if any(getattr(l.sharding, "spec", None) == P("pp", None, "mp")
+                for l in jax.tree_util.tree_leaves(st))), None)
+    assert mstate is not None
+
+    pp_losses = [float(engine(pt.to_tensor(xs[i]),
+                              pt.to_tensor(ys[i])).value)
+                 for i in range(steps)]
+    np.testing.assert_allclose(ref_losses, pp_losses, rtol=1e-4, atol=1e-5)
