@@ -50,6 +50,7 @@ class DistributedStrategy:
             "pp_degree": 1,
             "sharding_degree": 1,
             "sep_degree": 1,
+            "ep_degree": 1,
         }
         self.pipeline = False
         self.pipeline_configs = {"accumulate_steps": 1}
@@ -103,6 +104,10 @@ class _Fleet:
         if sep > 1:
             names.append("sep")
             dims.append(sep)
+        ep = int(hc.get("ep_degree", 1) or 1)
+        if ep > 1:
+            names.append("expert")
+            dims.append(ep)
         import jax
 
         ndev = len(jax.devices())

@@ -11,6 +11,7 @@ from .mp_layers import (  # noqa: F401
     RowParallelLinear,
     VocabParallelEmbedding,
 )
+from .moe_layer import MoELayer, top2_gating  # noqa: F401
 from .pipeline_parallel import PipelineParallel  # noqa: F401
 from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
 from .sequence_parallel import (  # noqa: F401
@@ -30,5 +31,6 @@ __all__ = [
     "ParallelCrossEntropy", "LayerDesc", "SharedLayerDesc", "PipelineLayer",
     "PipelineParallel", "ring_attention", "ulysses_attention",
     "split_sequence", "gather_sequence", "ShardingOptimizerStage2",
-    "GroupShardedParallel", "group_sharded_parallel",
+    "GroupShardedParallel", "group_sharded_parallel", "MoELayer",
+    "top2_gating",
 ]

@@ -1,0 +1,230 @@
+"""Expert parallelism: mixture-of-experts over an ``ep`` mesh axis.
+
+Reference context: the v2.1 snapshot has NO MoE vertical — SURVEY §2.4 marks
+"EP / expert parallel" as *absent*, with the ``alltoall`` collective
+(``python/paddle/distributed/collective.py:1456``) shipped only as a building
+block.  This module is therefore a new capability layer (like sequence
+parallelism, SURVEY §5.7) designed TPU-first rather than ported.
+
+TPU-native design (GShard/GSPMD recipe): expert weights are one *stacked*
+tensor ``[E, ...]`` placed over the ``ep`` mesh axis, and token routing is
+dense einsum algebra over a capacity-bounded dispatch tensor — no
+data-dependent shapes, so the whole layer jits.  The all-to-all the reference
+would hand-write falls out of the sharding change between the token layout
+(batch sharded over ``dp``/``ep``) and the expert layout (experts sharded
+over ``ep``): XLA's SPMD partitioner lowers the two dispatch/combine einsums
+to ``AllToAll`` over ICI.  Top-k gating follows the GShard top-2 scheme:
+per-expert capacity ``ceil(k*S*cf/E)``, position-in-expert via a cumulative
+sum over the token axis, overflowing tokens dropped (output 0 for their
+dropped slot — the residual connection carries them), and the load-balance
+auxiliary loss ``E * mean_e(me * ce)``.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...core.errors import InvalidArgumentError
+from ...framework.dispatch import make_op
+from ...framework.tensor import Tensor
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from ..collective import Group
+
+__all__ = ["MoELayer", "top2_gating"]
+
+
+def top2_gating(logits, capacity: int, top_k: int = 2):
+    """GShard-style top-k dispatch/combine from router logits.
+
+    logits: [B, S, E].  Returns (dispatch [B,S,E,C] float, combine
+    [B,S,E,C] float, aux_loss scalar).
+    """
+    probs = jax.nn.softmax(logits, axis=-1)
+    B, S, E = probs.shape
+
+    dispatch = None
+    combine = None
+    remaining = probs  # remaining probabilities after masking chosen experts
+    fills = jnp.zeros((B, E), probs.dtype)  # tokens already sent per expert
+    # fraction of tokens whose top-1 choice is e (for the aux loss)
+    top1_frac = None
+    for k in range(top_k):
+        idx = jnp.argmax(remaining, axis=-1)  # [B, S]
+        onehot = jax.nn.one_hot(idx, E, dtype=probs.dtype)  # [B, S, E]
+        if k == 0:
+            top1_frac = onehot.mean(axis=1)  # [B, E]
+        gate = (remaining * onehot).sum(-1)  # [B, S]
+        # position of each token within its chosen expert's buffer
+        pos = jnp.cumsum(onehot, axis=1) - onehot + fills[:, None, :]
+        pos_tok = (pos * onehot).sum(-1)  # [B, S]
+        keep = pos_tok < capacity
+        gate = jnp.where(keep, gate, 0.0)
+        pos_cap = jax.nn.one_hot(pos_tok.astype(jnp.int32), capacity,
+                                 dtype=probs.dtype)
+        # [B,S,E,C]
+        d_k = onehot[..., None] * pos_cap[:, :, None, :] \
+            * keep[..., None, None].astype(probs.dtype)
+        c_k = d_k * gate[..., None, None]
+        dispatch = d_k if dispatch is None else dispatch + d_k
+        combine = c_k if combine is None else combine + c_k
+        fills = fills + (onehot * keep[..., None].astype(probs.dtype)).sum(1)
+        remaining = remaining * (1.0 - onehot)
+
+    # load-balance loss: E * sum_e(mean-prob_e * top1-frac_e)
+    me = probs.mean(axis=1)  # [B, E]
+    aux = (me * top1_frac).sum(-1).mean() * E
+    return dispatch, combine, aux
+
+
+def _moe_raw(x, wg, w1, b1, w2, b2, top_k=2, capacity=0, activation="gelu",
+             renormalize=True):
+    """x: [B, S, M]; wg: [M, E]; w1: [E, M, H]; b1: [E, H]; w2: [E, H, M];
+    b2: [E, M].  Returns (out [B,S,M], aux_loss scalar)."""
+    # route in fp32: tiny matmul, and gate ordering is precision-sensitive
+    logits = jnp.einsum("bsm,me->bse", x.astype(jnp.float32),
+                        wg.astype(jnp.float32))
+    dispatch, combine, aux = top2_gating(logits, capacity, top_k)
+    if renormalize:
+        denom = combine.sum(axis=(2, 3), keepdims=True)
+        combine = combine / jnp.maximum(denom, 1e-9)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+    # dispatch: tokens → per-expert capacity buffers.  The ebcm layout is
+    # sharded over 'ep' on e; XLA emits the all-to-all here.
+    xs = jnp.einsum("bsec,bsm->ebcm", dispatch, x)
+    act = jax.nn.gelu if activation == "gelu" else jax.nn.relu
+    h = act(jnp.einsum("ebcm,emh->ebch", xs, w1) + b1[:, None, None, :])
+    ys = jnp.einsum("ebch,ehm->ebcm", h, w2) + b2[:, None, None, :]
+    out = jnp.einsum("bsec,ebcm->bsm", combine, ys)
+    return out, aux.astype(jnp.float32)
+
+
+_moe_op = make_op(_moe_raw, op_name="moe_dispatch_combine")
+
+
+class MoELayer(Layer):
+    """Sparsely-activated FFN: router + E expert MLPs over the ``ep`` axis.
+
+    With ``ep_group`` (or an active fleet hybrid topology with
+    ``ep_degree>1``) the stacked expert weights are placed
+    ``P('ep', None, ...)`` — each device holds ``E/ep`` experts and XLA
+    inserts the dispatch/combine all-to-alls.  Without a group it is a
+    dense single-device MoE (same math, same tests).
+    """
+
+    def __init__(self, d_model: int, d_hidden: int, num_experts: int,
+                 top_k: int = 2, capacity_factor: float = 1.25,
+                 activation: str = "gelu", renormalize: bool = True,
+                 ep_group: Optional[Group] = None, name=None):
+        super().__init__()
+        if num_experts < 1:
+            raise InvalidArgumentError("num_experts must be >= 1")
+        if top_k < 1:
+            raise InvalidArgumentError("top_k must be >= 1")
+        if top_k > num_experts:
+            raise InvalidArgumentError(
+                "top_k %d > num_experts %d" % (top_k, num_experts))
+        self.d_model = d_model
+        self.d_hidden = d_hidden
+        self.num_experts = num_experts
+        self.top_k = top_k
+        self.capacity_factor = capacity_factor
+        self.activation = activation
+        self.renormalize = renormalize
+
+        E = num_experts
+        self.gate_weight = self.create_parameter(
+            [d_model, E], default_initializer=I.XavierUniform())
+        self.w1 = self.create_parameter(
+            [E, d_model, d_hidden], default_initializer=I.XavierUniform())
+        self.b1 = self.create_parameter([E, d_hidden], is_bias=True)
+        self.w2 = self.create_parameter(
+            [E, d_hidden, d_model], default_initializer=I.XavierUniform())
+        self.b2 = self.create_parameter([E, d_model], is_bias=True)
+        # aux_loss bookkeeping: _aux_val is the differentiable value from the
+        # current forward (eager tape or live trace); the buffer is the
+        # concrete copy TrainStep threads through the jit and writes back,
+        # so monitoring after a compiled step never sees a leaked tracer.
+        self._aux_val = None
+        self.register_buffer(
+            "_aux_buffer", Tensor(jnp.zeros((), jnp.float32)),
+            persistable=False)
+
+        group = ep_group or self._fleet_ep_group()
+        self.ep_group = group
+        self.ep_degree = 1
+        if group is not None:
+            # the mesh axis is authoritative for the ep degree (Group.ranks
+            # are bookkeeping and may span other axes of a hybrid mesh)
+            ax = group.axis_name
+            self.ep_degree = int(group.mesh.shape[ax])
+            if E % self.ep_degree:
+                raise InvalidArgumentError(
+                    "num_experts %d not divisible by ep degree %d"
+                    % (E, self.ep_degree))
+            self._place(self.w1, group, P(ax, None, None))
+            self._place(self.b1, group, P(ax, None))
+            self._place(self.w2, group, P(ax, None, None))
+            self._place(self.b2, group, P(ax, None))
+
+    @staticmethod
+    def _fleet_ep_group() -> Optional[Group]:
+        from ..fleet import fleet
+
+        if fleet.is_initialized:
+            hcg = fleet.get_hybrid_communicate_group()
+            if hcg.get_expert_parallel_world_size() > 1:
+                return hcg.get_expert_parallel_group()
+        return None
+
+    @staticmethod
+    def _place(param, group: Group, spec: P):
+        from .mp_layers import _place
+
+        _place(param, group, spec)
+
+    def capacity(self, seq_len: int) -> int:
+        return max(1, int(math.ceil(
+            self.top_k * seq_len * self.capacity_factor / self.num_experts)))
+
+    def forward(self, x):
+        if len(x.shape) != 3:
+            raise InvalidArgumentError(
+                "MoELayer expects [batch, seq, d_model], got %s"
+                % (tuple(x.shape),))
+        cap = self.capacity(int(x.shape[1]))
+        out, aux = _moe_op(
+            x, self.gate_weight, self.w1, self.b1, self.w2, self.b2,
+            top_k=self.top_k, capacity=cap, activation=self.activation,
+            renormalize=self.renormalize)
+        self._aux_val = aux
+        self._aux_buffer.set_value(aux.value if isinstance(aux, Tensor)
+                                   else aux)
+        return out
+
+    @property
+    def aux_loss(self):
+        """Load-balance loss of the last forward.
+
+        Differentiable when read in the same eager step or inside the same
+        trace (add it to the training loss there); after a compiled
+        TrainStep it resolves to the concrete buffer value for monitoring.
+        """
+        from ...framework.dispatch import _trace_clean
+
+        v = self._aux_val
+        if v is not None:
+            raw = v.value if isinstance(v, Tensor) else v
+            if not isinstance(raw, jax.core.Tracer) or not _trace_clean():
+                return v
+        return self._aux_buffer
+
+    def extra_repr(self):
+        return ("d_model=%d, d_hidden=%d, num_experts=%d, top_k=%d, ep=%s"
+                % (self.d_model, self.d_hidden, self.num_experts, self.top_k,
+                   self.ep_degree if self.ep_group else 1))

@@ -129,6 +129,7 @@ _MESH_AXIS = {
     "sharding": "sharding",
     "model": "mp",
     "sep": "sep",
+    "expert": "ep",
 }
 
 
@@ -163,6 +164,7 @@ class HybridCommunicateGroup:
         )
         self._mp_degree = topology.get_dim("model") if "model" in names else 1
         self._sep_degree = topology.get_dim("sep") if "sep" in names else 1
+        self._ep_degree = topology.get_dim("expert") if "expert" in names else 1
 
         if devices is None:
             devices = jax.devices()
@@ -183,16 +185,19 @@ class HybridCommunicateGroup:
         self._sharding_rank = getattr(coord, "sharding", 0)
         self._mp_rank = getattr(coord, "model", 0)
         self._sep_rank = getattr(coord, "sep", 0)
+        self._ep_rank = getattr(coord, "expert", 0)
 
     def __repr__(self):
         return (
-            "HybridCommunicateGroup(dp=%d, pp=%d, sharding=%d, mp=%d, sep=%d)"
+            "HybridCommunicateGroup(dp=%d, pp=%d, sharding=%d, mp=%d, "
+            "sep=%d, ep=%d)"
             % (
                 self._dp_degree,
                 self._pp_degree,
                 self._sharding_degree,
                 self._mp_degree,
                 self._sep_degree,
+                self._ep_degree,
             )
         )
 
@@ -269,6 +274,15 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self):
         return self._axis_group("sep", "sep")
+
+    def get_expert_parallel_world_size(self) -> int:
+        return self._ep_degree
+
+    def get_expert_parallel_rank(self) -> int:
+        return self._ep_rank
+
+    def get_expert_parallel_group(self):
+        return self._axis_group("expert", "ep")
 
     # pipeline neighbors (topology.py get_p2p_groups analog)
     def get_p2p_next_rank(self) -> int:

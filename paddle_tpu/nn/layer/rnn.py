@@ -1,0 +1,532 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py —
+SimpleRNNCell/LSTMCell/GRUCell, RNN:? BiRNN, SimpleRNN/LSTM/GRU).
+
+TPU-native design: the reference unrolls recurrences through its dynamic
+``rnn()`` python loop (eager) or a StaticRNN program construct.  Here one
+layer-direction is a single composite op whose raw implementation is a
+``jax.lax.scan`` over the time axis — XLA compiles the whole recurrence to
+one fused loop (weights stay resident in VMEM across steps), and the eager
+autograd tape records a single ``jax.vjp`` pullback for the entire scan
+(backprop-through-time without per-step tape nodes).  ``sequence_length``
+masking keeps static shapes: finished examples carry their last valid state
+forward and emit zero outputs, matching the reference's padded semantics.
+
+Gate layouts match the reference (and torch, which the tests use as the
+independent oracle): LSTM [i, f, g, o]; GRU [r, z, c] with the hidden-side
+bias applied inside the reset gate product.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.errors import InvalidArgumentError
+from ...framework.dispatch import make_op
+from ...framework.tensor import Tensor
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = [
+    "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell",
+    "RNN", "BiRNN", "SimpleRNN", "LSTM", "GRU",
+]
+
+
+# ---------------------------------------------------------------------------
+# Raw (array-in/array-out) recurrence kernels
+# ---------------------------------------------------------------------------
+
+def _gates(x, h, w_ih, w_hh, b_ih, b_hh):
+    """Input-side and hidden-side projections, biases kept separate (GRU
+    needs the hidden bias inside the reset product)."""
+    gi = x @ w_ih.T
+    if b_ih is not None:
+        gi = gi + b_ih
+    gh = h @ w_hh.T
+    if b_hh is not None:
+        gh = gh + b_hh
+    return gi, gh
+
+
+def _step_simple(x, hc, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    (h,) = hc
+    gi, gh = _gates(x, h, w_ih, w_hh, b_ih, b_hh)
+    act = jnp.tanh if activation == "tanh" else jax.nn.relu
+    nh = act(gi + gh)
+    return (nh,)
+
+
+def _step_lstm(x, hc, w_ih, w_hh, b_ih, b_hh, activation=None):
+    h, c = hc
+    gi, gh = _gates(x, h, w_ih, w_hh, b_ih, b_hh)
+    i, f, g, o = jnp.split(gi + gh, 4, axis=-1)
+    nc = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    nh = jax.nn.sigmoid(o) * jnp.tanh(nc)
+    return (nh, nc)
+
+
+def _step_gru(x, hc, w_ih, w_hh, b_ih, b_hh, activation=None):
+    (h,) = hc
+    gi, gh = _gates(x, h, w_ih, w_hh, b_ih, b_hh)
+    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+    hr, hz, hc_ = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    c = jnp.tanh(ic + r * hc_)
+    nh = z * h + (1.0 - z) * c
+    return (nh,)
+
+
+_STEPS = {"simple": _step_simple, "lstm": _step_lstm, "gru": _step_gru}
+
+
+def _reverse_sequence(x_tm, seq_len):
+    """Per-example time reversal of a padded [T, B, ...] batch (the
+    reference's reverse-direction handling keeps padding at the tail)."""
+    T = x_tm.shape[0]
+    if seq_len is None:
+        return jnp.flip(x_tm, axis=0)
+    t = jnp.arange(T)[:, None]
+    sl = seq_len[None, :]
+    idx = jnp.where(t < sl, sl - 1 - t, t)  # [T, B]
+    return x_tm[idx, jnp.arange(x_tm.shape[1])[None, :]]
+
+
+def _rnn_scan_raw(inputs, seq_len, h0, c0, w_ih, w_hh, b_ih, b_hh,
+                  mode="simple", activation="tanh", reverse=False,
+                  time_major=False):
+    """One layer-direction recurrence as a single lax.scan.
+
+    inputs: [B, T, D] (or [T, B, D] when time_major); h0/c0: [B, H]
+    (c0 only for lstm).  Returns (outputs, h_T, c_T) with outputs in the
+    caller's layout.
+    """
+    step = _STEPS[mode]
+    x_tm = inputs if time_major else jnp.swapaxes(inputs, 0, 1)
+    if reverse:
+        x_tm = _reverse_sequence(x_tm, seq_len)
+    states = (h0,) if c0 is None else (h0, c0)
+
+    def body(carry, xt):
+        t, hc = carry
+        nhc = step(xt, hc, w_ih, w_hh, b_ih, b_hh, activation)
+        if seq_len is not None:
+            valid = (t < seq_len)[:, None]
+            nhc = tuple(jnp.where(valid, n, o) for n, o in zip(nhc, hc))
+            out = jnp.where(valid, nhc[0], jnp.zeros_like(nhc[0]))
+        else:
+            out = nhc[0]
+        return (t + 1, nhc), out
+
+    (_, final), outs = lax.scan(body, (jnp.int32(0), states), x_tm)
+    if reverse:
+        outs = _reverse_sequence(outs, seq_len)
+    if not time_major:
+        outs = jnp.swapaxes(outs, 0, 1)
+    hT = final[0]
+    cT = final[1] if len(final) > 1 else None
+    return (outs, hT, cT) if cT is not None else (outs, hT)
+
+
+_rnn_scan = make_op(_rnn_scan_raw, op_name="rnn_scan")
+
+
+def _reverse_raw(x, seq_len, time_major=False):
+    x_tm = x if time_major else jnp.swapaxes(x, 0, 1)
+    out = _reverse_sequence(x_tm, seq_len)
+    return out if time_major else jnp.swapaxes(out, 0, 1)
+
+
+_reverse_op = make_op(_reverse_raw, op_name="reverse_sequence")
+_cell_step_ops = {
+    name: make_op(
+        lambda x, h, c, w_ih, w_hh, b_ih, b_hh, _step=step, activation="tanh":
+        _step(x, (h,) if c is None else (h, c), w_ih, w_hh, b_ih, b_hh,
+              activation),
+        op_name="rnn_cell_%s" % name)
+    for name, step in _STEPS.items()
+}
+
+
+# ---------------------------------------------------------------------------
+# Cells
+# ---------------------------------------------------------------------------
+
+class RNNCellBase(Layer):
+    """Base cell: single-step recurrence + initial-state construction
+    (reference rnn.py RNNCellBase.get_initial_states)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        batch = int(batch_ref.shape[batch_dim_idx])
+        shapes = shape if shape is not None else self.state_shape
+        dtype = dtype or "float32"
+
+        def mk(s):
+            return Tensor(jnp.full((batch,) + tuple(s), init_value, dtype),
+                          stop_gradient=True)
+
+        if isinstance(shapes, (list, tuple)) and shapes \
+                and isinstance(shapes[0], (list, tuple)):
+            made = tuple(mk(s) for s in shapes)
+            return made if len(made) > 1 else made[0]
+        return mk(tuple(shapes))
+
+    @property
+    def state_shape(self):
+        raise NotImplementedError(
+            "cell %s must define state_shape" % type(self).__name__)
+
+
+class _BuiltinCell(RNNCellBase):
+    _mode: str = ""
+    _gate_mult: int = 1
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if hidden_size <= 0 or input_size <= 0:
+            raise InvalidArgumentError(
+                "cell sizes must be positive, got input_size=%s "
+                "hidden_size=%s" % (input_size, hidden_size))
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        k = self._gate_mult
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [k * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [k * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [k * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [k * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def _unpack_states(self, states, batch_ref):
+        if states is None:
+            states = self.get_initial_states(batch_ref)
+        if self._mode == "lstm":
+            h, c = states
+        else:
+            h, c = states, None
+            if isinstance(h, (tuple, list)):
+                (h,) = h
+        return h, c
+
+    def forward(self, inputs, states=None):
+        h, c = self._unpack_states(states, inputs)
+        act = getattr(self, "activation", "tanh")
+        out = _cell_step_ops[self._mode](
+            inputs, h, c, self.weight_ih, self.weight_hh,
+            self.bias_ih, self.bias_hh, activation=act)
+        if self._mode == "lstm":
+            nh, nc = out
+            return nh, (nh, nc)
+        (nh,) = out
+        return nh, nh
+
+    def extra_repr(self):
+        return "input_size=%d, hidden_size=%d" % (
+            self.input_size, self.hidden_size)
+
+
+class SimpleRNNCell(_BuiltinCell):
+    """y = act(W_ih x + b_ih + W_hh h + b_hh) (reference SimpleRNNCell)."""
+
+    _mode = "simple"
+    _gate_mult = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        if activation not in ("tanh", "relu"):
+            raise InvalidArgumentError(
+                "SimpleRNNCell activation must be tanh or relu, got %r"
+                % activation)
+        super().__init__(input_size, hidden_size, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self.activation = activation
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(_BuiltinCell):
+    """Gates [i, f, g, o]; returns (h, (h, c)) (reference LSTMCell)."""
+
+    _mode = "lstm"
+    _gate_mult = 4
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(_BuiltinCell):
+    """Gates [r, z, c], h' = z*h + (1-z)*c (reference GRUCell)."""
+
+    _mode = "gru"
+    _gate_mult = 3
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+# ---------------------------------------------------------------------------
+# Sequence wrappers
+# ---------------------------------------------------------------------------
+
+def _as_value(x):
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _run_layer(cell, inputs, init_states, sequence_length, reverse,
+               time_major):
+    """One layer-direction over the sequence.
+
+    Builtin cells run the fused scan; arbitrary user cells fall back to a
+    per-step python loop (taped per step, like the reference's rnn())."""
+    if isinstance(cell, _BuiltinCell):
+        batch_dim = 1 if time_major else 0
+        if init_states is None:
+            init_states = cell.get_initial_states(inputs,
+                                                  batch_dim_idx=batch_dim)
+        h0, c0 = cell._unpack_states(init_states, inputs)
+        if int(h0.shape[0]) != int(inputs.shape[batch_dim]):
+            raise InvalidArgumentError(
+                "initial state batch %s != input batch %s"
+                % (h0.shape[0], inputs.shape[batch_dim]))
+        act = getattr(cell, "activation", "tanh")
+        out = _rnn_scan(
+            inputs, sequence_length, h0, c0, cell.weight_ih, cell.weight_hh,
+            cell.bias_ih, cell.bias_hh, mode=cell._mode, activation=act,
+            reverse=reverse, time_major=time_major)
+        if cell._mode == "lstm":
+            outs, hT, cT = out
+            return outs, (hT, cT)
+        outs, hT = out
+        return outs, hT
+
+    # Generic cell: python loop (RNNCellBase contract: forward(x_t, states)).
+    # sequence_length gets the same masked semantics as the fused scan:
+    # finished examples freeze their state and emit zero outputs, and the
+    # reverse direction starts from each example's last valid step.
+    from ... import tensor as pt_tensor
+
+    time_axis = 0 if time_major else 1
+    T = int(inputs.shape[time_axis])
+    states = init_states if init_states is not None \
+        else cell.get_initial_states(inputs,
+                                     batch_dim_idx=1 if time_major else 0)
+    if reverse:
+        inputs = _reverse_op(inputs, sequence_length, time_major=time_major)
+    outs = [None] * T
+
+    def _mask(new, old, valid):
+        def one(n, o):
+            if not isinstance(n, Tensor):
+                return n
+            v = valid.reshape((-1,) + (1,) * (len(n.shape) - 1))
+            return pt_tensor.where(Tensor(v, stop_gradient=True), n, o)
+        return jax.tree_util.tree_map(
+            one, new, old, is_leaf=lambda t: isinstance(t, Tensor))
+
+    for t in range(T):
+        xt = (inputs[t] if time_major else inputs[:, t])
+        o, new_states = cell.forward(xt, states)
+        if sequence_length is not None:
+            valid = jnp.asarray(sequence_length) > t
+            states = _mask(new_states, states, valid)
+            o = o * Tensor(
+                valid.reshape((-1,) + (1,) * (len(o.shape) - 1)).astype(
+                    o.dtype), stop_gradient=True)
+        else:
+            states = new_states
+        outs[t] = o
+    outputs = pt_tensor.stack(outs, axis=time_axis)
+    if reverse:
+        outputs = _reverse_op(outputs, sequence_length, time_major=time_major)
+    return outputs, states
+
+
+class RNN(Layer):
+    """Runs a cell over a sequence (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        return _run_layer(self.cell, inputs, initial_states,
+                          _as_value(sequence_length), self.is_reverse,
+                          self.time_major)
+
+
+class BiRNN(Layer):
+    """Forward + reverse cells, outputs concatenated (reference BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major: bool = False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        init_fw = init_bw = None
+        if initial_states is not None:
+            init_fw, init_bw = initial_states
+        sl = _as_value(sequence_length)
+        out_fw, st_fw = _run_layer(self.cell_fw, inputs, init_fw, sl,
+                                   False, self.time_major)
+        out_bw, st_bw = _run_layer(self.cell_bw, inputs, init_bw, sl,
+                                   True, self.time_major)
+        from ... import tensor as pt_tensor
+        outputs = pt_tensor.concat([out_fw, out_bw], axis=-1)
+        return outputs, (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) stack over builtin cells
+    (reference rnn.py RNNBase → SimpleRNN/LSTM/GRU)."""
+
+    _mode = ""
+    _cell_cls: type = None
+
+    def __init__(self, input_size: int, hidden_size: int, num_layers: int = 1,
+                 direction: str = "forward", time_major: bool = False,
+                 dropout: float = 0.0, activation: str = "tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        if direction in ("bidirect", "bidirectional"):
+            self.num_directions = 2
+        elif direction == "forward":
+            self.num_directions = 1
+        else:
+            raise InvalidArgumentError(
+                "direction must be 'forward' or 'bidirect', got %r"
+                % direction)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self._cells = []
+        for layer_i in range(num_layers):
+            in_sz = input_size if layer_i == 0 \
+                else hidden_size * self.num_directions
+            for d in range(self.num_directions):
+                kw = {}
+                if self._mode == "simple":
+                    kw["activation"] = activation
+                cell = self._cell_cls(
+                    in_sz, hidden_size, weight_ih_attr=weight_ih_attr,
+                    weight_hh_attr=weight_hh_attr, bias_ih_attr=bias_ih_attr,
+                    bias_hh_attr=bias_hh_attr, **kw)
+                suffix = "l%d%s" % (layer_i, "_reverse" if d else "")
+                self.add_sublayer("cell_%s" % suffix, cell)
+                self._cells.append(cell)
+
+    def _cell(self, layer_i, direction):
+        return self._cells[layer_i * self.num_directions + direction]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor as pt_tensor
+
+        nd, nl = self.num_directions, self.num_layers
+        sl = _as_value(sequence_length)
+        lstm = self._mode == "lstm"
+
+        # [num_layers*nd, B, H] stacked states → per layer-direction
+        def slice_state(s, idx):
+            return s[idx]
+
+        if initial_states is None:
+            init_h = init_c = None
+        elif lstm:
+            init_h, init_c = initial_states
+        else:
+            init_h, init_c = initial_states, None
+
+        x = inputs
+        final_h, final_c = [], []
+        for layer_i in range(nl):
+            outs = []
+            for d in range(nd):
+                idx = layer_i * nd + d
+                cell = self._cell(layer_i, d)
+                if init_h is None:
+                    st = None
+                elif lstm:
+                    st = (slice_state(init_h, idx), slice_state(init_c, idx))
+                else:
+                    st = slice_state(init_h, idx)
+                o, stT = _run_layer(cell, x, st, sl, reverse=bool(d),
+                                    time_major=self.time_major)
+                outs.append(o)
+                if lstm:
+                    final_h.append(stT[0])
+                    final_c.append(stT[1])
+                else:
+                    final_h.append(stT)
+            x = outs[0] if nd == 1 else pt_tensor.concat(outs, axis=-1)
+            if self.dropout > 0.0 and layer_i < nl - 1:
+                x = F.dropout(x, self.dropout, training=self.training)
+
+        h = pt_tensor.stack(final_h, axis=0)
+        if lstm:
+            c = pt_tensor.stack(final_c, axis=0)
+            return x, (h, c)
+        return x, h
+
+    def extra_repr(self):
+        return ("input_size=%d, hidden_size=%d, num_layers=%d, "
+                "num_directions=%d" % (self.input_size, self.hidden_size,
+                                       self.num_layers, self.num_directions))
+
+
+class SimpleRNN(_RNNBase):
+    _mode = "simple"
+    _cell_cls = SimpleRNNCell
+
+
+class LSTM(_RNNBase):
+    _mode = "lstm"
+    _cell_cls = LSTMCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+
+class GRU(_RNNBase):
+    _mode = "gru"
+    _cell_cls = GRUCell
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, "tanh", weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)

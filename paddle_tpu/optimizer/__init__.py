@@ -26,7 +26,7 @@ from .lr import LRScheduler
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
-    "Adamax", "RMSProp", "Lamb", "Lars", "lr",
+    "Adamax", "RMSProp", "Lamb", "Lars", "Ftrl", "lr",
 ]
 
 lr = lr_sched
@@ -611,3 +611,37 @@ class Lars(Optimizer):
         new = m_w - v
         new_val, state2 = self._finish(new, val.dtype, dict(state, velocity=v))
         return new_val, state2
+
+
+class Ftrl(Optimizer):
+    """operators/optimizers/ftrl_op semantics (FTRL-proximal).
+
+    squared/linear accumulators; the closed-form proximal update
+    ``w = -linear_clipped / (l2 + sqrt(new_sq)/lr)`` with l1 soft threshold.
+    """
+
+    def __init__(self, learning_rate=0.001, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._l1 = l1
+        self._l2 = l2
+        self._lr_power = lr_power
+
+    def _init_state(self, p):
+        state = super()._init_state(p)
+        state["squared"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        state["linear"] = jnp.zeros_like(p.value, dtype=jnp.float32)
+        return state
+
+    def _apply_one(self, val, grad, state, lr, p):
+        g = grad.astype(jnp.float32)
+        w = val.astype(jnp.float32)
+        sq, lin = state["squared"], state["linear"]
+        new_sq = sq + jnp.square(g)
+        pw = -self._lr_power
+        sigma = (jnp.power(new_sq, pw) - jnp.power(sq, pw)) / lr
+        new_lin = lin + g - sigma * w
+        quad = jnp.power(new_sq, pw) / lr + 2.0 * self._l2
+        pre = jnp.clip(new_lin, -self._l1, self._l1) - new_lin
+        new = jnp.where(jnp.abs(new_lin) > self._l1, pre / quad, 0.0)
+        return new.astype(val.dtype), dict(state, squared=new_sq, linear=new_lin)

