@@ -11,6 +11,7 @@ from .callbacks import (  # noqa: F401
     ProgBarLogger,
 )
 from .model import Model  # noqa: F401
+from .summary import flops, summary  # noqa: F401
 
-__all__ = ["Model", "callbacks", "Callback", "ProgBarLogger",
+__all__ = ["Model", "callbacks", "summary", "flops", "Callback", "ProgBarLogger",
            "ModelCheckpoint", "LRScheduler", "EarlyStopping"]

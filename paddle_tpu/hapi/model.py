@@ -310,6 +310,12 @@ class Model:
         return self.network.parameters(*a, **k)
 
     def summary(self, input_size=None, dtype=None):
+        if input_size is not None:
+            from .summary import summary as _summary
+
+            return _summary(self.network, input_size,
+                            dtypes=[dtype] if dtype else None)
+        # no shapes to run a forward with: parameter table only
         total = sum(int(np.prod(p.shape)) for p in self.network.parameters())
         trainable = sum(int(np.prod(p.shape)) for p in self.network.parameters()
                         if not p.stop_gradient)

@@ -1,0 +1,148 @@
+"""``paddle.summary`` / ``paddle.flops`` (reference:
+python/paddle/hapi/model_summary.py, hapi/dynamic_flops.py).
+
+Both run one real forward with post-hooks collecting per-layer output
+shapes / parameter counts / FLOP estimates — the dygraph approach; there
+is no graph walk because the jaxpr is not needed for shape bookkeeping.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary", "flops"]
+
+
+def _shape_of(out):
+    if hasattr(out, "shape"):
+        return list(tuple(out.shape))
+    if isinstance(out, (tuple, list)) and out:
+        return _shape_of(out[0])
+    return []
+
+
+def _run_forward(net: Layer, input_size, input=None, dtypes=None):
+    import paddle_tpu as pt
+
+    if input is not None:
+        args = input if isinstance(input, (tuple, list)) else [input]
+        return [a for a in args]
+    if input_size is None:
+        raise InvalidArgumentError("summary/flops need input_size= or input=")
+    sizes = input_size if isinstance(input_size, list) else [input_size]
+    if sizes and not isinstance(sizes[0], (tuple, list)):
+        sizes = [tuple(sizes)]
+    dtypes = dtypes or ["float32"] * len(sizes)
+    rng = np.random.RandomState(0)
+    args = []
+    for s, dt in zip(sizes, dtypes):
+        s = tuple(1 if d is None or d == -1 else int(d) for d in s)
+        if np.issubdtype(np.dtype(dt), np.integer):
+            args.append(pt.to_tensor(rng.randint(0, 2, s).astype(dt)))
+        else:
+            args.append(pt.to_tensor(rng.randn(*s).astype(dt)))
+    return args
+
+
+def _collect(net: Layer, args, flop_fn=None):
+    rows = []
+    hooks = []
+
+    def mk_hook(name, layer):
+        def hook(lyr, inputs, outputs):
+            n_params = sum(int(np.prod(p.shape))
+                           for p in lyr.parameters(include_sublayers=False))
+            row = {
+                "name": "%s (%s)" % (name or type(lyr).__name__,
+                                     type(lyr).__name__),
+                "output_shape": _shape_of(outputs),
+                "params": n_params,
+            }
+            if flop_fn is not None:
+                row["flops"] = flop_fn(lyr, inputs, outputs)
+            rows.append(row)
+        return hook
+
+    for name, sub in net.named_sublayers(include_self=True):
+        if not list(sub.children()):  # leaves only, like the reference table
+            hooks.append(sub.register_forward_post_hook(mk_hook(name, sub)))
+    was_training = net.training
+    net.eval()
+    try:
+        net(*args)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    return rows
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """hapi/model_summary.py:summary parity: per-layer table + totals."""
+    args = _run_forward(net, input_size, input, dtypes)
+    rows = _collect(net, args)
+    total = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters()
+                    if not p.stop_gradient)
+    width = max([len(r["name"]) for r in rows] + [20])
+    lines = ["-" * (width + 40),
+             "%-*s %-20s %12s" % (width, "Layer (type)", "Output Shape",
+                                  "Param #"),
+             "=" * (width + 40)]
+    for r in rows:
+        lines.append("%-*s %-20s %12s" % (
+            width, r["name"], r["output_shape"], "{:,}".format(r["params"])))
+    lines += ["=" * (width + 40),
+              "Total params: {:,}".format(total),
+              "Trainable params: {:,}".format(trainable),
+              "Non-trainable params: {:,}".format(total - trainable),
+              "-" * (width + 40)]
+    print("\n".join(lines))
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def _layer_flops(layer: Layer, inputs, outputs) -> int:
+    """Multiply-accumulate based estimate (dynamic_flops.py count_* rules;
+    one MAC = 2 ops is NOT applied — the reference reports MACs too)."""
+    from ..nn import layer as L
+
+    out_shape = _shape_of(outputs)
+    n_out = int(np.prod(out_shape)) if out_shape else 0
+    cls = type(layer).__name__
+    if isinstance(layer, L.conv._ConvNd):
+        k = int(np.prod(layer._kernel_size)) * layer._in_channels \
+            // layer._groups
+        return n_out * k
+    if cls == "Linear":
+        return n_out * int(layer.weight.shape[0])
+    if "Norm" in cls:
+        return 2 * n_out
+    if cls in ("ReLU", "ReLU6", "LeakyReLU", "PReLU", "Sigmoid", "Tanh",
+               "GELU", "Softmax"):
+        return n_out
+    if cls in ("AvgPool2D", "MaxPool2D", "AdaptiveAvgPool2D",
+               "AdaptiveMaxPool2D"):
+        return n_out
+    if cls == "Embedding":
+        return 0
+    return 0
+
+
+def flops(net: Layer, input_size=None, dtypes=None, input=None,
+          print_detail: bool = False) -> int:
+    """hapi/dynamic_flops.py:flops parity: total multiply-accumulates of
+    one forward pass."""
+    args = _run_forward(net, input_size, input, dtypes)
+    rows = _collect(net, args, flop_fn=_layer_flops)
+    total = sum(r["flops"] for r in rows)
+    if print_detail:
+        for r in rows:
+            print("%-40s %-20s %15s" % (r["name"], r["output_shape"],
+                                        "{:,}".format(r["flops"])))
+        print("Total FLOPs: {:,}".format(total))
+    return int(total)

@@ -18,7 +18,10 @@ import numpy as np
 from ...core.errors import InvalidArgumentError
 from ...io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100"]
+__all__ = [
+    "MNIST", "FashionMNIST", "Cifar10", "Cifar100", "Flowers", "VOC2012",
+    "DatasetFolder", "ImageFolder",
+]
 
 
 def _no_download(name: str):
@@ -149,3 +152,8 @@ class Cifar100(_CifarBase):
     _train_members = ("train",)
     _test_members = ("test",)
     _label_key = b"fine_labels"
+
+
+from .flowers import Flowers  # noqa: E402,F401
+from .folder import DatasetFolder, ImageFolder  # noqa: E402,F401
+from .voc2012 import VOC2012  # noqa: E402,F401

@@ -21,7 +21,9 @@ from jax import lax
 
 from ..core.errors import InvalidArgumentError
 
-__all__ = ["box_iou", "nms", "box_coder", "yolo_box", "roi_align"]
+__all__ = ["box_iou", "nms", "box_coder", "yolo_box", "roi_align",
+           "deform_conv2d", "DeformConv2D", "read_file", "decode_jpeg",
+           "yolo_loss"]
 
 
 def box_iou(boxes1, boxes2):
@@ -211,3 +213,284 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
         return samp.reshape(c, oh, s, ow, s).mean(axis=(2, 4))
 
     return jax.vmap(per_roi)(batch_idx, ys, xs)
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+def _deform_conv2d_raw(x, offset, weight, bias, mask, stride=1, padding=0,
+                       dilation=1, deformable_groups=1, groups=1):
+    """Deformable conv v1/v2 as bilinear gather + grouped einsum.
+
+    The reference lowers to the custom ``deformable_conv`` CUDA kernel
+    (``operators/deformable_conv_op.cu``); here the sampling grid is dense
+    algebra the XLA fuser handles, and the contraction is an MXU einsum.
+    x [N,C,H,W]; offset [N, 2*dg*kH*kW, Ho, Wo] as (dy,dx) pairs per tap;
+    mask [N, dg*kH*kW, Ho, Wo] or None (v1).
+    """
+    x = jnp.asarray(x)
+    N, C, H, W = x.shape
+    Cout, Cpg, kH, kW = weight.shape
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    dg = deformable_groups
+    K = kH * kW
+    Ho = (H + 2 * ph - (dh * (kH - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kW - 1) + 1)) // sw + 1
+    if C % dg:
+        raise InvalidArgumentError(
+            "channels %d not divisible by deformable_groups %d" % (C, dg))
+    if C % groups:
+        raise InvalidArgumentError(
+            "channels %d not divisible by groups %d" % (C, groups))
+
+    off = offset.reshape(N, dg, K, 2, Ho, Wo)
+    ky = (jnp.arange(kH) * dh).repeat(kW)          # [K]
+    kx = jnp.tile(jnp.arange(kW) * dw, kH)         # [K]
+    oy = jnp.arange(Ho) * sh - ph                  # [Ho]
+    ox = jnp.arange(Wo) * sw - pw                  # [Wo]
+    # sampling positions [N, dg, K, Ho, Wo]
+    py = ky[None, None, :, None, None] + oy[None, None, None, :, None] \
+        + off[:, :, :, 0]
+    px = kx[None, None, :, None, None] + ox[None, None, None, None, :] \
+        + off[:, :, :, 1]
+
+    Cg = C // dg
+    xg = x.reshape(N, dg, Cg, H * W)
+
+    def corner(iy, ix):
+        valid = (iy >= 0) & (iy < H) & (ix >= 0) & (ix < W)
+        idx = (jnp.clip(iy, 0, H - 1) * W
+               + jnp.clip(ix, 0, W - 1)).reshape(N, dg, 1, -1)
+        v = jnp.take_along_axis(xg, idx, axis=3)   # [N,dg,Cg,K*Ho*Wo]
+        return v * valid.reshape(N, dg, 1, -1).astype(x.dtype)
+
+    y0 = jnp.floor(py).astype(jnp.int32)
+    x0 = jnp.floor(px).astype(jnp.int32)
+    wy = (py - y0).astype(x.dtype)
+    wx = (px - x0).astype(x.dtype)
+    wyf = wy.reshape(N, dg, 1, -1)
+    wxf = wx.reshape(N, dg, 1, -1)
+    sampled = (corner(y0, x0) * (1 - wyf) * (1 - wxf)
+               + corner(y0, x0 + 1) * (1 - wyf) * wxf
+               + corner(y0 + 1, x0) * wyf * (1 - wxf)
+               + corner(y0 + 1, x0 + 1) * wyf * wxf)
+    sampled = sampled.reshape(N, dg, Cg, K, Ho, Wo)
+    if mask is not None:
+        sampled = sampled * mask.reshape(N, dg, 1, K, Ho, Wo).astype(x.dtype)
+    sampled = sampled.reshape(N, groups, C // groups, K, Ho, Wo)
+    wg = weight.reshape(groups, Cout // groups, Cpg, K)
+    out = jnp.einsum("ngckhw,gock->ngohw", sampled, wg,
+                     preferred_element_type=x.dtype)
+    out = out.reshape(N, Cout, Ho, Wo)
+    if bias is not None:
+        out = out + bias.reshape(1, Cout, 1, 1)
+    return out
+
+
+from ..framework.dispatch import make_op as _make_op  # noqa: E402
+from ..nn.layer.layers import Layer as _Layer  # noqa: E402
+
+deform_conv2d = _make_op(_deform_conv2d_raw, op_name="deform_conv2d")
+
+
+def read_file(filename, name=None):
+    """vision/ops.py:810 parity: file bytes as a uint8 tensor (host op)."""
+    from ..framework.tensor import Tensor
+
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)),
+                  stop_gradient=True)
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None):
+    """vision/ops.py:855 parity: JPEG bytes → CHW uint8 tensor (host op,
+    PIL-backed; the reference uses nvjpeg)."""
+    import io as _io
+
+    from PIL import Image
+
+    from ..framework.tensor import Tensor
+
+    raw = bytes(np.asarray(x.value if hasattr(x, "value") else x,
+                           np.uint8).tobytes())
+    img = Image.open(_io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr), stop_gradient=True)
+
+
+class DeformConv2D(_Layer):
+    """vision/ops.py:621 parity — layer wrapper over deform_conv2d."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+
+        kh, kw = _pair(kernel_size)
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._deformable_groups = deformable_groups
+        self._groups = groups
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, kh, kw],
+            attr=weight_attr, default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, mask, stride=self._stride,
+            padding=self._padding, dilation=self._dilation,
+            deformable_groups=self._deformable_groups, groups=self._groups)
+
+
+def _bce_logits(logit, target):
+    # numerically-stable sigmoid cross entropy
+    return jnp.maximum(logit, 0) - logit * target \
+        + jnp.log1p(jnp.exp(-jnp.abs(logit)))
+
+
+def _yolo_loss_raw(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
+                   class_num, ignore_thresh, downsample_ratio,
+                   use_label_smooth=True, scale_x_y=1.0):
+    """YOLOv3 loss (reference vision/ops.py:35 / yolov3_loss_op semantics).
+
+    x [N, A*(5+C), H, W]; gt_box [N, B, 4] normalized cx/cy/w/h;
+    gt_label [N, B] int; gt_score [N, B] or None (mixup weights).
+    Per-image loss [N].  Static-shape: padded gt slots (w or h == 0) are
+    masked, target scatter uses one-hot algebra instead of dynamic writes.
+    """
+    x = jnp.asarray(x)
+    N, _, H, W = x.shape
+    A = len(anchor_mask)
+    C = int(class_num)
+    x = x.reshape(N, A, 5 + C, H, W)
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)  # [Atot, 2]
+    an_sel = an_all[jnp.asarray(anchor_mask)]                  # [A, 2]
+    in_w = float(downsample_ratio * W)
+    in_h = float(downsample_ratio * H)
+
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    B = gt_box.shape[1]
+    gw, gh = gt_box[..., 2], gt_box[..., 3]
+    valid = (gw > 1e-8) & (gh > 1e-8)                          # [N, B]
+    score = (jnp.asarray(gt_score, jnp.float32) if gt_score is not None
+             else jnp.ones((N, B), jnp.float32))
+
+    # --- responsible anchor per gt: shape-only IoU over ALL anchors ------
+    bw = gw[..., None] * in_w                                  # [N,B,1]
+    bh = gh[..., None] * in_h
+    inter = jnp.minimum(bw, an_all[None, None, :, 0]) \
+        * jnp.minimum(bh, an_all[None, None, :, 1])
+    union = bw * bh + an_all[None, None, :, 0] * an_all[None, None, :, 1] \
+        - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N,B]
+    mask_arr = jnp.asarray(anchor_mask)
+    on_scale = (best[..., None] == mask_arr[None, None, :])    # [N,B,A]
+    resp = valid[..., None] & on_scale                         # [N,B,A]
+    a_local = jnp.argmax(on_scale, axis=-1)                    # [N,B]
+
+    # --- cell assignment + regression targets ----------------------------
+    gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    t_x = gt_box[..., 0] * W - gi
+    t_y = gt_box[..., 1] * H - gj
+    p_sel = an_sel[a_local]                                    # [N,B,2]
+    t_w = jnp.log(jnp.maximum(gw * in_w, 1e-9) / p_sel[..., 0])
+    t_h = jnp.log(jnp.maximum(gh * in_h, 1e-9) / p_sel[..., 1])
+    box_w = 2.0 - gw * gh                                      # [N,B]
+
+    # one-hot scatter: cell[n,b] -> [A,H,W] membership of each gt
+    cell = (jax.nn.one_hot(gj, H, dtype=jnp.float32)[:, :, :, None]
+            * jax.nn.one_hot(gi, W, dtype=jnp.float32)[:, :, None, :])
+    sel = resp.astype(jnp.float32)[..., None, None] * cell[:, :, None]
+    # sel: [N, B, A, H, W] — 1 where gt b owns anchor a at cell (gj, gi)
+
+    def gather_pred(ch):
+        # prediction value at each gt's own cell/anchor: [N, B]
+        return jnp.einsum("nbahw,nahw->nb", sel, x[:, :, ch])
+
+    w_pos = box_w * score                                       # [N,B]
+    sxy = float(scale_x_y)
+    px_l, py_l = gather_pred(0), gather_pred(1)
+    if sxy != 1.0:
+        # scale_x_y widens the sigmoid: bx = sxy*sig(tx) - 0.5*(sxy-1)
+        tx_eff = (t_x + 0.5 * (sxy - 1.0)) / sxy
+        ty_eff = (t_y + 0.5 * (sxy - 1.0)) / sxy
+    else:
+        tx_eff, ty_eff = t_x, t_y
+    is_resp = resp.any(-1).astype(jnp.float32)                  # [N,B]
+    loss_xy = (_bce_logits(px_l, tx_eff) + _bce_logits(py_l, ty_eff))
+    loss_wh = (jnp.abs(gather_pred(2) - t_w) + jnp.abs(gather_pred(3) - t_h))
+    loss_box = ((loss_xy + loss_wh) * w_pos * is_resp).sum(-1)  # [N]
+
+    # --- classification ---------------------------------------------------
+    smooth_pos = 1.0 - 1.0 / C if (use_label_smooth and C > 1) else 1.0
+    smooth_neg = 1.0 / C if (use_label_smooth and C > 1) else 0.0
+    cls_t = jax.nn.one_hot(jnp.asarray(gt_label, jnp.int32), C,
+                           dtype=jnp.float32)
+    cls_t = cls_t * (smooth_pos - smooth_neg) + smooth_neg      # [N,B,C]
+    cls_logit = jnp.einsum("nbahw,nachw->nbc", sel, x[:, :, 5:])
+    loss_cls = (_bce_logits(cls_logit, cls_t).sum(-1)
+                * score * is_resp).sum(-1)
+
+    # --- objectness -------------------------------------------------------
+    # predicted boxes for the negative/ignore sweep
+    cx = (jnp.arange(W, dtype=jnp.float32) + 0.0)[None, None, None, :]
+    cy = (jnp.arange(H, dtype=jnp.float32) + 0.0)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (sxy * sig(x[:, :, 0]) - 0.5 * (sxy - 1.0) + cx) / W
+    by = (sxy * sig(x[:, :, 1]) - 0.5 * (sxy - 1.0) + cy) / H
+    pw = an_sel[:, 0][None, :, None, None] * jnp.exp(x[:, :, 2]) / in_w
+    ph = an_sel[:, 1][None, :, None, None] * jnp.exp(x[:, :, 3]) / in_h
+
+    def corners(cxc, cyc, ww, hh):
+        return cxc - ww / 2, cyc - hh / 2, cxc + ww / 2, cyc + hh / 2
+
+    px0, py0, px1, py1 = corners(bx, by, pw, ph)                # [N,A,H,W]
+    gx0, gy0, gx1, gy1 = corners(gt_box[..., 0], gt_box[..., 1], gw, gh)
+    ix0 = jnp.maximum(px0[:, None], gx0[:, :, None, None, None])
+    iy0 = jnp.maximum(py0[:, None], gy0[:, :, None, None, None])
+    ix1 = jnp.minimum(px1[:, None], gx1[:, :, None, None, None])
+    iy1 = jnp.minimum(py1[:, None], gy1[:, :, None, None, None])
+    inter2 = jnp.clip(ix1 - ix0, 0) * jnp.clip(iy1 - iy0, 0)    # [N,B,A,H,W]
+    area_p = (px1 - px0) * (py1 - py0)
+    area_g = ((gx1 - gx0) * (gy1 - gy0))[:, :, None, None, None]
+    iou = inter2 / jnp.maximum(area_p[:, None] + area_g - inter2, 1e-9)
+    iou = jnp.where(valid[:, :, None, None, None], iou, 0.0)
+    ignore = (iou.max(axis=1) > ignore_thresh)                  # [N,A,H,W]
+
+    obj_t = jnp.clip(jnp.einsum("nbahw,nb->nahw", sel, score), 0.0, 1.0)
+    obj_pos = jnp.clip(sel.sum(1), 0.0, 1.0)                    # [N,A,H,W]
+    obj_l = _bce_logits(x[:, :, 4], obj_t)
+    keep = obj_pos + (1.0 - obj_pos) * (1.0 - ignore.astype(jnp.float32))
+    loss_obj = (obj_l * keep).sum((1, 2, 3))
+
+    return loss_box + loss_cls + loss_obj
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """vision/ops.py:35 parity — see :func:`_yolo_loss_raw`."""
+    return _yolo_loss_op(
+        x, gt_box, gt_label, gt_score, list(anchors), list(anchor_mask),
+        int(class_num), float(ignore_thresh), int(downsample_ratio),
+        use_label_smooth=bool(use_label_smooth), scale_x_y=float(scale_x_y))
+
+
+_yolo_loss_op = _make_op(_yolo_loss_raw, op_name="yolo_loss")

@@ -1,0 +1,125 @@
+"""deform_conv2d: degenerate-case equivalence with standard conv, a
+per-pixel python oracle for real offsets, the v2 modulation mask, grads,
+and the host io ops (read_file/decode_jpeg)."""
+import io
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision.ops import (DeformConv2D, decode_jpeg, deform_conv2d,
+                                   read_file)
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def _oracle(x, offset, weight, bias, mask, stride, padding, dilation, dg):
+    """Naive per-output-pixel bilinear sampling reference."""
+    N, C, H, W = x.shape
+    Cout, Cpg, kH, kW = weight.shape
+    K = kH * kW
+    Ho = (H + 2 * padding - (dilation * (kH - 1) + 1)) // stride + 1
+    Wo = (W + 2 * padding - (dilation * (kW - 1) + 1)) // stride + 1
+    off = offset.reshape(N, dg, K, 2, Ho, Wo)
+    msk = (mask.reshape(N, dg, K, Ho, Wo) if mask is not None
+           else np.ones((N, dg, K, Ho, Wo), x.dtype))
+    Cg = C // dg
+
+    def sample(n, c, py, px):
+        y0, x0 = int(np.floor(py)), int(np.floor(px))
+        wy, wx = py - y0, px - x0
+        v = 0.0
+        for (yy, xx, w) in [(y0, x0, (1 - wy) * (1 - wx)),
+                            (y0, x0 + 1, (1 - wy) * wx),
+                            (y0 + 1, x0, wy * (1 - wx)),
+                            (y0 + 1, x0 + 1, wy * wx)]:
+            if 0 <= yy < H and 0 <= xx < W:
+                v += w * x[n, c, yy, xx]
+        return v
+
+    out = np.zeros((N, Cout, Ho, Wo), np.float64)
+    for n in range(N):
+        for o in range(Cout):
+            for ho in range(Ho):
+                for wo in range(Wo):
+                    acc = 0.0
+                    for ci in range(Cpg):
+                        g = ci // Cg  # deformable group of this channel
+                        for ky in range(kH):
+                            for kx in range(kW):
+                                k = ky * kW + kx
+                                py = (ho * stride - padding + ky * dilation
+                                      + off[n, g, k, 0, ho, wo])
+                                px = (wo * stride - padding + kx * dilation
+                                      + off[n, g, k, 1, ho, wo])
+                                acc += (weight[o, ci, ky, kx]
+                                        * msk[n, g, k, ho, wo]
+                                        * sample(n, ci, py, px))
+                    out[n, o, ho, wo] = acc + (bias[o] if bias is not None
+                                               else 0.0)
+    return out.astype(np.float32)
+
+
+def test_zero_offset_equals_conv2d(rng):
+    x = rng.randn(2, 4, 8, 8).astype(np.float32)
+    layer = DeformConv2D(4, 6, 3, padding=1)
+    off = np.zeros((2, 18, 8, 8), np.float32)
+    out = layer(pt.to_tensor(x), pt.to_tensor(off))
+    ref = F.conv2d(pt.to_tensor(x), layer.weight, layer.bias, padding=1)
+    np.testing.assert_allclose(np.asarray(out.value), np.asarray(ref.value),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_matches_python_oracle(rng):
+    N, C, H, W, Cout, k, dg = 1, 4, 6, 6, 3, 3, 2
+    x = rng.randn(N, C, H, W).astype(np.float32)
+    w = rng.randn(Cout, C, k, k).astype(np.float32)
+    b = rng.randn(Cout).astype(np.float32)
+    off = (rng.randn(N, 2 * dg * k * k, 6, 6) * 0.7).astype(np.float32)
+    msk = rng.rand(N, dg * k * k, 6, 6).astype(np.float32)
+    out = deform_conv2d(pt.to_tensor(x), pt.to_tensor(off), pt.to_tensor(w),
+                        pt.to_tensor(b), pt.to_tensor(msk), stride=1,
+                        padding=1, deformable_groups=dg)
+    want = _oracle(x, off, w, b, msk, 1, 1, 1, dg)
+    np.testing.assert_allclose(np.asarray(out.value), want,
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_stride_dilation_shapes(rng):
+    x = rng.randn(1, 2, 11, 11).astype(np.float32)
+    w = rng.randn(4, 2, 3, 3).astype(np.float32)
+    off = np.zeros((1, 18, 4, 4), np.float32)
+    out = deform_conv2d(pt.to_tensor(x), pt.to_tensor(off), pt.to_tensor(w),
+                        None, None, stride=2, padding=0, dilation=2)
+    assert tuple(out.shape) == (1, 4, 4, 4)
+
+
+def test_grads_flow(rng):
+    x = pt.to_tensor(rng.randn(1, 2, 5, 5).astype(np.float32))
+    x.stop_gradient = False
+    off = pt.to_tensor((rng.randn(1, 8, 6, 6) * 0.3).astype(np.float32))
+    off.stop_gradient = False
+    layer = DeformConv2D(2, 3, 2, padding=1)
+    out = layer(x, off)
+    (out * out).mean().backward()
+    for t in (layer.weight, layer.bias, x, off):
+        g = np.asarray(t.grad.value)
+        assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_read_file_decode_jpeg(tmp_path, rng):
+    from PIL import Image
+
+    arr = rng.randint(0, 255, (6, 7, 3), dtype=np.uint8)
+    path = str(tmp_path / "img.jpg")
+    Image.fromarray(arr).save(path, quality=95)
+    raw = read_file(path)
+    assert raw.dtype == np.uint8 and raw.shape[0] > 0
+    img = decode_jpeg(raw)
+    assert tuple(img.shape) == (3, 6, 7)
+    gray = decode_jpeg(raw, mode="gray")
+    assert tuple(gray.shape) == (1, 6, 7)

@@ -166,3 +166,17 @@ def test_progbar_logs(rng, capsys):
     model.fit((xs, ys), batch_size=16, epochs=1, verbose=2, log_freq=1)
     out = capsys.readouterr().out
     assert "Epoch 1/1" in out and "loss" in out
+
+
+def test_summary_and_flops():
+    """paddle.summary / paddle.flops (hapi model_summary/dynamic_flops)."""
+    from paddle_tpu.vision.models import LeNet
+
+    net = LeNet()
+    info = pt.summary(net, (1, 1, 28, 28))
+    assert info["total_params"] == sum(
+        int(np.prod(p.shape)) for p in net.parameters())
+    assert info["trainable_params"] == info["total_params"]
+    f = pt.flops(net, (1, 1, 28, 28))
+    # conv1: 28*28*6*25 + conv2: 10*10*16*150 + fc MACs ≈ 3.5e5
+    assert 3e5 < f < 4e5, f

@@ -1,0 +1,195 @@
+"""Flowers / VOC2012 / DatasetFolder / ImageFolder against synthetic
+archives in the standard on-disk formats."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.vision.datasets import (DatasetFolder, Flowers, ImageFolder,
+                                        VOC2012)
+
+
+def _jpg_bytes(rng, w=8, h=8):
+    from PIL import Image
+
+    arr = rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _png_bytes(arr):
+    from PIL import Image
+
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _add_member(tar, name, payload: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(payload)
+    tar.addfile(info, io.BytesIO(payload))
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def test_flowers(tmp_path, rng):
+    import scipy.io as scio
+
+    n = 6
+    data_file = str(tmp_path / "102flowers.tgz")
+    with tarfile.open(data_file, "w:gz") as tar:
+        for i in range(1, n + 1):
+            _add_member(tar, "jpg/image_%05d.jpg" % i, _jpg_bytes(rng))
+    label_file = str(tmp_path / "imagelabels.mat")
+    setid_file = str(tmp_path / "setid.mat")
+    labels = rng.randint(1, 103, (1, n))
+    scio.savemat(label_file, {"labels": labels})
+    scio.savemat(setid_file, {"tstid": np.array([[1, 2, 3, 4]]),
+                              "trnid": np.array([[5]]),
+                              "valid": np.array([[6]])})
+    train = Flowers(data_file, label_file, setid_file, mode="train")
+    assert len(train) == 4
+    img, label = train[0]
+    assert img.shape == (8, 8, 3) and img.dtype == np.uint8
+    assert label.shape == (1,) and label[0] == labels[0, 0]
+    test = Flowers(data_file, label_file, setid_file, mode="test")
+    assert len(test) == 1
+    _, tl = test[0]
+    assert tl[0] == labels[0, 4]
+    with pytest.raises(InvalidArgumentError):
+        Flowers(data_file, label_file, setid_file, mode="nope")
+    with pytest.raises(InvalidArgumentError):
+        Flowers(None)
+
+
+def test_voc2012(tmp_path, rng):
+    names = ["2007_000001", "2007_000002", "2007_000003"]
+    data_file = str(tmp_path / "VOCtrainval.tar")
+    masks = {}
+    with tarfile.open(data_file, "w") as tar:
+        _add_member(
+            tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+            ("\n".join(names[:2]) + "\n").encode())
+        _add_member(
+            tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+            (names[2] + "\n").encode())
+        for nm in names:
+            _add_member(tar, "VOCdevkit/VOC2012/JPEGImages/%s.jpg" % nm,
+                        _jpg_bytes(rng))
+            mask = rng.randint(0, 21, (8, 8), dtype=np.uint8)
+            masks[nm] = mask
+            _add_member(tar,
+                        "VOCdevkit/VOC2012/SegmentationClass/%s.png" % nm,
+                        _png_bytes(mask))
+    train = VOC2012(data_file, mode="train")
+    assert len(train) == 2
+    img, mask = train[1]
+    assert img.shape == (8, 8, 3)
+    np.testing.assert_array_equal(mask, masks[names[1]])
+    val = VOC2012(data_file, mode="valid")
+    assert len(val) == 1
+    with pytest.raises(InvalidArgumentError):
+        VOC2012(None)
+
+
+def test_dataset_folder(tmp_path, rng):
+    for cls in ("cat", "dog"):
+        d = tmp_path / "root" / cls
+        os.makedirs(str(d))
+        for i in range(3):
+            np.save(str(d / ("%d.npy" % i)),
+                    rng.randint(0, 255, (4, 4, 3), dtype=np.uint8))
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (4, 4, 3) and label == 0
+    assert ds.targets == [0, 0, 0, 1, 1, 1]
+    # transform applied
+    ds2 = DatasetFolder(str(tmp_path / "root"),
+                        transform=lambda x: x.astype("float32") / 255.0)
+    img2, _ = ds2[0]
+    assert img2.dtype == np.float32 and img2.max() <= 1.0
+    # empty dir: no class subdirs
+    os.makedirs(str(tmp_path / "empty"))
+    with pytest.raises(InvalidArgumentError):
+        DatasetFolder(str(tmp_path / "empty"))
+    # class dirs with no decodable files
+    os.makedirs(str(tmp_path / "junk" / "cls"))
+    (tmp_path / "junk" / "cls" / "x.txt").write_text("nope")
+    with pytest.raises(InvalidArgumentError):
+        DatasetFolder(str(tmp_path / "junk"))
+
+
+def test_image_folder(tmp_path, rng):
+    d = tmp_path / "imgs" / "sub"
+    os.makedirs(str(d))
+    np.save(str(tmp_path / "imgs" / "a.npy"),
+            rng.randint(0, 255, (4, 4, 3), dtype=np.uint8))
+    np.save(str(d / "b.npy"), rng.randint(0, 255, (4, 4, 3), dtype=np.uint8))
+    (tmp_path / "imgs" / "notes.txt").write_text("skip me")
+    ds = ImageFolder(str(tmp_path / "imgs"))
+    assert len(ds) == 2  # recursive, extension-filtered
+    (sample,) = ds[0]
+    assert sample.shape == (4, 4, 3)
+
+
+def test_folder_feeds_dataloader(tmp_path, rng):
+    from paddle_tpu.io import DataLoader
+
+    for cls in ("a", "b"):
+        d = tmp_path / "r" / cls
+        os.makedirs(str(d))
+        for i in range(4):
+            np.save(str(d / ("%d.npy" % i)),
+                    rng.rand(3, 3).astype("float32"))
+    ds = DatasetFolder(str(tmp_path / "r"))
+    batches = list(DataLoader(ds, batch_size=4, shuffle=False))
+    assert len(batches) == 2
+    xb, yb = batches[0]
+    assert tuple(xb.shape) == (4, 3, 3) and tuple(yb.shape) == (4,)
+
+
+def test_flowers_multiworker_reads(tmp_path, rng):
+    """Forked DataLoader workers must not corrupt tar reads (per-pid fds)."""
+    import scipy.io as scio
+
+    from paddle_tpu.io import DataLoader
+
+    n = 8
+    data_file = str(tmp_path / "fl.tgz")
+    arrs = {}
+    with tarfile.open(data_file, "w:gz") as tar:
+        for i in range(1, n + 1):
+            payload = _jpg_bytes(rng)
+            arrs[i] = payload
+            _add_member(tar, "jpg/image_%05d.jpg" % i, payload)
+    scio.savemat(str(tmp_path / "il.mat"),
+                 {"labels": np.arange(1, n + 1)[None]})
+    scio.savemat(str(tmp_path / "si.mat"),
+                 {"tstid": np.arange(1, n + 1)[None],
+                  "trnid": np.array([[1]]), "valid": np.array([[1]])})
+    ds = Flowers(data_file, str(tmp_path / "il.mat"),
+                 str(tmp_path / "si.mat"), mode="train")
+    got = []
+    for img, label in DataLoader(ds, batch_size=2, shuffle=False,
+                                 num_workers=2):
+        assert tuple(img.shape)[1:] == (8, 8, 3)
+        got.extend(np.asarray(label.value).ravel().tolist())
+    assert sorted(got) == list(range(1, n + 1))
+
+
+def test_summary_on_leaf_root():
+    """flops()/summary() must instrument a model that is itself a leaf."""
+    import paddle_tpu as pt
+
+    f = pt.flops(pt.nn.Linear(4, 8), (1, 4))
+    assert f == 4 * 8, f

@@ -1,0 +1,149 @@
+"""yolo_loss properties: a perfect prediction scores (near) minimal loss,
+worse predictions score higher, ignore_thresh suppresses near-hit
+objectness, padded gt slots contribute nothing, grads flow."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision.ops import yolo_loss
+
+ANCHORS = [10, 13, 16, 30, 33, 23]
+MASK = [0, 1, 2]
+
+
+def _perfect_logits(gt_box, gt_label, H, W, class_num, downsample):
+    """Build x whose decoded prediction reproduces the gt exactly."""
+    N, B, _ = gt_box.shape
+    A = len(MASK)
+    an = np.array(ANCHORS, np.float32).reshape(-1, 2)
+    x = np.zeros((N, A, 5 + class_num, H, W), np.float32)
+    x[:, :, 4] = -8.0  # objectness ~0 everywhere
+    in_w, in_h = downsample * W, downsample * H
+    for n in range(N):
+        for b in range(B):
+            gx, gy, gw, gh = gt_box[n, b]
+            if gw <= 0:
+                continue
+            bw, bh = gw * in_w, gh * in_h
+            ious = [min(bw, aw) * min(bh, ah)
+                    / (bw * bh + aw * ah - min(bw, aw) * min(bh, ah))
+                    for aw, ah in an]
+            a = int(np.argmax(ious))
+            gi, gj = int(gx * W), int(gy * H)
+            frac_x, frac_y = gx * W - gi, gy * H - gj
+            eps = 1e-6
+
+            def logit(p):
+                p = min(max(p, eps), 1 - eps)
+                return np.log(p / (1 - p))
+
+            x[n, a, 0, gj, gi] = logit(frac_x)
+            x[n, a, 1, gj, gi] = logit(frac_y)
+            x[n, a, 2, gj, gi] = np.log(bw / an[a, 0])
+            x[n, a, 3, gj, gi] = np.log(bh / an[a, 1])
+            x[n, a, 4, gj, gi] = 8.0
+            x[n, a, 5 + gt_label[n, b], gj, gi] = 8.0
+            x[n, a, 5:, gj, gi][np.arange(class_num) != gt_label[n, b]] = -8.0
+    return x.reshape(N, A * (5 + class_num), H, W)
+
+
+@pytest.fixture
+def setup():
+    H = W = 4
+    C, ds = 3, 32
+    # cell-aligned centers: sigmoid-CE against a soft fractional target has
+    # an entropy floor, so "perfect" means integer cell fractions
+    gt_box = np.array([[[0.50, 0.25, 0.28, 0.24], [0, 0, 0, 0]]], np.float32)
+    gt_label = np.array([[1, 0]], np.int32)
+    return H, W, C, ds, gt_box, gt_label
+
+
+def test_perfect_prediction_beats_noise(setup):
+    H, W, C, ds, gt_box, gt_label = setup
+    good = _perfect_logits(gt_box, gt_label, H, W, C, ds)
+    rng = np.random.RandomState(0)
+    bad = good + rng.randn(*good.shape).astype(np.float32) * 2.0
+    args = dict(anchors=ANCHORS, anchor_mask=MASK, class_num=C,
+                ignore_thresh=0.7, downsample_ratio=ds,
+                use_label_smooth=False)
+    l_good = float(yolo_loss(pt.to_tensor(good), pt.to_tensor(gt_box),
+                             pt.to_tensor(gt_label), **args).value.sum())
+    l_bad = float(yolo_loss(pt.to_tensor(bad), pt.to_tensor(gt_box),
+                            pt.to_tensor(gt_label), **args).value.sum())
+    assert l_good < 0.1, l_good
+    assert l_bad > l_good * 10
+
+
+def test_padded_slots_ignored(setup):
+    H, W, C, ds, gt_box, gt_label = setup
+    x = _perfect_logits(gt_box, gt_label, H, W, C, ds)
+    args = dict(anchors=ANCHORS, anchor_mask=MASK, class_num=C,
+                ignore_thresh=0.7, downsample_ratio=ds,
+                use_label_smooth=False)
+    l1 = float(yolo_loss(pt.to_tensor(x), pt.to_tensor(gt_box),
+                         pt.to_tensor(gt_label), **args).value.sum())
+    more_pad = np.concatenate([gt_box, np.zeros((1, 3, 4), np.float32)], 1)
+    more_lab = np.concatenate([gt_label, np.zeros((1, 3), np.int32)], 1)
+    l2 = float(yolo_loss(pt.to_tensor(x), pt.to_tensor(more_pad),
+                         pt.to_tensor(more_lab), **args).value.sum())
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_ignore_thresh_suppresses_near_hits(setup):
+    """A confident box overlapping gt above the threshold must not be
+    punished for objectness; the same box with a high threshold is."""
+    H, W, C, ds, gt_box, gt_label = setup
+    x = _perfect_logits(gt_box, gt_label, H, W, C, ds)
+    x = x.reshape(1, 3, 5 + C, H, W)
+    # anchor 0 at the NEIGHBOR cell (gj=1, gi=1) — not the gt's positive
+    # slot — with saturated offsets decoding (almost) onto the gt box
+    x[0, 0, 0, 1, 1] = 8.0    # sig→1: bx = (1+1)/4 = gt x
+    x[0, 0, 1, 1, 1] = -8.0   # sig→0: by = (0+1)/4 = gt y
+    x[0, 0, 2, 1, 1] = np.log(gt_box[0, 0, 2] * ds * W / ANCHORS[0])
+    x[0, 0, 3, 1, 1] = np.log(gt_box[0, 0, 3] * ds * H / ANCHORS[1])
+    x[0, 0, 4, 1, 1] = 6.0    # confident objectness
+    x = x.reshape(1, 3 * (5 + C), H, W)
+    args = dict(anchors=ANCHORS, anchor_mask=MASK, class_num=C,
+                downsample_ratio=ds, use_label_smooth=False)
+    l_lenient = float(yolo_loss(pt.to_tensor(x), pt.to_tensor(gt_box),
+                                pt.to_tensor(gt_label), ignore_thresh=0.3,
+                                **args).value.sum())
+    l_strict = float(yolo_loss(pt.to_tensor(x), pt.to_tensor(gt_box),
+                               pt.to_tensor(gt_label), ignore_thresh=0.999,
+                               **args).value.sum())
+    assert l_strict > l_lenient + 1.0, (l_strict, l_lenient)
+
+
+def test_output_shape_and_grads(setup):
+    H, W, C, ds, gt_box, gt_label = setup
+    rng = np.random.RandomState(1)
+    x = pt.to_tensor(rng.randn(2, 3 * (5 + C), H, W).astype(np.float32))
+    x.stop_gradient = False
+    gt2 = np.tile(gt_box, (2, 1, 1))
+    lab2 = np.tile(gt_label, (2, 1))
+    loss = yolo_loss(x, pt.to_tensor(gt2), pt.to_tensor(lab2),
+                     anchors=ANCHORS, anchor_mask=MASK, class_num=C,
+                     ignore_thresh=0.7, downsample_ratio=ds)
+    assert tuple(loss.shape) == (2,)
+    loss.sum().backward()
+    g = np.asarray(x.grad.value)
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+def test_gt_score_scales_positive_loss(setup):
+    H, W, C, ds, gt_box, gt_label = setup
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 3 * (5 + C), H, W).astype(np.float32)
+    args = dict(anchors=ANCHORS, anchor_mask=MASK, class_num=C,
+                ignore_thresh=0.7, downsample_ratio=ds,
+                use_label_smooth=False)
+    full = float(yolo_loss(pt.to_tensor(x), pt.to_tensor(gt_box),
+                           pt.to_tensor(gt_label),
+                           gt_score=pt.to_tensor(np.ones((1, 2), np.float32)),
+                           **args).value.sum())
+    half = float(yolo_loss(pt.to_tensor(x), pt.to_tensor(gt_box),
+                           pt.to_tensor(gt_label),
+                           gt_score=pt.to_tensor(
+                               np.full((1, 2), 0.5, np.float32)),
+                           **args).value.sum())
+    assert half < full
