@@ -93,7 +93,84 @@ def enable_static(*a, **k):
     )
 
 
-def in_dynamic_mode() -> bool:
+import builtins as _builtins  # noqa: E402
+
+def in_dynamic_mode() -> _builtins.bool:
     from .core.flags import flag as _flag
 
-    return bool(_flag("FLAGS_eager_mode"))
+    # _builtins.bool: the module-level `bool = bool_` dtype alias below
+    # shadows the builtin for every function defined in this module
+    return _builtins.bool(_flag("FLAGS_eager_mode"))
+
+from .core.device import CUDAPinnedPlace, NPUPlace  # noqa: E402,F401
+from .core import dtype as _dtype_mod  # noqa: E402
+import numpy as _np_mod  # noqa: E402
+# paddle.bool / paddle.dtype (data_type.py parity aliases): paddle.dtype is
+# the dtype *type* — np.dtype gives isinstance checks + dtype('float32')
+bool = _dtype_mod.bool_  # noqa: A001
+dtype = _np_mod.dtype
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions parity (delegates to numpy's print options,
+    which .numpy()/repr paths use)."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not _builtins.bool(sci_mode)
+    _np.set_printoptions(**kw)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """paddle.create_parameter parity (fluid layers.create_parameter)."""
+    from .nn.layer.layers import Layer
+
+    helper = Layer()
+    p = helper.create_parameter(shape, attr=attr, dtype=dtype,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """paddle.batch parity: wrap an instance reader into a batch reader."""
+    def batch_reader():
+        buf = []
+        for instance in reader():
+            buf.append(instance)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def check_shape(shape, op_name="", expected_shape_type=(list, tuple),
+                expected_element_type=(int,), expected_tensor_dtype=("int32", "int64")):
+    """data_feeder.py:142 parity: validate a shape argument's types."""
+    from .core.errors import InvalidArgumentError
+
+    if not isinstance(shape, expected_shape_type):
+        raise InvalidArgumentError(
+            "%s: shape must be %s, got %r" % (op_name, expected_shape_type,
+                                              type(shape)))
+    for item in shape:
+        if not isinstance(item, expected_element_type):
+            raise InvalidArgumentError(
+                "%s: shape element must be %s, got %r"
+                % (op_name, expected_element_type, type(item)))

@@ -151,3 +151,15 @@ def synchronize() -> None:
 
 def env_device_override() -> Optional[str]:
     return os.environ.get("PADDLE_TPU_DEVICE")
+
+
+def CUDAPinnedPlace(index: int = 0) -> Place:
+    """API parity: pinned host memory maps to the CPU backend on TPU hosts
+    (the prefetch path stages through ordinary host RAM + device_put)."""
+    return Place("cpu", index)
+
+
+def NPUPlace(index: int = 0) -> Place:
+    """API parity for the reference's Ascend backend: no NPU on this
+    platform; resolves to the accelerator if present, else CPU."""
+    return Place("tpu" if _backend_available("tpu") else "cpu", index)

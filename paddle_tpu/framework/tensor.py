@@ -381,3 +381,46 @@ def _tensor_unflatten(aux, children):
 
 jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
 jax.tree_util.register_pytree_node(Parameter, _tensor_flatten, _tensor_unflatten)
+
+
+def rebind_inplace(x: "Tensor", out: "Tensor") -> "Tensor":
+    """Shared machinery for ``*_`` inplace ops: rebind ``x``'s value and
+    tape linkage to ``out`` (same object identity, autograd continues
+    through the producing op).  Callers must pass an ``out`` computed from
+    a detached alias of ``x`` so the tape stays acyclic."""
+    x._value = out._value
+    x._node = out._node
+    x._leaf_idx = out._leaf_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def detached_alias(x: "Tensor") -> "Tensor":
+    """Alias of ``x`` carrying its tape linkage but a separate identity —
+    the safe input for an op whose result will be rebound onto ``x``."""
+    alias = Tensor(x._value, stop_gradient=x.stop_gradient)
+    alias._node = x._node
+    alias._leaf_idx = x._leaf_idx
+    return alias
+
+
+def make_inplace(base, name: str):
+    """Build a ``*_`` inplace variant of ``base`` (math_op_patch.py
+    semantics): guard leaves-requiring-grad, run the op on a detached
+    alias, rebind the result onto the argument."""
+    from ..core.errors import InvalidArgumentError
+
+    def fn(x, *args, **kwargs):
+        if not isinstance(x, Tensor):
+            raise InvalidArgumentError(
+                "%s is an inplace Tensor op; got %r" % (name, type(x)))
+        if x._node is None and not x.stop_gradient:
+            raise InvalidArgumentError(
+                "%s: a leaf Tensor that requires grad cannot be used in an "
+                "inplace operation (paddle parity)" % name)
+        return rebind_inplace(x, base(detached_alias(x), *args, **kwargs))
+
+    fn.__name__ = name
+    fn.__doc__ = "Inplace variant of %s (math_op_patch.py parity)." \
+        % base.__name__
+    return fn

@@ -15,16 +15,18 @@ from .layer.activation import (  # noqa: F401
 )
 from .layer.common import (  # noqa: F401
     AlphaDropout, Bilinear, CosineSimilarity, Dropout, Dropout2D, Dropout3D,
-    Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D, PixelShuffle,
-    Upsample, UpsamplingBilinear2D, UpsamplingNearest2D,
+    Embedding, Flatten, Identity, Linear, Pad1D, Pad2D, Pad3D,
+    PairwiseDistance, PixelShuffle, Unfold, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D,
 )
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
 from .layer.conv import (  # noqa: F401
     Conv1D, Conv1DTranspose, Conv2D, Conv2DTranspose, Conv3D, Conv3DTranspose,
 )
 from .layer.loss import (  # noqa: F401
-    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, HingeEmbeddingLoss, KLDivLoss,
-    L1Loss, MSELoss, MarginRankingLoss, NLLLoss, SmoothL1Loss,
+    BCELoss, BCEWithLogitsLoss, CrossEntropyLoss, CTCLoss, HingeEmbeddingLoss,
+    HSigmoidLoss, KLDivLoss, L1Loss, MSELoss, MarginRankingLoss, NLLLoss,
+    SmoothL1Loss,
 )
 from .layer.norm import (  # noqa: F401
     BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, GroupNorm, InstanceNorm1D,
@@ -36,6 +38,7 @@ from .layer.pooling import (  # noqa: F401
     AdaptiveMaxPool2D, AdaptiveMaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     MaxPool1D, MaxPool2D, MaxPool3D,
 )
+from .decode import BeamSearchDecoder, Decoder, dynamic_decode  # noqa: F401
 from .layer.rnn import (  # noqa: F401
     GRU, LSTM, RNN, BiRNN, GRUCell, LSTMCell, RNNCellBase, SimpleRNN,
     SimpleRNNCell,

@@ -1,0 +1,198 @@
+"""Seq2seq decoding: Decoder / BeamSearchDecoder / dynamic_decode
+(reference: python/paddle/fluid/layers/rnn.py:786 Decoder, :866
+BeamSearchDecoder, :1584 dynamic_decode, re-exported as paddle.nn.*).
+
+Generation is host-driven (data-dependent stop), so the decode loop is an
+eager python loop — each step's beam algebra (log-softmax, top-k, parent
+gather) is a handful of XLA ops; the final back-trace reuses
+``F.gather_tree``.  No gradients flow through decoding (inference-only,
+like the reference's ``is_test`` path).
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..framework.tensor import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+def _val(x):
+    return x.value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Decoder:
+    """rnn.py:786 parity: the interface dynamic_decode drives."""
+
+    tracks_own_finished = False
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        raise NotImplementedError
+
+
+class BeamSearchDecoder(Decoder):
+    """rnn.py:866 parity: beam search over a single-step cell.
+
+    cell: ``forward(inputs, states) -> (outputs, new_states)`` (an
+    RNNCellBase or any callable with that contract); ``embedding_fn`` maps
+    token ids to cell inputs; ``output_fn`` maps cell outputs to vocab
+    logits.
+    """
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        if beam_size < 1:
+            raise InvalidArgumentError("beam_size must be >= 1")
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size: int):
+        """[B, ...] -> [B*beam, ...] (rnn.py:1047 parity), for tensors the
+        cell closes over (e.g. attention memory)."""
+        v = _val(x)
+        tiled = jnp.repeat(v[:, None], beam_size, axis=1)
+        return Tensor(tiled.reshape((-1,) + v.shape[1:]), stop_gradient=True)
+
+    # -- [B, K, ...] <-> [B*K, ...] --------------------------------------
+    def _merge(self, v):
+        return v.reshape((-1,) + v.shape[2:])
+
+    def _split(self, v, batch):
+        return v.reshape((batch, self.beam_size) + v.shape[1:])
+
+    def initialize(self, initial_cell_states):
+        K = self.beam_size
+        leaves = [_val(t) for t in jax.tree_util.tree_leaves(
+            initial_cell_states, is_leaf=lambda t: isinstance(t, Tensor))]
+        if not leaves:
+            raise InvalidArgumentError(
+                "BeamSearchDecoder.initialize needs initial cell states")
+        batch = int(leaves[0].shape[0])
+
+        def tile(t):
+            v = _val(t)
+            return self._merge(jnp.repeat(v[:, None], K, axis=1))
+
+        cell_states = jax.tree_util.tree_map(
+            tile, initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        # all probability mass on beam 0 so step-0 top-k picks K distinct
+        # tokens instead of K copies of the same beam
+        log_probs = jnp.full((batch, K), -1e9, jnp.float32).at[:, 0].set(0.0)
+        init_ids = jnp.full((batch, K), self.start_token, jnp.int32)
+        finished = jnp.zeros((batch, K), bool)
+        states = {"cell": cell_states, "log_probs": log_probs,
+                  "finished": finished,
+                  "lengths": jnp.zeros((batch, K), jnp.int32)}
+        return init_ids, states, finished
+
+    def step(self, time, inputs, states, **kwargs):
+        K = self.beam_size
+        batch = inputs.shape[0]
+        ids_flat = self._merge(jnp.asarray(inputs))
+        cell_in = Tensor(ids_flat, stop_gradient=True)
+        if self.embedding_fn is not None:
+            cell_in = self.embedding_fn(cell_in)
+        cell_out, next_cell_states = self.cell(cell_in, states["cell"])
+        logits = self.output_fn(cell_out) if self.output_fn is not None \
+            else cell_out
+        step_lp = jax.nn.log_softmax(_val(logits).astype(jnp.float32), -1)
+        V = step_lp.shape[-1]
+        step_lp = self._split(step_lp, batch)  # [B, K, V]
+
+        # finished beams may only extend with end_token, contributing 0
+        finished = states["finished"]
+        eos_only = jnp.full((V,), -1e9, jnp.float32).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], eos_only[None, None, :],
+                            step_lp)
+
+        scores = states["log_probs"][..., None] + step_lp  # [B, K, V]
+        flat = scores.reshape(batch, K * V)
+        top_scores, top_idx = jax.lax.top_k(flat, K)
+        parent = (top_idx // V).astype(jnp.int32)   # [B, K]
+        token = (top_idx % V).astype(jnp.int32)
+
+        def gather_beam(v):
+            v = self._split(_val(v), batch)
+            idx = parent.reshape((batch, K) + (1,) * (v.ndim - 2))
+            taken = jnp.take_along_axis(v, idx.astype(jnp.int32), axis=1)
+            return self._merge(taken)
+
+        next_cell_states = jax.tree_util.tree_map(
+            gather_beam, next_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        prev_finished = jnp.take_along_axis(finished, parent.astype(jnp.int32),
+                                            axis=1)
+        now_finished = prev_finished | (token == self.end_token)
+        prev_lengths = jnp.take_along_axis(states["lengths"],
+                                           parent.astype(jnp.int32), axis=1)
+        lengths = prev_lengths + (~prev_finished).astype(jnp.int32)
+
+        next_states = {"cell": next_cell_states, "log_probs": top_scores,
+                       "finished": now_finished, "lengths": lengths}
+        outputs = {"predicted_ids": token, "parent_ids": parent,
+                   "scores": top_scores}
+        return outputs, next_states, token, now_finished
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        from . import functional as F
+
+        ids = jnp.stack([o["predicted_ids"] for o in outputs])     # [T,B,K]
+        parents = jnp.stack([o["parent_ids"] for o in outputs])
+        traced = _val(F.gather_tree(Tensor(ids, stop_gradient=True),
+                                    Tensor(parents, stop_gradient=True)))
+        return traced, final_states  # [T, B, K]
+
+
+def dynamic_decode(decoder: Decoder, inits=None,
+                   max_step_num: Optional[int] = None,
+                   output_time_major: bool = False,
+                   impute_finished: bool = False, is_test: bool = False,
+                   return_length: bool = False, **kwargs) -> Tuple[Any, ...]:
+    """rnn.py:1584 parity: run decoder.step until all finished (or
+    max_step_num), then finalize."""
+    inputs, states, finished = decoder.initialize(inits)
+    outputs = []
+    step = 0
+    # parity: with max_step_num=None decode until every beam finishes; the
+    # hard backstop only catches decoders that can never emit end_token
+    backstop = 10000
+    lengths = jnp.zeros(jnp.asarray(finished).shape, jnp.int32)
+    while max_step_num is None or step < max_step_num:
+        alive = ~jnp.asarray(finished)
+        out, states, inputs, finished = decoder.step(step, inputs, states,
+                                                     **kwargs)
+        lengths = lengths + alive.astype(jnp.int32)
+        outputs.append(out)
+        step += 1
+        if bool(jnp.all(jnp.asarray(finished))):
+            break
+        if step >= backstop:
+            raise InvalidArgumentError(
+                "dynamic_decode ran %d steps without finishing; pass "
+                "max_step_num to bound generation" % backstop)
+    if isinstance(states, dict) and "lengths" in states:
+        lengths = states["lengths"]  # decoder tracks beam-reordered lengths
+    final_out, final_states = decoder.finalize(outputs, states, lengths)
+    if not output_time_major:
+        final_out = jnp.moveaxis(final_out, 0, 1)  # [B, T, K]
+    final_out = Tensor(final_out, stop_gradient=True)
+    if return_length:
+        return final_out, final_states, Tensor(lengths, stop_gradient=True)
+    return final_out, final_states

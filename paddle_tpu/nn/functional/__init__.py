@@ -12,24 +12,26 @@ from .activation import (  # noqa: F401
     swish, tanh, tanhshrink, thresholded_relu,
 )
 from .common import (  # noqa: F401
-    alpha_dropout, bilinear, dropout, dropout2d, dropout3d, embedding,
-    interpolate, label_smooth, linear, one_hot, pad, pixel_shuffle,
-    scaled_dot_product_attention, sequence_mask, temporal_shift, unfold,
-    upsample,
+    alpha_dropout, bilinear, diag_embed, dropout, dropout2d, dropout3d,
+    embedding, gather_tree, interpolate, label_smooth, linear, one_hot, pad,
+    pixel_shuffle, scaled_dot_product_attention, sequence_mask,
+    temporal_shift, unfold, upsample,
 )
 from .conv import (  # noqa: F401
     conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d, conv3d_transpose,
 )
 from .loss import (  # noqa: F401
     bce_loss, binary_cross_entropy, binary_cross_entropy_with_logits,
-    cosine_similarity, cross_entropy, hinge_embedding_loss, kl_div, l1_loss,
-    log_loss, margin_ranking_loss, mse_loss, nll_loss, sigmoid_focal_loss,
+    cosine_similarity, cross_entropy, ctc_loss, dice_loss,
+    hinge_embedding_loss, hsigmoid_loss, kl_div, l1_loss, log_loss,
+    margin_ranking_loss, mse_loss, nll_loss, npair_loss, sigmoid_focal_loss,
     smooth_l1_loss, softmax_with_cross_entropy, square_error_cost,
 )
 from .norm import (  # noqa: F401
     batch_norm, group_norm, instance_norm, layer_norm, local_response_norm,
     normalize,
 )
+from .vision import affine_grid, grid_sample  # noqa: F401
 from .pooling import (  # noqa: F401
     adaptive_avg_pool1d, adaptive_avg_pool2d, adaptive_avg_pool3d,
     adaptive_max_pool1d, adaptive_max_pool2d, adaptive_max_pool3d, avg_pool1d,
@@ -71,3 +73,16 @@ def _install():
 
 
 _install()
+
+
+def _install_inplace_acts():
+    """F.elu_/softmax_/tanh_ (reference inplace activations) via the shared
+    factory (framework/tensor.py make_inplace)."""
+    from ...framework.tensor import make_inplace
+
+    for base_name in ("elu", "softmax", "tanh"):
+        nm = base_name + "_"
+        globals()[nm] = make_inplace(globals()[base_name], nm)
+
+
+_install_inplace_acts()

@@ -259,3 +259,45 @@ def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25, data_format: str 
     out = out.at[:, 1:, fold : 2 * fold].set(x[:, :-1, fold : 2 * fold])
     out = out.at[:, :, 2 * fold :].set(x[:, :, 2 * fold :])
     return out.reshape(nt, c, h, w)
+
+
+def diag_embed(input, offset: int = 0, dim1: int = -2, dim2: int = -1):
+    """nn.functional diag_embed parity: last axis becomes the (offset)
+    diagonal of a new matrix spanned by dim1/dim2."""
+    x = jnp.asarray(input)
+    n = x.shape[-1]
+    size = n + abs(offset)
+    rows = jnp.arange(n) + max(-offset, 0)
+    cols = jnp.arange(n) + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (size, size), x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    nd = out.ndim
+    d1 = dim1 % nd
+    d2 = dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        out = jnp.moveaxis(out, (nd - 2, nd - 1), (d1, d2))
+    return out
+
+
+def gather_tree(ids, parents):
+    """gather_tree_op parity: back-trace beam-search parent pointers.
+
+    ids/parents: [max_time, batch, beam] — returns the full sequences
+    reconstructed from the last step's beams.
+    """
+    from jax import lax
+
+    ids = jnp.asarray(ids)
+    parents = jnp.asarray(parents).astype(jnp.int32)
+    T, B, K = ids.shape
+
+    def step(beam_ptr, t):
+        # beam_ptr [B, K]: which original beam each final slot follows at t+1
+        idx = beam_ptr
+        tok = jnp.take_along_axis(ids[t], idx, axis=1)
+        prev = jnp.take_along_axis(parents[t], idx, axis=1)
+        return prev, tok
+
+    init = jnp.tile(jnp.arange(K)[None, :], (B, 1))
+    _, toks = lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(toks, axis=0)

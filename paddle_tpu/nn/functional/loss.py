@@ -190,3 +190,158 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25, gamma
 
 def square_error_cost(input, label):
     return jnp.square(input - label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank: int = 0,
+             reduction: str = "mean", norm_by_times: bool = False):
+    """CTC loss (reference: F.ctc_loss over the warpctc op).
+
+    log_probs: [T, N, C] unnormalized logits (softmax applied internally,
+    warpctc semantics); labels: [N, L] padded; lengths: [N].  The standard
+    alpha recursion over the blank-extended label runs as one ``lax.scan``
+    over time — static shapes, per-sample lengths handled by masking.
+    """
+    from jax import lax
+
+    lp = jax.nn.log_softmax(jnp.asarray(log_probs, jnp.float32), axis=-1)
+    T, N, C = lp.shape
+    labels = jnp.asarray(labels, jnp.int32)
+    L = labels.shape[1]
+    S = 2 * L + 1
+    input_lengths = jnp.asarray(input_lengths, jnp.int32)
+    label_lengths = jnp.asarray(label_lengths, jnp.int32)
+
+    # blank-extended target: [blank, l1, blank, l2, ..., blank]
+    ext = jnp.full((N, S), blank, jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    neg_inf = jnp.float32(-1e30)
+
+    # skip transition s-2 -> s allowed when ext[s] != blank and != ext[s-2]
+    can_skip = jnp.zeros((N, S), bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+    valid_s = jnp.arange(S)[None, :] <= 2 * label_lengths[:, None]
+
+    def emit(t):
+        return jnp.take_along_axis(lp[t], ext, axis=1)  # [N, S]
+
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(lp[0, :, blank])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(label_lengths > 0,
+                  jnp.take_along_axis(lp[0], ext[:, 1:2], axis=1)[:, 0],
+                  neg_inf))
+
+    def final_of(alpha):
+        lastb = jnp.take_along_axis(alpha, (2 * label_lengths)[:, None],
+                                    axis=1)[:, 0]
+        lastl = jnp.take_along_axis(
+            alpha, jnp.maximum(2 * label_lengths - 1, 0)[:, None],
+            axis=1)[:, 0]
+        lastl = jnp.where(label_lengths > 0, lastl, neg_inf)
+        return jnp.logaddexp(lastb, lastl)
+
+    def step(carry, t):
+        alpha, final = carry
+        stay = alpha
+        prev1 = jnp.concatenate(
+            [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        prev2 = jnp.concatenate(
+            [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        prev2 = jnp.where(can_skip, prev2, neg_inf)
+        new = jnp.logaddexp(jnp.logaddexp(stay, prev1), prev2) + emit(t)
+        new = jnp.where(valid_s, new, neg_inf)
+        alive = (t < input_lengths)[:, None]
+        new = jnp.where(alive, new, alpha)
+        # freeze each sample's final log-prob at its last valid frame
+        final = jnp.where(t == input_lengths - 1, final_of(new), final)
+        return (new, final), None
+
+    final0 = jnp.where(input_lengths == 1, final_of(alpha0),
+                       jnp.full((N,), neg_inf))
+    (alphaT, final), _ = lax.scan(step, (alpha0, final0),
+                                  jnp.arange(1, T))
+    loss = -final
+    if norm_by_times:
+        loss = loss / jnp.maximum(input_lengths.astype(jnp.float32), 1.0)
+    if reduction == "mean":
+        # warpctc mean: per-sample loss normalized by label length first
+        return jnp.mean(
+            loss / jnp.maximum(label_lengths.astype(jnp.float32), 1.0))
+    return _reduce(loss, reduction)
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):
+    """fluid/layers dice_loss parity: 1 - 2|X∩Y| / (|X|+|Y|)."""
+    input = jnp.asarray(input)
+    label = jnp.asarray(label)
+    num_classes = input.shape[-1]
+    if label.shape[-1] == 1:
+        label = label[..., 0]
+    one_hot = jax.nn.one_hot(label.astype(jnp.int32), num_classes,
+                             dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = 2.0 * jnp.sum(input * one_hot, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(one_hot,
+                                                       axis=reduce_dims)
+    return jnp.mean(1.0 - (inter + epsilon) / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    """fluid/layers npair_loss parity: softmax CE over anchor·positiveᵀ
+    with same-label targets + L2 on the embeddings."""
+    anchor = jnp.asarray(anchor)
+    positive = jnp.asarray(positive)
+    labels = jnp.asarray(labels).reshape(-1)
+    same = (labels[:, None] == labels[None, :]).astype(anchor.dtype)
+    targets = same / jnp.maximum(same.sum(axis=1, keepdims=True), 1e-9)
+    sim = anchor @ positive.T
+    # per-row soft-label CE, then the reference's column-weighted mean
+    # (loss.py:1723-1728: reduce_sum(labels * ce, 0) then reduce_mean)
+    ce = -jnp.sum(targets * jax.nn.log_softmax(sim, axis=1), axis=1)  # [N]
+    celoss = jnp.mean(jnp.sum(targets * ce[:, None], axis=0))
+    l2 = (jnp.mean(jnp.sum(jnp.square(anchor), 1))
+          + jnp.mean(jnp.sum(jnp.square(positive), 1))) * 0.25 * l2_reg
+    return celoss + l2
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse: bool = False):
+    """Hierarchical sigmoid (hierarchical_sigmoid_op / matrix_bit_code.h
+    SimpleCode semantics): complete-binary-tree paths by default, custom
+    trees via per-sample path_table/path_code.
+
+    input [N, D]; label [N] (or [N,1]); weight [num_classes-1, D] (or
+    [num_nodes, D] for custom trees); returns [N, 1] losses.
+    """
+    input = jnp.asarray(input)
+    label = jnp.asarray(label).reshape(-1).astype(jnp.int32)
+    weight = jnp.asarray(weight)
+    N = input.shape[0]
+
+    if path_table is not None:
+        pt_ = jnp.asarray(path_table, jnp.int32)
+        pc = jnp.asarray(path_code, jnp.float32)
+        valid = (pt_ >= 0).astype(jnp.float32)
+        idx = jnp.maximum(pt_, 0)
+    else:
+        # SimpleCode: c = label + num_classes; node = (c >> (bit+1)) - 1;
+        # branch bit = (c >> bit) & 1; path length = floor(log2(c))
+        c = label + int(num_classes)
+        max_len = max(int(num_classes - 1).bit_length(), 1)
+        bits = jnp.arange(max_len)
+        length = jnp.floor(
+            jnp.log2(c.astype(jnp.float32))).astype(jnp.int32)
+        valid = (bits[None, :] < length[:, None]).astype(jnp.float32)
+        idx = jnp.clip((c[:, None] >> (bits[None, :] + 1)) - 1, 0,
+                       weight.shape[0] - 1)
+        pc = ((c[:, None] >> bits[None, :]) & 1).astype(jnp.float32)
+
+    w = weight[idx]                       # [N, L, D]
+    pre = jnp.einsum("nld,nd->nl", w, input)
+    if bias is not None:
+        pre = pre + jnp.asarray(bias).reshape(-1)[idx]
+    # BCE-with-logits against the branch bits, masked to real path length
+    per_bit = jnp.maximum(pre, 0) - pre * pc + jnp.log1p(
+        jnp.exp(-jnp.abs(pre)))
+    return (per_bit * valid).sum(axis=1, keepdims=True)

@@ -1,0 +1,108 @@
+"""Spatial-transformer ops (reference: python/paddle/nn/functional/vision.py
+— affine_grid, grid_sample; ops: affine_grid_op.cc, grid_sampler_op.cc).
+
+Pure gather + algebra: XLA fuses the coordinate math; there is no cuDNN
+spatial-transformer path to mirror.  Layout NCHW, grid layout [N, H, W, 2]
+with (x, y) in [-1, 1], matching the reference exactly (tested against
+torch's grid_sample as an independent oracle).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.errors import InvalidArgumentError
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True):
+    """theta [N, 2, 3] affine maps → sampling grid [N, H, W, 2]."""
+    theta = jnp.asarray(theta)
+    if theta.ndim != 3 or theta.shape[1:] != (2, 3):
+        raise InvalidArgumentError(
+            "affine_grid expects theta [N, 2, 3], got %s"
+            % (tuple(theta.shape),))
+    N, _, H, W = [int(s) for s in out_shape]
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n) if n > 1 \
+                else jnp.zeros((1,))
+        step = 2.0 / n
+        return -1.0 + step / 2 + step * jnp.arange(n)
+
+    xs = axis_coords(W)
+    ys = axis_coords(H)
+    gx, gy = jnp.meshgrid(xs, ys)  # [H, W]
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # [H, W, 3]
+    # [N, H, W, 2] = base [H,W,3] @ theta^T [N,3,2]
+    return jnp.einsum("hwk,njk->nhwj", base, theta.astype(jnp.float32))
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) / 2.0 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) / 2.0
+
+
+def _reflect(ix, low, high):
+    # reflection padding per grid_sampler: reflect about the span edges
+    span = high - low
+    if span == 0:
+        return jnp.zeros_like(ix)
+    ix = jnp.abs(ix - low) % (2 * span)
+    return jnp.where(ix > span, 2 * span - ix, ix) + low
+
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True):
+    """Sample x [N,C,H,W] at grid [N,Hg,Wg,2] ((x, y) in [-1,1])."""
+    x = jnp.asarray(x)
+    grid = jnp.asarray(grid)
+    if mode not in ("bilinear", "nearest"):
+        raise InvalidArgumentError("grid_sample mode must be bilinear or "
+                                   "nearest, got %r" % mode)
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise InvalidArgumentError(
+            "grid_sample padding_mode must be zeros/border/reflection, "
+            "got %r" % padding_mode)
+    N, C, H, W = x.shape
+    ix = _unnormalize(grid[..., 0].astype(jnp.float32), W, align_corners)
+    iy = _unnormalize(grid[..., 1].astype(jnp.float32), H, align_corners)
+
+    if padding_mode == "border":
+        ix = jnp.clip(ix, 0, W - 1)
+        iy = jnp.clip(iy, 0, H - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            ix = _reflect(ix, 0.0, float(W - 1))
+            iy = _reflect(iy, 0.0, float(H - 1))
+        else:
+            ix = jnp.clip(_reflect(ix, -0.5, W - 0.5), 0, W - 1)
+            iy = jnp.clip(_reflect(iy, -0.5, H - 0.5), 0, H - 1)
+
+    flat = x.reshape(N, C, H * W)
+
+    def gather(yy, xx):
+        inside = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+        idx = (jnp.clip(yy, 0, H - 1) * W + jnp.clip(xx, 0, W - 1))
+        got = jnp.take_along_axis(
+            flat, idx.reshape(N, 1, -1).astype(jnp.int32), axis=2)
+        got = got * inside.reshape(N, 1, -1).astype(x.dtype)
+        return got  # [N, C, Hg*Wg]
+
+    Hg, Wg = grid.shape[1], grid.shape[2]
+    if mode == "nearest":
+        out = gather(jnp.round(iy).astype(jnp.int32),
+                     jnp.round(ix).astype(jnp.int32))
+        return out.reshape(N, C, Hg, Wg)
+
+    x0 = jnp.floor(ix).astype(jnp.int32)
+    y0 = jnp.floor(iy).astype(jnp.int32)
+    wx = (ix - x0).astype(x.dtype).reshape(N, 1, -1)
+    wy = (iy - y0).astype(x.dtype).reshape(N, 1, -1)
+    out = (gather(y0, x0) * (1 - wy) * (1 - wx)
+           + gather(y0, x0 + 1) * (1 - wy) * wx
+           + gather(y0 + 1, x0) * wy * (1 - wx)
+           + gather(y0 + 1, x0 + 1) * wy * wx)
+    return out.reshape(N, C, Hg, Wg)

@@ -249,3 +249,43 @@ class CosineSimilarity(Layer):
 
     def forward(self, x1, x2):
         return F.cosine_similarity(x1, x2, self.axis, self.eps)
+
+
+class PairwiseDistance(Layer):
+    """nn.PairwiseDistance parity: p-norm of x - y along the last axis."""
+
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        from ... import tensor as T
+
+        diff = x - y + self.epsilon
+        return T.norm(diff, p=self.p, axis=-1, keepdim=self.keepdim)
+
+    def extra_repr(self):
+        return "p=%s" % self.p
+
+
+class Unfold(Layer):
+    """nn.Unfold parity (im2col) over F.unfold."""
+
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self.kernel_sizes = kernel_sizes
+        self.strides = strides
+        self.paddings = paddings
+        self.dilations = dilations
+
+    def forward(self, x):
+        return F.unfold(x, self.kernel_sizes, self.strides, self.paddings,
+                        self.dilations)
+
+    def extra_repr(self):
+        return "kernel_sizes=%s, strides=%s" % (self.kernel_sizes,
+                                                self.strides)

@@ -1,7 +1,9 @@
 """Loss layers (reference: python/paddle/nn/layer/loss.py)."""
 from __future__ import annotations
 
+from ...core.errors import InvalidArgumentError
 from .. import functional as F
+from .. import initializer as I
 from .layers import Layer
 
 
@@ -113,3 +115,52 @@ class HingeEmbeddingLoss(Layer):
 
     def forward(self, input, label):
         return F.hinge_embedding_loss(input, label, self.margin, self.reduction)
+
+
+class CTCLoss(Layer):
+    """nn.CTCLoss parity over F.ctc_loss (warpctc semantics)."""
+
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times: bool = False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          blank=self.blank, reduction=self.reduction,
+                          norm_by_times=norm_by_times)
+
+
+class HSigmoidLoss(Layer):
+    """nn.HSigmoidLoss parity: holds the [num_classes-1, feature] internal
+    node weights for F.hsigmoid_loss's complete-binary-tree default (custom
+    trees pass path_table/path_code through forward)."""
+
+    def __init__(self, feature_size: int, num_classes: int,
+                 weight_attr=None, bias_attr=None, is_custom: bool = False,
+                 is_sparse: bool = False, name=None):
+        super().__init__()
+        if not is_custom and num_classes < 2:
+            raise InvalidArgumentError(
+                "num_classes must be >= 2, got %d" % num_classes)
+        self.feature_size = feature_size
+        self.num_classes = num_classes
+        self.is_custom = is_custom
+        rows = num_classes if is_custom else num_classes - 1
+        import math as _math
+
+        std = 1.0 / _math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            [rows, feature_size], attr=weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = self.create_parameter([rows], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        if self.is_custom and (path_table is None or path_code is None):
+            raise InvalidArgumentError(
+                "is_custom=True needs path_table and path_code")
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table=path_table,
+                               path_code=path_code)

@@ -70,6 +70,10 @@ from .linalg import (  # noqa: F401
 )
 from .logic import (  # noqa: F401
     allclose,
+    bitwise_and,
+    bitwise_not,
+    bitwise_or,
+    bitwise_xor,
     equal,
     equal_all,
     greater_equal,
@@ -88,9 +92,14 @@ from .logic import (  # noqa: F401
     not_equal,
 )
 from .manipulation import (  # noqa: F401
+    broadcast_shape,
+    broadcast_tensors,
     broadcast_to,
     cast,
     chunk,
+    crop,
+    reverse,
+    shard_index,
     concat,
     expand,
     expand_as,
@@ -123,6 +132,14 @@ from .math import (  # noqa: F401
     acosh,
     add,
     add_n,
+    addmm,
+    conj,
+    diagonal,
+    floor_mod,
+    inverse,
+    mm,
+    multiplex,
+    neg,
     all,
     amax,
     amin,
@@ -264,3 +281,40 @@ def _install_dispatch():
 
 
 _install_dispatch()
+
+
+def _install_inplace():
+    """In-place op variants (math_op_patch.py ``*_`` methods): compute
+    out-of-place, then rebind the tensor's value + tape linkage to the
+    result (paddle's inplace semantics: same object, autograd continues
+    through the producing op)."""
+    import sys
+
+    from ..framework.tensor import Tensor as _Tensor
+    from ..framework.tensor import make_inplace
+
+    mod = sys.modules[__name__]
+
+    def make(base_name):
+        return make_inplace(getattr(mod, base_name), base_name + "_")
+
+    for base_name in ("add", "subtract", "ceil", "clip", "exp", "flatten",
+                      "floor", "reciprocal", "reshape", "round", "rsqrt",
+                      "scale", "scatter", "sqrt", "squeeze", "tanh",
+                      "unsqueeze"):
+        fn = make(base_name)
+        globals()[fn.__name__] = fn
+        if not hasattr(_Tensor, fn.__name__):
+            setattr(_Tensor, fn.__name__, fn)
+
+
+from ..core.errors import InvalidArgumentError  # noqa: E402
+
+_install_inplace()
+
+
+def tolist(x):
+    """paddle.tolist parity: nested python lists from a Tensor."""
+    import numpy as _np
+
+    return _np.asarray(x.value if hasattr(x, "value") else x).tolist()

@@ -70,3 +70,19 @@ def allclose(x, y, rtol: float = 1e-5, atol: float = 1e-8, equal_nan: bool = Fal
 
 def is_empty(x):
     return x.size == 0
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)

@@ -214,3 +214,60 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False, axi
         )
     res = jnp.unique(np.asarray(x), return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
     return res
+
+
+def reverse(x, axis):
+    """reverse_op parity: flip along the listed axes."""
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return jnp.flip(x, axis=tuple(axes))
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Result shape of broadcasting two shapes (broadcast_shape parity)."""
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def broadcast_tensors(input):
+    """broadcast_tensors_op parity: broadcast all inputs to a common shape."""
+    arrs = [jnp.asarray(v) for v in input]
+    return list(jnp.broadcast_arrays(*arrs))
+
+
+def crop(x, shape=None, offsets=None):
+    """crop_tensor_op parity: slice at offsets with target shape; a shape
+    entry of -1 means "to the end" (dim - offset)."""
+    from ..core.errors import InvalidArgumentError
+
+    x = jnp.asarray(x)
+    ndim = x.ndim
+    if shape is None:
+        shape = list(x.shape)
+    if offsets is None:
+        offsets = [0] * ndim
+    starts = [int(o) for o in offsets]
+    sizes = []
+    for i, s in enumerate(shape):
+        dim = int(x.shape[i])
+        size = dim - starts[i] if int(s) == -1 else int(s)
+        if starts[i] < 0 or starts[i] + size > dim:
+            raise InvalidArgumentError(
+                "crop out of bounds on axis %d: offset %d + size %d > dim %d"
+                % (i, starts[i], size, dim))
+        sizes.append(size)
+    return jax.lax.slice(x, starts, [st + sz for st, sz in zip(starts, sizes)])
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """shard_index_op parity: map global ids to shard-local ids, masking
+    ids that land on other shards with ignore_value."""
+    if not 0 <= shard_id < nshards:
+        from ..core.errors import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            "shard_id %d out of range [0, %d)" % (shard_id, nshards))
+    x = jnp.asarray(input)
+    shard_size = (index_num + nshards - 1) // nshards
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)

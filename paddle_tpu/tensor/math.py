@@ -347,3 +347,44 @@ def add_n(inputs):
 
 def trace(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
     return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def floor_mod(x, y):
+    """Alias of mod (elementwise_floormod parity)."""
+    return jnp.mod(x, y)
+
+
+def mm(input, mat2):
+    """Matrix product without broadcasting (mm_op parity)."""
+    return jnp.matmul(input, mat2)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    """addmm_op parity: beta*input + alpha*(x @ y)."""
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def inverse(x):
+    """inverse_op parity (batched square-matrix inverse)."""
+    return jnp.linalg.inv(x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def multiplex(inputs, index):
+    """multiplex_op parity: row r of the output is row r of
+    inputs[index[r]]."""
+    stacked = jnp.stack([jnp.asarray(i) for i in inputs])  # [K, N, ...]
+    idx = jnp.asarray(index).reshape(-1).astype(jnp.int32)
+    rows = jnp.arange(stacked.shape[1])
+    return stacked[idx, rows]
