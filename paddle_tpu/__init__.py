@@ -82,15 +82,24 @@ from .nn.layer.layers import ParamAttr  # noqa: F401
 from .version import __version__  # noqa: F401
 
 
-def disable_static(*a, **k):  # dygraph is the default; parity no-op
-    return None
+_static_mode = False
+
+
+def disable_static(*a, **k):
+    """Return to dygraph (the default mode)."""
+    global _static_mode
+    _static_mode = False
 
 
 def enable_static(*a, **k):
-    raise NotImplementedError(
-        "paddle_tpu has no interpreted static-graph mode; use paddle_tpu.jit.to_static "
-        "(trace-to-XLA) which subsumes it"
-    )
+    """Enter static-graph compat mode: ``paddle.static.data`` placeholders
+    + ops on them build a deferred-jax Program executed by
+    ``paddle.static.Executor`` (optionally whole-program-jitted via
+    ``CompiledProgram``).  Graph building works on static Variables in
+    either mode; this flag exists for reference-code parity and
+    ``in_dynamic_mode`` reporting."""
+    global _static_mode
+    _static_mode = True
 
 
 import builtins as _builtins  # noqa: E402
@@ -100,7 +109,7 @@ def in_dynamic_mode() -> _builtins.bool:
 
     # _builtins.bool: the module-level `bool = bool_` dtype alias below
     # shadows the builtin for every function defined in this module
-    return _builtins.bool(_flag("FLAGS_eager_mode"))
+    return _builtins.bool(_flag("FLAGS_eager_mode")) and not _static_mode
 
 from .core.device import CUDAPinnedPlace, NPUPlace  # noqa: E402,F401
 from .core import dtype as _dtype_mod  # noqa: E402

@@ -7,7 +7,7 @@ the tape in ``framework.engine``; jitted code uses ``jax.grad`` directly (see
 """
 from ..framework.engine import backward, grad, is_grad_enabled, no_grad, set_grad_enabled, enable_grad  # noqa: F401
 
-__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled", "PyLayer"]
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled", "PyLayer", "PyLayerContext"]
 
 
 class PyLayer:
@@ -34,7 +34,7 @@ class PyLayer:
         from ..framework import engine
         from ..framework.tensor import Tensor
 
-        class _Ctx:
+        class _Ctx(PyLayerContext):
             def __init__(self):
                 self._saved = ()
 
@@ -79,3 +79,15 @@ class PyLayer:
                 t._node = node
                 t._leaf_idx = k
         return out
+
+
+class PyLayerContext:
+    """Type of the ``ctx`` object passed to PyLayer.forward/backward
+    (py_layer.py PyLayerContext parity).  Provided for isinstance checks
+    and documentation; PyLayer builds instances internally."""
+
+    def save_for_backward(self, *tensors):
+        self.saved_tensor_list = list(tensors)
+
+    def saved_tensor(self):
+        return list(getattr(self, "saved_tensor_list", ()))

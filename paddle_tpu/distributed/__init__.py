@@ -41,6 +41,10 @@ from .parallel import (  # noqa: F401
     scale_loss,
     shard_batch,
 )
+from .collective import split  # noqa: F401
+from .ps_compat import (  # noqa: F401
+    CountFilterEntry, InMemoryDataset, ProbabilityEntry, QueueDataset,
+)
 from .spawn import spawn  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology,
@@ -49,6 +53,8 @@ from .topology import (  # noqa: F401
 )
 
 __all__ = [
+    "split", "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry",
     "Group", "ReduceOp", "all_gather", "all_reduce", "all_to_all", "alltoall",
     "barrier", "broadcast", "destroy_process_group", "get_group", "get_rank",
     "get_world_size", "init_parallel_env", "irecv", "is_initialized", "isend",

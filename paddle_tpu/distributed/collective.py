@@ -658,3 +658,67 @@ class stream:
     scatter = staticmethod(scatter)
     alltoall = staticmethod(alltoall)
     reduce_scatter = staticmethod(reduce_scatter)
+
+
+_split_layers: dict = {}
+
+
+def get_split_layer(name: str):
+    """The parallel layer a named :func:`split` call site created (its
+    parameters feed an optimizer's parameter list)."""
+    if name not in _split_layers:
+        raise InvalidArgumentError("no split layer named %r" % name)
+    return _split_layers[name]
+
+
+def split(x, size, operation: str, axis: int = 0, num_partitions: int = 1,
+          gather_out: bool = True, weight_attr=None, bias_attr=None,
+          name=None):
+    """collective.py:1283 parity: model-parallel linear/embedding in one
+    call.  Builds the corresponding parallel layer over the active fleet
+    mp group and applies it — the reference's program-rewriting becomes
+    GSPMD placement inside the layer.
+
+    The layer (and its weights) is created ONCE per call site, keyed by
+    ``name`` (or an auto key from operation/size/axis): repeated calls in
+    a training loop reuse the same weights, and
+    :func:`get_split_layer` exposes them for the optimizer.
+    """
+    from .meta_parallel.mp_layers import (ColumnParallelLinear,
+                                          RowParallelLinear,
+                                          VocabParallelEmbedding, _mp_group)
+
+    group = _mp_group(None)
+    mp_deg = int(group.mesh.shape[group.axis_name])
+    if num_partitions != 1 and num_partitions != mp_deg:
+        raise InvalidArgumentError(
+            "num_partitions %d does not match the mp degree %d"
+            % (num_partitions, mp_deg))
+    key = name or "split_%s_%s_%d_%d" % (operation, tuple(size), axis,
+                                         num_partitions)
+    layer = _split_layers.get(key)
+    if layer is None:
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(int(size[0]), int(size[1]),
+                                           weight_attr=weight_attr,
+                                           mp_group=group)
+        elif operation != "linear":
+            raise InvalidArgumentError(
+                "split supports operation='linear' or 'embedding', got %r"
+                % operation)
+        elif axis == 1:
+            layer = ColumnParallelLinear(int(size[0]), int(size[1]),
+                                         weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out,
+                                         mp_group=group)
+        elif axis == 0:
+            layer = RowParallelLinear(int(size[0]), int(size[1]),
+                                      weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      input_is_parallel=False,
+                                      mp_group=group)
+        else:
+            raise InvalidArgumentError("split axis must be 0 or 1")
+        _split_layers[key] = layer
+    return layer(x)

@@ -335,3 +335,139 @@ worker_num = fleet.worker_num
 is_first_worker = fleet.is_first_worker
 barrier_worker = fleet.barrier_worker
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+Fleet = _Fleet  # class surface parity (fleet_base.py Fleet)
+
+
+class Role:
+    """role_maker.py Role enum parity."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    """role_maker.py RoleMakerBase parity: rank/topology bookkeeping."""
+
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_num = 1
+
+    def is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def is_first_worker(self) -> bool:
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self) -> int:
+        return self._current_id
+
+    def server_index(self) -> int:
+        return self._current_id
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def server_num(self) -> int:
+        return 0
+
+    def role_id(self) -> int:
+        return self._current_id
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """role_maker.py PaddleCloudRoleMaker parity: cluster facts from the
+    PADDLE_* environment (the launcher writes them; jax.distributed is the
+    rendezvous — SURVEY §5.8)."""
+
+    def __init__(self, is_collective: bool = False, **kwargs):
+        super().__init__()
+        import os
+
+        self._is_collective = is_collective
+        self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        endpoints = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = [e for e in endpoints.split(",") if e]
+        self._worker_num = max(len(self._worker_endpoints), 1)
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._role = Role.SERVER if training_role == "PSERVER" \
+            else Role.WORKER
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+
+class UserDefinedRoleMaker(RoleMakerBase):
+    """role_maker.py UserDefinedRoleMaker parity: explicit topology."""
+
+    def __init__(self, is_collective: bool = False, init_gloo: bool = False,
+                 current_id: int = 0, role=Role.WORKER, worker_num: int = 1,
+                 server_endpoints=None, **kwargs):
+        super().__init__()
+        self._current_id = current_id
+        self._role = role
+        self._worker_num = worker_num
+        self._server_endpoints = server_endpoints or []
+
+
+class UtilBase:
+    """fleet/base/util_factory.py UtilBase parity: small cross-worker
+    utilities over the collective layer."""
+
+    def all_reduce(self, input, mode: str = "sum", comm_world: str = "worker"):
+        """Host-value reduction across worker processes.  Single-controller
+        (jax.process_count()==1): the global value is already whole, so the
+        reduction is the identity."""
+        import jax
+        import numpy as np
+
+        arr = np.asarray(input.value if hasattr(input, "value") else input)
+        if jax.process_count() == 1:
+            return arr
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(arr)
+        if mode == "sum":
+            return np.asarray(gathered.sum(axis=0))
+        if mode == "max":
+            return np.asarray(gathered.max(axis=0))
+        if mode == "min":
+            return np.asarray(gathered.min(axis=0))
+        raise InvalidArgumentError(
+            "all_reduce mode must be sum/max/min, got %r" % mode)
+
+    def barrier(self, comm_world: str = "worker"):
+        from .. import collective as C
+
+        C.barrier()
+
+    def all_gather(self, input, comm_world: str = "worker"):
+        import numpy as np
+
+        # single-controller view: the global value is already whole
+        return [np.asarray(input)]
+
+    def get_file_shard(self, files):
+        """Split a file list evenly over workers (util_factory parity)."""
+        w = fleet.worker_num()
+        i = fleet.worker_index()
+        files = sorted(files)
+        per = (len(files) + w - 1) // w
+        return files[i * per:(i + 1) * per]
+
+    def print_on_rank(self, message: str, rank_id: int = 0):
+        if fleet.worker_index() == rank_id:
+            print(message)
+
+
+util = UtilBase()
+
+from ..ps_compat import (  # noqa: E402,F401
+    MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+)

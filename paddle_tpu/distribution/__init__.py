@@ -21,7 +21,8 @@ from ..core.errors import InvalidArgumentError
 from ..core.random import next_key
 from ..framework.tensor import Tensor
 
-__all__ = ["Distribution", "Uniform", "Normal", "Categorical"]
+__all__ = ["Distribution", "Uniform", "Normal", "Categorical",
+           "MultivariateNormalDiag", "sampling_id"]
 
 
 def _raw(x):
@@ -156,3 +157,70 @@ class Categorical(Distribution):
         logq = other.logits - jax.nn.logsumexp(other.logits, axis=-1, keepdims=True)
         return Tensor((jnp.exp(logp) * (logp - logq)).sum(-1),
                       stop_gradient=True)
+
+
+class MultivariateNormalDiag(Distribution):
+    """distribution.py MultivariateNormalDiag parity: N(loc, diag(scale))."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _raw(loc)
+        self.scale = _raw(scale)  # [..., D, D] diagonal matrix per reference
+        if self.scale.ndim < 2:
+            raise InvalidArgumentError(
+                "MultivariateNormalDiag scale must be a (batched) square "
+                "matrix carrying the diagonal, got shape %s"
+                % (self.scale.shape,))
+
+    def _diag(self):
+        return jnp.diagonal(self.scale, axis1=-2, axis2=-1)
+
+    def sample(self, shape=(), seed=0):
+        d = self._diag()
+        base = jnp.broadcast_shapes(jnp.shape(self.loc), d.shape)
+        z = jax.random.normal(next_key(), tuple(shape) + base, jnp.float32)
+        return Tensor(self.loc + z * d, stop_gradient=True)
+
+    def entropy(self):
+        d = self._diag()
+        D = d.shape[-1]
+        return Tensor(0.5 * D * (1.0 + math.log(2 * math.pi))
+                      + 0.5 * jnp.log(jnp.prod(jnp.square(d), axis=-1)),
+                      stop_gradient=True)
+
+    def log_prob(self, value):
+        v = _raw(value)
+        d = self._diag()
+        quad = jnp.sum(jnp.square((v - self.loc) / d), axis=-1)
+        D = d.shape[-1]
+        return Tensor(-0.5 * (quad + D * math.log(2 * math.pi))
+                      - jnp.sum(jnp.log(d), axis=-1), stop_gradient=True)
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_raw(self.log_prob(value))),
+                      stop_gradient=True)
+
+    def kl_divergence(self, other: "MultivariateNormalDiag"):
+        d1, d2 = self._diag(), other._diag()
+        var1, var2 = jnp.square(d1), jnp.square(d2)
+        D = d1.shape[-1]
+        kl = 0.5 * (jnp.sum(var1 / var2, -1)
+                    + jnp.sum(jnp.square(self.loc - other.loc) / var2, -1)
+                    - D + jnp.log(jnp.prod(var2, -1) / jnp.prod(var1, -1)))
+        return Tensor(kl, stop_gradient=True)
+
+
+def sampling_id(x, min=0.0, max=1.0, seed=0, dtype="int64"):
+    """fluid/layers sampling_id parity: sample one category id per row from
+    a [batch, V] probability matrix."""
+    p = _raw(x)
+    if p.ndim != 2:
+        raise InvalidArgumentError(
+            "sampling_id expects [batch, V] probabilities, got %s"
+            % (p.shape,))
+    key = next_key()
+    ids = jax.random.categorical(key, jnp.log(jnp.maximum(p, 1e-30)), axis=-1)
+    # int64 requests land on int32 unless x64 is enabled (TPU-first default)
+    want = jnp.dtype(dtype)
+    if want == jnp.dtype("int64") and not jax.config.jax_enable_x64:
+        want = jnp.dtype("int32")
+    return Tensor(ids.astype(want), stop_gradient=True)

@@ -65,7 +65,10 @@ def _amp_apply(fn: Callable, op_name: str) -> Callable:
 
 
 def _is_leaf(x) -> bool:
-    return isinstance(x, Tensor)
+    # static-graph Variables are leaves too (one flatten serves both the
+    # Tensor path and the symbolic check — see make_op)
+    return isinstance(x, Tensor) or (
+        _symbolic_cls is not None and isinstance(x, _symbolic_cls))
 
 
 def _aval(x):
@@ -148,6 +151,9 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
     def op(*args, **kwargs):
         run = (_amp_apply(fn, op_name) if amp_state.amp_enabled() else fn)
         leaves, treedef = _tree.tree_flatten((args, kwargs), is_leaf=_is_leaf)
+        if _symbolic_cls is not None and any(
+                isinstance(l, _symbolic_cls) for l in leaves):
+            return _symbolic_handler(run, op_name, args, kwargs)
         t_pos = [i for i, l in enumerate(leaves) if isinstance(l, Tensor)]
         if not t_pos:
             # No Tensors. Raw arrays / tracers, or an ambient trace in
@@ -358,3 +364,17 @@ def install_methods(tensor_ns) -> None:
     Tensor.__abs__ = __abs__
     Tensor.__invert__ = __invert__
     Tensor.__hash__ = object.__hash__
+
+
+# -- static-graph bridge ----------------------------------------------------
+# The paddle.static compat layer registers its Variable type + a handler;
+# any op invoked with a symbolic Variable among its inputs is deferred into
+# the graph instead of executed (framework.py Program-building parity).
+_symbolic_cls = None
+_symbolic_handler = None
+
+
+def register_symbolic(cls, handler) -> None:
+    global _symbolic_cls, _symbolic_handler
+    _symbolic_cls = cls
+    _symbolic_handler = handler

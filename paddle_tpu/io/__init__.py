@@ -23,9 +23,12 @@ from .sampler import (  # noqa: F401
     WeightedRandomSampler,
 )
 
+from .dataloader import WorkerInfo, get_worker_info  # noqa: F401
+
 __all__ = [
     "DataLoader", "default_collate_fn", "Dataset", "IterableDataset",
     "TensorDataset", "ComposeDataset", "ChainDataset", "ConcatDataset",
     "Subset", "random_split", "Sampler", "SequenceSampler", "RandomSampler",
     "WeightedRandomSampler", "BatchSampler", "DistributedBatchSampler",
+    "get_worker_info", "WorkerInfo",
 ]

@@ -118,8 +118,31 @@ def _put_batch(result_q, batch_idx, out, use_shm: bool):
         result_q.put((batch_idx, "ok", out))
 
 
+_worker_info = None
+
+
+class WorkerInfo:
+    """get_worker_info() payload (fluid/dataloader/worker.py parity)."""
+
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+    def __repr__(self):
+        return "WorkerInfo(id=%d, num_workers=%d)" % (self.id,
+                                                      self.num_workers)
+
+
+def get_worker_info():
+    """Inside a DataLoader worker process: (id, num_workers, dataset);
+    None in the main process (reference get_worker_info parity)."""
+    return _worker_info
+
+
 def _worker_loop(dataset, collate_fn, task_q, result_q, use_shm: bool,
-                 worker_id: int, worker_init_fn, iterable_cfg):
+                 worker_id: int, worker_init_fn, iterable_cfg,
+                 num_workers: int = 1):
     """Worker process body.
 
     Map-style (``iterable_cfg is None``): pull (batch_idx, indices) tasks,
@@ -128,6 +151,8 @@ def _worker_loop(dataset, collate_fn, task_q, result_q, use_shm: bool,
     ``(start, step, batch_size, drop_last)`` in batches with no task queue —
     order across workers is unordered by contract.
     """
+    global _worker_info
+    _worker_info = WorkerInfo(worker_id, num_workers, dataset)
     try:
         try:
             if worker_init_fn is not None:
@@ -213,7 +238,7 @@ class _MultiprocessIterator:
                 target=_worker_loop,
                 args=(loader.dataset, loader.collate_fn, self._task_q,
                       self._result_q, self._use_shm, wid,
-                      loader.worker_init_fn, iter_cfg),
+                      loader.worker_init_fn, iter_cfg, self._n_workers),
                 daemon=True)
             w.start()
             self._workers.append(w)

@@ -43,7 +43,8 @@ from ..nn.layer.layers import Layer
 
 __all__ = [
     "to_static", "not_to_static", "StaticFunction", "InputSpec", "TrainStep",
-    "save", "load", "TranslatedLayer",
+    "save", "load", "TranslatedLayer", "ProgramTranslator", "TracedLayer",
+    "set_code_level", "set_verbosity", "enable_to_static",
 ]
 
 
@@ -185,6 +186,10 @@ class StaticFunction:
         return self._jitted
 
     def __call__(self, *args, **kwargs):
+        if not ProgramTranslator._enabled:
+            # ProgramTranslator.enable(False): run the original function
+            # eagerly (the reference's dygraph fallback)
+            return self._function(*args, **kwargs)
         binding = self._ensure_binding()
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
         # Partition: Tensors/arrays become traced inputs; python scalars and
@@ -613,3 +618,65 @@ def load(path: str, **config) -> TranslatedLayer:
     params = [data["param:" + n] for n in meta["param_names"]]
     buffers = [data["buffer:" + n] for n in meta["buffer_names"]]
     return TranslatedLayer(exported, params, buffers, meta)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False) -> None:
+    """dy2static debugging-API parity: the trace-based pipeline has no
+    transformed source code to print; retained as an accepted no-op."""
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False) -> None:
+    """dy2static debugging-API parity (see set_code_level)."""
+
+
+class ProgramTranslator:
+    """program_translator.py:759 parity: global dygraph→static switch.
+
+    ``enable(False)`` makes ``to_static``-decorated functions run eagerly
+    (the reference's fallback interpreter path == our eager tape).
+    """
+
+    _instance = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static: bool) -> None:
+        type(self)._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self) -> bool:
+        return type(self)._enabled
+
+
+def enable_to_static(enable: bool = True) -> None:
+    """paddle.jit.enable_to_static parity."""
+    ProgramTranslator.get_instance().enable(enable)
+
+
+class TracedLayer:
+    """fluid/dygraph/jit.py TracedLayer parity over to_static machinery:
+    trace once with example inputs, then run/save the traced program."""
+
+    def __init__(self, static_fn, examples):
+        self._fn = static_fn
+        self._examples = examples
+
+    @classmethod
+    def trace(cls, layer, inputs):
+        inputs = list(inputs)
+        fn = to_static(lambda *a: layer(*a))
+        out = fn(*inputs)
+        return out, cls(fn, inputs)
+
+    def __call__(self, *args):
+        return self._fn(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        specs = [InputSpec.from_tensor(t) if hasattr(t, "value") else t
+                 for t in self._examples]
+        save(self._fn, path, input_spec=specs)

@@ -183,3 +183,19 @@ class Auc(Metric):
 
     def name(self):
         return self._name
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None, name=None):
+    """paddle.metric.accuracy functional parity: top-k accuracy scalar."""
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import make_op
+
+    def _raw(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        lab2 = jnp.asarray(lab).reshape(-1, 1)
+        hit = (topk == lab2).any(axis=-1)
+        return hit.astype(jnp.float32).mean()
+
+    return make_op(_raw, differentiable=False, op_name="metric_accuracy")(
+        input, label)

@@ -201,11 +201,108 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        """Dygraph minimize: backward + step (fleet_base.py:1288 single-proc)."""
+        """Dygraph minimize: backward + step (fleet_base.py:1288 single-proc).
+
+        Static-graph loss (a ``paddle.static.Variable``): append grad +
+        update nodes to the loss's program; ``Executor.run`` applies them
+        (the GradientDescent/Adam op insertion of fluid/optimizer.py)."""
+        from ..static.graph import Variable as _StaticVar
+
+        if isinstance(loss, _StaticVar):
+            return self._minimize_static(loss, parameters)
         if loss._node is not None:
             loss.backward()
         self.step()
         return None, None
+
+    def _minimize_static(self, loss, parameters=None):
+        """Static path: one joint functional update node for ALL parameters
+        (regularizer → grad clip → per-param _apply_one, same pipeline as
+        the eager step()); optimizer state slots become persistable
+        variables.  The learning rate is read at evaluation time, so
+        schedulers act per Executor.run; a CompiledProgram bakes the value
+        current at first compile (reference CompiledProgram semantics)."""
+        import jax.numpy as jnp
+
+        from ..static.graph import Variable as _StaticVar
+        from ..static.graph import (append_backward,
+                                    default_startup_program, global_scope)
+
+        pairs = append_backward(loss, parameter_list=parameters)
+        scope = global_scope()
+        prog = loss.program
+        params = [p for p, _ in pairs]
+        grads = [g for _, g in pairs]
+        layout: list = []          # per-param sorted state keys
+        state_vars: list = []      # flat persist vars matching layout
+        for param in params:
+            probe = type("_P", (), {"value": jnp.zeros(tuple(param.shape),
+                                                       param.dtype),
+                                    "name": param.name,
+                                    "stop_gradient": False})()
+            slots = {k: v for k, v in self._init_state(probe).items()
+                     if hasattr(v, "shape")}
+            keys = sorted(slots)
+            layout.append(keys)
+            for k in keys:
+                sv = _StaticVar("persist", "%s__%s" % (param.name, k),
+                                slots[k].shape, slots[k].dtype, prog,
+                                meta={"trainable": False})
+                init_val = slots[k]
+                default_startup_program()._initializers.append(
+                    (sv, (lambda v: (lambda: jnp.asarray(v)))(init_val)))
+                scope._values.setdefault(sv.name, jnp.asarray(init_val))
+                state_vars.append(sv)
+
+        n = len(params)
+
+        def apply_all(*vals):
+            p_vals = list(vals[:n])
+            g_vals = list(vals[n:2 * n])
+            s_vals = list(vals[2 * n:])
+            pg = [(p, self._regularized(p, pv, gv))
+                  for p, pv, gv in zip(params, p_vals, g_vals)]
+            if self._grad_clip is not None:
+                pg = self._grad_clip(pg)
+            lr = jnp.asarray(self._lr_value(), jnp.float32)
+            outs = []
+            si = 0
+            for (p, g), pv, keys in zip(pg, p_vals, layout):
+                state = dict(zip(keys, s_vals[si:si + len(keys)]))
+                si += len(keys)
+                new_val, new_state = self._apply_one(pv, g, state, lr, p)
+                outs.append(new_val)
+                outs.extend(new_state[k] for k in keys)
+            return tuple(outs)
+
+        bundle = _StaticVar(
+            "op", None, params[0].shape, params[0].dtype, prog, op=apply_all,
+            inputs=(tuple(params) + tuple(grads) + tuple(state_vars), {}),
+            meta={"op_name": "optimizer_update"})
+
+        def pick(i, shape, dtype):
+            return _StaticVar(
+                "op", None, shape, dtype, prog,
+                op=(lambda t, _i=i: t[_i]), inputs=((bundle,), {}),
+                meta={"op_name": "optimizer_update_slot"})
+
+        out_i = 0
+        sv_i = 0
+        for param, keys in zip(params, layout):
+            prog._updates.append((param, pick(out_i, param.shape,
+                                              param.dtype)))
+            out_i += 1
+            for k in keys:
+                sv = state_vars[sv_i]
+                prog._updates.append((sv, pick(out_i, sv.shape, sv.dtype)))
+                out_i += 1
+                sv_i += 1
+        return None, list(pairs)
+
+    def _lr_value(self):
+        lr = self._learning_rate
+        return lr() if callable(lr) and not isinstance(lr, (int, float)) \
+            else (lr.get_lr() if hasattr(lr, "get_lr") else float(lr))
 
     # -- checkpoint -------------------------------------------------------
     def state_dict(self) -> dict:

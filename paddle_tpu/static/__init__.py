@@ -1,0 +1,228 @@
+"""``paddle_tpu.static`` — the static-graph compatibility surface.
+
+Reference parity: ``python/paddle/static/__init__.py`` re-exports over
+``fluid/framework.py`` / ``fluid/executor.py`` / ``fluid/io.py``.  The
+graph engine itself lives in ``graph.py`` (deferred jax computation instead
+of an interpreted ProgramDesc); this module adds the io / metric helpers
+and keeps the structured-control-flow names importable.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..jit import InputSpec  # noqa: F401
+from ..tensor.control_flow import case, cond, switch_case, while_loop  # noqa: F401
+from .graph import (  # noqa: F401
+    BuildStrategy, CompiledProgram, Executor, ExecutionStrategy, Print,
+    Program, Scope, Variable, WeightNormParamAttr, append_backward,
+    cpu_places, create_global_var, create_parameter, cuda_places, data,
+    default_main_program, default_startup_program, device_guard, global_scope,
+    gradients, name_scope, program_guard, py_func, scope_guard, xpu_places,
+)
+
+
+class nn:
+    """paddle.static.nn subset: structured control flow + fc."""
+
+    while_loop = staticmethod(while_loop)
+    cond = staticmethod(cond)
+    case = staticmethod(case)
+    switch_case = staticmethod(switch_case)
+
+    @staticmethod
+    def fc(x, size, num_flatten_dims: int = 1, weight_attr=None,
+           bias_attr=None, activation=None, name=None):
+        """static.nn.fc parity over create_parameter + matmul."""
+        from .. import tensor as T
+        from ..nn import functional as F
+
+        in_dim = 1
+        for s in x.shape[num_flatten_dims:]:
+            in_dim *= int(s)
+        w = create_parameter([in_dim, size], x.dtype, name=None)
+        b = create_parameter([size], x.dtype, is_bias=True)
+        flat = T.reshape(x, list(x.shape[:num_flatten_dims]) + [in_dim]) \
+            if len(x.shape) > num_flatten_dims + 1 else x
+        out = T.add(T.matmul(flat, w), b)
+        if activation:
+            out = getattr(F, activation)(out)
+        return out
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None):
+    """layers.accuracy static parity: builds a graph node."""
+    from ..metric import accuracy as _acc
+
+    return _acc(input, label, k=k)
+
+
+def auc(input, label, curve: str = "ROC", num_thresholds: int = 4095,
+        topk: int = 1, slide_steps: int = 1):
+    """layers.auc static parity (stateless single-batch AUC node)."""
+    import jax.numpy as jnp
+
+    from ..framework.dispatch import make_op
+
+    def _raw(pred, lab):
+        score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        lab2 = jnp.asarray(lab).reshape(-1)
+        order = jnp.argsort(score)
+        ranks = jnp.empty_like(order).at[order].set(
+            jnp.arange(1, score.shape[0] + 1))
+        pos = (lab2 > 0)
+        n_pos = pos.sum()
+        n_neg = lab2.shape[0] - n_pos
+        s = jnp.where(pos, ranks, 0).sum()
+        return jnp.where(
+            (n_pos > 0) & (n_neg > 0),
+            (s - n_pos * (n_pos + 1) / 2.0) / jnp.maximum(n_pos * n_neg, 1),
+            jnp.float32(0.0)).astype(jnp.float32)
+
+    node = make_op(_raw, differentiable=False, op_name="auc")(input, label)
+    return node, [], []
+
+
+# -- persistence (fluid/io.py parity) ---------------------------------------
+
+def _collect_persistables(program: Program) -> Dict[str, np.ndarray]:
+    scope = global_scope()
+    return {name: np.asarray(scope._values[name])
+            for name, v in program._vars.items()
+            if v.kind == "persist" and name in scope._values}
+
+
+def save(program: Program, model_path: str, protocol: int = 4, **kwargs):
+    """static.save parity: persistables → <path>.pdparams."""
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    np.savez(model_path + ".pdparams", **_collect_persistables(program))
+
+
+def load(program: Program, model_path: str, executor=None, var_list=None):
+    """static.load parity."""
+    with np.load(model_path + ".pdparams.npz", allow_pickle=False) as z:
+        state = {k: z[k] for k in z.files}
+    program.set_state_dict(state)
+
+
+def load_program_state(model_path: str, var_list=None) -> Dict[str, np.ndarray]:
+    with np.load(model_path + ".pdparams.npz", allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+def set_program_state(program: Program, state_dict: Dict[str, np.ndarray]):
+    program.set_state_dict(state_dict)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs) -> bytes:
+    """Structural manifest of the graph (framework.proto stand-in)."""
+    fetch = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    doc = {
+        "feeds": [{"name": v.name, "shape": list(v.shape),
+                   "dtype": v.dtype.name} for v in feeds],
+        "fetches": [v.name for v in fetch],
+    }
+    return json.dumps(doc).encode("utf-8")
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs) -> bytes:
+    import io as _io
+
+    prog = (fetch_vars[0] if isinstance(fetch_vars, (list, tuple))
+            else fetch_vars).program
+    buf = _io.BytesIO()
+    np.savez(buf, **_collect_persistables(prog))
+    return buf.getvalue()
+
+
+def deserialize_program(data: bytes) -> dict:
+    return json.loads(data.decode("utf-8"))
+
+
+def deserialize_persistables(program: Program, data: bytes, executor=None):
+    import io as _io
+
+    with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+        program.set_state_dict({k: z[k] for k in z.files})
+
+
+def save_to_file(path: str, content: bytes) -> None:
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def normalize_program(program: Program, feed_vars, fetch_vars) -> Program:
+    return program.clone(for_test=True)
+
+
+# Same-process program registry: the deferred graph is a live python
+# object, not a serialized desc (jit.save/load carries the compiled-artifact
+# path for cross-process deployment), so save stamps a token that load
+# resolves back to the Program when still alive.
+_saved_programs: Dict[str, Program] = {}
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program: Optional[Program] = None, **kwargs):
+    """static.save_inference_model parity: manifest + persistables."""
+    fetch = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    program = program or fetch[0].program or default_main_program()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    save_to_file(path_prefix + ".pdmodel",
+                 serialize_program(feed_vars, fetch_vars))
+    np.savez(path_prefix + ".pdiparams", **_collect_persistables(program))
+    token = "prog_%d" % id(program)
+    # prune to the inference subgraph: no optimizer update ops (the
+    # reference's prune + for_test clone)
+    _saved_programs[token] = program.clone(for_test=True)
+    meta = {"fetches": [v.name for v in fetch], "token": token}
+    save_to_file(path_prefix + ".pdmeta", json.dumps(meta).encode())
+
+
+def load_inference_model(path_prefix: str, executor,
+                         program: Optional[Program] = None, **kwargs):
+    """Returns (program, feed_names, fetch_vars) like the reference."""
+    manifest = json.loads(load_from_file(path_prefix + ".pdmodel").decode())
+    meta = {}
+    if os.path.exists(path_prefix + ".pdmeta"):
+        meta = json.loads(load_from_file(path_prefix + ".pdmeta").decode())
+    prog = program or _saved_programs.get(meta.get("token", ""), None) \
+        or default_main_program()
+    with np.load(path_prefix + ".pdiparams.npz", allow_pickle=False) as z:
+        prog.set_state_dict({k: z[k] for k in z.files})
+    feed_names = [f["name"] for f in manifest["feeds"]]
+    fetch_vars = [prog._vars[name] for name in meta.get("fetches", ())
+                  if name in prog._vars]
+    return prog, feed_names, fetch_vars
+
+
+ParallelExecutor = CompiledProgram  # graph replication == SPMD compilation
+
+
+__all__ = [
+    "InputSpec", "nn", "while_loop", "cond", "case", "switch_case",
+    "Variable", "Program", "Scope", "Executor", "CompiledProgram",
+    "ParallelExecutor", "BuildStrategy", "ExecutionStrategy", "Print",
+    "WeightNormParamAttr", "append_backward", "gradients", "accuracy", "auc",
+    "cpu_places", "cuda_places", "xpu_places", "create_global_var",
+    "create_parameter", "data", "default_main_program",
+    "default_startup_program", "device_guard", "global_scope", "name_scope",
+    "program_guard", "py_func", "scope_guard", "save", "load",
+    "load_program_state", "set_program_state", "serialize_program",
+    "serialize_persistables", "deserialize_program",
+    "deserialize_persistables", "save_to_file", "load_from_file",
+    "normalize_program", "save_inference_model", "load_inference_model",
+]

@@ -1,0 +1,603 @@
+"""Deferred-graph engine behind ``paddle.static``.
+
+Reference parity: ``fluid/framework.py`` (Program:4017, Variable:805,
+program_guard:5686), ``fluid/executor.py`` (Executor.run:916, Scope),
+``fluid/backward.py`` (append_backward/gradients), ``fluid/compiler.py``
+(CompiledProgram).
+
+TPU-first design: the reference interprets a ProgramDesc op-by-op in C++.
+Here a Program is a *deferred jax computation*: ops called on symbolic
+``Variable``s (via the dispatch hook in ``framework/dispatch.py``) record
+(raw_fn, inputs) nodes; ``Executor.run`` evaluates fetches functionally —
+eagerly op-by-op for debuggability, or whole-program under ``jax.jit`` when
+wrapped in ``CompiledProgram`` (the ParallelExecutor analog: one fused XLA
+program instead of an op interpreter).  Shapes are inferred at build time
+with ``jax.eval_shape`` (InferShape parity, for free).  Gradients are not
+graph-rewritten (backward.py's op-by-op grad program): ``gradients()``
+nodes evaluate ``jax.grad`` of the deferred computation — the autodiff IS
+the transform.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..framework import dispatch
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "Variable", "Program", "Scope", "Executor", "CompiledProgram",
+    "BuildStrategy", "ExecutionStrategy", "data", "program_guard",
+    "default_main_program", "default_startup_program", "global_scope",
+    "scope_guard", "name_scope", "create_global_var", "create_parameter",
+    "gradients", "append_backward", "py_func", "Print", "device_guard",
+    "WeightNormParamAttr", "cpu_places", "cuda_places", "xpu_places",
+]
+
+
+class Variable:
+    """Symbolic graph node (framework.py Variable:805 parity).
+
+    kind: 'data' (feed placeholder), 'op' (deferred computation),
+    'persist' (parameter / global var living in a Scope), 'grad'
+    (jax.grad of a target w.r.t. a persist/data var), 'py_func'.
+    """
+
+    _counter = 0
+
+    def __init__(self, kind: str, name: Optional[str], shape, dtype,
+                 program: "Program", op=None, inputs=(), meta=None):
+        if name is None:
+            Variable._counter += 1
+            name = "_generated_var_%d" % Variable._counter
+        self.kind = kind
+        self.name = name
+        self.shape = tuple(int(s) for s in shape)
+        self.dtype = np.dtype(dtype) if not isinstance(dtype, np.dtype) \
+            else dtype
+        self.program = program
+        self.op = op                    # raw fn for 'op' kind
+        self.inputs = tuple(inputs)     # mixed Variables / constants
+        self.meta = meta or {}
+        self.persistable = kind == "persist"
+        self.stop_gradient = kind not in ("persist",) \
+            and not self.meta.get("trainable", False)
+        if program is not None:
+            program._vars[self.name] = self
+
+    # paddle Variable surface --------------------------------------------
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype):
+        from .. import tensor as T
+
+        return T.cast(self, dtype)
+
+    def __repr__(self):
+        return "static.Variable(name=%s, kind=%s, shape=%s, dtype=%s)" % (
+            self.name, self.kind, list(self.shape), self.dtype.name)
+
+    __str__ = __repr__
+
+
+def _install_variable_operators():
+    """math_op_patch.py parity: arithmetic on Variables builds graph ops."""
+    from .. import tensor as T
+
+    table = {
+        "__add__": T.add, "__radd__": lambda a, b: T.add(b, a),
+        "__sub__": T.subtract, "__rsub__": lambda a, b: T.subtract(b, a),
+        "__mul__": T.multiply, "__rmul__": lambda a, b: T.multiply(b, a),
+        "__truediv__": T.divide, "__rtruediv__": lambda a, b: T.divide(b, a),
+        "__pow__": T.pow, "__neg__": T.neg, "__matmul__": T.matmul,
+        "__lt__": T.less_than, "__le__": T.less_equal, "__gt__": T.greater_than,
+        "__ge__": T.greater_equal,
+    }
+    for name, fn in table.items():
+        setattr(Variable, name, (lambda f: lambda *a: f(*a))(fn))
+    for method in ("sum", "mean", "max", "min", "reshape", "transpose",
+                   "cast", "flatten", "matmul", "sqrt", "exp", "log",
+                   "abs", "clip", "unsqueeze", "squeeze"):
+        fn = getattr(T, method if method != "cast" else "cast")
+
+        def mk(f):
+            def m(self, *args, **kwargs):
+                return f(self, *args, **kwargs)
+            return m
+
+        setattr(Variable, method, mk(fn))
+
+
+class Program:
+    """framework.py Program:4017 parity: a recording context for ops."""
+
+    def __init__(self):
+        self._vars: Dict[str, Variable] = {}
+        self._updates: List[Tuple[Variable, Variable]] = []  # (persist, new)
+        self._initializers: List[Tuple[Variable, Callable]] = []
+        self.random_seed = 0
+
+    # block surface (framework.py Block:2522): single implicit block
+    def global_block(self):
+        return self
+
+    def var(self, name: str) -> Variable:
+        if name not in self._vars:
+            raise InvalidArgumentError("program has no variable %r" % name)
+        return self._vars[name]
+
+    def all_parameters(self) -> List[Variable]:
+        return [v for v in self._vars.values()
+                if v.kind == "persist" and v.meta.get("trainable")]
+
+    def list_vars(self):
+        return list(self._vars.values())
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p._vars = dict(self._vars)
+        p._initializers = list(self._initializers)
+        if not for_test:
+            p._updates = list(self._updates)
+        return p
+
+    def state_dict(self, mode: str = "all"):
+        scope = global_scope()
+        out = {}
+        for v in self._vars.values():
+            if v.kind == "persist" and v.name in scope._values:
+                out[v.name] = scope._values[v.name]
+        return out
+
+    def set_state_dict(self, state):
+        scope = global_scope()
+        for k, val in state.items():
+            scope._values[k] = jnp.asarray(val)
+
+
+class Scope:
+    """Name→value store (fluid/executor.py Scope / C++ Scope parity)."""
+
+    def __init__(self):
+        self._values: Dict[str, Any] = {}
+
+    def find_var(self, name: str):
+        if name not in self._values:
+            return None
+
+        class _Var:
+            def __init__(self, v):
+                self._v = v
+
+            def get_tensor(self):
+                return np.asarray(self._v)
+
+        return _Var(self._values[name])
+
+    def set(self, name: str, value) -> None:
+        self._values[name] = jnp.asarray(value)
+
+
+_state = threading.local()
+
+
+def _tls():
+    if not hasattr(_state, "main"):
+        _state.main = Program()
+        _state.startup = Program()
+        _state.scope = Scope()
+    return _state
+
+
+def default_main_program() -> Program:
+    return _tls().main
+
+
+def default_startup_program() -> Program:
+    return _tls().startup
+
+
+def global_scope() -> Scope:
+    return _tls().scope
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    """framework.py:5686 parity."""
+    st = _tls()
+    prev = (st.main, st.startup)
+    st.main = main_program
+    st.startup = startup_program if startup_program is not None else st.startup
+    try:
+        yield
+    finally:
+        st.main, st.startup = prev
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    st = _tls()
+    prev = st.scope
+    st.scope = scope
+    try:
+        yield
+    finally:
+        st.scope = prev
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str = ""):
+    yield  # naming sugar only; variable names already carry uniqueness
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """framework.py:5801 parity: placement hints dissolve into GSPMD —
+    accepted and recorded as a no-op under single-program compilation."""
+    yield
+
+
+def cpu_places(device_count: Optional[int] = None):
+    from ..core.device import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    from ..core.device import Place
+
+    ids = device_ids if device_ids is not None else [0]
+    return [Place("tpu", i) for i in ids]  # accelerator slots on this stack
+
+
+xpu_places = cuda_places
+
+
+class WeightNormParamAttr:
+    """ParamAttr marker parity (weight-norm reparameterization request)."""
+
+    def __init__(self, dim=None, name=None, initializer=None, **kwargs):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+
+
+# -- graph construction -----------------------------------------------------
+
+def data(name: str, shape, dtype="float32", lod_level: int = 0) -> Variable:
+    """static.data parity: a feed placeholder (None/-1 dims = dynamic)."""
+    shape = [(-1 if s is None else int(s)) for s in shape]
+    return Variable("data", name, shape, dtype, default_main_program())
+
+
+def _aval_of(v) -> jax.ShapeDtypeStruct:
+    shape = tuple(1 if s == -1 else s for s in v.shape)
+    return jax.ShapeDtypeStruct(shape, v.dtype)
+
+
+def _infer(fn, args, kwargs) -> Tuple[Tuple[int, ...], np.dtype, bool]:
+    """Build-time shape/dtype inference via jax.eval_shape."""
+    dyn_batch = False
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=lambda l: isinstance(l, (Variable, Tensor)))
+    specs = []
+    var_pos = []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, Variable):
+            if leaf.shape and leaf.shape[0] == -1:
+                dyn_batch = True
+            specs.append(_aval_of(leaf))
+            var_pos.append(i)
+        elif isinstance(leaf, Tensor):
+            specs.append(jax.ShapeDtypeStruct(tuple(leaf.shape),
+                                              np.dtype(leaf.value.dtype)))
+            var_pos.append(i)
+
+    def shaped(*spec_leaves):
+        # only tensor-like leaves trace; python scalars/lists stay static
+        full = list(leaves)
+        for pos, v in zip(var_pos, spec_leaves):
+            full[pos] = v
+        a, k = jax.tree_util.tree_unflatten(treedef, full)
+        return fn(*a, **k)
+
+    out = jax.eval_shape(shaped, *specs)
+    out_leaves = jax.tree_util.tree_leaves(out)
+    first = out_leaves[0]
+    return tuple(first.shape), np.dtype(first.dtype), dyn_batch
+
+
+def _symbolic_apply(fn, op_name, args, kwargs):
+    """dispatch hook: record an op on symbolic inputs as a graph node."""
+    shape, dtype, dyn = _infer(fn, args, kwargs)
+    if dyn and shape and shape[0] == 1:
+        shape = (-1,) + shape[1:]
+    prog = None
+    for leaf in jax.tree_util.tree_leaves(
+            (args, kwargs), is_leaf=lambda l: isinstance(l, Variable)):
+        if isinstance(leaf, Variable):
+            prog = leaf.program
+            break
+    return Variable("op", None, shape, dtype, prog, op=fn,
+                    inputs=(args, kwargs), meta={"op_name": op_name})
+
+
+def create_global_var(shape, value, dtype, persistable: bool = False,
+                      force_cpu: bool = False, name: Optional[str] = None
+                      ) -> Variable:
+    v = Variable("persist", name, shape, dtype, default_main_program(),
+                 meta={"trainable": False})
+    init = lambda: jnp.full(tuple(v.shape), value, v.dtype)
+    default_startup_program()._initializers.append((v, init))
+    global_scope()._values.setdefault(v.name, init())
+    return v
+
+
+def create_parameter(shape, dtype, name: Optional[str] = None, attr=None,
+                     is_bias: bool = False, default_initializer=None
+                     ) -> Variable:
+    """layers.create_parameter static parity: trainable persistable var,
+    value materialized by running the startup program."""
+    from ..nn import initializer as I
+
+    init_obj = default_initializer or (
+        I.Constant(0.0) if is_bias else I.XavierUniform())
+    v = Variable("persist", name, shape, dtype, default_main_program(),
+                 meta={"trainable": True})
+    v.stop_gradient = False
+
+    def init(v=v, init_obj=init_obj):
+        return init_obj(tuple(v.shape), np.dtype(v.dtype).name)
+
+    default_startup_program()._initializers.append((v, init))
+    return v
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None
+              ) -> List[Variable]:
+    """backward.py calc_gradient parity: d(sum targets)/d(inputs) nodes."""
+    targets = targets if isinstance(targets, (list, tuple)) else [targets]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = []
+    for x in inputs:
+        g = Variable("grad", None, x.shape, x.dtype, x.program,
+                     meta={"targets": tuple(targets), "wrt": x})
+        outs.append(g)
+    return outs
+
+
+def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
+                    callbacks=None) -> List[Tuple[Variable, Variable]]:
+    """backward.py append_backward parity: grads for every trainable
+    parameter in the loss's program."""
+    params = parameter_list or loss.program.all_parameters()
+    params = [loss.program.var(p) if isinstance(p, str) else p
+              for p in params]
+    grads = gradients([loss], params)
+    return list(zip(params, grads))
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """py_func_op parity: a host python function as a graph node.  The
+    functional executor calls it with evaluated inputs (host round-trip,
+    like the reference's py_func op); backward_func is honored by the
+    grad evaluator via jax.pure_callback being out of scope — forward-only
+    (matching py_func's dominant use)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    template = outs[0]
+
+    def host_fn(*vals):
+        res = func(*[np.asarray(v) for v in vals])
+        return jnp.asarray(res)
+
+    v = Variable("py_func", None, template.shape, template.dtype,
+                 template.program if isinstance(template, Variable)
+                 else xs[0].program,
+                 op=host_fn, inputs=(tuple(xs), {}),
+                 meta={"host": True})
+    return v
+
+
+def Print(input: Variable, first_n: int = -1, message: Optional[str] = None,
+          summarize: int = 20, **kwargs) -> Variable:
+    """print_op parity: pass-through node that prints at evaluation."""
+
+    def printing(v):
+        flat = np.asarray(v).reshape(-1)
+        head = flat[:summarize] if summarize and summarize > 0 else flat
+        print("%s %s" % (message or "Variable:", head))
+        return jnp.asarray(v)
+
+    nv = Variable("py_func", None, input.shape, input.dtype, input.program,
+                  op=printing, inputs=((input,), {}), meta={"host": True})
+    return nv
+
+
+# -- evaluation -------------------------------------------------------------
+
+class _Evaluator:
+    """Functional interpreter over the deferred graph."""
+
+    def __init__(self, feed: Dict[str, Any], scope: Scope,
+                 overrides: Optional[Dict[str, Any]] = None):
+        self.feed = feed or {}
+        self.scope = scope
+        self.overrides = overrides or {}
+        self.memo: Dict[int, Any] = {}
+
+    def value_of(self, node):
+        if isinstance(node, Tensor):
+            return node.value
+        if not isinstance(node, Variable):
+            return node
+        key = id(node)
+        if key in self.memo:
+            return self.memo[key]
+        val = self._compute(node)
+        self.memo[key] = val
+        return val
+
+    def _compute(self, v: Variable):
+        if v.name in self.overrides:
+            return self.overrides[v.name]
+        if v.kind == "data":
+            if v.name not in self.feed:
+                raise InvalidArgumentError(
+                    "feed is missing input variable %r" % v.name)
+            return jnp.asarray(self.feed[v.name])
+        if v.kind == "persist":
+            if v.name not in self.scope._values:
+                raise InvalidArgumentError(
+                    "variable %r is uninitialized; run the startup program "
+                    "first (exe.run(paddle.static.default_startup_program()))"
+                    % v.name)
+            return self.scope._values[v.name]
+        if v.kind in ("op", "py_func"):
+            args, kwargs = v.inputs
+            ev = lambda t: jax.tree_util.tree_map(
+                self.value_of, t,
+                is_leaf=lambda l: isinstance(l, (Variable, Tensor)))
+            if v.meta.get("host"):
+                vals = [self.value_of(a) for a in args]
+                return v.op(*vals)
+            return v.op(*ev(list(args)), **ev(dict(kwargs)))
+        if v.kind == "grad":
+            return self._grad(v)
+        raise InvalidArgumentError("unknown variable kind %r" % v.kind)
+
+    def _grad(self, gvar: Variable):
+        targets = gvar.meta["targets"]
+        wrt: Variable = gvar.meta["wrt"]
+
+        def loss_fn(x_val):
+            ev = _Evaluator(self.feed, self.scope,
+                            overrides={**self.overrides, wrt.name: x_val})
+            total = 0.0
+            for t in targets:
+                total = total + jnp.sum(ev.value_of(t))
+            return total
+
+        base = jnp.asarray(self.value_of(wrt))
+        if not jnp.issubdtype(base.dtype, jnp.floating):
+            raise InvalidArgumentError(
+                "cannot differentiate w.r.t. non-float variable %r"
+                % wrt.name)
+        return jax.grad(loss_fn)(base)
+
+
+class BuildStrategy:
+    """compiler.py BuildStrategy parity: fusion/memory knobs all dissolve
+    into XLA; retained as an attribute bag."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+
+    def __setattr__(self, k, v):  # accept any reference knob
+        object.__setattr__(self, k, v)
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """compiler.py CompiledProgram parity: whole-program jax.jit.
+
+    ``Executor.run`` on a CompiledProgram evaluates (feeds, params) →
+    (fetches, updated params) as ONE jitted XLA program — the
+    ParallelExecutor/build-strategy pipeline collapses into the compiler.
+    """
+
+    def __init__(self, program: Program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy or BuildStrategy()
+        self._cache = {}
+
+    def with_data_parallel(self, loss_name=None, build_strategy=None,
+                           exec_strategy=None, places=None):
+        return self  # SPMD replaces graph replication
+
+
+class Executor:
+    """fluid/executor.py Executor:916 parity over the functional graph."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def close(self):
+        return None
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            scope: Optional[Scope] = None, return_numpy: bool = True,
+            **kwargs):
+        scope = scope or global_scope()
+        compiled = isinstance(program, CompiledProgram)
+        prog = program.program if compiled else \
+            (program or default_main_program())
+        # startup semantics: materialize pending initializers
+        if prog._initializers and not fetch_list:
+            for v, init in prog._initializers:
+                scope._values[v.name] = jnp.asarray(init())
+            return []
+        fetch_list = fetch_list or []
+        fetch_vars = [prog.var(f) if isinstance(f, str) else f
+                      for f in fetch_list]
+        if compiled:
+            outs, new_params = self._run_jit(prog, feed or {}, fetch_vars,
+                                             scope)
+        else:
+            ev = _Evaluator(feed or {}, scope)
+            outs = [ev.value_of(v) for v in fetch_vars]
+            new_params = [(p.name, ev.value_of(nv))
+                          for p, nv in prog._updates]
+        for name, val in new_params:
+            scope._values[name] = val
+        if return_numpy:
+            outs = [np.asarray(o) for o in outs]
+        return outs
+
+    def _run_jit(self, prog: Program, feed, fetch_vars, scope):
+        feed_names = tuple(sorted(feed))
+        fetch_key = tuple(id(v) for v in fetch_vars)
+        param_names = tuple(sorted(
+            n for n in scope._values
+            if n in prog._vars and prog._vars[n].kind == "persist"))
+        key = (feed_names, fetch_key, param_names,
+               tuple(np.asarray(feed[n]).shape for n in feed_names))
+        cache = getattr(prog, "_jit_cache", None)
+        if cache is None:
+            cache = prog._jit_cache = {}
+        if key not in cache:
+            def pure(feed_vals, param_vals):
+                f = dict(zip(feed_names, feed_vals))
+                overrides = dict(zip(param_names, param_vals))
+                ev = _Evaluator(f, scope, overrides=overrides)
+                outs = [ev.value_of(v) for v in fetch_vars]
+                upd_vals = [ev.value_of(nv) for _, nv in prog._updates]
+                return outs, upd_vals
+
+            cache[key] = jax.jit(pure)
+        feed_vals = [jnp.asarray(feed[n]) for n in feed_names]
+        param_vals = [scope._values[n] for n in param_names]
+        outs, upd_vals = cache[key](feed_vals, param_vals)
+        return outs, [(p.name, v)
+                      for (p, _), v in zip(prog._updates, upd_vals)]
+
+
+_install_variable_operators()
+dispatch.register_symbolic(Variable, _symbolic_apply)

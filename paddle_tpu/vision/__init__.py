@@ -8,3 +8,37 @@ from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 
 __all__ = ["datasets", "models", "ops", "transforms"]
+
+
+_image_backend = "cv2"
+
+
+def set_image_backend(backend: str) -> None:
+    """image.py parity: select the decode backend ('pil'/'cv2'-style numpy)."""
+    from ..core.errors import InvalidArgumentError
+
+    if backend not in ("pil", "cv2"):
+        raise InvalidArgumentError(
+            "image backend must be 'pil' or 'cv2', got %r" % backend)
+    global _image_backend
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path: str, backend=None):
+    """image.py parity: load an image file; numpy HWC for 'cv2' mode, a PIL
+    handle for 'pil'."""
+    from PIL import Image
+
+    img = Image.open(path)
+    if (backend or _image_backend) == "pil":
+        return img
+    import numpy as np
+
+    return np.asarray(img)
+
+
+__all__ += ["set_image_backend", "get_image_backend", "image_load"]

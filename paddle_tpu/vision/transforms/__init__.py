@@ -19,8 +19,11 @@ from ...framework.tensor import Tensor
 __all__ = [
     "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
     "RandomHorizontalFlip", "RandomVerticalFlip", "Transpose", "Pad",
-    "BrightnessTransform", "to_tensor", "normalize", "resize", "center_crop",
-    "crop", "hflip", "vflip", "pad",
+    "BrightnessTransform", "ContrastTransform", "SaturationTransform",
+    "HueTransform", "ColorJitter", "Grayscale", "RandomRotation",
+    "RandomResizedCrop", "to_tensor", "normalize", "resize", "center_crop",
+    "crop", "hflip", "vflip", "pad", "adjust_brightness", "adjust_contrast",
+    "adjust_saturation", "adjust_hue", "rotate", "to_grayscale",
 ]
 
 
@@ -269,3 +272,258 @@ class BrightnessTransform(BaseTransform):
         if arr.dtype == np.uint8:
             return np.clip(arr.astype(np.float32) * factor, 0, 255).astype(np.uint8)
         return (arr * np.asarray(factor, arr.dtype))  # float stays float
+
+
+# -- photometric functional ops (transforms/functional.py parity) ----------
+
+def _as_float(arr):
+    was_uint8 = arr.dtype == np.uint8
+    return arr.astype(np.float32), was_uint8
+
+
+def _restore(arr, was_uint8):
+    if was_uint8:
+        return np.clip(arr, 0, 255).astype(np.uint8)
+    return arr.astype(np.float32)
+
+
+def adjust_brightness(img, brightness_factor: float):
+    arr, u8 = _as_float(_to_numpy(img))
+    return _restore(arr * brightness_factor, u8)
+
+
+def to_grayscale(img, num_output_channels: int = 1):
+    arr = _to_numpy(img)
+    gray = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114)
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    return gray.astype(arr.dtype)
+
+
+def adjust_contrast(img, contrast_factor: float):
+    arr, u8 = _as_float(_to_numpy(img))
+    mean = to_grayscale(arr).mean()
+    return _restore(arr * contrast_factor + mean * (1 - contrast_factor), u8)
+
+
+def adjust_saturation(img, saturation_factor: float):
+    arr, u8 = _as_float(_to_numpy(img))
+    gray = to_grayscale(arr)
+    return _restore(arr * saturation_factor
+                    + gray * (1 - saturation_factor), u8)
+
+
+def adjust_hue(img, hue_factor: float):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV round-trip."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise InvalidArgumentError(
+            "hue_factor must be in [-0.5, 0.5], got %s" % hue_factor)
+    arr = _to_numpy(img)
+    f, u8 = _as_float(arr)
+    f = f / 255.0 if u8 else f
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f[..., :3].max(-1)
+    minc = f[..., :3].min(-1)
+    v = maxc
+    span = maxc - minc
+    s = np.where(maxc > 0, span / np.maximum(maxc, 1e-12), 0.0)
+    safe = np.maximum(span, 1e-12)
+    rc = (maxc - r) / safe
+    gc = (maxc - g) / safe
+    bc = (maxc - b) / safe
+    h = np.where(maxc == r, bc - gc,
+                 np.where(maxc == g, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(span > 0, (h / 6.0) % 1.0, 0.0)
+    h = (h + hue_factor) % 1.0
+    # hsv -> rgb
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * fr)
+    t = v * (1.0 - s * (1.0 - fr))
+    i = (i.astype(np.int32) % 6)[..., None]
+    out = np.select(
+        [i == 0, i == 1, i == 2, i == 3, i == 4, i == 5],
+        [np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+         np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+         np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    return _restore(out * 255.0 if u8 else out, u8)
+
+
+def rotate(img, angle: float, interpolation: str = "nearest",
+           expand: bool = False, center=None, fill=0):
+    """Rotate counter-clockwise by angle degrees (inverse affine map)."""
+    arr = _to_numpy(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    H, W = arr.shape[:2]
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    cy, cx = ((H - 1) / 2.0, (W - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    if expand:
+        newW = int(np.ceil(abs(W * cos) + abs(H * sin)))
+        newH = int(np.ceil(abs(W * sin) + abs(H * cos)))
+    else:
+        newW, newH = W, H
+    ys, xs = np.meshgrid(np.arange(newH), np.arange(newW), indexing="ij")
+    # destination center
+    dy, dx = (newH - 1) / 2.0, (newW - 1) / 2.0
+    yy = ys - (dy if expand else cy)
+    xx = xs - (dx if expand else cx)
+    # inverse rotation back into source coords
+    sx = cos * xx - sin * yy + cx
+    sy = sin * xx + cos * yy + cy
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        wx = (sx - x0)[..., None]
+        wy = (sy - y0)[..., None]
+
+        def take(yi, xi):
+            inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            v = arr[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)].astype(
+                np.float32)
+            v[~inside] = fill
+            return v
+
+        out = (take(y0, x0) * (1 - wy) * (1 - wx)
+               + take(y0, x0 + 1) * (1 - wy) * wx
+               + take(y0 + 1, x0) * wy * (1 - wx)
+               + take(y0 + 1, x0 + 1) * wy * wx)
+        out = out.astype(arr.dtype) if arr.dtype != np.uint8 \
+            else np.clip(out, 0, 255).astype(np.uint8)
+    else:
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        out = arr[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)].copy()
+        out[~inside] = fill
+    return out
+
+
+# -- photometric / geometric transform classes ------------------------------
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        if value < 0:
+            raise InvalidArgumentError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_numpy(img)
+        factor = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, factor)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        if value < 0:
+            raise InvalidArgumentError(
+                "saturation value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_numpy(img)
+        factor = random.uniform(max(0.0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, factor)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value: float, keys=None):
+        if not 0 <= value <= 0.5:
+            raise InvalidArgumentError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _to_numpy(img)
+        return adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self._transforms = [
+            BrightnessTransform(brightness), ContrastTransform(contrast),
+            SaturationTransform(saturation), HueTransform(hue),
+        ]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self._transforms[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels: int = 1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation: str = "nearest",
+                 expand: bool = False, center=None, fill=0, keys=None):
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise InvalidArgumentError(
+                    "degrees must be non-negative when scalar")
+            self.degrees = (-float(degrees), float(degrees))
+        else:
+            self.degrees = (float(degrees[0]), float(degrees[1]))
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, self.expand,
+                      self.center, self.fill)
+
+
+class RandomResizedCrop(BaseTransform):
+    """Random area/aspect crop resized to a fixed size (inception-style)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4, 4.0 / 3),
+                 interpolation: str = "bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def _sample(self, H, W):
+        area = H * W
+        for _ in range(10):
+            target = area * random.uniform(*self.scale)
+            log_ratio = (np.log(self.ratio[0]), np.log(self.ratio[1]))
+            aspect = np.exp(random.uniform(*log_ratio))
+            w = int(round(np.sqrt(target * aspect)))
+            h = int(round(np.sqrt(target / aspect)))
+            if 0 < w <= W and 0 < h <= H:
+                i = random.randint(0, H - h)
+                j = random.randint(0, W - w)
+                return i, j, h, w
+        # fallback: center crop at the closest valid aspect
+        in_ratio = W / H
+        if in_ratio < self.ratio[0]:
+            w, h = W, int(round(W / self.ratio[0]))
+        elif in_ratio > self.ratio[1]:
+            h, w = H, int(round(H * self.ratio[1]))
+        else:
+            w, h = W, H
+        return (H - h) // 2, (W - w) // 2, h, w
+
+    def _apply_image(self, img):
+        arr = _to_numpy(img)
+        i, j, h, w = self._sample(arr.shape[0], arr.shape[1])
+        return resize(arr[i:i + h, j:j + w], self.size, self.interpolation)

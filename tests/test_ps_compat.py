@@ -1,0 +1,186 @@
+"""PS-era compat: slot data generators + InMemory/Queue datasets,
+distributed.split, fleet role makers and UtilBase."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.distributed as dist
+import paddle_tpu.distributed.fleet as fleet_mod
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.distributed.fleet import (PaddleCloudRoleMaker, Role,
+                                          UserDefinedRoleMaker, UtilBase,
+                                          MultiSlotDataGenerator)
+
+
+def _write_slot_file(path, rows):
+    gen = MultiSlotDataGenerator()
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(gen._gen_str(row))
+
+
+@pytest.fixture
+def slot_file(tmp_path):
+    rows = [
+        [("ids", [3, 7, 9]), ("label", [1])],
+        [("ids", [5]), ("label", [0])],
+        [("ids", [2, 4]), ("label", [1])],
+    ]
+    path = str(tmp_path / "part-000")
+    _write_slot_file(path, rows)
+    return path, rows
+
+
+class _Var:
+    def __init__(self, name, dtype="int64"):
+        self.name = name
+        self.dtype = dtype
+
+
+def test_queue_dataset_streams(slot_file):
+    path, rows = slot_file
+    ds = dist.QueueDataset()
+    ds.init(batch_size=2, use_var=[_Var("ids"), _Var("label")])
+    ds.set_filelist([path])
+    batches = list(ds)
+    assert len(batches) == 2
+    b0 = batches[0]
+    # ragged slots are padded to the batch max width
+    assert b0["ids"].shape == (2, 3)
+    np.testing.assert_array_equal(b0["ids"][1], [5, 0, 0])
+    np.testing.assert_array_equal(b0["label"].ravel(), [1, 0])
+
+
+def test_inmemory_dataset_shuffle(slot_file):
+    path, rows = slot_file
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=1, use_var=[_Var("ids"), _Var("label")])
+    ds.set_filelist([path])
+    with pytest.raises(InvalidArgumentError):
+        list(ds)  # must load first
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    ds.local_shuffle(seed=0)
+    labels = [b["label"][0, 0] for b in ds]
+    assert sorted(labels) == [0, 1, 1]
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+
+def test_entries_validate():
+    assert dist.CountFilterEntry(5)._to_attr() == "count_filter_entry:5"
+    assert "0.5" in dist.ProbabilityEntry(0.5)._to_attr()
+    with pytest.raises(InvalidArgumentError):
+        dist.CountFilterEntry(0)
+    with pytest.raises(InvalidArgumentError):
+        dist.ProbabilityEntry(1.5)
+
+
+def test_role_makers(monkeypatch):
+    monkeypatch.setenv("PADDLE_TRAINER_ID", "2")
+    monkeypatch.setenv("PADDLE_TRAINER_ENDPOINTS",
+                       "10.0.0.1:6170,10.0.0.2:6170,10.0.0.3:6170")
+    rm = PaddleCloudRoleMaker(is_collective=True)
+    assert rm.worker_index() == 2
+    assert rm.worker_num() == 3
+    assert rm.is_worker() and not rm.is_first_worker()
+    assert rm.get_trainer_endpoints()[0] == "10.0.0.1:6170"
+
+    u = UserDefinedRoleMaker(current_id=0, role=Role.WORKER, worker_num=4)
+    assert u.is_first_worker() and u.worker_num() == 4
+
+
+def test_util_base(tmp_path):
+    util = UtilBase()
+    files = ["f%d" % i for i in range(7)]
+    shard = util.get_file_shard(files)
+    assert shard == sorted(files)[:7]  # single worker owns all
+    out = util.all_reduce(np.array([2.0, 3.0], np.float32))
+    np.testing.assert_allclose(out, [2.0, 3.0])
+    util.barrier()
+
+
+def test_distributed_split_linear():
+    from paddle_tpu.distributed.fleet import fleet as fleet_singleton
+
+    strategy = fleet_mod.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet_mod.init(is_collective=True, strategy=strategy)
+    try:
+        pt.seed(0)
+        x = pt.to_tensor(np.random.RandomState(0).randn(2, 8)
+                         .astype(np.float32))
+        out = dist.split(x, (8, 12), operation="linear", axis=1,
+                         num_partitions=4)
+        assert tuple(out.shape) == (2, 12)
+        out_row = dist.split(x, (8, 12), operation="linear", axis=0,
+                             num_partitions=4)
+        assert tuple(out_row.shape) == (2, 12)
+        ids = pt.to_tensor(np.array([[1, 5], [7, 2]], np.int32))
+        emb = dist.split(ids, (16, 6), operation="embedding",
+                         num_partitions=4)
+        assert tuple(emb.shape) == (2, 2, 6)
+        with pytest.raises(InvalidArgumentError):
+            dist.split(x, (8, 12), operation="linear", num_partitions=3)
+    finally:
+        fleet_singleton._initialized = False
+        fleet_singleton._hcg = None
+
+
+def test_split_reuses_weights():
+    """Repeated split() calls at one call site must reuse the same layer."""
+    from paddle_tpu.distributed.collective import get_split_layer
+    from paddle_tpu.distributed.fleet import fleet as fleet_singleton
+
+    strategy = fleet_mod.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                               "pp_degree": 1, "sharding_degree": 1}
+    fleet_mod.init(is_collective=True, strategy=strategy)
+    try:
+        x = pt.to_tensor(np.ones((2, 8), np.float32))
+        o1 = dist.split(x, (8, 12), operation="linear", axis=1, name="fc_a")
+        o2 = dist.split(x, (8, 12), operation="linear", axis=1, name="fc_a")
+        np.testing.assert_array_equal(np.asarray(o1.value),
+                                      np.asarray(o2.value))
+        layer = get_split_layer("fc_a")
+        assert len(list(layer.parameters())) >= 1
+    finally:
+        fleet_singleton._initialized = False
+        fleet_singleton._hcg = None
+
+
+def test_static_minimize_honors_clip_and_scheduler():
+    """Static minimize must apply grad clip and live LR (review item)."""
+    import paddle_tpu.static as static
+
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 2], "float32")
+        w_var = static.create_parameter([2, 1], "float32")
+        loss = pt.mean(pt.matmul(x, w_var) * 1e3)  # huge grads
+        sched = pt.optimizer.lr.StepDecay(learning_rate=1.0, step_size=1,
+                                          gamma=0.1)
+        opt = pt.optimizer.SGD(
+            learning_rate=sched,
+            grad_clip=pt.nn.ClipGradByGlobalNorm(1.0))
+        opt.minimize(loss)
+    exe = static.Executor()
+    import paddle_tpu.static as st
+    with st.scope_guard(st.Scope()):
+        exe.run(startup)
+        scope = st.global_scope()
+        xs = np.ones((4, 2), np.float32)
+        before = np.asarray(scope._values[w_var.name]).copy()
+        exe.run(main, feed={"x": xs}, fetch_list=[loss])
+        after1 = np.asarray(scope._values[w_var.name])
+        # clipped global grad norm 1.0 at lr 1.0 → |Δw| ≤ 1
+        step1 = np.abs(after1 - before).max()
+        assert step1 <= 1.0 + 1e-5, step1
+        sched.step()  # lr 1.0 → 0.1
+        exe.run(main, feed={"x": xs}, fetch_list=[loss])
+        after2 = np.asarray(scope._values[w_var.name])
+        step2 = np.abs(after2 - after1).max()
+        assert step2 <= 0.1 + 1e-6, (step1, step2)

@@ -1,0 +1,207 @@
+"""paddle.static compat layer: deferred-graph build, Executor eval,
+CompiledProgram whole-program jit, optimizer.minimize update ops,
+gradients, persistence, and the misc graph utilities."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+import paddle_tpu.static as static
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+@pytest.fixture
+def linreg(rng):
+    """A fresh linear-regression program + data."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [-1, 4], "float32")
+        y = static.data("y", [-1, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = pt.mean(pt.square(pred - y))
+    xs = rng.randn(32, 4).astype(np.float32)
+    ys = xs @ np.array([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    return main, startup, x, y, pred, loss, xs, ys
+
+
+def test_build_and_eval(linreg):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    assert isinstance(pred, static.Variable)
+    assert pred.shape == (-1, 1)  # batch stays dynamic through eval_shape
+    exe = static.Executor()
+    exe.run(startup)
+    (out,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[pred])
+    assert out.shape == (32, 1)
+    # fetch by name too
+    (out2,) = exe.run(main, feed={"x": xs, "y": ys},
+                      fetch_list=[pred.name])
+    np.testing.assert_array_equal(out, out2)
+
+
+def test_uninitialized_raises(linreg):
+    from paddle_tpu.core.errors import InvalidArgumentError
+
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    with static.scope_guard(static.Scope()):
+        exe = static.Executor()
+        with pytest.raises(InvalidArgumentError):
+            exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[pred])
+
+
+@pytest.mark.parametrize("compiled", [False, True])
+def test_sgd_minimize_trains(linreg, compiled):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    with static.program_guard(main, startup):
+        pt.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        prog = static.CompiledProgram(main) if compiled else main
+        losses = [float(exe.run(prog, feed={"x": xs, "y": ys},
+                                fetch_list=[loss])[0])
+                  for _ in range(60)]
+        assert losses[-1] < losses[0] * 0.1, losses[::20]
+
+
+def test_adam_state_slots_in_scope(linreg):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    with static.program_guard(main, startup):
+        pt.optimizer.Adam(learning_rate=0.05).minimize(loss)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()) as _:
+        exe.run(startup)
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        scope = static.global_scope()
+        moment_keys = [k for k in scope._values if "__moment" in k]
+        assert moment_keys, list(scope._values)
+        # moments actually update across steps
+        before = np.asarray(scope._values[moment_keys[0]]).copy()
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        after = np.asarray(scope._values[moment_keys[0]])
+        assert not np.allclose(before, after)
+
+
+def test_gradients_vs_numeric(linreg):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    w = main.all_parameters()[0]
+    with static.program_guard(main, startup):
+        (g,) = static.gradients([loss], [w])
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        feed = {"x": xs, "y": ys}
+        (gv,) = exe.run(main, feed=feed, fetch_list=[g])
+        # numeric check on one coordinate
+        scope = static.global_scope()
+        base = np.asarray(scope._values[w.name]).copy()
+        eps = 1e-3
+        bumped = base.copy()
+        bumped[0, 0] += eps
+        scope._values[w.name] = bumped
+        (l1,) = exe.run(main, feed=feed, fetch_list=[loss])
+        scope._values[w.name] = base - np.eye(4, 1) * eps
+        (l0,) = exe.run(main, feed=feed, fetch_list=[loss])
+        numeric = (float(l1) - float(l0)) / (2 * eps)
+        np.testing.assert_allclose(gv[0, 0], numeric, rtol=1e-2)
+
+
+def test_append_backward(linreg):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    with static.program_guard(main, startup):
+        pairs = static.append_backward(loss)
+    assert len(pairs) == len(main.all_parameters())
+    for p, g in pairs:
+        assert g.shape == p.shape
+
+
+def test_save_load_roundtrip(tmp_path, linreg):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        feed = {"x": xs, "y": ys}
+        before = exe.run(main, feed=feed, fetch_list=[pred])[0]
+        static.save(main, str(tmp_path / "model"))
+        scope = static.global_scope()
+        w = main.all_parameters()[0]
+        scope._values[w.name] = np.zeros_like(
+            np.asarray(scope._values[w.name]))
+        static.load(main, str(tmp_path / "model"))
+        after = exe.run(main, feed=feed, fetch_list=[pred])[0]
+        np.testing.assert_allclose(before, after, rtol=1e-5)
+        # program_state api
+        state = static.load_program_state(str(tmp_path / "model"))
+        assert w.name in state
+        static.set_program_state(main, state)
+
+
+def test_inference_model_roundtrip(tmp_path, linreg):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        path = str(tmp_path / "inf" / "model")
+        static.save_inference_model(path, [x], [pred], exe, program=main)
+        prog, feed_names, fetches = static.load_inference_model(path, exe)
+        assert feed_names == ["x"]
+        out = exe.run(prog, feed={"x": xs[:5]}, fetch_list=fetches)[0]
+        assert out.shape == (5, 1)
+        # serialize/deserialize helpers
+        blob = static.serialize_program([x], [pred])
+        doc = static.deserialize_program(blob)
+        assert doc["feeds"][0]["name"] == "x"
+        pblob = static.serialize_persistables([x], [pred])
+        static.deserialize_persistables(main, pblob)
+
+
+def test_py_func_print_metrics(linreg, capsys):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    with static.program_guard(main, startup):
+        doubled = static.py_func(lambda a: a * 2, x, out=x)
+        printed = static.Print(loss, message="static-loss:")
+        probs = static.data("probs", [-1, 2], "float32")
+        lab = static.data("lab", [-1, 1], "int64")
+        acc = static.accuracy(probs, lab)
+        auc_node, _, _ = static.auc(probs, lab)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        dv, _, accv, aucv = exe.run(main, feed={
+            "x": xs, "y": ys,
+            "probs": np.array([[0.1, 0.9], [0.8, 0.2]], np.float32),
+            "lab": np.array([[1], [0]], np.int64)},
+            fetch_list=[doubled, printed, acc, auc_node])
+    np.testing.assert_allclose(dv, xs * 2)
+    assert float(accv) == 1.0 and float(aucv) == 1.0
+    assert "static-loss:" in capsys.readouterr().out
+
+
+def test_variable_operators(linreg):
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    with static.program_guard(main, startup):
+        z = (x * 2 + 1).mean()
+    exe = static.Executor()
+    (zv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[z])
+    np.testing.assert_allclose(zv, (xs * 2 + 1).mean(), rtol=1e-6)
+
+
+def test_enable_disable_static():
+    assert pt.in_dynamic_mode()
+    pt.enable_static()
+    try:
+        assert not pt.in_dynamic_mode()
+    finally:
+        pt.disable_static()
+    assert pt.in_dynamic_mode()
+
+
+def test_fetch_by_name_requires_known_var(linreg):
+    from paddle_tpu.core.errors import InvalidArgumentError
+
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    exe = static.Executor()
+    with pytest.raises(InvalidArgumentError):
+        exe.run(main, feed={"x": xs}, fetch_list=["nope"])
