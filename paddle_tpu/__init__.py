@@ -74,6 +74,7 @@ from . import text  # noqa: F401
 from . import vision  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi import flops, summary  # noqa: F401
+from . import utils  # noqa: F401
 from .hapi import callbacks  # noqa: F401
 from .distributed.parallel import DataParallel  # noqa: F401
 from .framework.io import load, save  # noqa: F401

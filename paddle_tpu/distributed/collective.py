@@ -694,8 +694,21 @@ def split(x, size, operation: str, axis: int = 0, num_partitions: int = 1,
         raise InvalidArgumentError(
             "num_partitions %d does not match the mp degree %d"
             % (num_partitions, mp_deg))
-    key = name or "split_%s_%s_%d_%d" % (operation, tuple(size), axis,
-                                         num_partitions)
+    if name is None:
+        # unnamed call: fresh weights every call (reference build-time
+        # semantics — split is called once while constructing the model);
+        # name= opts into call-site reuse for eager loops
+        import warnings
+
+        warnings.warn(
+            "distributed.split without name= creates new weights on every "
+            "call; pass name='...' to reuse one layer across steps",
+            stacklevel=2)
+        from .. import utils as _utils
+
+        key = _utils.unique_name.generate("split_auto")
+    else:
+        key = name
     layer = _split_layers.get(key)
     if layer is None:
         if operation == "embedding":

@@ -49,13 +49,14 @@ class Variable:
     (jax.grad of a target w.r.t. a persist/data var), 'py_func'.
     """
 
-    _counter = 0
-
     def __init__(self, kind: str, name: Optional[str], shape, dtype,
                  program: "Program", op=None, inputs=(), meta=None):
         if name is None:
-            Variable._counter += 1
-            name = "_generated_var_%d" % Variable._counter
+            # thread-safe + guard-able (utils/unique_name.py, the single
+            # name allocator for the framework)
+            from ..utils import unique_name
+
+            name = unique_name.generate("_generated_var")
         self.kind = kind
         self.name = name
         self.shape = tuple(int(s) for s in shape)
@@ -285,6 +286,13 @@ def _aval_of(v) -> jax.ShapeDtypeStruct:
     return jax.ShapeDtypeStruct(shape, v.dtype)
 
 
+def _pick(bundle: "Variable", index: int, shape, dtype) -> "Variable":
+    """Element selector over a tuple-valued node (multi-output ops)."""
+    return Variable("op", None, shape, dtype, bundle.program,
+                    op=(lambda t, _i=index: t[_i]), inputs=((bundle,), {}),
+                    meta={"op_name": "tuple_get_%d" % index})
+
+
 def _infer(fn, args, kwargs) -> Tuple[Tuple[int, ...], np.dtype, bool]:
     """Build-time shape/dtype inference via jax.eval_shape."""
     dyn_batch = False
@@ -312,24 +320,41 @@ def _infer(fn, args, kwargs) -> Tuple[Tuple[int, ...], np.dtype, bool]:
         return fn(*a, **k)
 
     out = jax.eval_shape(shaped, *specs)
-    out_leaves = jax.tree_util.tree_leaves(out)
-    first = out_leaves[0]
-    return tuple(first.shape), np.dtype(first.dtype), dyn_batch
+    return out, dyn_batch
 
 
 def _symbolic_apply(fn, op_name, args, kwargs):
-    """dispatch hook: record an op on symbolic inputs as a graph node."""
-    shape, dtype, dyn = _infer(fn, args, kwargs)
-    if dyn and shape and shape[0] == 1:
-        shape = (-1,) + shape[1:]
+    """dispatch hook: record an op on symbolic inputs as graph node(s).
+
+    Multi-output ops (topk, unique, split, ...) record one bundle node plus
+    per-element selectors, returned in the op's own output structure."""
+    out_avals, dyn = _infer(fn, args, kwargs)
+    leaves, treedef = jax.tree_util.tree_flatten(out_avals)
+
+    def shape_of(aval):
+        shape = tuple(aval.shape)
+        if dyn and shape and shape[0] == 1:
+            shape = (-1,) + shape[1:]
+        return shape
+
     prog = None
     for leaf in jax.tree_util.tree_leaves(
             (args, kwargs), is_leaf=lambda l: isinstance(l, Variable)):
         if isinstance(leaf, Variable):
             prog = leaf.program
             break
-    return Variable("op", None, shape, dtype, prog, op=fn,
-                    inputs=(args, kwargs), meta={"op_name": op_name})
+    if len(leaves) == 1:
+        return Variable("op", None, shape_of(leaves[0]), leaves[0].dtype,
+                        prog, op=fn, inputs=(args, kwargs),
+                        meta={"op_name": op_name})
+    flat_fn = (lambda *a, _fn=fn, **k:
+               tuple(jax.tree_util.tree_leaves(_fn(*a, **k))))
+    bundle = Variable("op", None, shape_of(leaves[0]), leaves[0].dtype,
+                      prog, op=flat_fn, inputs=(args, kwargs),
+                      meta={"op_name": op_name})
+    picks = [_pick(bundle, i, shape_of(a), a.dtype)
+             for i, a in enumerate(leaves)]
+    return jax.tree_util.tree_unflatten(treedef, picks)
 
 
 def create_global_var(shape, value, dtype, persistable: bool = False,
@@ -365,15 +390,21 @@ def create_parameter(shape, dtype, name: Optional[str] = None, attr=None,
 
 def gradients(targets, inputs, target_gradients=None, no_grad_set=None
               ) -> List[Variable]:
-    """backward.py calc_gradient parity: d(sum targets)/d(inputs) nodes."""
+    """backward.py calc_gradient parity: d(sum targets)/d(inputs).
+
+    One joint grad node computes all partials in a single jax.grad pass
+    (the reference appends one backward program, not one per input);
+    selectors expose them as individual Variables."""
     targets = targets if isinstance(targets, (list, tuple)) else [targets]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-    outs = []
-    for x in inputs:
-        g = Variable("grad", None, x.shape, x.dtype, x.program,
-                     meta={"targets": tuple(targets), "wrt": x})
-        outs.append(g)
-    return outs
+    bundle = Variable("grad", None, inputs[0].shape, inputs[0].dtype,
+                      inputs[0].program,
+                      meta={"targets": tuple(targets),
+                            "wrt_list": tuple(inputs)})
+    if len(inputs) == 1:
+        return [bundle]
+    return [_pick(bundle, i, x.shape, x.dtype)
+            for i, x in enumerate(inputs)]
 
 
 def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
@@ -388,25 +419,38 @@ def append_backward(loss: Variable, parameter_list=None, no_grad_set=None,
 
 
 def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
-    """py_func_op parity: a host python function as a graph node.  The
-    functional executor calls it with evaluated inputs (host round-trip,
-    like the reference's py_func op); backward_func is honored by the
-    grad evaluator via jax.pure_callback being out of scope — forward-only
-    (matching py_func's dominant use)."""
+    """py_func_op parity: a host python function as a graph node, run with
+    evaluated inputs (eager) or via jax.pure_callback (CompiledProgram).
+    Multiple ``out`` templates yield one Variable per output.  Forward-only
+    (py_func's dominant use); pass differentiable logic through ops."""
     xs = x if isinstance(x, (list, tuple)) else [x]
-    outs = out if isinstance(out, (list, tuple)) else [out]
-    template = outs[0]
+    single = not isinstance(out, (list, tuple))
+    outs = [out] if single else list(out)
+    prog = next((t.program for t in outs if isinstance(t, Variable)),
+                xs[0].program)
 
-    def host_fn(*vals):
+    if single:
+        def host_fn(*vals):
+            return jnp.asarray(func(*[np.asarray(v) for v in vals]))
+
+        return Variable("py_func", None, outs[0].shape, outs[0].dtype, prog,
+                        op=host_fn, inputs=(tuple(xs), {}),
+                        meta={"host": True})
+
+    def host_fn_multi(*vals):
         res = func(*[np.asarray(v) for v in vals])
-        return jnp.asarray(res)
+        if not isinstance(res, (list, tuple)) or len(res) != len(outs):
+            raise InvalidArgumentError(
+                "py_func declared %d outputs but returned %r"
+                % (len(outs), type(res)))
+        return tuple(jnp.asarray(r) for r in res)
 
-    v = Variable("py_func", None, template.shape, template.dtype,
-                 template.program if isinstance(template, Variable)
-                 else xs[0].program,
-                 op=host_fn, inputs=(tuple(xs), {}),
-                 meta={"host": True})
-    return v
+    bundle = Variable("py_func", None, outs[0].shape, outs[0].dtype, prog,
+                      op=host_fn_multi, inputs=(tuple(xs), {}),
+                      meta={"host": True,
+                            "out_avals": [(tuple(t.shape), t.dtype)
+                                          for t in outs]})
+    return [_pick(bundle, i, t.shape, t.dtype) for i, t in enumerate(outs)]
 
 
 def Print(input: Variable, first_n: int = -1, message: Optional[str] = None,
@@ -470,6 +514,25 @@ class _Evaluator:
                 is_leaf=lambda l: isinstance(l, (Variable, Tensor)))
             if v.meta.get("host"):
                 vals = [self.value_of(a) for a in args]
+                if any(isinstance(x, jax.core.Tracer) for x in vals):
+                    # inside CompiledProgram's jit: host code runs via
+                    # callback (py_func_op's host round-trip, jit-safe)
+                    def concrete(shape, dtype):
+                        return jax.ShapeDtypeStruct(tuple(
+                            vals[0].shape[i] if s == -1
+                            and i < len(vals[0].shape) else s
+                            for i, s in enumerate(shape)), dtype)
+
+                    multi = v.meta.get("out_avals")
+                    if multi:
+                        avals = tuple(concrete(s, d) for s, d in multi)
+                        return jax.pure_callback(
+                            lambda *a: tuple(
+                                np.asarray(r) for r in v.op(*a)),
+                            avals, *vals)
+                    return jax.pure_callback(
+                        lambda *a: np.asarray(v.op(*a), v.dtype),
+                        concrete(v.shape, v.dtype), *vals)
                 return v.op(*vals)
             return v.op(*ev(list(args)), **ev(dict(kwargs)))
         if v.kind == "grad":
@@ -478,22 +541,28 @@ class _Evaluator:
 
     def _grad(self, gvar: Variable):
         targets = gvar.meta["targets"]
-        wrt: Variable = gvar.meta["wrt"]
+        wrt_list = gvar.meta["wrt_list"]
 
-        def loss_fn(x_val):
-            ev = _Evaluator(self.feed, self.scope,
-                            overrides={**self.overrides, wrt.name: x_val})
+        def loss_fn(x_vals):
+            overrides = dict(self.overrides)
+            overrides.update(
+                {w.name: xv for w, xv in zip(wrt_list, x_vals)})
+            ev = _Evaluator(self.feed, self.scope, overrides=overrides)
             total = 0.0
             for t in targets:
                 total = total + jnp.sum(ev.value_of(t))
             return total
 
-        base = jnp.asarray(self.value_of(wrt))
-        if not jnp.issubdtype(base.dtype, jnp.floating):
-            raise InvalidArgumentError(
-                "cannot differentiate w.r.t. non-float variable %r"
-                % wrt.name)
-        return jax.grad(loss_fn)(base)
+        bases = []
+        for w in wrt_list:
+            base = jnp.asarray(self.value_of(w))
+            if not jnp.issubdtype(base.dtype, jnp.floating):
+                raise InvalidArgumentError(
+                    "cannot differentiate w.r.t. non-float variable %r"
+                    % w.name)
+            bases.append(base)
+        grads = jax.grad(loss_fn)(bases)
+        return grads[0] if len(wrt_list) == 1 else tuple(grads)
 
 
 class BuildStrategy:

@@ -1,0 +1,101 @@
+"""``paddle_tpu.utils`` (reference: python/paddle/utils/__init__.py —
+deprecated, try_import, run_check, require_version, unique_name,
+download).  ``run_check`` exercises the real device path (a matmul on the
+default backend + an 8-way CPU-mesh psum) instead of the reference's
+single/multi-GPU fluid program."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import cpp_extension  # noqa: F401
+from . import unique_name  # noqa: F401
+
+__all__ = ["deprecated", "run_check", "require_version", "try_import",
+           "unique_name", "cpp_extension"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 0):
+    """utils/deprecated.py parity: warn (or raise, level=2) on use."""
+
+    def decorator(fn):
+        msg = "API %r is deprecated since %s" % (
+            getattr(fn, "__name__", str(fn)), since or "this release")
+        if update_to:
+            msg += ", use %r instead" % update_to
+        if reason:
+            msg += " (%s)" % reason
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if level == 2:
+                raise RuntimeError(msg)
+            if level >= 0:
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name: str, err_msg: str = ""):
+    """utils/lazy_import.py parity."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or "%s is required but not installed; this no-egress "
+            "build cannot fetch it" % module_name)
+
+
+def require_version(min_version: str, max_version: str = None) -> bool:
+    """fluid/framework.py require_version parity against this package."""
+    from ..version import full_version
+
+    def parse(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+
+    cur = parse(full_version)
+    if parse(min_version) > cur:
+        raise RuntimeError(
+            "installed version %s is below required %s"
+            % (full_version, min_version))
+    if max_version is not None and parse(max_version) < cur:
+        raise RuntimeError(
+            "installed version %s is above supported %s"
+            % (full_version, max_version))
+    return True
+
+
+def run_check() -> None:
+    """install_check.py:162 parity: verify the install can compute.
+
+    1) a jitted matmul on the default backend (TPU when attached);
+    2) a psum across an 8-device CPU mesh (the collective path).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as pt
+
+    a = pt.to_tensor(np.ones((2, 2), np.float32))
+    out = pt.matmul(a, a)
+    assert float(out.value.sum()) == 8.0
+    backend = jax.default_backend()
+
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()
+    n = min(len(devs), 8)
+    mesh = Mesh(np.array(devs[:n]), ("dp",))
+    x = jax.device_put(jnp.ones((n, 2)), NamedSharding(mesh, P("dp")))
+    total = jax.jit(lambda v: v.sum())(x)
+    assert float(total) == 2 * n
+    print("PaddlePaddle-TPU works well on 1 %s device." % backend)
+    if n > 1:
+        print("PaddlePaddle-TPU works well on %d devices." % n)
+    print("PaddlePaddle-TPU is installed successfully!")

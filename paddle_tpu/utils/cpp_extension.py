@@ -1,0 +1,170 @@
+"""JIT-compiled C++ custom ops (reference:
+python/paddle/utils/cpp_extension/ + paddle/fluid/framework/custom_operator.cc
+/ paddle/extension.h).
+
+TPU-first position: device kernels belong to XLA/pallas
+(``incubate.register_custom_op``); what C++ extensions buy on this stack is
+*host* compute — tokenizers, feature hashing, decoders — so ``load``
+compiles the sources with the system toolchain into a shared library and
+registers each exported function as a framework op whose implementation is
+a ``jax.pure_callback`` into the C++ code.  The ops are taped (eager
+backward via an optional python ``backward``) and trace-safe (callback
+works under jit).
+
+C ABI convention (this stack's ``paddle/extension.h`` analog, see
+``extension_header()``): each op is
+
+    extern "C" void <name>(const float** ins, const long long** shapes,
+                           const int* ndims, int n_ins, float* out);
+
+operating on contiguous float32 buffers.  The python side supplies the
+output shape rule (``out_shape``), mirroring the reference's InferShapeFn
+registration.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["load", "extension_header", "CppExtension", "get_build_directory"]
+
+_HEADER = """\
+// paddle_tpu extension header (paddle/extension.h analog, host-op C ABI)
+#pragma once
+#include <cstdint>
+#define PT_OP(name) \\
+  extern "C" __attribute__((visibility("default"))) void name( \\
+      const float** ins, const long long** shapes, const int* ndims, \\
+      int n_ins, float* out)
+"""
+
+
+def extension_header() -> str:
+    """The C++ header text user sources can #include (written next to the
+    sources by ``load``)."""
+    return _HEADER
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """setup()-style sources bundle (cpp_extension.CppExtension parity)."""
+
+    def __init__(self, sources: Sequence[str], name: Optional[str] = None,
+                 extra_compile_args=None, **kwargs):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = extra_compile_args or []
+
+
+def _compile(name: str, sources: Sequence[str], extra_flags: Sequence[str],
+             build_dir: str, verbose: bool) -> str:
+    so_path = os.path.join(build_dir, "lib%s.so" % name)
+    header_path = os.path.join(build_dir, "pt_extension.h")
+    with open(header_path, "w") as f:
+        f.write(_HEADER)
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           "-I", build_dir, *extra_flags, *sources, "-o", so_path]
+    if verbose:
+        print("cpp_extension:", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise InvalidArgumentError(
+            "C++ extension %r failed to compile:\n%s" % (name, proc.stderr))
+    return so_path
+
+
+def _make_host_fn(lib, fn_name: str, out_shape: Callable):
+    cfn = getattr(lib, fn_name)
+    cfn.restype = None
+
+    def host(*arrays) -> np.ndarray:
+        arrays = [np.ascontiguousarray(a, np.float32) for a in arrays]
+        n = len(arrays)
+        ins = (ctypes.POINTER(ctypes.c_float) * n)(*[
+            a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            for a in arrays])
+        shapes_store = [
+            (ctypes.c_longlong * max(a.ndim, 1))(*(a.shape or (1,)))
+            for a in arrays]
+        shapes = (ctypes.POINTER(ctypes.c_longlong) * n)(*shapes_store)
+        ndims = (ctypes.c_int * n)(*[a.ndim for a in arrays])
+        out = np.zeros(out_shape(*[a.shape for a in arrays]), np.float32)
+        cfn(ins, shapes, ndims, ctypes.c_int(n),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        return out
+
+    return host
+
+
+def load(name: str, sources: Sequence[str],
+         functions: Dict[str, dict],
+         extra_cxx_cflags: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None, verbose: bool = False):
+    """Compile ``sources`` and return a module-like object exposing each
+    function in ``functions`` as a registered framework op.
+
+    functions: ``{op_name: {"out_shape": fn(*in_shapes)->shape,
+    "backward": optional python vjp}}`` — out_shape is the InferShapeFn
+    (custom_operator.cc parity); the op body runs on host via
+    jax.pure_callback, so it composes with jit/TrainStep.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..incubate import register_custom_op
+
+    if not functions:
+        raise InvalidArgumentError("load needs a functions={...} mapping")
+    build_dir = build_directory or get_build_directory()
+    so_path = _compile(name, sources, list(extra_cxx_cflags or ()),
+                       build_dir, verbose)
+    lib = ctypes.CDLL(so_path)
+
+    class _Module:
+        __name__ = name
+        _library_path = so_path
+
+    mod = _Module()
+    for fn_name, spec in functions.items():
+        if "out_shape" not in spec:
+            raise InvalidArgumentError(
+                "function %r needs an out_shape rule (the InferShapeFn)"
+                % fn_name)
+        host = _make_host_fn(lib, fn_name, spec["out_shape"])
+        out_shape = spec["out_shape"]
+
+        def forward(*arrays, _host=host, _os=out_shape):
+            aval = jax.ShapeDtypeStruct(
+                tuple(_os(*[tuple(np.shape(a)) for a in arrays])),
+                jnp.float32)
+            return jax.pure_callback(_host, aval, *arrays, vmap_method=None)
+
+        # re-loading after a source edit must bind the NEW library: registry
+        # names are unique, so version the internal name per reload
+        base_key = "%s.%s" % (name, fn_name)
+        key = base_key
+        version = 0
+        while True:
+            try:
+                op = register_custom_op(key, forward,
+                                        backward=spec.get("backward"))
+                break
+            except InvalidArgumentError:
+                version += 1
+                key = "%s#v%d" % (base_key, version)
+        setattr(mod, fn_name, op)
+    return mod

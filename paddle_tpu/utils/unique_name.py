@@ -1,0 +1,45 @@
+"""utils/unique_name.py parity: process-wide unique name generator with
+guard contexts (the reference's UniqueNameGenerator over fluid cores)."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "guard", "switch"]
+
+_lock = threading.Lock()
+
+
+class _Generator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key: str) -> str:
+        with _lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return "%s_%d" % (key, n)
+
+
+_generator = _Generator()
+
+
+def generate(key: str) -> str:
+    return _generator(key)
+
+
+def switch(new_generator=None):
+    """Swap the active generator, returning the previous one."""
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -355,7 +355,8 @@ def rotate(img, angle: float, interpolation: str = "nearest",
            expand: bool = False, center=None, fill=0):
     """Rotate counter-clockwise by angle degrees (inverse affine map)."""
     arr = _to_numpy(img)
-    if arr.ndim == 2:
+    was_2d = arr.ndim == 2
+    if was_2d:
         arr = arr[:, :, None]
     H, W = arr.shape[:2]
     rad = np.deg2rad(angle)
@@ -400,7 +401,7 @@ def rotate(img, angle: float, interpolation: str = "nearest",
         inside = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
         out = arr[np.clip(yi, 0, H - 1), np.clip(xi, 0, W - 1)].copy()
         out[~inside] = fill
-    return out
+    return out[:, :, 0] if was_2d else out
 
 
 # -- photometric / geometric transform classes ------------------------------

@@ -205,3 +205,57 @@ def test_fetch_by_name_requires_known_var(linreg):
     exe = static.Executor()
     with pytest.raises(InvalidArgumentError):
         exe.run(main, feed={"x": xs}, fetch_list=["nope"])
+
+
+def test_multi_output_ops(linreg):
+    """topk/split on static Variables: tuple outputs become selectors."""
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    with static.program_guard(main, startup):
+        values, indices = pt.topk(x, k=2)
+        parts = pt.split(x, 2, axis=1)
+    exe = static.Executor()
+    v, i, p0, p1 = exe.run(main, feed={"x": xs, "y": ys},
+                           fetch_list=[values, indices, parts[0], parts[1]])
+    wv, wi = np.sort(xs, 1)[:, ::-1][:, :2], np.argsort(-xs, 1)[:, :2]
+    np.testing.assert_allclose(v, wv, rtol=1e-6)
+    np.testing.assert_array_equal(i, wi)
+    np.testing.assert_allclose(p0, xs[:, :2], rtol=1e-6)
+    np.testing.assert_allclose(p1, xs[:, 2:], rtol=1e-6)
+
+
+def test_print_pyfunc_under_compiled_program(linreg, capsys):
+    """Host-callback nodes must survive whole-program jit (pure_callback)."""
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    with static.program_guard(main, startup):
+        printed = static.Print(loss, message="jit-loss:")
+        doubled = static.py_func(lambda a: a * 2, x, out=x)
+        two = static.py_func(lambda a: (a + 1, a - 1), x, out=[x, x])
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        cp = static.CompiledProgram(main)
+        pv, dv, t0, t1 = exe.run(cp, feed={"x": xs, "y": ys},
+                                 fetch_list=[printed, doubled,
+                                             two[0], two[1]])
+    np.testing.assert_allclose(dv, xs * 2, rtol=1e-6)
+    np.testing.assert_allclose(t0, xs + 1, rtol=1e-6)
+    np.testing.assert_allclose(t1, xs - 1, rtol=1e-6)
+    assert "jit-loss:" in capsys.readouterr().out
+
+
+def test_joint_gradients_single_backward(linreg):
+    """gradients() over several inputs shares one grad bundle node."""
+    main, startup, x, y, pred, loss, xs, ys = linreg
+    params = main.all_parameters()
+    with static.program_guard(main, startup):
+        gs = static.gradients([loss], params)
+    assert len(gs) == len(params)
+    # all selectors point at one shared bundle
+    bundles = {id(g.inputs[0][0]) for g in gs if g.inputs}
+    assert len(bundles) <= 1
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        outs = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=gs)
+    for g, p in zip(outs, params):
+        assert g.shape == tuple(p.shape) and np.isfinite(g).all()

@@ -1,0 +1,130 @@
+"""paddle.utils: deprecated/try_import/require_version/run_check,
+unique_name, and the C++ extension JIT-build path (real g++ compile)."""
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.utils import (cpp_extension, deprecated, require_version,
+                              run_check, try_import, unique_name)
+
+
+def test_deprecated_levels():
+    @deprecated(update_to="paddle.new", since="2.0")
+    def old():
+        return 7
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert old() == 7
+        assert len(w) == 1 and "paddle.new" in str(w[0].message)
+
+    @deprecated(level=2)
+    def gone():
+        return 1
+
+    with pytest.raises(RuntimeError):
+        gone()
+
+
+def test_try_import():
+    assert try_import("json") is not None
+    with pytest.raises(ImportError):
+        try_import("definitely_not_a_module_xyz")
+
+
+def test_require_version():
+    assert require_version("0.0.1")
+    with pytest.raises(RuntimeError):
+        require_version("999.0.0")
+
+
+def test_run_check(capsys):
+    run_check()
+    assert "installed successfully" in capsys.readouterr().out
+
+
+def test_unique_name_guard():
+    a = unique_name.generate("w")
+    b = unique_name.generate("w")
+    assert a != b
+    with unique_name.guard():
+        fresh = unique_name.generate("w")
+        assert fresh == "w_0"
+    after = unique_name.generate("w")
+    assert after not in (a, b, "w_0") or after.endswith("_2")
+
+
+@pytest.fixture(scope="module")
+def ext_module(tmp_path_factory):
+    src_dir = tmp_path_factory.mktemp("ext")
+    src = src_dir / "ops.cc"
+    src.write_text("""
+#include "pt_extension.h"
+#include <cmath>
+
+PT_OP(ext_scale2) {
+  long long n = 1;
+  for (int d = 0; d < ndims[0]; ++d) n *= shapes[0][d];
+  for (long long i = 0; i < n; ++i) out[i] = 2.0f * ins[0][i];
+}
+
+PT_OP(ext_dot_bias) {
+  // out = ins[0] + ins[1] elementwise (two-input op)
+  long long n = 1;
+  for (int d = 0; d < ndims[0]; ++d) n *= shapes[0][d];
+  for (long long i = 0; i < n; ++i) out[i] = ins[0][i] + ins[1][i];
+}
+""")
+    return cpp_extension.load(
+        name="test_ext_%d" % os.getpid(),
+        sources=[str(src)],
+        functions={
+            "ext_scale2": {
+                "out_shape": lambda s: s,
+                # d(2x)/dx = 2 — hand-written vjp enters the tape
+                "backward": lambda res, ct: (2.0 * ct,),
+            },
+            "ext_dot_bias": {"out_shape": lambda s1, s2: s1},
+        },
+        build_directory=str(src_dir))
+
+
+def test_cpp_extension_forward(ext_module):
+    x = np.linspace(-1, 1, 6).astype(np.float32)
+    y = ext_module.ext_scale2(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(y.value), 2 * x, rtol=1e-6)
+    z = ext_module.ext_dot_bias(pt.to_tensor(x), pt.to_tensor(x * 3))
+    np.testing.assert_allclose(np.asarray(z.value), 4 * x, rtol=1e-6)
+
+
+def test_cpp_extension_backward(ext_module):
+    x = pt.to_tensor(np.array([1.0, -2.0], np.float32), stop_gradient=False)
+    y = ext_module.ext_scale2(x)
+    (y * y).sum().backward()
+    # d/dx (2x)^2 = 8x
+    np.testing.assert_allclose(np.asarray(x.grad.value), [8.0, -16.0],
+                               rtol=1e-5)
+
+
+def test_cpp_extension_under_jit(ext_module):
+    from paddle_tpu.jit import to_static
+
+    @to_static
+    def f(a):
+        return ext_module.ext_dot_bias(a, a)
+
+    x = np.ones((4,), np.float32)
+    np.testing.assert_allclose(np.asarray(f(pt.to_tensor(x)).value), 2 * x)
+
+
+def test_cpp_extension_compile_error(tmp_path):
+    bad = tmp_path / "bad.cc"
+    bad.write_text("this is not C++")
+    with pytest.raises(InvalidArgumentError):
+        cpp_extension.load(name="bad_ext", sources=[str(bad)],
+                           functions={"x": {"out_shape": lambda s: s}},
+                           build_directory=str(tmp_path))
