@@ -92,16 +92,22 @@ def bench_bert(pt, jax, on_tpu: bool):
 
 
 def bench_resnet50(pt, jax, on_tpu: bool):
-    """Config #2: ResNet50, compiled ("static Executor") path + AMP."""
+    """Config #2: ResNet50, compiled ("static Executor") path + AMP.
+
+    Batch size is swept upward with early abort: per-chip HBM determines
+    the throughput knee, and a spilling batch collapses per-image speed
+    (measured 6.6s/step at 256 vs 0.065s at 64 on v5e), so the sweep keeps
+    the best imgs/sec instead of betting on one size.
+    """
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.vision.models import resnet50
 
     pt.seed(0)
     if on_tpu:
-        batch, hw, classes = 256, 224, 1000
+        batches, hw, classes = [64, 128, 256], 224, 1000
         flops_fwd = RESNET50_FWD_FLOPS
     else:
-        batch, hw, classes = 4, 32, 10
+        batches, hw, classes = [4], 32, 10
         flops_fwd = 1e9  # nominal; CPU smoke only checks the harness runs
 
     model = resnet50(num_classes=classes)
@@ -113,21 +119,27 @@ def bench_resnet50(pt, jax, on_tpu: bool):
         with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
             return criterion(m(x), y)
 
-    step = TrainStep(model, loss_fn, opt)
+    step = TrainStep(model, loss_fn, opt, donate=False)
     rng = np.random.RandomState(0)
-    imgs = rng.randn(batch, 3, hw, hw).astype("float32")
-    labels = rng.randint(0, classes, (batch,)).astype("int64")
-
-    dt, loss = _time_steps(step, (imgs, labels), 10 if on_tpu else 2)
-    flops_per_step = 3.0 * flops_fwd * batch  # fwd + ~2x bwd
-    mfu = flops_per_step / dt / _peak_flops(jax, on_tpu)
-    return {
-        "imgs_per_sec": batch / dt,
-        "step_time_s": dt,
-        "mfu": mfu,
-        "batch": batch,
-        "loss": loss,
-    }
+    best = None
+    for batch in batches:
+        imgs = rng.randn(batch, 3, hw, hw).astype("float32")
+        labels = rng.randint(0, classes, (batch,)).astype("int64")
+        dt, loss = _time_steps(step, (imgs, labels), 6 if on_tpu else 2)
+        ips = batch / dt
+        flops_per_step = 3.0 * flops_fwd * batch  # fwd + ~2x bwd
+        cur = {
+            "imgs_per_sec": ips,
+            "step_time_s": dt,
+            "mfu": flops_per_step / dt / _peak_flops(jax, on_tpu),
+            "batch": batch,
+            "loss": loss,
+        }
+        if best is None or ips > best["imgs_per_sec"]:
+            best = cur
+        elif ips < best["imgs_per_sec"] * 0.9:
+            break  # past the knee (HBM spill) — larger only gets worse
+    return best
 
 
 def main():

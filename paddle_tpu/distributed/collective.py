@@ -679,10 +679,10 @@ def split(x, size, operation: str, axis: int = 0, num_partitions: int = 1,
     mp group and applies it — the reference's program-rewriting becomes
     GSPMD placement inside the layer.
 
-    The layer (and its weights) is created ONCE per call site, keyed by
-    ``name`` (or an auto key from operation/size/axis): repeated calls in
-    a training loop reuse the same weights, and
-    :func:`get_split_layer` exposes them for the optimizer.
+    With ``name=`` the layer (and its weights) is created once and reused
+    on every later call with that name (:func:`get_split_layer` exposes it
+    for the optimizer).  Unnamed calls create fresh, uncached weights each
+    time — the reference's build-once semantics — and warn.
     """
     from .meta_parallel.mp_layers import (ColumnParallelLinear,
                                           RowParallelLinear,
@@ -704,12 +704,10 @@ def split(x, size, operation: str, axis: int = 0, num_partitions: int = 1,
             "distributed.split without name= creates new weights on every "
             "call; pass name='...' to reuse one layer across steps",
             stacklevel=2)
-        from .. import utils as _utils
-
-        key = _utils.unique_name.generate("split_auto")
+        key = None
     else:
         key = name
-    layer = _split_layers.get(key)
+    layer = _split_layers.get(key) if key is not None else None
     if layer is None:
         if operation == "embedding":
             layer = VocabParallelEmbedding(int(size[0]), int(size[1]),
@@ -733,5 +731,6 @@ def split(x, size, operation: str, axis: int = 0, num_partitions: int = 1,
                                       mp_group=group)
         else:
             raise InvalidArgumentError("split axis must be 0 or 1")
-        _split_layers[key] = layer
+        if key is not None:
+            _split_layers[key] = layer
     return layer(x)

@@ -6,4 +6,11 @@ BERT-base → ERNIE → GPT-1.3B); the transformer stack mirrors what
 TransformerEncoder:622) is used for in the reference's NLP model zoo.
 Vision CNNs live in ``paddle_tpu.vision.models``.
 """
-from .language_model import TransformerLM, TransformerLMCriterion, bert_base_config, gpt_1p3b_config  # noqa: F401
+from .language_model import (  # noqa: F401
+    TransformerForSequenceClassification,
+    TransformerLM,
+    TransformerLMCriterion,
+    bert_base_config,
+    ernie_base_config,
+    gpt_1p3b_config,
+)

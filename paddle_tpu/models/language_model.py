@@ -38,6 +38,22 @@ def bert_base_config() -> dict:
     )
 
 
+def ernie_base_config() -> dict:
+    """ERNIE-3.0-base-style encoder config (BASELINE.md workload #4:
+    fine-tune under sharding stage 2/3).  Same transformer geometry as
+    BERT-base with segment (token-type) embeddings enabled."""
+    return dict(
+        vocab_size=40000,  # ERNIE zh vocab (39979) padded to 64
+        hidden_size=768,
+        num_layers=12,
+        num_heads=12,
+        intermediate_size=3072,
+        max_position=2048,
+        causal=False,
+        type_vocab_size=4,
+    )
+
+
 def gpt_1p3b_config() -> dict:
     """GPT-3 1.3B config (BASELINE.md workload #5)."""
     return dict(
@@ -66,6 +82,7 @@ class TransformerLM(Layer):
         activation: str = "gelu",
         causal: bool = True,
         normalize_before: bool = True,
+        type_vocab_size: int = 0,
     ):
         super().__init__()
         intermediate_size = intermediate_size or 4 * hidden_size
@@ -77,6 +94,10 @@ class TransformerLM(Layer):
         self.causal = causal
         self.word_embeddings = Embedding(vocab_size, hidden_size)
         self.position_embeddings = Embedding(max_position, hidden_size)
+        # segment embeddings (BERT/ERNIE token types); 0 disables
+        self.token_type_embeddings = (
+            Embedding(type_vocab_size, hidden_size)
+            if type_vocab_size else None)
         self.embed_dropout = Dropout(dropout)
         layer = TransformerEncoderLayer(
             hidden_size,
@@ -111,17 +132,23 @@ class TransformerLM(Layer):
         allow = idx[None, :] <= idx[:, None]
         return jnp.where(allow, 0.0, jnp.finfo(jnp.float32).min).astype(dtype)
 
-    def forward(self, input_ids, attn_mask=None):
+    def encode(self, input_ids, attn_mask=None, token_type_ids=None):
+        """Final hidden states [B, L, H] (the backbone for task heads)."""
         seq_len = input_ids.shape[1]
         pos = T.arange(0, seq_len, dtype="int64")
         h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if self.token_type_embeddings is not None and token_type_ids is not None:
+            h = h + self.token_type_embeddings(token_type_ids)
         h = self.embed_dropout(h)
         if attn_mask is None and self.causal and not self._sequence_parallel:
             attn_mask = Tensor(
                 self._causal_mask(seq_len, h.value.dtype), stop_gradient=True
             )
         h = self.encoder(h, attn_mask)
-        h = self.final_norm(h)
+        return self.final_norm(h)
+
+    def forward(self, input_ids, attn_mask=None, token_type_ids=None):
+        h = self.encode(input_ids, attn_mask, token_type_ids)
         # tied LM head: logits = h @ E^T
         logits = T.matmul(h, self.word_embeddings.weight, transpose_y=True)
         return logits
@@ -153,3 +180,22 @@ class TransformerLMCriterion(Layer):
         return F.cross_entropy(
             T.reshape(logits, [-1, v]), T.reshape(labels, [-1]), reduction="mean"
         )
+
+
+class TransformerForSequenceClassification(Layer):
+    """Encoder + BERT-style pooler + classifier (the ERNIE fine-tune head,
+    BASELINE.md workload #4)."""
+
+    def __init__(self, num_classes: int = 2, dropout: float = 0.1, **config):
+        super().__init__()
+        config.setdefault("causal", False)
+        self.backbone = TransformerLM(dropout=dropout, **config)
+        h = self.backbone.hidden_size
+        self.pooler = Linear(h, h)
+        self.classifier_dropout = Dropout(dropout)
+        self.classifier = Linear(h, num_classes)
+
+    def forward(self, input_ids, attn_mask=None, token_type_ids=None):
+        hidden = self.backbone.encode(input_ids, attn_mask, token_type_ids)
+        pooled = T.tanh(self.pooler(hidden[:, 0]))  # [CLS] pooling
+        return self.classifier(self.classifier_dropout(pooled))

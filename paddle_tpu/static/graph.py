@@ -49,14 +49,14 @@ class Variable:
     (jax.grad of a target w.r.t. a persist/data var), 'py_func'.
     """
 
+    # private allocator: must stay unique for the process lifetime, so it
+    # is NOT the public unique_name generator (guard()/switch() reset that)
+    _name_counter = __import__("itertools").count()
+
     def __init__(self, kind: str, name: Optional[str], shape, dtype,
                  program: "Program", op=None, inputs=(), meta=None):
         if name is None:
-            # thread-safe + guard-able (utils/unique_name.py, the single
-            # name allocator for the framework)
-            from ..utils import unique_name
-
-            name = unique_name.generate("_generated_var")
+            name = "_generated_var_%d" % next(Variable._name_counter)
         self.kind = kind
         self.name = name
         self.shape = tuple(int(s) for s in shape)
@@ -526,9 +526,11 @@ class _Evaluator:
                     multi = v.meta.get("out_avals")
                     if multi:
                         avals = tuple(concrete(s, d) for s, d in multi)
+                        dts = [d for _, d in multi]
                         return jax.pure_callback(
                             lambda *a: tuple(
-                                np.asarray(r) for r in v.op(*a)),
+                                np.asarray(r, d)
+                                for r, d in zip(v.op(*a), dts)),
                             avals, *vals)
                     return jax.pure_callback(
                         lambda *a: np.asarray(v.op(*a), v.dtype),

@@ -72,7 +72,17 @@ class CppExtension:
 
 def _compile(name: str, sources: Sequence[str], extra_flags: Sequence[str],
              build_dir: str, verbose: bool) -> str:
-    so_path = os.path.join(build_dir, "lib%s.so" % name)
+    # unique per-build output: dlopen caches by path, so overwriting one
+    # lib<name>.so would hand reloads the previously mapped machine code
+    import hashlib
+
+    digest = hashlib.sha1()
+    for src in sources:
+        with open(src, "rb") as f:
+            digest.update(f.read())
+    digest.update(" ".join(extra_flags).encode())
+    so_path = os.path.join(build_dir,
+                           "lib%s_%s.so" % (name, digest.hexdigest()[:12]))
     header_path = os.path.join(build_dir, "pt_extension.h")
     with open(header_path, "w") as f:
         f.write(_HEADER)
