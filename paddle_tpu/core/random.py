@@ -130,3 +130,18 @@ def get_cuda_rng_state():  # API-parity alias; single generator on TPU
 
 def set_cuda_rng_state(state) -> None:
     set_rng_state(state)
+
+
+@contextlib.contextmanager
+def replay_counter(counter: int):
+    """Pin the generator's fold-in counter for a deterministic replay.
+
+    ``create_graph`` re-executes recorded primal functions at backward time
+    (engine.py); random ops inside them must re-draw the SAME keys they drew
+    at forward time, and the replay must not advance the global stream."""
+    save = default_generator._counter
+    default_generator._counter = counter
+    try:
+        yield
+    finally:
+        default_generator._counter = save

@@ -202,6 +202,9 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
             return run(*a, **k)
 
         t0 = _maybe_t0()
+        from ..core import random as _random
+
+        rng_counter = _random.default_generator._counter
         out, vjp_fn = jax.vjp(pure, *diff_vals)
         # Same traced-input guard as the non-diff branch: non-Tensor leaves
         # can still be tracers (e.g. inside jax.checkpoint), and profiling
@@ -219,6 +222,8 @@ def make_op(fn: Callable, differentiable: bool = True, op_name: str = "") -> Cal
             out_treedef,
             out_avals,
             op_name=op_name,
+            pure=pure,
+            rng_counter=rng_counter,
         )
         return _wrap_outputs(out, node=node)
 

@@ -78,15 +78,22 @@ class GradNode:
     for non-array leaves (python scalars riding along in the output pytree).
     """
 
-    __slots__ = ("vjp_fn", "inputs", "out_treedef", "out_avals", "id", "op_name")
+    __slots__ = ("vjp_fn", "inputs", "out_treedef", "out_avals", "id",
+                 "op_name", "pure", "rng_counter")
 
-    def __init__(self, vjp_fn, inputs, out_treedef, out_avals, op_name=""):
+    def __init__(self, vjp_fn, inputs, out_treedef, out_avals, op_name="",
+                 pure=None, rng_counter=0):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list of Tensor (each with stop_gradient=False at record time)
         self.out_treedef = out_treedef
         self.out_avals = out_avals
         self.id = next(_node_counter)
         self.op_name = op_name
+        # the primal function over the diff inputs; create_graph re-derives
+        # a fresh vjp from it at backward time so the pullback itself can be
+        # taped (partial_grad_engine.cc's create_graph re-recording)
+        self.pure = pure
+        self.rng_counter = rng_counter
 
 
 def _zero_cotangent(aval):
@@ -100,10 +107,17 @@ def _zero_cotangent(aval):
 def _accumulate(a, b):
     if a is None:
         return b
+    # keep Tensor on the left so taped __add__ runs (a raw jax array's
+    # __add__ would silently coerce the Tensor and drop its tape)
+    from .tensor import Tensor
+
+    if isinstance(b, Tensor) and not isinstance(a, Tensor):
+        return b + a
     return a + b
 
 
-def _run_engine(roots, root_grads, sinks: Optional[list], retain_graph: bool):
+def _run_engine(roots, root_grads, sinks: Optional[list], retain_graph: bool,
+                create_graph: bool = False):
     """Shared sweep for ``backward`` and ``grad``.
 
     roots: output Tensors to seed; root_grads: matching cotangents (raw arrays).
@@ -163,10 +177,48 @@ def _run_engine(roots, root_grads, sinks: Optional[list], retain_graph: bool):
             b if b is not None else _zero_cotangent(aval)
             for b, aval in zip(buf, node.out_avals)
         ]
-        cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, cots)
-        in_grads = node.vjp_fn(cot_tree)
-        if not retain_graph:
-            node.vjp_fn = None
+        if create_graph and node.pure is None:
+            raise NotImplementedError(
+                "create_graph=True cannot differentiate through op %r "
+                "(PyLayer / traced-function nodes record no re-derivable "
+                "primal); write it with regular ops or use "
+                "incubate.autograd" % node.op_name)
+        if create_graph:
+            # re-derive the vjp from the primal function through the TAPED
+            # dispatch: the resulting in_grads are Tensors whose graph
+            # reaches both the cotangents and the primal inputs, so a
+            # second backward differentiates the gradient itself
+            from .dispatch import make_op
+
+            n_in = len(node.inputs)
+
+            def pullback(*flat, _pure=node.pure, _n=n_in,
+                         _treedef=node.out_treedef, _rng=node.rng_counter):
+                from ..core.random import replay_counter
+
+                prim = flat[:_n]
+                cot_leaves = list(flat[_n:])
+                with replay_counter(_rng):
+                    # random ops replay the keys they drew at forward time
+                    _, vjp = jax.vjp(_pure, *prim)
+                tree = jax.tree_util.tree_unflatten(_treedef, cot_leaves)
+                return tuple(vjp(tree))
+
+            taped = make_op(pullback, op_name=node.op_name + "_grad")
+            in_grads = taped(*node.inputs, *cots)
+            if not isinstance(in_grads, tuple):
+                in_grads = (in_grads,)
+            if not retain_graph:
+                node.vjp_fn = None
+                node.pure = None
+        else:
+            cot_tree = jax.tree_util.tree_unflatten(
+                node.out_treedef,
+                [c.value if isinstance(c, Tensor) else c for c in cots])
+            in_grads = node.vjp_fn(cot_tree)
+            if not retain_graph:
+                node.vjp_fn = None
+                node.pure = None  # release the primal closure's residuals
         for inp, g in zip(node.inputs, in_grads):
             # When a node output is also a sink target we may want its grad too;
             # partial-grad targets are handled on entry via roots/sinks.
@@ -224,18 +276,13 @@ def grad(
 ):
     """paddle.grad parity (partial_grad_engine.cc analog).
 
-    ``create_graph`` (double backward) is not supported on the eager tape; use
-    the functional path (``paddle_tpu.incubate.autograd`` / ``jax.grad`` of a
-    jitted function) for higher-order derivatives.
+    ``create_graph=True`` re-derives each node's vjp through the taped
+    dispatch, so the returned gradients carry their own graph — grad-of-grad
+    composes to any order (gradient penalties, HVPs).  The functional path
+    (``paddle_tpu.incubate.autograd``) remains the jit-friendly alternative.
     """
     from .tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is unsupported on the eager tape; "
-            "use paddle_tpu.incubate.autograd (grad/hvp/Hessian compose to "
-            "any order) for higher-order derivatives"
-        )
     single_out = isinstance(outputs, Tensor)
     single_in = isinstance(inputs, Tensor)
     outputs = [outputs] if single_out else list(outputs)
@@ -245,16 +292,20 @@ def grad(
     elif isinstance(grad_outputs, Tensor):
         grad_outputs = [grad_outputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = create_graph  # double grad re-walks the graph
     roots, seeds = [], []
     for t, g in zip(outputs, grad_outputs):
         if g is None:
             g = jnp.ones_like(t.value)
-        else:
-            g = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        elif not isinstance(g, Tensor):
+            g = jnp.asarray(g)
+        elif not create_graph:
+            g = g.value
         roots.append(t)
         seeds.append(g)
-    sink_grads = _run_engine(roots, seeds, sinks=inputs, retain_graph=retain_graph)
+    sink_grads = _run_engine(roots, seeds, sinks=inputs,
+                             retain_graph=retain_graph,
+                             create_graph=create_graph)
     results = []
     for t in inputs:
         g = sink_grads.get(id(t))
@@ -263,7 +314,12 @@ def grad(
                 "One of the differentiated tensors appears unused in the graph. "
                 "Set allow_unused=True to return None for it."
             )
-        results.append(None if g is None else t._wrap_grad(g))
+        if g is None:
+            results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)  # create_graph: keep the taped gradient
+        else:
+            results.append(t._wrap_grad(g))
     if single_in:
         return results[0]
     return results

@@ -53,6 +53,83 @@ class nn:
             out = getattr(F, activation)(out)
         return out
 
+    @staticmethod
+    def embedding(input, size, is_sparse: bool = False, padding_idx=None,
+                  param_attr=None, dtype: str = "float32", name=None):
+        """static.nn.embedding parity: lookup over a created table."""
+        from ..nn import functional as F
+
+        w = create_parameter([int(size[0]), int(size[1])], dtype)
+        return F.embedding(input, w, padding_idx=padding_idx)
+
+    @staticmethod
+    def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+               dilation=1, groups=1, param_attr=None, bias_attr=None,
+               act=None, data_format: str = "NCHW", name=None):
+        """static.nn.conv2d parity over create_parameter + F.conv2d."""
+        from .. import tensor as T
+        from ..nn import functional as F
+
+        k = (filter_size, filter_size) if isinstance(filter_size, int) \
+            else tuple(filter_size)
+        cin = int(input.shape[1] if data_format == "NCHW"
+                  else input.shape[-1])
+        w = create_parameter([num_filters, cin // groups, k[0], k[1]],
+                             input.dtype)
+        out = F.conv2d(input, w, None, stride=stride, padding=padding,
+                       dilation=dilation, groups=groups,
+                       data_format=data_format)
+        if bias_attr is not False:
+            b = create_parameter([num_filters], input.dtype, is_bias=True)
+            shape = [1, num_filters, 1, 1] if data_format == "NCHW" \
+                else [1, 1, 1, num_filters]
+            out = T.add(out, T.reshape(b, shape))
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
+    @staticmethod
+    def dropout(x, dropout_prob: float = 0.5, is_test: bool = False,
+                name=None):
+        from ..nn import functional as F
+
+        return F.dropout(x, dropout_prob, training=not is_test)
+
+    @staticmethod
+    def batch_norm(input, act=None, is_test: bool = False, momentum=0.9,
+                   epsilon=1e-5, param_attr=None, bias_attr=None,
+                   data_format: str = "NCHW", name=None):
+        """static.nn.batch_norm parity: scale/shift parameters + running
+        stats as persistable vars; training mode appends the running-stat
+        update nodes to the program (the reference's batch_norm op's
+        MeanOut/VarianceOut outputs)."""
+        from .. import tensor as T
+        from ..nn import functional as F
+
+        c = int(input.shape[1] if data_format == "NCHW" else input.shape[-1])
+        from ..nn import initializer as I
+
+        scale = create_parameter([c], input.dtype,
+                                 default_initializer=I.Constant(1.0))
+        shift = create_parameter([c], input.dtype, is_bias=True)
+        tag = "bn_%d" % len(input.program._vars)
+        mean = create_global_var([c], 0.0, input.dtype, persistable=True,
+                                 name=tag + "_mean")
+        var = create_global_var([c], 1.0, input.dtype, persistable=True,
+                                name=tag + "_variance")
+        prog = input.program
+        # the one BN implementation (functional.norm triple-return): the
+        # symbolic dispatch turns its 3 outputs into selector Variables
+        out, new_mean, new_var = F._bn_triple(
+            input, mean, var, scale, shift, training=not is_test,
+            momentum=momentum, epsilon=epsilon, data_format=data_format)
+        if not is_test:
+            prog._updates.append((mean, new_mean))
+            prog._updates.append((var, new_var))
+        if act:
+            out = getattr(F, act)(out)
+        return out
+
 
 def accuracy(input, label, k: int = 1, correct=None, total=None):
     """layers.accuracy static parity: builds a graph node."""

@@ -309,3 +309,113 @@ class TestRawInterop:
 
         g = jax.grad(loss)(np.array([1.0, 2.0], np.float32))
         np.testing.assert_allclose(np.asarray(g), [2.0, 4.0])
+
+
+class TestCreateGraph:
+    """Double backward on the eager tape (partial_grad_engine create_graph
+    parity): grad-of-grad, gradient penalty, HVP."""
+
+    def test_third_order_polynomial(self):
+        from paddle_tpu.autograd import grad
+
+        x = paddle.to_tensor(np.array([2.0, -1.0]), stop_gradient=False)
+        y = x * x * x
+        (g1,) = grad([y.sum()], [x], create_graph=True)
+        np.testing.assert_allclose(np.asarray(g1.value), [12.0, 3.0])
+        (g2,) = grad([g1.sum()], [x], create_graph=True)
+        np.testing.assert_allclose(np.asarray(g2.value), [12.0, -6.0])
+        (g3,) = grad([g2.sum()], [x])
+        np.testing.assert_allclose(np.asarray(g3.value), [6.0, 6.0])
+
+    def test_gradient_penalty_reaches_params(self):
+        from paddle_tpu.autograd import grad
+
+        paddle.seed(0)
+        lin = paddle.nn.Linear(3, 1)
+        xx = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 3).astype(np.float32),
+            stop_gradient=False)
+        (gx,) = grad([lin(xx).sum()], [xx], create_graph=True)
+        ((gx * gx).sum()).backward()
+        # out = sum(xW + b): d||dx out||^2 / dW = 2 * 4 * W per column
+        np.testing.assert_allclose(
+            np.asarray(lin.weight.grad.value),
+            8 * np.asarray(lin.weight.value), rtol=1e-5, atol=1e-6)
+
+    def test_hessian_vector_product(self):
+        from paddle_tpu.autograd import grad
+
+        x = paddle.to_tensor(np.array([1.0, 2.0]), stop_gradient=False)
+        (g,) = grad([(x * x * x).sum()], [x], create_graph=True)
+        v = paddle.to_tensor(np.array([1.0, 0.5]))
+        (hvp,) = grad([(g * v).sum()], [x])
+        np.testing.assert_allclose(np.asarray(hvp.value), [6.0, 6.0])
+
+    def test_nonlinear_chain_vs_torch(self):
+        import torch
+
+        from paddle_tpu.autograd import grad
+
+        xv = np.array([0.3, -0.7, 1.2], np.float32)
+        x = paddle.to_tensor(xv, stop_gradient=False)
+        y = paddle.tanh(x * x).sum()
+        (g,) = grad([y], [x], create_graph=True)
+        (gg,) = grad([g.sum()], [x])
+
+        tx = torch.tensor(xv, requires_grad=True)
+        ty = torch.tanh(tx * tx).sum()
+        (tg,) = torch.autograd.grad(ty, tx, create_graph=True)
+        (tgg,) = torch.autograd.grad(tg.sum(), tx)
+        np.testing.assert_allclose(np.asarray(g.value), tg.detach().numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gg.value), tgg.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_create_graph_replays_dropout_mask(self):
+        """Random ops must re-draw the SAME keys when create_graph replays
+        the primal at backward time (replay_counter pinning)."""
+        from paddle_tpu.autograd import grad
+        import paddle_tpu.nn.functional as F
+
+        paddle.seed(7)
+        x = paddle.to_tensor(np.ones((64,), np.float32),
+                             stop_gradient=False)
+        y = F.dropout(x, 0.5, training=True)
+        mask = (np.asarray(y.value) != 0).astype(np.float32)
+        (g,) = grad([y.sum()], [x], create_graph=True)
+        # d/dx of upscale-dropout = mask / (1-p): same zeros as the forward
+        np.testing.assert_allclose(np.asarray(g.value), mask * 2.0,
+                                   rtol=1e-6)
+        # and the replay must not advance the global RNG stream
+        from paddle_tpu.core.random import default_generator
+
+        c0 = default_generator._counter
+        (gg,) = grad([(g * g).sum()], [x], allow_unused=True)
+        assert gg is None or np.isfinite(np.asarray(gg.value)).all()
+
+    def test_create_graph_frees_when_not_retained(self):
+        from paddle_tpu.autograd import grad
+        from paddle_tpu.core.errors import InvalidArgumentError
+
+        x = paddle.to_tensor(np.array([2.0]), stop_gradient=False)
+        y = (x * x).sum()
+        (g,) = grad([y], [x], create_graph=True, retain_graph=False)
+        with pytest.raises(InvalidArgumentError):
+            grad([y], [x])
+
+    def test_create_graph_through_pylayer_raises(self):
+        from paddle_tpu.autograd import PyLayer, grad
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, a):
+                return a * 2
+
+            @staticmethod
+            def backward(ctx, gy):
+                return gy * 2
+
+        x = paddle.to_tensor(np.array([1.0]), stop_gradient=False)
+        y = Double.apply(x).sum()
+        with pytest.raises(NotImplementedError):
+            grad([y], [x], create_graph=True)

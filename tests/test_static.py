@@ -259,3 +259,42 @@ def test_joint_gradients_single_backward(linreg):
         outs = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=gs)
     for g, p in zip(outs, params):
         assert g.shape == tuple(p.shape) and np.isfinite(g).all()
+
+
+def test_static_nn_layers(rng):
+    """static.nn embedding/conv2d/dropout/batch_norm build + train."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        img = static.data("img", [-1, 3, 8, 8], "float32")
+        conv = static.nn.conv2d(img, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        bn = static.nn.batch_norm(conv)
+        ids = static.data("ids", [-1, 5], "int64")
+        emb = static.nn.embedding(ids, size=[32, 6])
+        feat = pt.concat([bn.mean(axis=[2, 3]), emb.mean(axis=1)], axis=1)
+        logits = static.nn.fc(feat, 2)
+        lab = static.data("lab", [-1], "int64")
+        loss = pt.mean(pt.nn.functional.cross_entropy(logits, lab))
+        pt.optimizer.Adam(learning_rate=0.01).minimize(loss)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        imgs = rng.randn(16, 3, 8, 8).astype(np.float32)
+        idsv = rng.randint(0, 32, (16, 5)).astype(np.int64)
+        labs = rng.randint(0, 2, (16,)).astype(np.int64)
+        feed = {"img": imgs, "ids": idsv, "lab": labs}
+        losses = [float(exe.run(main, feed=feed, fetch_list=[loss])[0])
+                  for _ in range(15)]
+        assert losses[-1] < losses[0], losses[::7]
+        # running stats were updated by the bn update nodes (named bn_*_mean
+        # / bn_*_variance by static.nn.batch_norm)
+        scope = static.global_scope()
+        means = [k for k in scope._values if k.endswith("_mean")
+                 and k.startswith("bn_")]
+        variances = [k for k in scope._values if k.endswith("_variance")
+                     and k.startswith("bn_")]
+        assert means and variances
+        assert any(not np.allclose(np.asarray(scope._values[k]), 0.0)
+                   for k in means)
+        assert any(not np.allclose(np.asarray(scope._values[k]), 1.0)
+                   for k in variances)

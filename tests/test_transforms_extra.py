@@ -1,0 +1,93 @@
+"""Photometric/geometric transforms added for reference parity: hue via
+colorsys oracle, contrast/saturation/brightness algebra, rotate
+(including expand + rank preservation), ColorJitter, RandomResizedCrop,
+RandomRotation, Grayscale."""
+import colorsys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.vision import transforms as T
+
+
+@pytest.fixture
+def img(rng=None):
+    return np.random.RandomState(0).randint(0, 255, (12, 10, 3),
+                                            dtype=np.uint8)
+
+
+def test_adjust_hue_matches_colorsys(img):
+    out = T.adjust_hue(img, 0.25)
+    for (y, x) in [(0, 0), (5, 3), (11, 9)]:
+        r, g, b = img[y, x].astype(np.float64) / 255
+        h, s, v = colorsys.rgb_to_hsv(r, g, b)
+        want = np.array(colorsys.hsv_to_rgb((h + 0.25) % 1.0, s, v)) * 255
+        np.testing.assert_allclose(out[y, x], want, atol=2)
+    # identity at 0
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=2)
+    with pytest.raises(InvalidArgumentError):
+        T.adjust_hue(img, 0.7)
+
+
+def test_adjust_contrast_brightness_saturation(img):
+    # contrast 1 and saturation 1 are identities
+    np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img, atol=1)
+    np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img, atol=1)
+    np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img, atol=1)
+    # contrast 0 collapses to the grayscale mean
+    flat = T.adjust_contrast(img, 0.0)
+    assert flat.std() < 1.0
+    # saturation 0 == grayscale
+    gray3 = T.adjust_saturation(img, 0.0)
+    np.testing.assert_allclose(gray3[..., 0], gray3[..., 1], atol=1)
+    # brightness scales linearly (pre-clip)
+    bright = T.adjust_brightness((img // 4), 2.0)
+    np.testing.assert_allclose(bright, (img // 4) * 2, atol=1)
+
+
+def test_to_grayscale(img):
+    g1 = T.to_grayscale(img)
+    assert g1.shape == (12, 10, 1)
+    g3 = T.to_grayscale(img, 3)
+    assert g3.shape == (12, 10, 3)
+    np.testing.assert_array_equal(g3[..., 0], g3[..., 1])
+    want = (img[..., 0] * 0.299 + img[..., 1] * 0.587 + img[..., 2] * 0.114)
+    np.testing.assert_allclose(g1[..., 0], want, atol=1)
+
+
+def test_rotate_identities(img):
+    out0 = T.rotate(img, 0.0)
+    np.testing.assert_array_equal(out0, img)
+    # 90-degree CCW rotation of a square equals np.rot90
+    sq = img[:10, :10]
+    out90 = T.rotate(sq, 90.0)
+    np.testing.assert_array_equal(out90, np.rot90(sq))
+    # expand grows the canvas for diagonal rotations
+    out45 = T.rotate(img, 45.0, expand=True)
+    assert out45.shape[0] > img.shape[0] and out45.shape[1] > img.shape[1]
+    # 2-D input keeps rank 2
+    assert T.rotate(img[..., 0], 30.0).ndim == 2
+    # bilinear runs and stays uint8
+    assert T.rotate(img, 30.0, interpolation="bilinear").dtype == np.uint8
+
+
+def test_transform_classes(img):
+    assert T.ColorJitter(0.4, 0.4, 0.4, 0.25)(img).shape == img.shape
+    assert T.Grayscale()(img).shape == (12, 10, 1)
+    out = T.RandomResizedCrop(8)(img)
+    assert out.shape == (8, 8, 3)
+    out = T.RandomRotation(30)(img)
+    assert out.shape == img.shape
+    with pytest.raises(InvalidArgumentError):
+        T.RandomRotation(-5)
+    with pytest.raises(InvalidArgumentError):
+        T.HueTransform(0.9)
+    # zero-strength jitter is identity
+    np.testing.assert_array_equal(T.ColorJitter(0, 0, 0, 0)(img), img)
+
+
+def test_random_resized_crop_scale_bounds(img):
+    rrc = T.RandomResizedCrop(6, scale=(0.99, 1.0), ratio=(0.99, 1.01))
+    out = rrc(img)
+    assert out.shape == (6, 6, 3)
