@@ -119,7 +119,7 @@ def bench_resnet50(pt, jax, on_tpu: bool):
         with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
             return criterion(m(x), y)
 
-    step = TrainStep(model, loss_fn, opt, donate=False)
+    step = TrainStep(model, loss_fn, opt)  # donated buffers: less HBM
     rng = np.random.RandomState(0)
     best = None
     for batch in batches:
