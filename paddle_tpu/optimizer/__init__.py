@@ -13,6 +13,7 @@ reference's per-device optimizer kernels.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Dict, List, Optional, Sequence
 
 import jax.numpy as jnp
@@ -26,7 +27,8 @@ from .lr import LRScheduler
 
 __all__ = [
     "Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad", "Adadelta",
-    "Adamax", "RMSProp", "Lamb", "Lars", "Ftrl", "lr",
+    "Adamax", "RMSProp", "Lamb", "Lars", "Ftrl", "Lookahead",
+    "ModelAverage", "lr",
 ]
 
 lr = lr_sched
@@ -742,3 +744,147 @@ class Ftrl(Optimizer):
         pre = jnp.clip(new_lin, -self._l1, self._l1) - new_lin
         new = jnp.where(jnp.abs(new_lin) > self._l1, pre / quad, 0.0)
         return new.astype(val.dtype), dict(state, squared=new_sq, linear=new_lin)
+
+
+class Lookahead:
+    """fluid/optimizer.py:5969 LookaheadOptimizer semantics: an inner (fast)
+    optimizer steps normally; every ``k`` steps the slow weights move
+    ``alpha`` of the way toward the fast weights and the fast weights are
+    reset onto them.  Non-subclassing wrapper (the meta_optimizers pattern):
+    unknown attributes delegate to the inner optimizer, so the jit TrainStep
+    machinery (_parameter_list/_states/_functional_step) sees the inner
+    optimizer's state directly."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5):
+        if inner_optimizer is None:
+            raise InvalidArgumentError("Lookahead needs an inner optimizer")
+        if not 0.0 <= alpha <= 1.0:
+            raise InvalidArgumentError("alpha must be in [0, 1]")
+        if k < 1:
+            raise InvalidArgumentError("k must be a positive integer")
+        self._inner = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        # keyed by position in the inner parameter list: auto-generated
+        # param names differ across processes, positions do not
+        self._slow: dict = {}
+
+    @property
+    def inner_opt(self):
+        return self._inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def step(self) -> None:
+        self._inner.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        for i, p in enumerate(self._inner._parameter_list or ()):
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(i)
+            if slow is None:
+                # first sync point: slow weights start at the initial fast
+                # weights, which step() has since advanced — seed from the
+                # current value (the reference seeds at minimize start)
+                slow = p.value
+            slow = slow + self.alpha * (p.value - slow)
+            self._slow[i] = slow
+            p.set_value(slow)
+
+    def clear_grad(self, *args, **kwargs) -> None:
+        self._inner.clear_grad(*args, **kwargs)
+
+    def state_dict(self) -> dict:
+        sd = self._inner.state_dict()
+        sd["__lookahead_step__"] = Tensor(jnp.asarray(self._step_count))
+        for i, slow in self._slow.items():
+            sd["__lookahead_slow__%d" % i] = Tensor(slow)
+        return sd
+
+    def set_state_dict(self, state_dict: dict) -> None:
+        state_dict = dict(state_dict)
+        step = state_dict.pop("__lookahead_step__", None)
+        if step is not None:
+            self._step_count = int(np.asarray(
+                step.value if hasattr(step, "value") else step))
+        self._slow = {}
+        for key in [k for k in state_dict if
+                    k.startswith("__lookahead_slow__")]:
+            v = state_dict.pop(key)
+            self._slow[int(key[len("__lookahead_slow__"):])] = jnp.asarray(
+                v.value if hasattr(v, "value") else v)
+        if state_dict:  # stateless inner optimizers (SGD) save no slots
+            self._inner.set_state_dict(state_dict)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..static.graph import Variable as _StaticVar
+
+        if isinstance(loss, _StaticVar):
+            raise NotImplementedError(
+                "Lookahead is an eager-mode wrapper on this stack; for "
+                "static programs minimize with the inner optimizer")
+        if loss._node is not None:
+            loss.backward()
+        self.step()
+        return None, None
+
+
+class ModelAverage:
+    """fluid/optimizer.py:3573 ModelAverage semantics (dygraph form):
+    maintain a running average of parameter values; ``apply()`` swaps the
+    averaged weights in for evaluation, ``restore()`` swaps back.  The
+    effective window follows the reference:
+    ``min(max(num_updates * rate, min_window), max_window)``."""
+
+    def __init__(self, average_window_rate: float = 0.15,
+                 parameters: Optional[Sequence] = None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        if parameters is None:
+            raise InvalidArgumentError(
+                "ModelAverage needs parameters=model.parameters()")
+        self._params = [p for p in parameters if not p.stop_gradient]
+        self._rate = average_window_rate
+        self._min_w = min_average_window
+        self._max_w = max_average_window
+        self._sums = {p.name: jnp.zeros_like(p.value) for p in self._params}
+        self._count = 0.0
+        self._updates = 0
+        self._saved: Optional[dict] = None
+
+    def step(self) -> None:
+        """Accumulate the current weights (call after optimizer.step())."""
+        self._updates += 1
+        window = min(max(self._updates * self._rate, self._min_w),
+                     self._max_w)
+        decay = 1.0 if self._count < window else float(window) / (window + 1)
+        for p in self._params:
+            self._sums[p.name] = self._sums[p.name] * decay + p.value
+        self._count = self._count * decay + 1 if self._count >= window \
+            else self._count + 1
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        if self._count == 0:
+            raise InvalidArgumentError(
+                "ModelAverage.apply before any accumulation step()")
+        self._saved = {p.name: p.value for p in self._params}
+        for p in self._params:
+            p.set_value(self._sums[p.name] / self._count)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None) -> None:
+        if self._saved is None:
+            return
+        for p in self._params:
+            p.set_value(self._saved[p.name])
+        self._saved = None

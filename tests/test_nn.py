@@ -441,3 +441,106 @@ class TestLRSchedulers:
         for m in [1.0, 1.0, 1.0, 1.0]:
             s.step(m)
         assert s() == pytest.approx(0.05)
+
+
+class TestOptimizerWrappers:
+    def test_lookahead_sync_semantics(self):
+        p = paddle.Parameter(np.array([10.0], np.float32))
+        inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        opt = paddle.optimizer.Lookahead(inner, alpha=0.5, k=2)
+        traj = []
+        for _ in range(4):
+            (p * 1.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+            traj.append(float(np.asarray(p.value)[0]))
+        # fast steps -1 each; sync at k=2 seeds slow, second sync pulls
+        # halfway: 8 + 0.5*(6-8) = 7
+        assert traj == [9.0, 8.0, 7.0, 7.0], traj
+
+    def test_lookahead_validates(self):
+        inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[
+            paddle.Parameter(np.zeros(1, np.float32))])
+        with pytest.raises(Exception):
+            paddle.optimizer.Lookahead(inner, alpha=2.0)
+        with pytest.raises(Exception):
+            paddle.optimizer.Lookahead(inner, k=0)
+
+    def test_model_average_apply_restore(self):
+        p = paddle.Parameter(np.array([0.0], np.float32))
+        ma = paddle.optimizer.ModelAverage(
+            0.15, parameters=[p], min_average_window=2,
+            max_average_window=10)
+        for v in (1.0, 2.0, 3.0):
+            p.set_value(np.array([v], np.float32))
+            ma.step()
+        with ma.apply():
+            inside = float(np.asarray(p.value)[0])
+        assert 1.0 < inside < 3.0
+        assert float(np.asarray(p.value)[0]) == 3.0
+        # apply without restore keeps averaged weights
+        with ma.apply(need_restore=False):
+            pass
+        assert float(np.asarray(p.value)[0]) == pytest.approx(inside)
+
+    def test_lookahead_composes_with_trainstep(self):
+        """Wrapper delegation must keep jit.TrainStep working (review item)."""
+        from paddle_tpu.jit import TrainStep
+
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        opt = paddle.optimizer.Lookahead(
+            paddle.optimizer.SGD(learning_rate=0.1,
+                                 parameters=net.parameters()), k=2)
+        step = TrainStep(net, lambda m, x, y: F.cross_entropy(m(x), y),
+                         opt.inner_opt)
+        xs = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+        ys = np.random.RandomState(1).randint(0, 2, (8,)).astype(np.int32)
+        l0 = float(step(xs, ys))
+        l1 = float(step(xs, ys))
+        assert l1 < l0
+        # eager wrapper usage still works alongside
+        loss = F.cross_entropy(net(paddle.to_tensor(xs)),
+                               paddle.to_tensor(ys))
+        loss.backward()
+        opt.step()
+        opt.clear_grad(set_to_zero=False)
+
+    def test_lookahead_state_dict_restores_slow_weights(self):
+        p = paddle.Parameter(np.array([10.0], np.float32))
+        inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        opt = paddle.optimizer.Lookahead(inner, alpha=0.5, k=2)
+        for _ in range(3):
+            (p * 1.0).sum().backward()
+            opt.step()
+            opt.clear_grad()
+        sd = opt.state_dict()
+        assert any(k.startswith("__lookahead_slow__") for k in sd)
+        p2 = paddle.Parameter(np.asarray(p.value))
+        inner2 = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p2])
+        opt2 = paddle.optimizer.Lookahead(inner2, alpha=0.5, k=2)
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == opt._step_count
+        for name in opt._slow:
+            np.testing.assert_allclose(np.asarray(opt2._slow[name]),
+                                       np.asarray(opt._slow[name]))
+        # continued runs agree
+        for o, pp in ((opt, p), (opt2, p2)):
+            (pp * 1.0).sum().backward()
+            o.step()
+            o.clear_grad()
+        np.testing.assert_allclose(np.asarray(p.value), np.asarray(p2.value))
+
+    def test_model_average_window_rate_matters(self):
+        """The reference window formula consults num_updates * rate."""
+        p = paddle.Parameter(np.array([0.0], np.float32))
+        ma = paddle.optimizer.ModelAverage(
+            0.5, parameters=[p], min_average_window=1,
+            max_average_window=100)
+        for v in (1.0, 2.0, 3.0, 4.0):
+            p.set_value(np.array([v], np.float32))
+            ma.step()
+        with ma.apply():
+            early_heavy = float(np.asarray(p.value)[0])
+        # growing window keeps more history than a fixed min window would
+        assert 2.0 < early_heavy < 4.0
