@@ -142,8 +142,28 @@ def bench_resnet50(pt, jax, on_tpu: bool):
     return best
 
 
+def _probe_accelerator(timeout_s: int = 180) -> bool:
+    """Check from a THROWAWAY subprocess that the accelerator runtime
+    answers; a wedged tunnel (the axon transport can hang for hours) must
+    not hang the bench — we fall back to CPU and still emit the JSON line."""
+    import subprocess
+    import sys
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout_s)
+        return proc.returncode == 0 and "cpu" not in proc.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     import os
+
+    if os.environ.get("JAX_PLATFORMS") != "cpu" and not _probe_accelerator():
+        os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
 

@@ -774,8 +774,44 @@ class Lookahead:
     def inner_opt(self):
         return self._inner
 
+    @property
+    def _parameter_list(self):
+        return self._inner._parameter_list
+
+    @_parameter_list.setter
+    def _parameter_list(self, params):
+        # writes must reach the inner optimizer (TrainStep assigns this
+        # when the optimizer was built without parameters=)
+        self._inner._parameter_list = params
+
     def __getattr__(self, name):
+        if name == "_inner":  # guard: deepcopy/pickle probe pre-__init__
+            raise AttributeError(name)
         return getattr(self._inner, name)
+
+    def _functional_step(self, *args, **kwargs):
+        raise NotImplementedError(
+            "Lookahead's k-step slow-weight sync is host-side state and "
+            "does not compose with the jitted TrainStep; jit the inner "
+            "optimizer (TrainStep(model, loss_fn, opt.inner_opt)) and call "
+            "opt.sync() every k steps, or train eagerly via "
+            "backward()/opt.step()")
+
+    def sync(self) -> None:
+        """Force a slow-weight sync now (for jitted training loops that
+        step the inner optimizer directly)."""
+        self._step_count = 0
+        for i, p in enumerate(self._inner._parameter_list or ()):
+            if p.stop_gradient:
+                continue
+            slow = self._slow.get(i)
+            if slow is None:
+                slow = p.value
+            slow = slow + self.alpha * (p.value - slow)
+            # independent copy: the param's buffer may be donated by a
+            # jitted TrainStep, which would delete a shared reference
+            self._slow[i] = jnp.array(slow, copy=True)
+            p.set_value(slow)
 
     def step(self) -> None:
         self._inner.step()
@@ -792,7 +828,9 @@ class Lookahead:
                 # current value (the reference seeds at minimize start)
                 slow = p.value
             slow = slow + self.alpha * (p.value - slow)
-            self._slow[i] = slow
+            # independent copy: the param's buffer may be donated by a
+            # jitted TrainStep, which would delete a shared reference
+            self._slow[i] = jnp.array(slow, copy=True)
             p.set_value(slow)
 
     def clear_grad(self, *args, **kwargs) -> None:

@@ -484,7 +484,9 @@ class TestOptimizerWrappers:
         assert float(np.asarray(p.value)[0]) == pytest.approx(inside)
 
     def test_lookahead_composes_with_trainstep(self):
-        """Wrapper delegation must keep jit.TrainStep working (review item)."""
+        """The jitted path steps the inner optimizer; the wrapper's sync()
+        applies the slow-weight pull between jitted steps, and passing the
+        wrapper itself to TrainStep raises loudly (review item)."""
         from paddle_tpu.jit import TrainStep
 
         paddle.seed(0)
@@ -499,6 +501,18 @@ class TestOptimizerWrappers:
         l0 = float(step(xs, ys))
         l1 = float(step(xs, ys))
         assert l1 < l0
+        before = np.asarray(net.weight.value).copy()
+        opt.sync()  # documented jit-loop pattern
+        after_first_sync = np.asarray(net.weight.value)
+        np.testing.assert_allclose(after_first_sync, before)  # seeds slow
+        float(step(xs, ys))
+        opt.sync()
+        assert not np.allclose(np.asarray(net.weight.value),
+                               after_first_sync)
+        # the wrapper itself must not silently degrade to plain SGD
+        with pytest.raises(NotImplementedError):
+            TrainStep(net, lambda m, x, y: F.cross_entropy(m(x), y),
+                      opt)(xs, ys)
         # eager wrapper usage still works alongside
         loss = F.cross_entropy(net(paddle.to_tensor(xs)),
                                paddle.to_tensor(ys))
