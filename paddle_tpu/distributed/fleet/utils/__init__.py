@@ -23,7 +23,8 @@ from ....framework.dispatch import make_op
 from ....framework.tensor import Parameter, Tensor
 from ....nn.layer.layers import Layer
 
-__all__ = ["recompute"]
+__all__ = ["recompute", "FS", "LocalFS", "HDFSClient",
+           "DistributedInfer"]
 
 
 def _closure_params(fn: Callable) -> List[Parameter]:
@@ -96,3 +97,6 @@ def recompute(function: Callable, *args, preserve_rng_state: bool = True, **kwar
 
     op = make_op(jax.checkpoint(raw_fn), op_name="recompute")
     return op(*params, *args)
+
+
+from .fs import FS, DistributedInfer, HDFSClient, LocalFS  # noqa: E402,F401

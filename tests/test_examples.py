@@ -1,0 +1,30 @@
+"""The examples/ scripts (BASELINE.md's five configs) must stay runnable:
+each executes as a real subprocess on the 8-device CPU mesh."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+SCRIPTS = [
+    ("01_mnist_lenet.py", ["--epochs", "1"]),
+    ("02_resnet_amp_compiled.py", ["--steps", "4"]),
+    ("03_bert_pretrain_dp.py", ["--steps", "3"]),
+    ("04_ernie_finetune_sharding.py", ["--steps", "3"]),
+    ("05_gpt_pipeline_tp.py", ["--steps", "2"]),
+]
+
+
+@pytest.mark.parametrize("script,args", SCRIPTS,
+                         ids=[s for s, _ in SCRIPTS])
+def test_example_runs(script, args):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO)
+    assert proc.returncode == 0, (script, proc.stdout[-1500:],
+                                  proc.stderr[-1500:])
