@@ -1,0 +1,73 @@
+"""paddle.distribution: log_prob/entropy/KL against scipy oracles, sample
+statistics, and sampling_id."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as pt
+from paddle_tpu.distribution import (Categorical, MultivariateNormalDiag,
+                                     Normal, Uniform, sampling_id)
+
+
+def test_normal_vs_scipy():
+    d = Normal(loc=1.5, scale=2.0)
+    v = np.array([0.0, 1.5, 4.0], np.float32)
+    np.testing.assert_allclose(np.asarray(d.log_prob(v).value),
+                               st.norm(1.5, 2.0).logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(d.entropy().value)),
+                               st.norm(1.5, 2.0).entropy(), rtol=1e-5)
+    other = Normal(loc=0.0, scale=1.0)
+    # analytic KL(N(1.5,2) || N(0,1))
+    kl = float(np.asarray(d.kl_divergence(other).value))
+    want = np.log(1 / 2.0) + (4.0 + 1.5 ** 2) / 2.0 - 0.5
+    np.testing.assert_allclose(kl, want, rtol=1e-5)
+
+
+def test_uniform_vs_scipy():
+    d = Uniform(low=-1.0, high=3.0)
+    v = np.array([-0.5, 0.0, 2.9], np.float32)
+    np.testing.assert_allclose(np.asarray(d.log_prob(v).value),
+                               st.uniform(-1.0, 4.0).logpdf(v), rtol=1e-5)
+    pt.seed(0)
+    s = np.asarray(d.sample([2000]).value)
+    assert (-1.0 <= s).all() and (s <= 3.0).all()
+    assert abs(s.mean() - 1.0) < 0.1
+
+
+def test_categorical_probs_and_samples():
+    logits = np.log(np.array([0.2, 0.5, 0.3], np.float32))
+    d = Categorical(logits)
+    pt.seed(0)
+    s = np.asarray(d.sample([4000]).value).ravel()
+    freq = np.bincount(s, minlength=3) / len(s)
+    np.testing.assert_allclose(freq, [0.2, 0.5, 0.3], atol=0.03)
+
+
+def test_mvn_diag_vs_scipy():
+    loc = np.array([0.5, -1.0, 2.0], np.float32)
+    diag = np.array([1.5, 0.7, 2.2], np.float32)
+    d = MultivariateNormalDiag(loc, np.diag(diag))
+    v = np.array([0.3, -0.5, 1.0], np.float32)
+    ref = st.multivariate_normal(loc, np.diag(diag ** 2))
+    np.testing.assert_allclose(float(np.asarray(d.log_prob(v).value)),
+                               ref.logpdf(v), rtol=1e-5)
+    np.testing.assert_allclose(float(np.asarray(d.entropy().value)),
+                               ref.entropy(), rtol=1e-5)
+    pt.seed(1)
+    s = np.asarray(d.sample([5000]).value)
+    np.testing.assert_allclose(s.mean(0), loc, atol=0.12)
+    np.testing.assert_allclose(s.std(0), diag, atol=0.12)
+    # KL to itself is ~0; to a different diag is positive
+    same = float(np.asarray(d.kl_divergence(d).value))
+    assert abs(same) < 1e-5
+    other = MultivariateNormalDiag(loc * 0, np.diag(np.ones(3, np.float32)))
+    assert float(np.asarray(d.kl_divergence(other).value)) > 0
+
+
+def test_sampling_id_distribution():
+    pt.seed(0)
+    probs = np.tile(np.array([[0.1, 0.9]], np.float32), (3000, 1))
+    ids = np.asarray(sampling_id(pt.to_tensor(probs)).value)
+    assert ids.shape == (3000,)
+    freq1 = (ids == 1).mean()
+    assert 0.85 < freq1 < 0.95
