@@ -1,0 +1,100 @@
+"""Namespace parity audit: diff every public ``__all__`` of the reference
+against this tree and print what's missing.
+
+Usage:
+    JAX_PLATFORMS=cpu python tools/audit_parity.py [--reference /root/reference]
+
+Exit code 0 iff no audited namespace is missing a symbol.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def names_of(path: str) -> set:
+    src = open(path).read()
+    out: set = set()
+    # top-level __init__ lists one quoted name per line; submodule files use
+    # __all__ = [...] blocks — collect both
+    for m in re.finditer(r"__all__\s*(?:\+?=)\s*\[([^\]]*)\]", src, re.S):
+        out |= set(re.findall(r"['\"]([A-Za-z_0-9]+)['\"]", m.group(1)))
+    out |= set(re.findall(r"^\s+'([A-Za-z_0-9]+)',\s*$", src, re.M))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--reference", default="/root/reference")
+    args = ap.parse_args()
+    ref = os.path.join(args.reference, "python", "paddle")
+
+    import paddle_tpu as pt
+    import paddle_tpu.autograd
+    import paddle_tpu.distributed
+    import paddle_tpu.distributed.fleet
+    import paddle_tpu.distributed.fleet.utils
+    import paddle_tpu.distribution
+    import paddle_tpu.inference
+    import paddle_tpu.io
+    import paddle_tpu.jit
+    import paddle_tpu.metric
+    import paddle_tpu.onnx
+    import paddle_tpu.static
+    import paddle_tpu.text
+    import paddle_tpu.utils
+    import paddle_tpu.vision
+
+    audits = [
+        ("__init__.py", pt, "paddle"),
+        ("nn/__init__.py", pt.nn, "paddle.nn"),
+        ("nn/functional/__init__.py", pt.nn.functional,
+         "paddle.nn.functional"),
+        ("tensor/__init__.py", pt, "paddle.tensor (top-level)"),
+        ("io/__init__.py", pt.io, "paddle.io"),
+        ("metric/__init__.py", pt.metric, "paddle.metric"),
+        ("amp/__init__.py", pt.amp, "paddle.amp"),
+        ("jit/__init__.py", pt.jit, "paddle.jit"),
+        ("static/__init__.py", pt.static, "paddle.static"),
+        ("autograd/__init__.py", pt.autograd, "paddle.autograd"),
+        ("vision/__init__.py", pt.vision, "paddle.vision"),
+        ("vision/transforms/__init__.py", pt.vision.transforms,
+         "paddle.vision.transforms"),
+        ("vision/models/__init__.py", pt.vision.models,
+         "paddle.vision.models"),
+        ("distribution.py", pt.distribution, "paddle.distribution"),
+        ("optimizer/__init__.py", pt.optimizer, "paddle.optimizer"),
+        ("optimizer/lr.py", pt.optimizer.lr, "paddle.optimizer.lr"),
+        ("text/__init__.py", pt.text, "paddle.text"),
+        ("distributed/__init__.py", pt.distributed, "paddle.distributed"),
+        ("distributed/fleet/__init__.py", pt.distributed.fleet,
+         "paddle.distributed.fleet"),
+        ("distributed/fleet/utils/__init__.py", pt.distributed.fleet.utils,
+         "paddle.distributed.fleet.utils"),
+        ("inference/__init__.py", pt.inference, "paddle.inference"),
+        ("onnx/__init__.py", pt.onnx, "paddle.onnx"),
+        ("utils/__init__.py", pt.utils, "paddle.utils"),
+    ]
+    total_missing = 0
+    for ref_file, mod, label in audits:
+        path = os.path.join(ref, ref_file)
+        if not os.path.exists(path):
+            print("%-34s (no reference file)" % label)
+            continue
+        names = names_of(path)
+        missing = sorted(n for n in names if not hasattr(mod, n))
+        total_missing += len(missing)
+        status = "OK (%d symbols)" % len(names) if not missing \
+            else "MISSING %d: %s" % (len(missing), " ".join(missing))
+        print("%-34s %s" % (label, status))
+    print("\ntotal missing symbols: %d" % total_missing)
+    return 1 if total_missing else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
