@@ -155,3 +155,42 @@ def test_moe_trains_under_jit(rng):
     # leaked tracer (the buffer write-back path)
     aux = float(model.moe.aux_loss)
     assert np.isfinite(aux) and aux > 0.0
+
+
+def test_moe_ep_sharding_survives_training(rng):
+    """Expert weights must STAY ep-sharded after donated TrainStep updates
+    (placement must round-trip through the optimizer)."""
+    from paddle_tpu.jit import TrainStep
+
+    devs = jax.devices()
+    mesh = Mesh(np.array(devs[:8]).reshape(2, 4), ("dp", "ep"))
+    group = Group(ranks=list(range(8)), mesh=mesh, axis_name="ep")
+    pt.seed(0)
+    moe = MoELayer(8, 16, num_experts=4, ep_group=group)
+    head = pt.nn.Linear(8, 4)
+
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.moe = moe
+            self.head = head
+
+        def forward(self, x):
+            return self.head(x + self.moe(x))
+
+    model = Net()
+    opt = pt.optimizer.Adam(1e-2, parameters=model.parameters())
+    xs = rng.randn(4, 8, 8).astype(np.float32)
+    ys = rng.randint(0, 4, (4, 8)).astype(np.int32)
+
+    def loss_fn(m, x, y):
+        logits = m(x)
+        return pt.nn.functional.cross_entropy(
+            pt.reshape(logits, [-1, 4]), pt.reshape(y, [-1]))
+
+    step = TrainStep(model, loss_fn, opt)
+    with mesh:
+        for _ in range(3):
+            step(xs, ys)
+    spec = moe.w1.value.sharding.spec
+    assert spec[0] == "ep", spec
