@@ -1,0 +1,144 @@
+"""All 13 LR schedulers against closed-form / torch.optim.lr_scheduler
+oracles over multi-epoch trajectories."""
+import math
+
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as pt
+import paddle_tpu.optimizer.lr as lr
+
+
+def _trajectory(sched, epochs, metrics=None):
+    vals = []
+    for e in range(epochs):
+        vals.append(float(sched()))
+        if metrics is not None:
+            sched.step(metrics[e])
+        else:
+            sched.step()
+    return np.asarray(vals)
+
+
+def _torch_trajectory(make_sched, epochs):
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=0.1)
+    sched = make_sched(opt)
+    vals = []
+    for _ in range(epochs):
+        vals.append(opt.param_groups[0]["lr"])
+        opt.step()
+        sched.step()
+    return np.asarray(vals)
+
+
+def test_step_decay_vs_torch():
+    ours = _trajectory(lr.StepDecay(0.1, step_size=3, gamma=0.5), 10)
+    want = _torch_trajectory(
+        lambda o: torch.optim.lr_scheduler.StepLR(o, 3, 0.5), 10)
+    np.testing.assert_allclose(ours, want, rtol=1e-6)
+
+
+def test_multistep_decay_vs_torch():
+    ours = _trajectory(lr.MultiStepDecay(0.1, milestones=[2, 5], gamma=0.1),
+                       8)
+    want = _torch_trajectory(
+        lambda o: torch.optim.lr_scheduler.MultiStepLR(o, [2, 5], 0.1), 8)
+    np.testing.assert_allclose(ours, want, rtol=1e-6)
+
+
+def test_exponential_decay_vs_torch():
+    ours = _trajectory(lr.ExponentialDecay(0.1, gamma=0.9), 8)
+    want = _torch_trajectory(
+        lambda o: torch.optim.lr_scheduler.ExponentialLR(o, 0.9), 8)
+    np.testing.assert_allclose(ours, want, rtol=1e-6)
+
+
+def test_cosine_annealing_vs_torch():
+    ours = _trajectory(lr.CosineAnnealingDecay(0.1, T_max=10), 10)
+    want = _torch_trajectory(
+        lambda o: torch.optim.lr_scheduler.CosineAnnealingLR(o, 10), 10)
+    np.testing.assert_allclose(ours, want, rtol=1e-5, atol=1e-8)
+
+
+def test_lambda_decay():
+    ours = _trajectory(lr.LambdaDecay(0.1, lr_lambda=lambda e: 0.95 ** e), 6)
+    want = 0.1 * 0.95 ** np.arange(6)
+    np.testing.assert_allclose(ours, want, rtol=1e-6)
+
+
+def test_polynomial_decay_closed_form():
+    sched = lr.PolynomialDecay(0.1, decay_steps=5, end_lr=0.01, power=2.0)
+    vals = _trajectory(sched, 8)
+    for e in range(8):
+        t = min(e, 5)
+        want = (0.1 - 0.01) * (1 - t / 5) ** 2 + 0.01
+        np.testing.assert_allclose(vals[e], want, rtol=1e-6)
+
+
+def test_inverse_time_and_natural_exp():
+    it = _trajectory(lr.InverseTimeDecay(0.1, gamma=0.5), 4)
+    np.testing.assert_allclose(it, [0.1 / (1 + 0.5 * e) for e in range(4)],
+                               rtol=1e-6)
+    ne = _trajectory(lr.NaturalExpDecay(0.1, gamma=0.5), 4)
+    np.testing.assert_allclose(ne, [0.1 * math.exp(-0.5 * e)
+                                    for e in range(4)], rtol=1e-6)
+
+
+def test_noam_decay_shape():
+    sched = lr.NoamDecay(d_model=64, warmup_steps=4, learning_rate=1.0)
+    vals = _trajectory(sched, 12)
+    peak = int(np.argmax(vals))
+    assert 2 <= peak <= 5  # rises through warmup then decays
+    assert vals[-1] < vals[peak]
+
+
+def test_piecewise_decay():
+    sched = lr.PiecewiseDecay(boundaries=[2, 4], values=[1.0, 0.5, 0.1])
+    vals = _trajectory(sched, 6)
+    np.testing.assert_allclose(vals, [1.0, 1.0, 0.5, 0.5, 0.1, 0.1])
+
+
+def test_linear_warmup():
+    base = lr.StepDecay(0.1, step_size=100, gamma=0.5)
+    sched = lr.LinearWarmup(base, warmup_steps=4, start_lr=0.0, end_lr=0.1)
+    vals = _trajectory(sched, 6)
+    np.testing.assert_allclose(vals[:4], [0.0, 0.025, 0.05, 0.075],
+                               rtol=1e-6)
+    np.testing.assert_allclose(vals[4:], [0.1, 0.1], rtol=1e-6)
+
+
+def test_reduce_on_plateau():
+    sched = lr.ReduceOnPlateau(0.1, mode="min", factor=0.5, patience=1)
+    metrics = [1.0, 0.9, 0.95, 0.96, 0.97, 0.98]
+    vals = _trajectory(sched, len(metrics), metrics=metrics)
+    assert vals[0] == pytest.approx(0.1)
+    assert vals[-1] < 0.1  # plateaued metrics forced a reduction
+
+
+def test_one_cycle_shape():
+    sched = lr.OneCycleLR(max_learning_rate=0.1, total_steps=10)
+    vals = _trajectory(sched, 10)
+    peak = int(np.argmax(vals))
+    assert 0 < peak < 9
+    assert vals[-1] < vals[0] + 1e-9 or vals[-1] < vals[peak]
+
+
+def test_scheduler_in_optimizer_and_state():
+    sched = lr.StepDecay(0.05, step_size=1, gamma=0.1)
+    p = pt.Parameter(np.array([1.0], np.float32))
+    opt = pt.optimizer.SGD(learning_rate=sched, parameters=[p])
+    (p * 1.0).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    np.testing.assert_allclose(np.asarray(p.value), [1.0 - 0.05], rtol=1e-6)
+    sched.step()
+    (p * 1.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(np.asarray(p.value),
+                               [1.0 - 0.05 - 0.005], rtol=1e-5)
+    sd = sched.state_dict()
+    fresh = lr.StepDecay(0.05, step_size=1, gamma=0.1)
+    fresh.set_state_dict(sd)
+    assert float(fresh()) == pytest.approx(float(sched()))
