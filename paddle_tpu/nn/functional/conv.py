@@ -96,15 +96,30 @@ def _conv_transpose_nd(
         x.shape, weight.shape, (lhs_spec, "IO" + spatial, lhs_spec)
     )
     strides = _normalize_tuple(stride, n, "stride")
+    dil = _normalize_tuple(dilation, n, "dilation")
     pads = _normalize_padding(padding, n)
+    op = _normalize_tuple(output_padding, n, "output_padding") \
+        if output_padding else (0,) * n
+    for i in range(n):
+        if op[i] >= strides[i] and op[i] >= dil[i]:
+            raise InvalidArgumentError(
+                "output_padding must be smaller than either stride or "
+                "dilation, got output_padding=%s stride=%s dilation=%s"
+                % (op, strides, dil))
     if isinstance(pads, str):
+        if any(op):
+            raise InvalidArgumentError(
+                "output_padding requires explicit integer padding, not %r"
+                % pads)
         pad_arg = pads
     else:
-        # convert forward-conv padding semantics to conv_transpose padding
+        # convert forward-conv padding semantics to conv_transpose padding;
+        # output_padding extends the RIGHT/BOTTOM edge of the computation
+        # (extra rows carry real conv contributions, not zeros)
         k = weight.shape[2:]
-        dil = _normalize_tuple(dilation, n, "dilation")
         pad_arg = [
-            (dil[i] * (k[i] - 1) - pads[i][0], dil[i] * (k[i] - 1) - pads[i][1])
+            (dil[i] * (k[i] - 1) - pads[i][0],
+             dil[i] * (k[i] - 1) - pads[i][1] + op[i])
             for i in range(n)
         ]
     # transpose-conv == lhs-dilated conv with the kernel spatially flipped and
@@ -116,16 +131,9 @@ def _conv_transpose_nd(
         window_strides=(1,) * n,
         padding=pad_arg,
         lhs_dilation=strides,
-        rhs_dilation=_normalize_tuple(dilation, n, "dilation"),
+        rhs_dilation=dil,
         dimension_numbers=dn,
     )
-    if output_padding:
-        op = _normalize_tuple(output_padding, n, "output_padding")
-        pad_cfg = [(0, 0)] * out.ndim
-        for i in range(n):
-            ax = (i + 1) if channel_last else (i + 2)
-            pad_cfg[ax] = (0, op[i])
-        out = jnp.pad(out, pad_cfg)
     if bias is not None:
         if channel_last:
             out = out + bias.reshape((1,) * (out.ndim - 1) + (-1,))

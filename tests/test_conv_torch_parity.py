@@ -1,0 +1,120 @@
+"""Convolution family vs torch.nn.functional oracles across
+stride/padding/dilation/groups, including transposed convs and 1d/3d."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tf
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+@pytest.mark.parametrize("stride,padding,dilation,groups", [
+    (1, 0, 1, 1),
+    (2, 1, 1, 1),
+    (1, 2, 2, 1),
+    (1, 1, 1, 2),
+    (2, 2, 2, 4),
+])
+def test_conv2d_configs(rng, stride, padding, dilation, groups):
+    cin, cout = 4, 8
+    x = rng.randn(2, cin, 11, 9).astype(np.float32)
+    w = rng.randn(cout, cin // groups, 3, 3).astype(np.float32)
+    b = rng.randn(cout).astype(np.float32)
+    ours = F.conv2d(pt.to_tensor(x), pt.to_tensor(w), pt.to_tensor(b),
+                    stride=stride, padding=padding, dilation=dilation,
+                    groups=groups)
+    want = tf.conv2d(torch.from_numpy(x), torch.from_numpy(w),
+                     torch.from_numpy(b), stride=stride, padding=padding,
+                     dilation=dilation, groups=groups)
+    np.testing.assert_allclose(np.asarray(ours.value), want.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("stride,padding,output_padding", [
+    (1, 0, 0),
+    (2, 1, 0),
+    (2, 1, 1),
+])
+def test_conv2d_transpose_configs(rng, stride, padding, output_padding):
+    x = rng.randn(2, 4, 6, 5).astype(np.float32)
+    w = rng.randn(4, 6, 3, 3).astype(np.float32)  # [in, out, kh, kw]
+    ours = F.conv2d_transpose(pt.to_tensor(x), pt.to_tensor(w), None,
+                              stride=stride, padding=padding,
+                              output_padding=output_padding)
+    want = tf.conv_transpose2d(torch.from_numpy(x), torch.from_numpy(w),
+                               None, stride=stride, padding=padding,
+                               output_padding=output_padding)
+    np.testing.assert_allclose(np.asarray(ours.value), want.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv1d_and_conv3d(rng):
+    x1 = rng.randn(2, 3, 16).astype(np.float32)
+    w1 = rng.randn(5, 3, 4).astype(np.float32)
+    ours = F.conv1d(pt.to_tensor(x1), pt.to_tensor(w1), stride=2, padding=1)
+    want = tf.conv1d(torch.from_numpy(x1), torch.from_numpy(w1), stride=2,
+                     padding=1)
+    np.testing.assert_allclose(np.asarray(ours.value), want.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+    x3 = rng.randn(1, 2, 5, 6, 7).astype(np.float32)
+    w3 = rng.randn(4, 2, 3, 3, 3).astype(np.float32)
+    ours = F.conv3d(pt.to_tensor(x3), pt.to_tensor(w3), padding=1)
+    want = tf.conv3d(torch.from_numpy(x3), torch.from_numpy(w3), padding=1)
+    np.testing.assert_allclose(np.asarray(ours.value), want.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grads_vs_torch(rng):
+    x = rng.randn(1, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    xt = pt.to_tensor(x)
+    xt.stop_gradient = False
+    wt = pt.to_tensor(w)
+    wt.stop_gradient = False
+    out = F.conv2d(xt, wt, padding=1)
+    (out * out).sum().backward()
+
+    tx = torch.tensor(x, requires_grad=True)
+    tw = torch.tensor(w, requires_grad=True)
+    tout = tf.conv2d(tx, tw, padding=1)
+    (tout * tout).sum().backward()
+    np.testing.assert_allclose(np.asarray(xt.grad.value), tx.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(wt.grad.value), tw.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("pool,tpool,kw", [
+    (F.max_pool2d, tf.max_pool2d, dict(kernel_size=3, stride=2)),
+    (F.avg_pool2d, tf.avg_pool2d, dict(kernel_size=2, stride=2)),
+])
+def test_pooling_vs_torch(rng, pool, tpool, kw):
+    x = rng.randn(2, 3, 9, 9).astype(np.float32)
+    ours = pool(pt.to_tensor(x), **kw)
+    want = tpool(torch.from_numpy(x), **kw)
+    np.testing.assert_allclose(np.asarray(ours.value), want.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_adaptive_pool_vs_torch(rng):
+    x = rng.randn(2, 3, 10, 7).astype(np.float32)
+    ours = F.adaptive_avg_pool2d(pt.to_tensor(x), [4, 3])
+    want = tf.adaptive_avg_pool2d(torch.from_numpy(x), (4, 3))
+    np.testing.assert_allclose(np.asarray(ours.value), want.numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_output_padding_must_be_smaller_than_stride(rng):
+    from paddle_tpu.core.errors import InvalidArgumentError
+
+    x = pt.to_tensor(rng.randn(1, 2, 4, 4).astype(np.float32))
+    w = pt.to_tensor(rng.randn(2, 2, 3, 3).astype(np.float32))
+    with pytest.raises(InvalidArgumentError):
+        F.conv2d_transpose(x, w, stride=2, padding=1, output_padding=2)

@@ -122,7 +122,8 @@ def test_one_cycle_shape():
     vals = _trajectory(sched, 10)
     peak = int(np.argmax(vals))
     assert 0 < peak < 9
-    assert vals[-1] < vals[0] + 1e-9 or vals[-1] < vals[peak]
+    # the anneal phase must actually land far below the peak
+    assert vals[-1] < 0.2 * vals[peak], vals
 
 
 def test_scheduler_in_optimizer_and_state():
