@@ -159,8 +159,57 @@ def interpolate(
         out_shape = (x.shape[0],) + size + (x.shape[-1],)
     else:
         out_shape = (x.shape[0], x.shape[1]) + size
-    method = {"nearest": "nearest", "bilinear": "linear", "trilinear": "linear", "linear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+    spatial_axes = tuple(range(1, 1 + n_spatial)) if channel_last \
+        else tuple(range(2, 2 + n_spatial))
+    if mode == "nearest":
+        out = x
+        for ax, out_len in zip(spatial_axes, size):
+            out = _resize_axis_nearest(out, ax, out_len, align_corners)
+        return out
+    if mode in ("linear", "bilinear", "trilinear"):
+        out = x
+        for ax, out_len in zip(spatial_axes, size):
+            out = _resize_axis_linear(out, ax, out_len, align_corners,
+                                      align_mode)
+        return out
+    # bicubic/area keep the jax.image kernel (half-pixel Keys cubic; the
+    # reference's bicubic uses a=-0.75 so values differ slightly)
+    method = {"bicubic": "cubic", "area": "linear"}[mode]
     return jax.image.resize(x, out_shape, method=method)
+
+
+def _resize_axis_nearest(x, axis, out_len, align_corners=False):
+    in_len = x.shape[axis]
+    if align_corners and out_len > 1:
+        # reference align_corners nearest: round(dst * (in-1)/(out-1))
+        idx = jnp.round(
+            jnp.arange(out_len) * ((in_len - 1) / (out_len - 1)))
+    else:
+        # default convention: src = floor(dst * in/out)
+        idx = jnp.floor(jnp.arange(out_len) * (in_len / out_len))
+    idx = jnp.clip(idx.astype(jnp.int32), 0, in_len - 1)
+    return jnp.take(x, idx, axis=axis)
+
+
+def _resize_axis_linear(x, axis, out_len, align_corners, align_mode=0):
+    in_len = x.shape[axis]
+    if align_corners:
+        # output_size 1 defines scale = 0 (select index 0, torch/paddle)
+        scale = (in_len - 1) / (out_len - 1) if out_len > 1 else 0.0
+        src = jnp.arange(out_len) * scale
+    elif align_mode == 1:
+        # paddle align_mode=1: src = dst * in/out (no half-pixel shift)
+        src = jnp.arange(out_len) * (in_len / out_len)
+    else:
+        src = (jnp.arange(out_len) + 0.5) * (in_len / out_len) - 0.5
+    i0 = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_len - 1)
+    i1 = jnp.clip(i0 + 1, 0, in_len - 1)
+    w = jnp.clip(src - i0, 0.0, 1.0).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[axis] = out_len
+    w = w.reshape(shape)
+    return jnp.take(x, i0, axis=axis) * (1 - w) \
+        + jnp.take(x, i1, axis=axis) * w
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):

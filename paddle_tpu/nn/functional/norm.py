@@ -128,7 +128,8 @@ def local_response_norm(x, size: int, alpha: float = 1e-4, beta: float = 0.75, k
     windows = sum(
         jnp.take(padded, jnp.arange(i, i + x.shape[1]), axis=1) for i in range(size)
     )
-    return x / jnp.power(k + alpha * windows, beta)
+    # reference (and torch) average the window: alpha scales sum/size
+    return x / jnp.power(k + alpha * windows / size, beta)
 
 
 def normalize(x, p: float = 2, axis: int = 1, epsilon: float = 1e-12):

@@ -1,0 +1,182 @@
+"""Broad functional parity vs torch oracles: norm family, interpolate,
+activation long tail, and loss long tail."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tf
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def _close(ours, want, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(ours.value), want.numpy(),
+                               rtol=rtol, atol=atol)
+
+
+# -- norms ------------------------------------------------------------------
+
+def test_group_norm_vs_torch(rng):
+    x = rng.randn(2, 6, 4, 4).astype(np.float32)
+    w = rng.randn(6).astype(np.float32)
+    b = rng.randn(6).astype(np.float32)
+    ours = F.group_norm(pt.to_tensor(x), num_groups=3,
+                        weight=pt.to_tensor(w), bias=pt.to_tensor(b),
+                        epsilon=1e-5)
+    want = tf.group_norm(torch.tensor(x), 3, torch.tensor(w),
+                         torch.tensor(b), eps=1e-5)
+    _close(ours, want)
+
+
+def test_instance_norm_vs_torch(rng):
+    x = rng.randn(2, 3, 5, 5).astype(np.float32)
+    ours = F.instance_norm(pt.to_tensor(x), eps=1e-5)
+    want = tf.instance_norm(torch.tensor(x), eps=1e-5)
+    _close(ours, want)
+
+
+def test_local_response_norm_vs_torch(rng):
+    x = rng.randn(2, 8, 6, 6).astype(np.float32)
+    ours = F.local_response_norm(pt.to_tensor(x), size=5, alpha=1e-4,
+                                 beta=0.75, k=1.0)
+    want = tf.local_response_norm(torch.tensor(x), 5, alpha=1e-4,
+                                  beta=0.75, k=1.0)
+    _close(ours, want)
+
+
+def test_normalize_vs_torch(rng):
+    x = rng.randn(4, 7).astype(np.float32)
+    for p in (1.0, 2.0):
+        ours = F.normalize(pt.to_tensor(x), p=p, axis=1)
+        want = tf.normalize(torch.tensor(x), p=p, dim=1)
+        _close(ours, want)
+
+
+# -- interpolate ------------------------------------------------------------
+
+@pytest.mark.parametrize("mode,align", [
+    ("nearest", None),
+    ("bilinear", False),
+    ("bilinear", True),
+])
+def test_interpolate_vs_torch(rng, mode, align):
+    x = rng.randn(2, 3, 5, 7).astype(np.float32)
+    kw = {} if align is None else {"align_corners": align}
+    ours = F.interpolate(pt.to_tensor(x), size=[10, 13], mode=mode, **kw)
+    want = tf.interpolate(torch.tensor(x), size=(10, 13), mode=mode, **kw)
+    _close(ours, want, rtol=1e-4, atol=1e-4)
+
+
+# -- activations ------------------------------------------------------------
+
+ACTS = [
+    ("selu", {}, "selu", {}),
+    ("silu", {}, "silu", {}),
+    ("mish", {}, "mish", {}),
+    ("hardswish", {}, "hardswish", {}),
+    ("hardsigmoid", {}, "hardsigmoid", {}),
+    ("softplus", dict(beta=2.0), "softplus", dict(beta=2.0)),
+    ("elu", dict(alpha=0.7), "elu", dict(alpha=0.7)),
+    ("leaky_relu", dict(negative_slope=0.2), "leaky_relu",
+     dict(negative_slope=0.2)),
+    ("gelu", dict(approximate=True), "gelu", dict(approximate="tanh")),
+    ("gelu", dict(approximate=False), "gelu", dict(approximate="none")),
+    ("log_sigmoid", {}, "logsigmoid", {}),
+    ("relu6", {}, "relu6", {}),
+    ("hardshrink", dict(threshold=0.4), "hardshrink", dict(lambd=0.4)),
+    ("softshrink", dict(threshold=0.3), "softshrink", dict(lambd=0.3)),
+    ("tanhshrink", {}, "tanhshrink", {}),
+]
+
+
+@pytest.mark.parametrize("ours_name,okw,torch_name,tkw", ACTS,
+                         ids=["%s-%d" % (c[0], i)
+                              for i, c in enumerate(ACTS)])
+def test_activation_vs_torch(rng, ours_name, okw, torch_name, tkw):
+    x = (rng.randn(64) * 2).astype(np.float32)
+    ours = getattr(F, ours_name)(pt.to_tensor(x), **okw)
+    want = getattr(tf, torch_name)(torch.tensor(x), **tkw)
+    _close(ours, want, rtol=1e-4, atol=1e-5)
+
+
+# -- losses -----------------------------------------------------------------
+
+def test_kl_div_vs_torch(rng):
+    logq = np.log(rng.dirichlet(np.ones(5), size=6)).astype(np.float32)
+    p = rng.dirichlet(np.ones(5), size=6).astype(np.float32)
+    ours = F.kl_div(pt.to_tensor(logq), pt.to_tensor(p), reduction="mean")
+    want = tf.kl_div(torch.tensor(logq), torch.tensor(p), reduction="mean")
+    _close(ours, want)
+
+
+def test_smooth_l1_vs_torch(rng):
+    x = rng.randn(10).astype(np.float32)
+    y = rng.randn(10).astype(np.float32)
+    # paddle delta == torch beta
+    ours = F.smooth_l1_loss(pt.to_tensor(x), pt.to_tensor(y), delta=0.5)
+    want = tf.smooth_l1_loss(torch.tensor(x), torch.tensor(y), beta=0.5)
+    _close(ours, want)
+
+
+def test_margin_ranking_vs_torch(rng):
+    a = rng.randn(8).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    lab = np.sign(rng.randn(8)).astype(np.float32)
+    ours = F.margin_ranking_loss(pt.to_tensor(a), pt.to_tensor(b),
+                                 pt.to_tensor(lab), margin=0.3)
+    want = tf.margin_ranking_loss(torch.tensor(a), torch.tensor(b),
+                                  torch.tensor(lab), margin=0.3)
+    _close(ours, want)
+
+
+def test_bce_with_logits_pos_weight_vs_torch(rng):
+    logits = rng.randn(6, 3).astype(np.float32)
+    labels = rng.randint(0, 2, (6, 3)).astype(np.float32)
+    pw = np.array([1.0, 2.0, 0.5], np.float32)
+    ours = F.binary_cross_entropy_with_logits(
+        pt.to_tensor(logits), pt.to_tensor(labels),
+        pos_weight=pt.to_tensor(pw))
+    want = tf.binary_cross_entropy_with_logits(
+        torch.tensor(logits), torch.tensor(labels),
+        pos_weight=torch.tensor(pw))
+    _close(ours, want)
+
+
+def test_nll_loss_vs_torch(rng):
+    logp = tf.log_softmax(torch.tensor(rng.randn(8, 4).astype(np.float32)),
+                          dim=1)
+    labels = rng.randint(0, 4, (8,))
+    w = np.array([1.0, 2.0, 0.5, 1.5], np.float32)
+    ours = F.nll_loss(pt.to_tensor(logp.numpy()),
+                      pt.to_tensor(labels.astype(np.int32)),
+                      weight=pt.to_tensor(w))
+    want = tf.nll_loss(logp, torch.tensor(labels),
+                       weight=torch.tensor(w))
+    _close(ours, want)
+
+
+def test_interpolate_edge_conventions(rng):
+    """align_corners size-1 target selects index 0; nearest+align_corners
+    rounds over (in-1)/(out-1); align_mode=1 drops the half-pixel shift."""
+    x = rng.randn(1, 1, 5, 5).astype(np.float32)
+    out = F.interpolate(pt.to_tensor(x), size=[1, 1], mode="bilinear",
+                        align_corners=True)
+    np.testing.assert_allclose(np.asarray(out.value)[0, 0, 0, 0],
+                               x[0, 0, 0, 0], rtol=1e-6)
+    # nearest align_corners vs torch-free closed form
+    row = np.arange(5, dtype=np.float32).reshape(1, 1, 1, 5)
+    out = F.interpolate(pt.to_tensor(row), size=[1, 8], mode="nearest",
+                        align_corners=True)
+    want = np.round(np.arange(8) * (4 / 7.0))
+    np.testing.assert_allclose(np.asarray(out.value).ravel(), want)
+    # align_mode=1: src = dst * in/out exactly
+    out = F.interpolate(pt.to_tensor(row), size=[1, 10], mode="bilinear",
+                        align_corners=False, align_mode=1)
+    want = np.clip(np.arange(10) * 0.5, 0, 4)
+    np.testing.assert_allclose(np.asarray(out.value).ravel(), want,
+                               rtol=1e-6)

@@ -1,3 +1,9 @@
+"""Manual BERT throughput sweep on the attached chip.
+
+Usage: python tools/bert_sweep.py [batch ...]   (defaults: 16 24 32 48)
+Used to locate the v5e throughput knee (batch 40, MFU 0.4365) that
+bench.py's sweep now centers on.
+"""
 import time, numpy as np, jax
 import paddle_tpu as pt
 from paddle_tpu.jit import TrainStep
