@@ -300,3 +300,30 @@ def test_conll05st_section_isolation(tmp_path):
                    target_dict_file=td)  # default section test.wsj
     assert len(ds) == 1
     assert ds.sentences[0] == ["Dogs", "bark"]
+
+
+def test_wordpiece_matches_huggingface(tmp_path):
+    """Python AND native C++ paths must agree with transformers'
+    BertTokenizer (the wordpiece reference implementation)."""
+    transformers = pytest.importorskip("transformers")
+
+    vocab_list = ["[PAD]", "[UNK]", "[CLS]", "[SEP]", "the", "quick",
+                  "brown", "fox", "jump", "##ed", "##s", "over", "lazy",
+                  "dog", "un", "##believ", "##able", "hello", "world", "!"]
+    vp = tmp_path / "vocab.txt"
+    vp.write_text("\n".join(vocab_list) + "\n")
+    hf = transformers.BertTokenizer(str(vp), do_lower_case=True)
+    vocab_map = {w: i for i, w in enumerate(vocab_list)}
+    sentences = [
+        "The quick brown fox",
+        "jumped over the lazy dog",
+        "unbelievable hello world!",
+        "jumps UNKNOWNWORD fox",
+        "the... fox!! (hello)",
+    ]
+    for use_native in (False, None):
+        tok = WordpieceTokenizer(vocab_map, use_native=use_native)
+        for s in sentences:
+            ids = list(tok.tokenize(s))
+            want = hf.convert_tokens_to_ids(hf.tokenize(s))
+            assert ids == want, (use_native, s, ids, want)
