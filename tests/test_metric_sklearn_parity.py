@@ -1,0 +1,55 @@
+"""Metrics vs scikit-learn oracles: Auc (streaming), Precision/Recall,
+Accuracy top-k."""
+import numpy as np
+import pytest
+from sklearn import metrics as sk
+
+import paddle_tpu as pt
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def test_auc_vs_sklearn(rng):
+    scores = rng.rand(400).astype(np.float32)
+    labels = (rng.rand(400) < scores).astype(np.int64)  # correlated
+    m = Auc(num_thresholds=4095)
+    # stream in four batches like a validation loop
+    probs = np.stack([1 - scores, scores], axis=1)
+    for i in range(0, 400, 100):
+        m.update(probs[i:i + 100], labels[i:i + 100, None])
+    ours = float(m.accumulate())
+    want = sk.roc_auc_score(labels, scores)
+    assert abs(ours - want) < 0.01, (ours, want)
+
+
+def test_precision_recall_vs_sklearn(rng):
+    probs = rng.rand(300).astype(np.float32)
+    labels = (rng.rand(300) < probs).astype(np.int64)
+    preds = (probs > 0.5).astype(np.int64)
+    p = Precision()
+    r = Recall()
+    p.update(probs[:, None], labels[:, None])
+    r.update(probs[:, None], labels[:, None])
+    np.testing.assert_allclose(float(p.accumulate()),
+                               sk.precision_score(labels, preds), atol=1e-6)
+    np.testing.assert_allclose(float(r.accumulate()),
+                               sk.recall_score(labels, preds), atol=1e-6)
+
+
+def test_accuracy_topk_vs_sklearn(rng):
+    logits = rng.randn(200, 5).astype(np.float32)
+    labels = rng.randint(0, 5, (200,))
+    m = Accuracy(topk=(1, 3))
+    corr = m.compute(pt.to_tensor(logits), pt.to_tensor(labels))
+    m.update(corr)
+    acc1, acc3 = m.accumulate()
+    want1 = sk.top_k_accuracy_score(labels, logits, k=1,
+                                    labels=list(range(5)))
+    want3 = sk.top_k_accuracy_score(labels, logits, k=3,
+                                    labels=list(range(5)))
+    np.testing.assert_allclose(acc1, want1, atol=1e-6)
+    np.testing.assert_allclose(acc3, want3, atol=1e-6)
