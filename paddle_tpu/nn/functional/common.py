@@ -145,6 +145,11 @@ def interpolate(
     align_mode: int = 0,
     data_format: str = "NCHW",
 ):
+    if mode not in ("nearest", "linear", "bilinear", "trilinear", "area",
+                    "bicubic"):
+        raise InvalidArgumentError(
+            "interpolate mode must be one of nearest/linear/bilinear/"
+            "trilinear/bicubic/area, got %r" % (mode,))
     channel_last = data_format.endswith("C") and x.ndim > 2
     n_spatial = x.ndim - 2
     if size is None:
@@ -172,10 +177,19 @@ def interpolate(
             out = _resize_axis_linear(out, ax, out_len, align_corners,
                                       align_mode)
         return out
-    # bicubic/area keep the jax.image kernel (half-pixel Keys cubic; the
+    if mode == "area":
+        # reference common.py:294-300: AREA delegates to adaptive_avg_pool,
+        # which averages whole input cells over integer span boundaries
+        from . import pooling as _pooling
+        pool = {1: _pooling.adaptive_avg_pool1d,
+                2: _pooling.adaptive_avg_pool2d,
+                3: _pooling.adaptive_avg_pool3d}[n_spatial]
+        fmt = {1: "NLC", 2: "NHWC", 3: "NDHWC"}[n_spatial] if channel_last \
+            else {1: "NCL", 2: "NCHW", 3: "NCDHW"}[n_spatial]
+        return pool(x, list(size), data_format=fmt)
+    # bicubic keeps the jax.image kernel (half-pixel Keys cubic; the
     # reference's bicubic uses a=-0.75 so values differ slightly)
-    method = {"bicubic": "cubic", "area": "linear"}[mode]
-    return jax.image.resize(x, out_shape, method=method)
+    return jax.image.resize(x, out_shape, method="cubic")
 
 
 def _resize_axis_nearest(x, axis, out_len, align_corners=False):

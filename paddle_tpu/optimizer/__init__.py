@@ -769,6 +769,16 @@ class Lookahead:
         # keyed by position in the inner parameter list: auto-generated
         # param names differ across processes, positions do not
         self._slow: dict = {}
+        # reference LookaheadOptimizer snapshots the slow weights at
+        # minimize start; capture now so the first sync interpolates from
+        # the *initial* weights, not the already-advanced fast weights
+        self._seed_slow()
+
+    def _seed_slow(self) -> None:
+        for i, p in enumerate(self._inner._parameter_list or ()):
+            if p.stop_gradient or i in self._slow:
+                continue
+            self._slow[i] = jnp.array(p.value, copy=True)
 
     @property
     def inner_opt(self):
@@ -783,6 +793,7 @@ class Lookahead:
         # writes must reach the inner optimizer (TrainStep assigns this
         # when the optimizer was built without parameters=)
         self._inner._parameter_list = params
+        self._seed_slow()
 
     def __getattr__(self, name):
         if name == "_inner":  # guard: deepcopy/pickle probe pre-__init__
@@ -806,6 +817,20 @@ class Lookahead:
                 continue
             slow = self._slow.get(i)
             if slow is None:
+                # parameters attached to the inner optimizer after __init__
+                # (e.g. TrainStep assigns inner._parameter_list directly):
+                # the initial snapshot is unrecoverable here, so this first
+                # sync is a no-op for this param. Warn — constructing the
+                # inner optimizer with parameters= gives reference-faithful
+                # first-sync behavior.
+                import warnings
+
+                warnings.warn(
+                    "Lookahead slow weights were never seeded for param %d "
+                    "(parameters attached after construction); first sync "
+                    "is a no-op for it. Pass parameters= to the inner "
+                    "optimizer before wrapping to match the reference's "
+                    "minimize-start snapshot." % i)
                 slow = p.value
             slow = slow + self.alpha * (p.value - slow)
             # independent copy: the param's buffer may be donated by a
@@ -814,6 +839,7 @@ class Lookahead:
             p.set_value(slow)
 
     def step(self) -> None:
+        self._seed_slow()  # params attached after __init__: snapshot pre-step
         self._inner.step()
         self._step_count += 1
         if self._step_count % self.k:
@@ -823,9 +849,6 @@ class Lookahead:
                 continue
             slow = self._slow.get(i)
             if slow is None:
-                # first sync point: slow weights start at the initial fast
-                # weights, which step() has since advanced — seed from the
-                # current value (the reference seeds at minimize start)
                 slow = p.value
             slow = slow + self.alpha * (p.value - slow)
             # independent copy: the param's buffer may be donated by a

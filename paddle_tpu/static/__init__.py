@@ -149,9 +149,12 @@ def auc(input, label, curve: str = "ROC", num_thresholds: int = 4095,
         score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
             else pred.reshape(-1)
         lab2 = jnp.asarray(lab).reshape(-1)
-        order = jnp.argsort(score)
-        ranks = jnp.empty_like(order).at[order].set(
-            jnp.arange(1, score.shape[0] + 1))
+        # midranks: tied scores get the average of their rank span, so the
+        # Mann-Whitney statistic matches sklearn on discrete/quantized scores
+        sorted_s = jnp.sort(score)
+        lo = jnp.searchsorted(sorted_s, score, side="left")
+        hi = jnp.searchsorted(sorted_s, score, side="right")
+        ranks = (lo + hi + 1) / 2.0
         pos = (lab2 > 0)
         n_pos = pos.sum()
         n_neg = lab2.shape[0] - n_pos

@@ -20,7 +20,9 @@ __all__ = ["VOC2012"]
 SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
 DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
 LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
-MODE_FLAG_MAP = {"train": "train", "test": "val", "valid": "val"}
+# Reference voc2012.py:85 maps train->trainval (2913 imgs), test->train,
+# valid->val; matching it exactly so ported code sees the same splits.
+MODE_FLAG_MAP = {"train": "trainval", "test": "train", "valid": "val"}
 
 
 class VOC2012(Dataset):

@@ -407,6 +407,17 @@ def _yolo_loss_raw(x, gt_box, gt_label, gt_score, anchors, anchor_mask,
     # --- cell assignment + regression targets ----------------------------
     gi = jnp.clip((gt_box[..., 0] * W).astype(jnp.int32), 0, W - 1)
     gj = jnp.clip((gt_box[..., 1] * H).astype(jnp.int32), 0, H - 1)
+
+    # last-write-wins dedup: if two gts land on the same (anchor, cell),
+    # only the later slot keeps the assignment (matches the reference
+    # kernel's target scatter, which overwrites)
+    resp_any = resp.any(-1)                                    # [N,B]
+    key = a_local * (H * W) + gj * W + gi                      # [N,B]
+    same = (key[:, :, None] == key[:, None, :]) \
+        & resp_any[:, :, None] & resp_any[:, None, :]          # [N,B,B']
+    later = jnp.triu(jnp.ones((B, B), bool), k=1)[None]        # b' > b
+    kept = resp_any & ~(same & later).any(-1)                  # [N,B]
+    resp = resp & kept[..., None]
     t_x = gt_box[..., 0] * W - gi
     t_y = gt_box[..., 1] * H - gj
     p_sel = an_sel[a_local]                                    # [N,B,2]

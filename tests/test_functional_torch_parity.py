@@ -59,6 +59,18 @@ def test_normalize_vs_torch(rng):
 
 # -- interpolate ------------------------------------------------------------
 
+def test_interpolate_area_vs_torch(rng):
+    # non-integer scale: box averaging with fractional edge weights
+    x = rng.randn(2, 3, 7, 9).astype(np.float32)
+    ours = F.interpolate(pt.to_tensor(x), size=[4, 5], mode="area")
+    want = tf.interpolate(torch.tensor(x), size=(4, 5), mode="area")
+    _close(ours, want, rtol=1e-4, atol=1e-4)
+    # upscale path
+    ours = F.interpolate(pt.to_tensor(x), size=[10, 13], mode="area")
+    want = tf.interpolate(torch.tensor(x), size=(10, 13), mode="area")
+    _close(ours, want, rtol=1e-4, atol=1e-4)
+
+
 @pytest.mark.parametrize("mode,align", [
     ("nearest", None),
     ("bilinear", False),

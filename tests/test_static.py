@@ -179,6 +179,29 @@ def test_py_func_print_metrics(linreg, capsys):
     assert "static-loss:" in capsys.readouterr().out
 
 
+def test_static_auc_tied_scores_match_sklearn():
+    """Tied (quantized) scores need midranks; sklearn is the oracle."""
+    from sklearn.metrics import roc_auc_score
+
+    from paddle_tpu import static
+
+    scores = np.array([0.5, 0.5, 0.5, 0.2, 0.8, 0.2, 0.8, 0.5],
+                      np.float32)
+    labels = np.array([1, 0, 1, 0, 1, 1, 0, 0], np.int64)
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        probs = static.data("probs", [-1], "float32")
+        lab = static.data("lab", [-1], "int64")
+        auc_node, _, _ = static.auc(probs, lab)
+    exe = static.Executor()
+    with static.scope_guard(static.Scope()):
+        exe.run(startup)
+        aucv = exe.run(main, feed={"probs": scores, "lab": labels},
+                       fetch_list=[auc_node])[0]
+    want = roc_auc_score(labels, scores)
+    np.testing.assert_allclose(float(aucv), want, rtol=1e-6)
+
+
 def test_variable_operators(linreg):
     main, startup, x, y, pred, loss, xs, ys = linreg
     with static.program_guard(main, startup):

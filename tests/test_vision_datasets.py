@@ -76,8 +76,11 @@ def test_voc2012(tmp_path, rng):
     masks = {}
     with tarfile.open(data_file, "w") as tar:
         _add_member(
-            tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+            tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
             ("\n".join(names[:2]) + "\n").encode())
+        _add_member(
+            tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+            (names[0] + "\n").encode())
         _add_member(
             tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
             (names[2] + "\n").encode())
@@ -96,6 +99,9 @@ def test_voc2012(tmp_path, rng):
     np.testing.assert_array_equal(mask, masks[names[1]])
     val = VOC2012(data_file, mode="valid")
     assert len(val) == 1
+    # reference split map: mode="test" reads the *train* list
+    test_split = VOC2012(data_file, mode="test")
+    assert len(test_split) == 1
     with pytest.raises(InvalidArgumentError):
         VOC2012(None)
 
