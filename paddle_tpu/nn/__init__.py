@@ -5,6 +5,7 @@ layer/functional library) — see SURVEY.md §2.5 / A.6.
 """
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
 from .layer.layers import Layer, ParamAttr  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from .layer.activation import (  # noqa: F401

@@ -217,6 +217,36 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
-    def __init__(self, weight_shape, dim: int = 0, power_iters: int = 1, epsilon: float = 1e-12, name=None):
-        super().__init__()
-        raise NotImplementedError("SpectralNorm is not yet implemented")
+    """Spectral normalization layer (reference fluid/dygraph/nn.py:2994 +
+    spectral_norm_op kernel semantics): forward(weight) runs ``power_iters``
+    power-iteration rounds from the stored u/v vectors and returns
+    weight / sigma.  u/v are registered buffers initialised ~N(0,1); the
+    reference op reads them without write-back, mirrored here."""
+
+    def __init__(self, weight_shape, dim: int = 0, power_iters: int = 1,
+                 epsilon: float = 1e-12, name=None, dtype="float32"):
+        super().__init__(dtype=dtype)
+        if power_iters < 1:
+            raise ValueError("power_iters must be a positive integer")
+        self._weight_shape = [int(s) for s in weight_shape]
+        self._dim = int(dim) % len(self._weight_shape)
+        self._power_iters = int(power_iters)
+        self._eps = float(epsilon)
+        h = self._weight_shape[self._dim]
+        w = 1
+        for i, s in enumerate(self._weight_shape):
+            if i != self._dim:
+                w *= s
+        self.weight_u = self.create_parameter(
+            [h], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v = self.create_parameter(
+            [w], dtype=dtype, default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        from ..utils import _spectral_normalize
+
+        return _spectral_normalize(
+            weight, self.weight_u, self.weight_v, self._dim,
+            self._power_iters, self._eps)

@@ -1,0 +1,220 @@
+"""Weight reparameterization hooks (reference:
+python/paddle/nn/utils/spectral_norm_hook.py:32 and weight_norm_hook.py:94).
+
+Both hooks store the raw parameter under ``<name>_orig`` (plus auxiliary
+state) and recompute ``<name>`` in a forward pre-hook, so the recomputed
+weight participates in the autograd tape each call while the power-iteration
+vectors stay out of it.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Parameter, Tensor
+
+__all__ = ["spectral_norm", "weight_norm", "remove_weight_norm"]
+
+
+def _reshape_to_matrix(weight, dim: int):
+    """Permute ``dim`` to the front and flatten the rest: [h, w] view."""
+    ndim = len(weight.shape)
+    dim = dim % ndim  # negative dims: same normalization as weight_norm
+    if dim != 0:
+        perm = [dim] + [d for d in range(ndim) if d != dim]
+        weight = weight.transpose(perm)
+    h = weight.shape[0]
+    return weight.reshape([h, -1])
+
+
+def _l2normalize(x, eps):
+    return x / jnp.maximum(jnp.linalg.norm(x), eps)
+
+
+def _spectral_normalize(weight, u, v, dim, power_iters, eps,
+                        write_back: bool = False):
+    """sigma = u^T W v after ``power_iters`` rounds; returns weight/sigma.
+
+    Power iteration runs on raw device arrays (outside the tape, matching
+    the reference op where U/V are non-differentiable inputs); the final
+    u/v enter the sigma computation as constants so gradients flow only
+    through ``weight``.  With ``write_back`` the updated u/v are stored
+    (hook semantics, reference spectral_norm_hook.py:60-80); without, the
+    stored vectors are left untouched (fluid op semantics).
+    """
+    w_mat_t = _reshape_to_matrix(weight, dim)  # Tensor, tape-recorded
+    w_raw = jnp.asarray(w_mat_t.value)
+    u_raw = jnp.asarray(u.value)
+    v_raw = jnp.asarray(v.value)
+    for _ in range(power_iters):
+        v_raw = _l2normalize(jnp.matmul(w_raw.T, u_raw), eps)
+        u_raw = _l2normalize(jnp.matmul(w_raw, v_raw), eps)
+    if write_back:
+        u.set_value(u_raw)
+        v.set_value(v_raw)
+    u_const = Tensor(u_raw, stop_gradient=True)
+    v_const = Tensor(v_raw, stop_gradient=True)
+    from ... import tensor as pt_ops
+
+    sigma = pt_ops.dot(u_const, pt_ops.mv(w_mat_t, v_const))
+    return weight / sigma
+
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, dim, eps):
+        if n_power_iterations <= 0:
+            raise ValueError(
+                "Expected n_power_iterations to be positive, got %r"
+                % (n_power_iterations,))
+        self.name = name
+        self.dim = dim
+        self.n_power_iterations = n_power_iterations
+        self.eps = eps
+
+    def compute_weight(self, layer, do_power_iteration):
+        weight = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+        v = getattr(layer, self.name + "_v")
+        return _spectral_normalize(
+            weight, u, v, self.dim,
+            self.n_power_iterations if do_power_iteration else 0,
+            self.eps, write_back=do_power_iteration)
+
+    def __call__(self, layer, inputs):
+        setattr(layer, self.name,
+                self.compute_weight(layer, do_power_iteration=layer.training))
+
+    @staticmethod
+    def apply(layer, name, n_power_iterations, dim, eps):
+        for hook in layer._forward_pre_hooks.values():
+            if isinstance(hook, _SpectralNormHook) and hook.name == name:
+                raise RuntimeError(
+                    "Cannot register two spectral_norm hooks on the same "
+                    "parameter %s" % name)
+        fn = _SpectralNormHook(name, n_power_iterations, dim, eps)
+        weight = layer._parameters[name]
+        w_mat = _reshape_to_matrix(weight, dim)
+        h, w = w_mat.shape
+        rng = np.random.default_rng()
+        u0 = rng.standard_normal(h).astype(np.asarray(weight.value).dtype)
+        v0 = rng.standard_normal(w).astype(np.asarray(weight.value).dtype)
+        u0 = u0 / max(float(np.linalg.norm(u0)), eps)
+        v0 = v0 / max(float(np.linalg.norm(v0)), eps)
+        del layer._parameters[name]
+        layer.add_parameter(name + "_orig", weight)
+        # plain attribute (not a Parameter) so forward sees a weight even
+        # before the first pre-hook fires
+        object.__setattr__(layer, name, weight * 1.0)
+        layer.register_buffer(name + "_u", Tensor(jnp.asarray(u0)))
+        layer.register_buffer(name + "_v", Tensor(jnp.asarray(v0)))
+        layer.register_forward_pre_hook(fn)
+        return fn
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim=None):
+    """Apply spectral normalization to ``layer.<name>`` (reference
+    spectral_norm_hook.py:171).  ``dim`` defaults to 1 for Linear and
+    transposed convolutions (output axis last/second), else 0."""
+    if dim is None:
+        from ..layer.common import Linear
+        from ..layer.conv import (Conv1DTranspose, Conv2DTranspose,
+                                  Conv3DTranspose)
+
+        dim = 1 if isinstance(layer, (Conv1DTranspose, Conv2DTranspose,
+                                      Conv3DTranspose, Linear)) else 0
+    _SpectralNormHook.apply(layer, name, n_power_iterations, dim, eps)
+    return layer
+
+
+def _norm_except_dim_raw(w, dim):
+    """||w|| reduced over every axis except ``dim`` (raw array in/out);
+    dim=-1 reduces everything to a scalar."""
+    if dim == -1:
+        return jnp.linalg.norm(w)
+    perm = [dim] + [d for d in range(w.ndim) if d != dim]
+    mat = jnp.transpose(w, perm).reshape(w.shape[dim], -1)
+    return jnp.linalg.norm(mat, axis=1)
+
+
+def _weight_norm_compute(v, g, dim):
+    """weight = g * v / ||v||_(except dim), differentiable in both."""
+    v_arr = v if isinstance(v, Tensor) else Tensor(v)
+    from ... import tensor as pt_ops
+
+    if dim == -1:
+        norm = pt_ops.sqrt((v_arr * v_arr).sum())
+        return v_arr * (g / norm)
+    axes = [d for d in range(len(v_arr.shape)) if d != dim]
+    norm = pt_ops.sqrt((v_arr * v_arr).sum(axis=axes, keepdim=True))
+    shape = [1] * len(v_arr.shape)
+    shape[dim] = -1
+    return v_arr / norm * g.reshape(shape)
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = -1 if dim is None else dim
+
+    def compute_weight(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        return _weight_norm_compute(v, g, self.dim)
+
+    def __call__(self, layer, inputs):
+        setattr(layer, self.name, self.compute_weight(layer))
+
+    @staticmethod
+    def apply(layer, name, dim):
+        for hook in layer._forward_pre_hooks.values():
+            if isinstance(hook, _WeightNormHook) and hook.name == name:
+                raise RuntimeError(
+                    "Cannot register two weight_norm hooks on the same "
+                    "parameter %s" % name)
+        if dim is None:
+            dim = -1
+        w = layer._parameters[name]
+        ndim = len(w.shape)
+        if not (-ndim <= dim < ndim):
+            raise ValueError(
+                "dim must be in [-R, R), R = weight rank %d" % ndim)
+        if dim != -1:
+            dim = (dim + ndim) % ndim
+        fn = _WeightNormHook(name, dim)
+        g0 = _norm_except_dim_raw(jnp.asarray(w.value), dim)
+        del layer._parameters[name]
+        layer.add_parameter(name + "_g", Parameter(g0))
+        layer.add_parameter(name + "_v", w)
+        object.__setattr__(layer, name, w * 1.0)
+        layer.register_forward_pre_hook(fn)
+        return fn
+
+    def remove(self, layer):
+        w = self.compute_weight(layer)
+        delattr(layer, self.name + "_g")
+        delattr(layer, self.name + "_v")
+        try:
+            object.__delattr__(layer, self.name)
+        except AttributeError:
+            pass
+        layer.add_parameter(
+            self.name, Parameter(jnp.asarray(w.value)))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparameterize ``layer.<name>`` as magnitude ``g`` times direction
+    ``v/||v||`` (reference weight_norm_hook.py:155)."""
+    _WeightNormHook.apply(layer, name, dim)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Fold the g/v reparameterization back into a single parameter
+    (reference weight_norm_hook.py:203)."""
+    for hook_id, hook in list(layer._forward_pre_hooks.items()):
+        if isinstance(hook, _WeightNormHook) and hook.name == name:
+            hook.remove(layer)
+            del layer._forward_pre_hooks[hook_id]
+            return layer
+    raise ValueError("weight_norm of %r not found in %r" % (name, layer))

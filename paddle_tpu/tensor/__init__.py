@@ -35,6 +35,7 @@ from .creation import (  # noqa: F401
 # control-flow cond stays out of this namespace: ``cond`` is linalg's
 # condition number here (paddle parity); structured control flow lives at
 # paddle_tpu.static.nn.* (and .control_flow directly)
+from .array import TensorArray, array_length, array_read, array_write, create_array  # noqa: F401
 from .control_flow import case, switch_case, while_loop  # noqa: F401
 from .einsum import einsum  # noqa: F401
 from .linalg import (  # noqa: F401

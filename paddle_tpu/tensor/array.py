@@ -1,0 +1,186 @@
+"""TensorArray ops (reference: python/paddle/tensor/array.py:22-150 and the
+LoDTensorArray container, paddle/fluid/framework/lod_tensor_array.h:1).
+
+Two representations, matching how the reference splits dygraph vs static:
+
+- **Eager**: a plain Python ``list`` (exactly the reference's dygraph mode).
+  Reads return the written Tensor object itself, so the autograd tape flows
+  through naturally.
+- **Traced / scan-compatible**: :class:`TensorArray`, a fixed-capacity
+  stacked buffer ``[capacity, *element_shape]`` plus a length scalar,
+  registered as a JAX pytree so it threads through
+  ``paddle_tpu.tensor.while_loop`` / ``lax.scan`` loop state.  Writes are
+  functional (``dynamic_update_index_in_dim``) — the TPU-native answer to
+  the reference's mutable LoDTensorArray + array_write ops, which cannot
+  exist under XLA's value semantics.  Forward-only, like ``while_loop``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dtype import convert_dtype
+from ..core.errors import InvalidArgumentError
+from ..framework.tensor import Tensor
+
+__all__ = ["create_array", "array_write", "array_read", "array_length",
+           "TensorArray"]
+
+
+def _raw(v):
+    return v.value if isinstance(v, Tensor) else jnp.asarray(v)
+
+
+def _index(i) -> jnp.ndarray:
+    arr = _raw(i)
+    if arr.shape not in ((), (1,)):
+        raise InvalidArgumentError(
+            "array index must be a scalar (shape [] or [1]), got %s"
+            % (arr.shape,))
+    if not jnp.issubdtype(arr.dtype, jnp.integer):
+        raise InvalidArgumentError(
+            "array index must be an integer, got dtype %s" % (arr.dtype,))
+    return arr.reshape(()).astype(jnp.int32)
+
+
+class TensorArray:
+    """Stacked fixed-capacity tensor array for traced loops.
+
+    ``buffer`` is ``[capacity, *element_shape]``; ``length`` tracks
+    ``max(written_index + 1)``.  All operations return a NEW TensorArray
+    (functional update — XLA value semantics).
+    """
+
+    def __init__(self, buffer, length):
+        self.buffer = buffer
+        self.length = length
+
+    @staticmethod
+    def create(capacity: int, element_shape, dtype="float32"):
+        dtype = convert_dtype(dtype) or "float32"
+        buf = jnp.zeros((int(capacity),) + tuple(int(s) for s in
+                                                 element_shape), dtype)
+        return TensorArray(buf, jnp.zeros((), jnp.int32))
+
+    @property
+    def capacity(self) -> int:
+        return self.buffer.shape[0]
+
+    def _check_bounds(self, idx) -> None:
+        # concrete indices get a real bounds check (tracer indices cannot:
+        # XLA clamps, documented lax.dynamic_*_in_dim semantics)
+        if not isinstance(idx, jax.core.Tracer):
+            c = int(idx)
+            if not 0 <= c < self.capacity:
+                raise InvalidArgumentError(
+                    "TensorArray index %d out of capacity [0, %d)"
+                    % (c, self.capacity))
+
+    def write(self, i, x) -> "TensorArray":
+        idx = _index(i)
+        self._check_bounds(idx)
+        buf = lax.dynamic_update_index_in_dim(
+            self.buffer, _raw(x).astype(self.buffer.dtype), idx, axis=0)
+        return TensorArray(buf, jnp.maximum(self.length, idx + 1))
+
+    def read(self, i):
+        idx = _index(i)
+        self._check_bounds(idx)
+        return Tensor(
+            lax.dynamic_index_in_dim(self.buffer, idx, axis=0,
+                                     keepdims=False),
+            stop_gradient=True)
+
+    def stack(self):
+        """The stacked buffer [capacity, *elem] as a Tensor (padded slots
+        beyond ``length`` are zeros)."""
+        return Tensor(self.buffer, stop_gradient=True)
+
+    def __len__(self):
+        return int(self.length)
+
+
+jax.tree_util.register_pytree_node(
+    TensorArray,
+    lambda ta: ((ta.buffer, ta.length), None),
+    lambda _, children: TensorArray(*children),
+)
+
+
+def create_array(dtype: str = "float32", initialized_list=None, *,
+                 capacity: Optional[int] = None, element_shape=None):
+    """tensor/array.py:125 parity.  Plain list in eager use; pass
+    ``capacity=`` + ``element_shape=`` to get the stacked
+    :class:`TensorArray` for use inside traced ``while_loop`` bodies."""
+    if capacity is not None:
+        if element_shape is None:
+            raise InvalidArgumentError(
+                "stacked TensorArray needs element_shape= with capacity=")
+        ta = TensorArray.create(capacity, element_shape, dtype)
+        for idx, x in enumerate(initialized_list or ()):
+            ta = ta.write(idx, x)
+        return ta
+    out = []
+    for x in initialized_list or ():
+        if not isinstance(x, Tensor):
+            x = Tensor(jnp.asarray(x))
+        out.append(x)
+    return out
+
+
+def array_write(x, i, array=None):
+    """tensor/array.py:91 parity: write ``x`` at position ``i``; returns the
+    array.  ``i`` must satisfy ``i <= len`` for the list representation
+    (the reference's dygraph assert)."""
+    if array is None:
+        array = []
+    if isinstance(array, TensorArray):
+        return array.write(i, x)
+    if not isinstance(array, list):
+        raise InvalidArgumentError(
+            "array must be a list or TensorArray, got %r" % type(array))
+    idx = int(_index(i))
+    if idx > len(array):
+        raise InvalidArgumentError(
+            "array_write index %d beyond array length %d" % (idx, len(array)))
+    if not isinstance(x, Tensor):
+        x = Tensor(jnp.asarray(x))
+    if idx == len(array):
+        array.append(x)
+    else:
+        array[idx] = x
+    return array
+
+
+def array_read(array, i):
+    """tensor/array.py:49 parity."""
+    if isinstance(array, TensorArray):
+        return array.read(i)
+    if not isinstance(array, list):
+        raise InvalidArgumentError(
+            "array must be a list or TensorArray, got %r" % type(array))
+    idx = int(_index(i))
+    if not 0 <= idx < len(array):
+        raise InvalidArgumentError(
+            "array_read index %d out of range [0, %d)" % (idx, len(array)))
+    return array[idx]
+
+
+def array_length(array):
+    """tensor/array.py:22 parity: length as a 0-d integer Tensor (int32
+    under JAX's default x32 mode; the reference returns int64)."""
+    if isinstance(array, TensorArray):
+        return Tensor(array.length, stop_gradient=True)
+    if not isinstance(array, list):
+        raise InvalidArgumentError(
+            "array must be a list or TensorArray, got %r" % type(array))
+    return Tensor(jnp.asarray(len(array)), stop_gradient=True)
+
+
+# these manage their own Tensor (un)wrapping and operate on containers —
+# opt out of the namespace-wide make_op wrap in tensor/__init__.install_ops
+for _f in (create_array, array_write, array_read, array_length):
+    _f.__paddle_tpu_op__ = True  # type: ignore[attr-defined]

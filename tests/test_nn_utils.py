@@ -1,0 +1,158 @@
+"""SpectralNorm layer + nn.utils hooks (spectral_norm / weight_norm),
+oracle-checked against numpy SVD and torch.nn.utils."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(0)
+
+
+def test_spectral_norm_layer_converges_to_svd(rng):
+    """Many power iterations => sigma -> largest singular value."""
+    w = rng.randn(6, 4).astype(np.float32)
+    layer = nn.SpectralNorm([6, 4], dim=0, power_iters=64)
+    out = np.asarray(layer(paddle.to_tensor(w)).value)
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(out, w / sigma, rtol=1e-4, atol=1e-5)
+
+
+def test_spectral_norm_layer_dim_and_4d(rng):
+    """Conv-style weight, dim=1: matrix is [C, N*H*W]."""
+    w = rng.randn(2, 8, 3, 3).astype(np.float32)
+    layer = nn.SpectralNorm(w.shape, dim=1, power_iters=64)
+    out = np.asarray(layer(paddle.to_tensor(w)).value)
+    mat = np.transpose(w, (1, 0, 2, 3)).reshape(8, -1)
+    sigma = np.linalg.svd(mat, compute_uv=False)[0]
+    np.testing.assert_allclose(out, w / sigma, rtol=1e-4, atol=1e-5)
+    assert out.shape == w.shape
+
+
+def test_spectral_norm_negative_dim(rng):
+    """dim=-1 normalizes like weight_norm's; matches dim=ndim-1."""
+    w = rng.randn(3, 5).astype(np.float32)
+    a = nn.SpectralNorm([3, 5], dim=-1, power_iters=64)
+    b = nn.SpectralNorm([3, 5], dim=1, power_iters=64)
+    oa = np.asarray(a(paddle.to_tensor(w)).value)
+    ob = np.asarray(b(paddle.to_tensor(w)).value)
+    np.testing.assert_allclose(oa, ob, rtol=1e-5, atol=1e-6)
+
+
+def test_spectral_norm_layer_validates():
+    with pytest.raises(ValueError):
+        nn.SpectralNorm([4, 4], power_iters=0)
+
+
+def test_spectral_norm_hook_vs_torch(rng):
+    """Drive both frameworks' hooks with identical weights; after several
+    training-mode forwards both power iterations converge to the same
+    normalized weight."""
+    w = rng.randn(5, 3).astype(np.float32)  # ours: [in=5, out=3]
+    ours = nn.Linear(5, 3)
+    ours.weight.set_value(w)
+    nn.utils.spectral_norm(ours, n_power_iterations=8)  # dim=1 for Linear
+
+    t = torch.nn.Linear(5, 3)
+    with torch.no_grad():
+        t.weight.copy_(torch.tensor(w.T))  # torch: [out, in]
+    torch.nn.utils.spectral_norm(t, n_power_iterations=8)
+
+    x = rng.randn(2, 5).astype(np.float32)
+    for _ in range(12):  # both sides iterate toward the top singular pair
+        ours(paddle.to_tensor(x))
+        t(torch.tensor(x))
+    got = np.asarray(ours.weight.value)
+    want = t.weight.detach().numpy().T
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_spectral_norm_hook_grad_flows_and_eval_frozen(rng):
+    ours = nn.Linear(4, 2)
+    nn.utils.spectral_norm(ours)
+    x = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    out = ours(x).sum()
+    out.backward()
+    g = ours.weight_orig.grad
+    assert g is not None and np.isfinite(np.asarray(g.value)).all()
+    # eval mode: u/v stay fixed
+    ours.eval()
+    u_before = np.asarray(ours.weight_u.value).copy()
+    ours(x)
+    np.testing.assert_array_equal(u_before, np.asarray(ours.weight_u.value))
+    # duplicate registration rejected
+    with pytest.raises(RuntimeError):
+        nn.utils.spectral_norm(ours)
+
+
+def test_spectral_norm_hook_state_dict_roundtrip(rng):
+    ours = nn.Linear(4, 2)
+    nn.utils.spectral_norm(ours)
+    sd = ours.state_dict()
+    assert "weight_orig" in sd and "weight_u" in sd and "weight_v" in sd
+    assert "weight" not in sd
+
+
+def test_weight_norm_vs_torch(rng):
+    """dim=1 on our [in,out] weight == torch dim=0 on its [out,in]."""
+    w = rng.randn(5, 3).astype(np.float32)
+    ours = nn.Linear(5, 3)
+    ours.weight.set_value(w)
+    nn.utils.weight_norm(ours, dim=1)
+
+    t = torch.nn.Linear(5, 3)
+    with torch.no_grad():
+        t.weight.copy_(torch.tensor(w.T))
+    torch.nn.utils.weight_norm(t, dim=0)
+
+    np.testing.assert_allclose(
+        np.asarray(ours.weight_g.value).reshape(-1),
+        t.weight_g.detach().numpy().reshape(-1), rtol=1e-5, atol=1e-6)
+    x = rng.randn(2, 5).astype(np.float32)
+    got = ours(paddle.to_tensor(x))
+    # zero the bias difference
+    want = tfwd = t(torch.tensor(x)).detach().numpy() \
+        - t.bias.detach().numpy() \
+        + np.asarray(ours.bias.value)
+    np.testing.assert_allclose(np.asarray(got.value), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_weight_norm_scalar_dim_and_remove(rng):
+    w = rng.randn(4, 2).astype(np.float32)
+    ours = nn.Linear(4, 2)
+    ours.weight.set_value(w)
+    nn.utils.weight_norm(ours, dim=-1)  # scalar g
+    assert np.asarray(ours.weight_g.value).shape == ()
+    x = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    before = np.asarray(ours(x).value)
+    nn.utils.remove_weight_norm(ours)
+    assert "weight" in ours._parameters
+    assert "weight_g" not in ours._parameters
+    after = np.asarray(ours(x).value)
+    np.testing.assert_allclose(before, after, rtol=1e-5, atol=1e-6)
+    with pytest.raises(ValueError):
+        nn.utils.remove_weight_norm(ours)
+
+
+def test_weight_norm_trains(rng):
+    """g and v receive gradients and a step changes the effective weight."""
+    ours = nn.Linear(3, 2)
+    nn.utils.weight_norm(ours, dim=1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=ours.parameters())
+    x = paddle.to_tensor(rng.randn(4, 3).astype(np.float32))
+    (ours(x) ** 2).sum().backward()
+    assert ours.weight_g.grad is not None
+    assert ours.weight_v.grad is not None
+    w_before = np.asarray(ours.weight.value).copy() \
+        if not isinstance(ours.weight, paddle.Tensor) \
+        else np.asarray(ours.weight.value).copy()
+    opt.step()
+    opt.clear_grad()
+    ours(x)  # pre-hook recomputes weight from updated g/v
+    assert not np.allclose(w_before, np.asarray(ours.weight.value))

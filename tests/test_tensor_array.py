@@ -1,0 +1,119 @@
+"""TensorArray / create_array / array_write / array_read (reference
+python/paddle/tensor/array.py + lod_tensor_array.h)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.errors import InvalidArgumentError
+
+
+def test_eager_list_semantics():
+    arr = paddle.create_array("float32")
+    assert arr == []
+    x = paddle.full([1, 3], 5.0)
+    i = paddle.zeros([1], "int32")
+    arr = paddle.array_write(x, i, array=arr)
+    assert int(np.asarray(paddle.array_length(arr).value)) == 1
+    item = paddle.array_read(arr, i)
+    np.testing.assert_allclose(np.asarray(item.value), 5.0)
+    # overwrite in place and append at the end
+    arr = paddle.array_write(paddle.full([1, 3], 7.0), 0, array=arr)
+    arr = paddle.array_write(paddle.full([1, 3], 9.0), 1, array=arr)
+    assert len(arr) == 2
+    np.testing.assert_allclose(
+        np.asarray(paddle.array_read(arr, 0).value), 7.0)
+    # array=None creates a fresh list (reference default)
+    fresh = paddle.array_write(x, 0)
+    assert len(fresh) == 1
+
+
+def test_eager_list_errors():
+    arr = paddle.create_array()
+    with pytest.raises(InvalidArgumentError):
+        paddle.array_write(paddle.ones([2]), 5, array=arr)  # gap write
+    with pytest.raises(InvalidArgumentError):
+        paddle.array_read(arr, 0)  # empty
+    with pytest.raises(InvalidArgumentError):
+        paddle.array_read("nope", 0)
+
+
+def test_eager_autograd_flows_through_read():
+    p = paddle.Parameter(np.array([2.0], np.float32))
+    arr = paddle.array_write(p * 3.0, 0)
+    out = paddle.array_read(arr, 0).sum()
+    out.backward()
+    np.testing.assert_allclose(np.asarray(p.grad.value), [3.0])
+
+
+def test_create_array_initialized_list():
+    arr = paddle.create_array("float32", [np.ones(2), np.zeros(2)])
+    assert len(arr) == 2
+    np.testing.assert_allclose(np.asarray(paddle.array_read(arr, 1).value),
+                               [0.0, 0.0])
+
+
+def test_stacked_array_in_while_loop():
+    """The reference's while_loop + array_write idiom for dynamic sequence
+    collection, expressed scan-compatibly: the TensorArray threads through
+    the traced loop state."""
+    ta = paddle.create_array("float32", capacity=8, element_shape=[2])
+
+    def cond(i, ta):
+        return i < 5
+
+    def body(i, ta):
+        val = paddle.full([2], 1.0) * i.astype("float32")
+        ta = paddle.array_write(val, i, array=ta)
+        return i + 1, ta
+
+    i0 = paddle.zeros([], "int32")
+    i_out, ta_out = paddle.tensor.while_loop(cond, body, [i0, ta])
+    assert int(np.asarray(paddle.array_length(ta_out).value)) == 5
+    for k in range(5):
+        np.testing.assert_allclose(
+            np.asarray(paddle.array_read(ta_out, k).value), [k, k])
+    stacked = np.asarray(ta_out.stack().value)
+    assert stacked.shape == (8, 2)
+    np.testing.assert_allclose(stacked[5:], 0.0)  # padded slots
+
+
+def test_stacked_array_under_jit():
+    """Whole write/read flow compiles under jax.jit (static shapes)."""
+    import jax
+
+    from paddle_tpu.tensor.array import TensorArray
+
+    @jax.jit
+    def run(n):
+        ta = TensorArray.create(4, (3,), "float32")
+        import jax.numpy as jnp
+        from jax import lax
+
+        def body(k, ta):
+            return ta.write(k, jnp.full((3,), k, jnp.float32))
+
+        return lax.fori_loop(0, n, body, ta)
+
+    out = run(3)
+    assert int(out.length) == 3
+    np.testing.assert_allclose(np.asarray(out.buffer)[2], 2.0)
+
+
+def test_stacked_bounds_and_dtype_checks():
+    from paddle_tpu.tensor.array import TensorArray
+
+    ta = TensorArray.create(4, (2,), "float32")
+    with pytest.raises(InvalidArgumentError):
+        ta.write(10, np.ones(2, np.float32))  # beyond capacity
+    with pytest.raises(InvalidArgumentError):
+        ta.read(4)
+    with pytest.raises(InvalidArgumentError):
+        paddle.array_write(paddle.ones([2]), 1.5)  # fractional index
+
+
+def test_stacked_create_validates():
+    with pytest.raises(InvalidArgumentError):
+        paddle.create_array("float32", capacity=4)  # missing element_shape
+    ta = paddle.create_array("float32", [np.ones(2)], capacity=4,
+                             element_shape=[2])
+    assert int(np.asarray(paddle.array_length(ta).value)) == 1

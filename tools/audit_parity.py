@@ -17,6 +17,49 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 
+def _body_is_bare_raise(fn) -> bool:
+    """True when the function body is nothing but (docstring +) an
+    unconditional ``raise NotImplementedError`` — a stub masquerading as
+    parity.  Conditional raises and raises in other methods (abstract-base
+    pattern, e.g. Dataset.__getitem__) are NOT flagged."""
+    import ast
+    import inspect
+    import textwrap
+
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return False
+    node = tree.body[0]
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    body = list(node.body)
+    if body and isinstance(body[0], ast.Expr) \
+            and isinstance(body[0].value, ast.Constant):
+        body = body[1:]  # docstring
+    # tolerate super().__init__()-style calls before the raise
+    while body and isinstance(body[0], ast.Expr) \
+            and isinstance(body[0].value, ast.Call):
+        body = body[1:]
+    return len(body) == 1 and isinstance(body[0], ast.Raise) \
+        and "NotImplementedError" in ast.dump(body[0])
+
+
+def is_stub(obj) -> bool:
+    """A public symbol whose construction/call can only raise: counts as
+    missing, not as parity."""
+    import inspect
+
+    if inspect.isclass(obj):
+        init = obj.__dict__.get("__init__")
+        return init is not None and inspect.isfunction(init) \
+            and _body_is_bare_raise(init)
+    if inspect.isfunction(obj):
+        return _body_is_bare_raise(obj)
+    return False
+
+
 def names_of(path: str) -> set:
     src = open(path).read()
     out: set = set()
@@ -88,11 +131,17 @@ def main() -> int:
             continue
         names = names_of(path)
         missing = sorted(n for n in names if not hasattr(mod, n))
-        total_missing += len(missing)
-        status = "OK (%d symbols)" % len(names) if not missing \
-            else "MISSING %d: %s" % (len(missing), " ".join(missing))
+        stubs = sorted(n for n in names
+                       if hasattr(mod, n) and is_stub(getattr(mod, n)))
+        total_missing += len(missing) + len(stubs)
+        parts = []
+        if missing:
+            parts.append("MISSING %d: %s" % (len(missing), " ".join(missing)))
+        if stubs:
+            parts.append("STUB %d: %s" % (len(stubs), " ".join(stubs)))
+        status = " | ".join(parts) if parts else "OK (%d symbols)" % len(names)
         print("%-34s %s" % (label, status))
-    print("\ntotal missing symbols: %d" % total_missing)
+    print("\ntotal missing symbols (incl. raise-stubs): %d" % total_missing)
     return 1 if total_missing else 0
 
 
