@@ -210,6 +210,11 @@ class _MultiprocessIterator:
     bounded by ``num_workers * depth`` batches.
     """
 
+    # bound at class-definition time: at interpreter shutdown the ``queue``
+    # module global may be None, and ``except None`` inside __del__ raises
+    # TypeError before the shm drain finishes (leaking segments)
+    _EMPTY = queue.Empty
+
     def __init__(self, loader, depth: int):
         ctx = mp.get_context("fork")  # workers inherit the dataset w/o pickle
         self._loader = loader
@@ -366,7 +371,7 @@ class _MultiprocessIterator:
                 _, status, payload = self._result_q.get_nowait()
                 if status == "ok":
                     _free(payload)
-        except queue.Empty:
+        except self._EMPTY:
             pass
 
     def __del__(self):
@@ -411,16 +416,24 @@ class _PrefetchIterator:
             raise StopIteration
         return item
 
+    # bound at class-definition time: during interpreter shutdown the
+    # ``queue`` module global may already be torn down to None, and
+    # ``except None`` raises TypeError inside __del__
+    _EMPTY = queue.Empty
+
     def shutdown(self):
         self._stop.set()
         try:
             while True:
                 self._q.get_nowait()
-        except queue.Empty:
+        except self._EMPTY:
             pass
 
     def __del__(self):
-        self.shutdown()
+        try:
+            self.shutdown()
+        except Exception:
+            pass  # interpreter teardown: modules may be half-destroyed
 
 
 class DataLoader:

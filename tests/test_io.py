@@ -127,42 +127,62 @@ def test_distributed_batch_sampler_shards():
     assert sorted(e0) == sorted(e1) == list(range(16)) and e0 != e1
 
 
-class _Slow(Dataset):
-    """Dataset with measurable per-item latency (host IO stand-in)."""
+class _Stamped(Dataset):
+    """Dataset whose items carry their own fetch timestamps (epoch seconds,
+    shared clock across worker processes), so tests can assert an
+    order-of-events overlap invariant rather than a wall-clock ratio."""
 
     def __init__(self, n=8, delay=0.02):
         self.n, self.delay = n, delay
+        # times stored relative to this base so they survive a float32
+        # collate cast with microsecond precision (time.time() is a shared
+        # clock across worker processes; the base is pickled to workers)
+        self.base = time.time()
 
     def __len__(self):
         return self.n
 
     def __getitem__(self, i):
-        time.sleep(self.delay)
-        return np.full((4,), i, np.float32)
+        start = time.time() - self.base
+        time.sleep(self.delay)  # host IO stand-in
+        return np.array([i, start, time.time() - self.base], np.float64)
 
 
-def _consume(loader, work=0.02):
-    t0 = time.perf_counter()
-    for batch in loader:
+def _consume_stamped(loader, base, work=0.04):
+    """Returns per-item (request_time, fetch_start, fetch_end)."""
+    events = []
+    it = iter(loader)
+    while True:
+        req = time.time() - base
+        try:
+            batch = next(it)
+        except StopIteration:
+            break
+        row = np.asarray(batch.value).reshape(-1)
+        events.append((req, float(row[1]), float(row[2])))
         time.sleep(work)  # consumer "compute"
-        _ = np.asarray(batch.value)
-    return time.perf_counter() - t0
+    return events
 
 
 def test_prefetch_overlaps_io():
-    """buffered_reader.cc property: producer IO overlaps consumer compute."""
-    # Wall-clock comparison; retry to ride out scheduler noise on a loaded box
-    attempts = []
-    for _ in range(3):
-        ds = _Slow(n=8, delay=0.02)
-        sync_t = _consume(DataLoader(ds, batch_size=1, num_workers=0))
-        pre_t = _consume(DataLoader(ds, batch_size=1, num_workers=1,
-                                    prefetch_factor=4))
-        attempts.append((pre_t, sync_t))
-        # sync: 8*(0.02 io + 0.02 work) ≈ 0.32s; prefetch: io hides under work
-        if pre_t < sync_t * 0.85:
-            return
-    raise AssertionError(attempts)
+    """buffered_reader.cc property: producer IO overlaps consumer compute.
+
+    Order-of-events invariant (not a wall-clock ratio): the consumer is
+    slower than the producer (work 0.04 > delay 0.02), so with prefetching
+    some item must have FINISHED fetching before the consumer even asked
+    for it.  A synchronous loader can never do that — each fetch starts
+    only after the request.  Scheduler noise can delay the worker but
+    can't reorder these events, so no retry loop is needed.
+    """
+    ds = _Stamped(n=8, delay=0.02)
+    sync = _consume_stamped(DataLoader(ds, batch_size=1, num_workers=0),
+                            ds.base)
+    # instrument sanity: synchronous fetches start only after the request
+    assert all(fs >= req for req, fs, _ in sync), sync
+    pre = _consume_stamped(DataLoader(ds, batch_size=1, num_workers=1,
+                                      prefetch_factor=4), ds.base)
+    # overlap: at least one item was fully fetched before it was requested
+    assert any(fe < req for req, _, fe in pre), pre
 
 
 def test_loader_feeds_training(rng):
