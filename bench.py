@@ -371,6 +371,7 @@ def _persist_tpu_record(record: dict) -> None:
     tmp = _TPU_RECORD + ".tmp"
     with open(tmp, "w") as f:
         json.dump(record, f, indent=2)
+        f.write("\n")
     os.replace(tmp, _TPU_RECORD)
     with open(_HISTORY, "a") as f:
         f.write(json.dumps(record) + "\n")

@@ -45,6 +45,7 @@ from .collective import split  # noqa: F401
 from .ps_compat import (  # noqa: F401
     CountFilterEntry, InMemoryDataset, ProbabilityEntry, QueueDataset,
 )
+from .comm_hooks import CompressedAllReduceStep  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology,
@@ -61,5 +62,5 @@ __all__ = [
     "new_group", "p2p", "recv", "reduce", "reduce_scatter", "scatter", "send",
     "stream", "wait", "DataParallel", "ParallelEnv", "scale_loss",
     "shard_batch", "CommunicateTopology", "HybridCommunicateGroup",
-    "ParallelMode", "fleet", "launch", "spawn",
+    "ParallelMode", "fleet", "launch", "spawn", "CompressedAllReduceStep",
 ]

@@ -274,6 +274,20 @@ class _Fleet:
         self._maybe_amp_decorate()
         return out
 
+    @staticmethod
+    def _dgc_cfg(st):
+        """Normalize dgc_configs (sparsity may be a scalar or the
+        reference's per-epoch list; empty list -> default)."""
+        cfg = getattr(st, "dgc_configs", None) or {}
+        sp = cfg.get("sparsity")
+        if isinstance(sp, (list, tuple)):
+            sp = sp[0] if sp else None
+        if sp is None:
+            sp = 0.999
+        return dict(sparsity=float(sp),
+                    momentum=float(cfg.get("momentum", 0.9)),
+                    rampup_begin_step=int(cfg.get("rampup_begin_step", 0)))
+
     def distributed_optimizer(self, optimizer, strategy=None):
         """Apply the active strategy's optimizer stack (fleet_base.py:783):
         lamb/lars class swap → dgc/fp16-allreduce grad transforms →
@@ -293,14 +307,7 @@ class _Fleet:
 
         optimizer = apply_lamb_lars(optimizer, st)
         if getattr(st, "dgc", False):
-            cfg = getattr(st, "dgc_configs", None) or {}
-            optimizer = DGCOptimizer(
-                optimizer,
-                momentum=float(cfg.get("momentum", 0.9)),
-                sparsity=float((cfg.get("sparsity") or [0.999])[0]
-                               if isinstance(cfg.get("sparsity"), (list, tuple))
-                               else cfg.get("sparsity", 0.999)),
-                rampup_begin_step=int(cfg.get("rampup_begin_step", 0)))
+            optimizer = DGCOptimizer(optimizer, **self._dgc_cfg(st))
         if getattr(st, "fp16_allreduce", False):
             optimizer = FP16AllreduceOptimizer(optimizer)
         if st.sharding:
@@ -322,6 +329,30 @@ class _Fleet:
         self._opt = optimizer
         self._maybe_amp_decorate()
         return optimizer
+
+    def compressed_train_step(self, model, loss_fn, optimizer):
+        """Build the COMPILED data-parallel train step whose gradient
+        communication is actually compressed per the active strategy
+        (``dgc`` → top-k sparse allgather, ``fp16_allreduce`` → half-width
+        psum) — the wire-format counterpart of the eager math wrappers
+        ``DGCOptimizer``/``FP16AllreduceOptimizer``.  Reference:
+        ``sparse_all_reduce_op_handle.cc:1`` /
+        ``fp16_allreduce_optimizer.py:20``, whose program rewrites change
+        what NCCL reduces; here the shard_map'd step changes what rides ICI
+        (see ``distributed/comm_hooks.py``)."""
+        from ..comm_hooks import CompressedAllReduceStep
+
+        st = self.strategy
+        if getattr(st, "dgc", False):
+            return CompressedAllReduceStep(
+                model, loss_fn, optimizer, compression="dgc",
+                **self._dgc_cfg(st))
+        if getattr(st, "fp16_allreduce", False):
+            return CompressedAllReduceStep(
+                model, loss_fn, optimizer, compression="fp16")
+        raise InvalidArgumentError(
+            "compressed_train_step requires strategy.dgc or "
+            "strategy.fp16_allreduce")
 
 
 fleet = _Fleet()

@@ -126,10 +126,15 @@ class DGCOptimizer:
     §3), then top-``rampup`` fraction of entries by magnitude form the
     "communicated" gradient; the rest stays in the residual for later steps.
 
-    TPU mapping: GSPMD reduces dense tensors, so the bandwidth saving does
-    not materialize on ICI — what this preserves is DGC's *training
-    semantics* (sparsified updates with error feedback), which is the part
-    that affects convergence and the part a user ports.  The sparsity knob
+    **Math-parity-only wrapper** (eager loops): the compression here runs
+    *after* the GSPMD path has already all-reduced dense fp32 grads, so it
+    reproduces DGC's training semantics (sparsified updates with error
+    feedback) but not its bandwidth saving.  For communication that is
+    actually compressed on the wire, use the compiled DP step
+    ``fleet.compressed_train_step`` /
+    :class:`paddle_tpu.distributed.CompressedAllReduceStep`, whose
+    shard_map'd sync exchanges top-k (index, value) pairs via all_gather —
+    the ``sparse_all_reduce_op_handle.cc`` design.  The sparsity knob
     ``sparsity`` follows dgc_configs.rampup_begin_step semantics loosely:
     compression activates after ``rampup_begin_step`` steps.
     """
@@ -183,11 +188,13 @@ class DGCOptimizer:
 
 
 class FP16AllreduceOptimizer:
-    """fp16_allreduce_optimizer.py parity: gradients cross the wire in
-    fp16.  GSPMD emits the collectives, so the knob is expressed as a
-    cast-down/cast-up at the optimizer boundary — reproducing the numerics
-    (fp16 rounding of the reduced gradient) that the reference's rewritten
-    program produces."""
+    """fp16_allreduce_optimizer.py parity — **math-parity-only wrapper**
+    (eager loops): the cast-down/cast-up at the optimizer boundary
+    reproduces the numerics (fp16 rounding of the reduced gradient) after
+    GSPMD has already reduced in fp32.  For a reduce whose operand is
+    actually half-width on ICI, use ``fleet.compressed_train_step`` /
+    :class:`paddle_tpu.distributed.CompressedAllReduceStep`
+    (``compression='fp16'``), whose shard_map'd step psums fp16."""
 
     def __init__(self, inner):
         self._inner = inner

@@ -443,28 +443,62 @@ class PipelineTrainStep:
             leaves = [l[0] for l in core_local]
 
             def tick(carry, t):
-                act, acc = carry
+                # The rotation is PURE block compute: the suffix (LM head +
+                # loss) is hoisted out of the loop and paid once per
+                # microbatch below — the reference's SectionWorker also runs
+                # the head exactly once per microbatch on the last stage
+                # (section_worker.cc:167-175); the r3 design ran it on every
+                # stage every tick, masked, wasting head-FLOPs x pp x ticks.
+                act, buf = carry
                 x_in = lax.dynamic_index_in_dim(
                     h0, jnp.minimum(t, M - 1), axis=0, keepdims=False)
                 inp = jnp.where(s == 0, x_in, act)
                 k_t = jax.random.fold_in(jax.random.fold_in(key, t), s)
                 out = stage_apply(leaves, inp, k_t)
                 m = t - (pp - 1)
-                valid = (m >= 0) & (m < M)
-                lab = lax.dynamic_index_in_dim(
-                    labels, jnp.clip(m, 0, M - 1), axis=0, keepdims=False)
-                lt = suffix_loss(outer_vals, out, lab,
-                                 jax.random.fold_in(key, 1000003 + t))
-                acc = acc + jnp.where(
-                    valid & (s == pp - 1), lt.astype(jnp.float32), 0.0)
+                # collect the finished microbatch output (real only on the
+                # last stage; pre-valid clipped writes to slot 0 are
+                # overwritten by the valid t = pp-1 write)
+                buf = lax.dynamic_update_index_in_dim(
+                    buf, out, jnp.clip(m, 0, M - 1), axis=0)
                 nxt = lax.ppermute(
                     out, pp_axis, [(i, (i + 1) % pp) for i in range(pp)])
-                return (nxt, acc), None
+                return (nxt, buf), None
 
             act0 = jnp.zeros_like(h0[0])
-            (_, acc), _ = lax.scan(
-                tick, (act0, jnp.asarray(0.0, jnp.float32)),
-                jnp.arange(M + pp - 1))
+            (_, buf), _ = lax.scan(
+                tick, (act0, jnp.zeros_like(h0)), jnp.arange(M + pp - 1))
+            # keep only the last stage's collected outputs, then spread the
+            # M microbatches over the pp axis (reduce-scatter) so each stage
+            # computes the head for M/pp of them — head cost per step is
+            # M x head_flops machine-wide instead of (M+pp-1) x pp x head.
+            buf = jnp.where(s == pp - 1, buf, jnp.zeros_like(buf))
+
+            def mb_loss(o, lab, mi):
+                lt = suffix_loss(outer_vals, o, lab,
+                                 jax.random.fold_in(key, 1000003 + pp - 1
+                                                    + mi))
+                return lt.astype(jnp.float32)
+
+            if M % pp == 0:
+                chunk = lax.psum_scatter(buf, pp_axis, scatter_dimension=0,
+                                         tiled=True)  # [M/pp, mb, ...] real
+                k = M // pp
+                labs = lax.dynamic_slice_in_dim(labels, s * k, k, axis=0)
+                idx = s * k + jnp.arange(k)
+                # per-stage partial sum over its own microbatch chunk
+                acc = jnp.sum(jax.vmap(mb_loss)(chunk, labs, idx))
+            else:
+                # M not divisible by pp: broadcast the real outputs to all
+                # stages (psum of the masked buffer) and compute the head
+                # replicated — still once per microbatch, not per tick; the
+                # jnp.where above keeps garbage activations out of the head.
+                # /pp makes each stage's identical total a partial sum, so
+                # the single psum below yields the true total and its
+                # transpose distributes exactly one unit of cotangent.
+                full = lax.psum(buf, pp_axis)
+                acc = jnp.sum(jax.vmap(mb_loss)(full, labels,
+                                                jnp.arange(M))) / pp
             loss = lax.psum(acc, pp_axis) / M
             if dp_axis:
                 loss = lax.pmean(loss, dp_axis)
@@ -529,7 +563,19 @@ class PipelineTrainStep:
         n_outer = len(self._outer_params)
 
         def loss_of(core_stacked, outer_vals, x_mb, y_mb, key):
-            h0 = prefix_apply(x_mb, outer_vals) if prefix else x_mb
+            if prefix:
+                # shard the prefix's compute over BOTH pp (microbatch index
+                # axis) and dp: each pp group embeds M/pp microbatches
+                # instead of all M replicated; the shard_map entry below
+                # all-gathers h0 over pp (cheap: activations ride ICI, and
+                # the prefix compute drops pp-fold)
+                x_mb = lax.with_sharding_constraint(
+                    x_mb, NamedSharding(mesh, P(
+                        pp_axis, dp_axis if dp_axis else None,
+                        *((None,) * (len(x_shape) - 2)))))
+                h0 = prefix_apply(x_mb, outer_vals)
+            else:
+                h0 = x_mb
             return sharded_core(core_stacked, h0, y_mb, outer_vals, key)
 
         def update(vals, grads, states, lr, params, vmapped):

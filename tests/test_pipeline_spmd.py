@@ -120,9 +120,12 @@ class Strat:
         self.pipeline_configs = {"accumulate_steps": k}
 
 
-@pytest.mark.parametrize("pp_degree,n_blocks,B", [(4, 4, 8), (2, 4, 16)])
-def test_pipeline_spmd_loss_parity(pp_degree, n_blocks, B):
-    steps, M = 3, 4
+@pytest.mark.parametrize("pp_degree,n_blocks,B,M", [
+    (4, 4, 8, 4), (2, 4, 16, 4),
+    (2, 4, 12, 3),  # M % pp != 0: replicated-suffix fallback path
+])
+def test_pipeline_spmd_loss_parity(pp_degree, n_blocks, B, M):
+    steps = 3
     xs, ys = _make_data(steps, B)
 
     ref_layers = _build_layers(n_blocks)
