@@ -198,6 +198,11 @@ class CompressedAllReduceStep:
             new_param_vals = list(param_vals)
             for i, v in zip(diff_idx, new_diff_vals):
                 new_param_vals[i] = v
+            # non-grad buffers (BatchNorm running stats) were updated from
+            # each device's local shard; average them so the P() out_spec's
+            # replication claim holds and eval sees global-batch statistics
+            new_bufs = [lax.pmean(b, axis) if jnp.issubdtype(
+                b.dtype, jnp.floating) else b for b in new_bufs]
             loss = lax.pmean(loss, axis)
             return loss, new_param_vals, new_states, new_bufs, \
                 (new_uv if compression == "dgc" else uv)
@@ -244,8 +249,8 @@ class CompressedAllReduceStep:
             l = jnp.asarray(l)
             if l.ndim == 0 or l.shape[0] % self.dp != 0:
                 raise InvalidArgumentError(
-                    "CompressedAllReduceStep: batch dim must divide dp=%d"
-                    % self.dp)
+                    "CompressedAllReduceStep: batch dim must be divisible "
+                    "by dp=%d" % self.dp)
             batch_leaves.append(l)
         if self._jitted is None:
             self._build()

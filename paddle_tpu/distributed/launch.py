@@ -76,6 +76,8 @@ def _spawn_gang(args, endpoints: List[str], log_dir: Optional[str]):
     for local_rank in range(nproc):
         rank = args.node_rank * nproc + local_rank
         env = build_child_env(rank, args.world_size, endpoints)
+        if getattr(args, "auto_checkpoint_dir", None):
+            env["PADDLE_AUTO_CHECKPOINT_DIR"] = args.auto_checkpoint_dir
         cmd = [sys.executable]
         if args.module:
             cmd.append("-m")
@@ -187,6 +189,11 @@ def _parse(argv):
                         "(then relaunched per --max_restarts)")
     p.add_argument("--elastic_timeout", type=float, default=10.0)
     p.add_argument("--restart_delay", type=float, default=1.0)
+    p.add_argument("--auto_checkpoint_dir", type=str, default=None,
+                   help="shared dir for incubate.auto_checkpoint snapshots: "
+                        "exported as $PADDLE_AUTO_CHECKPOINT_DIR so a "
+                        "relaunched gang (--max_restarts) resumes from the "
+                        "last snapshot instead of restarting from scratch")
     p.add_argument("--module", action="store_true",
                    help="run training_script as a python module (-m)")
     p.add_argument("training_script")

@@ -13,11 +13,14 @@ optional hand-written vjp and entered into the SAME dispatch layer as every
 built-in op, so they are taped in eager, differentiable, and jittable.
 """
 from . import autograd  # noqa: F401
+from . import auto_checkpoint  # noqa: F401
+from .auto_checkpoint import AutoCheckpoint, train_epoch_range  # noqa: F401
 from .custom_op import (  # noqa: F401
     get_custom_op,
     register_custom_op,
     registered_custom_ops,
 )
 
-__all__ = ["autograd", "get_custom_op", "register_custom_op",
+__all__ = ["autograd", "auto_checkpoint", "AutoCheckpoint",
+           "train_epoch_range", "get_custom_op", "register_custom_op",
            "registered_custom_ops"]

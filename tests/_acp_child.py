@@ -1,0 +1,63 @@
+"""Child for the auto-checkpoint/auto-resume gang test.
+
+Trains a tiny deterministic model for --steps steps under
+incubate.AutoCheckpoint (snapshot every step).  With --fail-at N and a
+missing sentinel, rank 1 dies at step N before computing it (exit 17) —
+the launcher kills the gang and relaunches; the relaunched child resumes
+from the last snapshot instead of step 0.  Losses are logged per step to
+--log-file as "STEP <i> <loss>" lines for the continuity assertion.
+"""
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--fail-at", type=int, default=-1)
+    ap.add_argument("--fail-sentinel", type=str, default="")
+    ap.add_argument("--log-file", type=str, required=True)
+    args = ap.parse_args()
+
+    import paddle_tpu as pt
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.incubate import AutoCheckpoint
+
+    dist.init_parallel_env()  # rendezvous: resume must survive relaunch
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+    pt.seed(1234)
+    model = pt.nn.Sequential(pt.nn.Linear(8, 16), pt.nn.Tanh(),
+                             pt.nn.Linear(16, 4))
+    opt = pt.optimizer.Momentum(0.05, momentum=0.9,
+                                parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    xs = rng.randn(args.steps, 16, 8).astype("float32")
+    ys = rng.randint(0, 4, (args.steps, 16)).astype("int64")
+
+    acp = AutoCheckpoint({"model": model, "opt": opt}, every_n_steps=1,
+                         name="gangtest")
+    start = acp.start_step
+    log = open("%s.rank%d" % (args.log_file, rank), "a")
+    for step in range(start, args.steps):
+        if (rank == 1 and step == args.fail_at and args.fail_sentinel
+                and not os.path.exists(args.fail_sentinel)):
+            open(args.fail_sentinel, "w").write("died at %d" % step)
+            os._exit(17)
+        loss = pt.nn.functional.cross_entropy(
+            model(pt.to_tensor(xs[step])), pt.to_tensor(ys[step]))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        log.write("STEP %d %.6f\n" % (step, float(loss.value)))
+        log.flush()
+        acp.after_step(step)
+    log.close()
+    print("ACP_DONE rank=%d start=%d" % (rank, start))
+
+
+if __name__ == "__main__":
+    main()

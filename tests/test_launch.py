@@ -15,6 +15,7 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHILD = os.path.join(REPO, "tests", "_launch_child.py")
+CHILD_ACP = os.path.join(REPO, "tests", "_acp_child.py")
 
 
 def _clean_env(n_local_devices: int = 1):
@@ -129,3 +130,54 @@ def test_localsgd_cross_process_sync(tmp_path):
     assert "LOCALSGD_OK" in r.stdout
     assert sorted(p.name for p in tmp_path.glob("w*.txt")) == \
         ["w0.txt", "w1.txt"]
+
+
+@pytest.mark.slow
+def test_auto_resume_loss_continuity(tmp_path):
+    """VERDICT r3 next #5 'done' check: rank 1 dies at step 5, the gang
+    relaunches with --auto_checkpoint_dir, training resumes from the last
+    snapshot, and the per-step losses exactly reproduce an uninterrupted
+    reference run (state + RNG restored - loss continuity, not restart)."""
+    # reference: uninterrupted run
+    ref_log = str(tmp_path / "ref_losses")
+    r = _run_launch(
+        ["--nproc_per_node", "2",
+         "--auto_checkpoint_dir", str(tmp_path / "ref_ckpt"),
+         CHILD_ACP, "--steps", "10", "--log-file", ref_log],
+        _clean_env(1))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+    def parse(path):
+        out = {}
+        with open(path) as f:
+            for line in f:
+                _, step, loss = line.split()
+                out.setdefault(int(step), float(loss))
+        return out
+
+    ref = parse(ref_log + ".rank0")
+    assert sorted(ref) == list(range(10))
+
+    # interrupted run: rank 1 exits at step 5 on the first attempt
+    log = str(tmp_path / "losses")
+    sentinel = str(tmp_path / "died_once")
+    r = _run_launch(
+        ["--nproc_per_node", "2", "--max_restarts", "1",
+         "--auto_checkpoint_dir", str(tmp_path / "ckpt"),
+         CHILD_ACP, "--steps", "10", "--fail-at", "5",
+         "--fail-sentinel", sentinel, "--log-file", log],
+        _clean_env(1))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert os.path.exists(sentinel), "rank 1 never died - test is vacuous"
+    assert "relaunching gang" in r.stderr
+    # the relaunched attempt resumed (start > 0), not restarted
+    import re
+    starts = [int(s) for s in re.findall(r"\bstart=(\d+)", r.stdout)]
+    assert 0 in starts, r.stdout  # first attempt began fresh
+    assert any(s > 0 for s in starts), r.stdout  # relaunch resumed
+
+    got = parse(log + ".rank0")
+    assert sorted(got) == list(range(10)), sorted(got)
+    for step in range(10):
+        assert abs(got[step] - ref[step]) < 1e-5, \
+            (step, got[step], ref[step])
