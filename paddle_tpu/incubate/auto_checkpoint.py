@@ -78,10 +78,37 @@ class AutoCheckpoint:
         return os.path.join(self.dir, "%s.ckpt.%d" % (self.name, serial))
 
     # -- save -----------------------------------------------------------
+    def _done_path(self, serial: int, rank: int) -> str:
+        return os.path.join(self.dir, "%s.ckpt.%d.rank%d.done"
+                            % (self.name, serial, rank))
+
+    def _full_serials(self, world: int):
+        """Serials whose fragments ALL ranks have finished writing."""
+        import re
+
+        pat = re.compile(r"^%s\.ckpt\.(\d+)\.rank(\d+)\.done$"
+                         % re.escape(self.name))
+        ranks_by_serial: Dict[int, set] = {}
+        for fn in os.listdir(self.dir):
+            m = pat.match(fn)
+            if m:
+                ranks_by_serial.setdefault(int(m.group(1)), set()).add(
+                    int(m.group(2)))
+        return sorted(s for s, r in ranks_by_serial.items()
+                      if len(r) >= world)
+
     def save(self, meta: Optional[dict] = None, serial: Optional[int] = None
              ) -> None:
-        """Snapshot all registered state (sharded, per-process fragments)
-        and publish the resume marker (rank 0, atomic rename last)."""
+        """Snapshot all registered state (sharded, per-process fragments).
+
+        Ranks are NOT barrier-synchronized (a dying rank is the whole
+        point), so each rank marks its fragment complete with a done-file
+        and rank 0 only publishes the marker for the newest serial that
+        EVERY rank finished — a lagging/dead rank can delay the published
+        serial but never produce a marker pointing at unloadable fragments.
+        """
+        import jax
+
         from ..framework import io as fio
 
         prev = self._read_marker()
@@ -92,9 +119,26 @@ class AutoCheckpoint:
         payload["__rng__"] = np.asarray([rng["seed"], rng["counter"]],
                                         np.int64)
         fio.save(payload, self._ckpt_path(serial))
-        if _process_index() == 0:
-            marker = {"serial": serial, "name": self.name,
-                      "meta": meta or {},
+        rank = _process_index()
+        # the done-file carries this serial's meta, so the publishable
+        # serial's meta survives even across a rank-0 restart
+        with open(self._done_path(serial, rank), "w") as f:
+            json.dump(meta or {}, f)
+        if rank == 0:
+            world = jax.process_count()
+            full = self._full_serials(world)
+            if not full:
+                return
+            publish = full[-1]
+            if prev is not None and publish == prev.get("serial"):
+                return  # nothing new fully covered yet
+            try:
+                with open(self._done_path(publish, 0)) as f:
+                    pub_meta = json.load(f)
+            except Exception:
+                pub_meta = meta or {}
+            marker = {"serial": publish, "name": self.name,
+                      "meta": pub_meta,
                       "prev_serial": (prev or {}).get("serial"),
                       # per-serial meta so a fallback load resumes at the
                       # step matching the state it actually restored
@@ -103,9 +147,12 @@ class AutoCheckpoint:
             with open(tmp, "w") as f:
                 json.dump(marker, f)
             os.replace(tmp, self._marker_path())
-            self._gc(keep={serial, (prev or {}).get("serial")})
+            keep = {publish, (prev or {}).get("serial")}
+            self._gc(keep, floor=publish)
 
-    def _gc(self, keep) -> None:
+    def _gc(self, keep, floor: int) -> None:
+        """Remove snapshot files except ``keep`` and anything newer than
+        ``floor`` (another rank may still be writing those)."""
         prefix = "%s.ckpt." % self.name
         for fn in os.listdir(self.dir):
             if not fn.startswith(prefix):
@@ -115,7 +162,7 @@ class AutoCheckpoint:
                 s = int(tail)
             except ValueError:
                 continue
-            if s not in keep:
+            if s not in keep and s < floor:
                 try:
                     os.remove(os.path.join(self.dir, fn))
                 except OSError:

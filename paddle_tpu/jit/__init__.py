@@ -190,6 +190,46 @@ class StaticFunction:
             # ProgramTranslator.enable(False): run the original function
             # eagerly (the reference's dygraph fallback)
             return self._function(*args, **kwargs)
+        tracer_errors = (jax.errors.TracerBoolConversionError,
+                         jax.errors.ConcretizationTypeError)
+        try:
+            return self._call_impl(*args, **kwargs)
+        except tracer_errors as e:
+            # data-dependent Python if/while hit at trace time: retry once
+            # through the minimal AST conversion (the reference converts
+            # up front via its ast_transformer stack; here conversion is
+            # attempted on demand), else re-raise with the rewrite hint
+            from . import dy2static
+
+            if not getattr(self._function, "__dy2static_converted__",
+                           False):
+                try:
+                    conv = dy2static.convert(self._function)
+                except dy2static.ConversionError as ce:
+                    raise RuntimeError(
+                        dy2static.hint_for_tracer_error(e, self._function)
+                        + " (auto-conversion: %s)" % ce) from e
+                owner = getattr(self._function, "__self__", None)
+                if owner is not None:
+                    conv = conv.__get__(owner)
+                # swap in the converted fn only for the retry; commit it
+                # only on success so ProgramTranslator.enable(False)'s
+                # eager fallback always runs the ORIGINAL function
+                old_fn, old_jitted = self._function, self._jitted
+                self._function, self._jitted = conv, None
+                try:
+                    return self._call_impl(*args, **kwargs)
+                except tracer_errors as e2:
+                    self._function, self._jitted = old_fn, old_jitted
+                    raise RuntimeError(dy2static.hint_for_tracer_error(
+                        e2, conv)) from e2
+                except Exception:
+                    self._function, self._jitted = old_fn, old_jitted
+                    raise
+            raise RuntimeError(dy2static.hint_for_tracer_error(
+                e, self._function)) from e
+
+    def _call_impl(self, *args, **kwargs):
         binding = self._ensure_binding()
         leaves, treedef = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_is_tensor)
         # Partition: Tensors/arrays become traced inputs; python scalars and

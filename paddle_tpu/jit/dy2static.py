@@ -1,0 +1,353 @@
+"""Minimal dy2static AST conversion for tensor-conditioned control flow.
+
+Reference parity: the dygraph_to_static AST transformer stack
+(``fluid/dygraph/dygraph_to_static/ast_transformer.py:1``,
+``ifelse_transformer.py``, ``loop_transformer.py``) — the reference rewrites
+every ``if``/``while`` whose predicate is a Tensor into
+``cond``/``while_loop`` program ops before building the ProgramDesc.
+
+TPU-native design: ``to_static`` traces through JAX, where a
+data-dependent Python ``if``/``while`` raises a tracer-boolean error at
+trace time.  This module provides the two halves of the reference's story:
+
+1. :func:`convert` — an AST pass rewriting the COMMON control-flow shapes,
+   the same shapes the reference's ifelse/loop transformers target:
+
+   - ``if <pred>: ... [else: ...]`` with plain-assignment branches (no
+     return/break/continue) becomes a pair of branch functions taking
+     their free reads as parameters and returning the assigned names,
+     joined by a runtime dispatch that uses ``tensor.cond`` for traced
+     predicates and a plain Python branch otherwise;
+   - ``while <pred>: ...`` with a plain-assignment body becomes a
+     carry-tuple ``tensor.while_loop``.
+
+   Unconvertible shapes are left untouched (a static-bool ``if`` still
+   traces fine as-is).
+
+2. :func:`hint_for_tracer_error` — the message ``to_static`` attaches when
+   tracing still hits a tracer-boolean error (used by
+   ``StaticFunction.__call__``, which first retries with the converted
+   function).
+
+Known (documented) semantic deltas of the minimal pass, matching XLA
+rather than Python: under a traced predicate BOTH branches execute; each
+branch's free reads are evaluated at the dispatch point even if that
+branch is not taken.
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+from typing import Callable, Iterable, List, Set
+
+__all__ = ["convert", "ConversionError", "hint_for_tracer_error",
+           "_rt_cond", "_rt_while"]
+
+
+class ConversionError(Exception):
+    """Raised when the minimal AST pass cannot convert the function."""
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers the rewritten source calls
+# ---------------------------------------------------------------------------
+
+def _is_tensorish(x) -> bool:
+    import jax
+
+    from ..framework.tensor import Tensor
+
+    return isinstance(x, (Tensor, jax.Array, jax.core.Tracer))
+
+
+def _rt_cond(pred, true_fn, true_args, false_fn, false_args):
+    """Tensor predicate -> tensor.cond (lax.cond under trace); python
+    bool -> plain branch call."""
+    if _is_tensorish(pred):
+        from ..tensor.control_flow import cond
+
+        return cond(pred, lambda: true_fn(*true_args),
+                    lambda: false_fn(*false_args))
+    return true_fn(*true_args) if pred else false_fn(*false_args)
+
+
+def _rt_while(cond_fn, body_fn, carry):
+    """Tensor-predicated while -> tensor.while_loop; python predicate ->
+    plain loop.  ``carry`` is always a tuple."""
+    probe = cond_fn(*carry)
+    if _is_tensorish(probe):
+        from ..tensor.control_flow import while_loop
+
+        return tuple(while_loop(cond_fn, body_fn, list(carry)))
+    while probe:
+        out = body_fn(*carry)
+        carry = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+        probe = cond_fn(*carry)
+    return carry
+
+
+# ---------------------------------------------------------------------------
+# scope analysis (never descends into nested function/class bodies)
+# ---------------------------------------------------------------------------
+
+_SCOPE_BARRIERS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                   ast.ClassDef)
+
+
+def _shallow_walk(nodes: Iterable[ast.AST]):
+    stack = list(nodes)
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, _SCOPE_BARRIERS):
+            continue  # their bodies are a different scope
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _assigned_names(stmts) -> Set[str]:
+    """Names bound by the statements at THIS scope level."""
+    names: Set[str] = set()
+    for node in _shallow_walk(stmts):
+        if isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+        elif isinstance(node, _SCOPE_BARRIERS) and hasattr(node, "name"):
+            names.add(node.name)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for a in node.names:
+                names.add((a.asname or a.name).split(".")[0])
+    return names
+
+
+class _FreeReads(ast.NodeVisitor):
+    """Names loaded before being bound, in (approximate) execution order."""
+
+    def __init__(self, bound: Set[str]):
+        self.bound = set(bound)
+        self.free: Set[str] = set()
+
+    def visit_Name(self, node: ast.Name):
+        if isinstance(node.ctx, ast.Load):
+            if node.id not in self.bound:
+                self.free.add(node.id)
+        else:
+            self.bound.add(node.id)
+
+    def visit_Assign(self, node: ast.Assign):
+        self.visit(node.value)  # RHS evaluates first
+        for t in node.targets:
+            self.visit(t)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self.visit(node.value)
+        # target is read-then-written
+        for n in _shallow_walk([node.target]):
+            if isinstance(n, ast.Name) and n.id not in self.bound:
+                self.free.add(n.id)
+        for t in _shallow_walk([node.target]):
+            if isinstance(t, ast.Name):
+                self.bound.add(t.id)
+
+    def generic_visit(self, node):
+        if isinstance(node, _SCOPE_BARRIERS):
+            if hasattr(node, "name"):
+                self.bound.add(node.name)
+            return
+        super().generic_visit(node)
+
+
+def _free_reads(stmts, pre_bound: Set[str] = frozenset()) -> Set[str]:
+    v = _FreeReads(set(pre_bound))
+    for s in stmts:
+        v.visit(s)
+    return v.free
+
+
+_BANNED = (ast.Return, ast.Break, ast.Continue, ast.Yield, ast.YieldFrom)
+
+
+def _convertible_body(stmts) -> bool:
+    return not any(isinstance(n, _BANNED) for n in _shallow_walk(stmts))
+
+
+# ---------------------------------------------------------------------------
+# the transformer
+# ---------------------------------------------------------------------------
+
+def _user_names(names: Set[str]) -> Set[str]:
+    """Drop the transformer's own generated names (__pt_*)."""
+    return {n for n in names if not n.startswith("__pt_")}
+
+
+class _CtrlFlowTransformer:
+    """Statement-list-level rewriter.
+
+    Works on statement lists (not NodeTransformer field recursion) so a
+    ``While`` sees its successor statements: the carry can then be the
+    assigned names that are actually LIVE — read by the loop test, read
+    before assignment within an iteration (loop-carried), or read after
+    the loop — instead of every body temporary (which would be unbound at
+    loop entry)."""
+
+    def __init__(self, local_names: Set[str]):
+        self.locals = set(local_names)
+        self.n = 0
+
+    def _tuple(self, names, ctx) -> ast.expr:
+        return ast.Tuple(
+            elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
+
+    def transform_block(self, stmts: List[ast.stmt]) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        for idx, s in enumerate(stmts):
+            succ = stmts[idx + 1:]
+            if isinstance(s, ast.If):
+                out.extend(self._transform_if(s))
+            elif isinstance(s, ast.While):
+                out.extend(self._transform_while(s, succ))
+            else:
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(s, field, None)
+                    if isinstance(sub, list) and sub and isinstance(
+                            sub[0], ast.stmt):
+                        setattr(s, field, self.transform_block(sub))
+                out.append(s)
+        return out
+
+    def _transform_if(self, node: ast.If) -> List[ast.stmt]:
+        node.body = self.transform_block(node.body)
+        node.orelse = self.transform_block(node.orelse)
+        if not (_convertible_body(node.body)
+                and _convertible_body(node.orelse)):
+            return [node]
+        outs = sorted(_user_names(
+            _assigned_names(list(node.body) + list(node.orelse))))
+        self.n += 1
+        i = self.n
+        defs, branches = [], []
+        for tag, body in (("true", list(node.body)),
+                          ("false", list(node.orelse) or [ast.Pass()])):
+            ret = ast.Return(value=self._tuple(outs, ast.Load))
+            # free reads of the branch (incl. the return of outs the other
+            # branch assigned), restricted to function-local names — only
+            # those risk UnboundLocalError inside the closure
+            params = sorted(_free_reads(body + [ret]) & self.locals)
+            name = "__pt_%s_%d" % (tag, i)
+            defs.append(ast.FunctionDef(
+                name=name,
+                args=_make_args(params),
+                body=body + [ret],
+                decorator_list=[]))
+            branches.append((name, params))
+        call_args = [node.test]
+        for name, params in branches:
+            call_args.append(ast.Name(id=name, ctx=ast.Load()))
+            call_args.append(self._tuple(params, ast.Load))
+        call = ast.Assign(
+            targets=[self._tuple(outs, ast.Store)] if outs else
+            [ast.Name(id="__pt_unused_%d" % i, ctx=ast.Store())],
+            value=ast.Call(func=ast.Name(id="__pt_rt_cond", ctx=ast.Load()),
+                           args=call_args, keywords=[]))
+        return defs + [call]
+
+    def _transform_while(self, node: ast.While,
+                         successors: List[ast.stmt]) -> List[ast.stmt]:
+        node.body = self.transform_block(node.body)
+        if node.orelse or not _convertible_body(node.body):
+            return [node]
+        assigned = _user_names(_assigned_names(node.body))
+        live = (_free_reads([ast.Expr(value=node.test)])  # loop test
+                | _free_reads(node.body)                  # loop-carried
+                | _free_reads(successors)) & self.locals  # read after loop
+        carry = sorted(assigned & live
+                       | (_free_reads([ast.Expr(value=node.test)])
+                          & self.locals))
+        if not (assigned & live):
+            return [node]  # nothing loop-carried: leave untouched
+        self.n += 1
+        i = self.n
+        cname, bname = "__pt_wcond_%d" % i, "__pt_wbody_%d" % i
+        cond_def = ast.FunctionDef(
+            name=cname, args=_make_args(carry),
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        body_def = ast.FunctionDef(
+            name=bname, args=_make_args(carry),
+            body=list(node.body) +
+            [ast.Return(value=self._tuple(carry, ast.Load))],
+            decorator_list=[])
+        call = ast.Assign(
+            targets=[self._tuple(carry, ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__pt_rt_while", ctx=ast.Load()),
+                args=[ast.Name(id=cname, ctx=ast.Load()),
+                      ast.Name(id=bname, ctx=ast.Load()),
+                      self._tuple(carry, ast.Load)],
+                keywords=[]))
+        return [cond_def, body_def, call]
+
+
+def _make_args(names: List[str]) -> ast.arguments:
+    return ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+
+
+def convert(fn: Callable) -> Callable:
+    """Rewrite ``fn``'s tensor-conditioned if/while into cond/while_loop
+    calls and return the recompiled function.  Raises ConversionError when
+    the source is unavailable, the function has closure cells (recompiling
+    would sever them), or nothing was rewritten."""
+    inner = inspect.unwrap(fn)
+    if getattr(inner, "__closure__", None):
+        raise ConversionError(
+            "cannot convert %r: it closes over outer variables; rewrite "
+            "the tensor-dependent if/while with paddle_tpu.tensor.cond / "
+            "while_loop by hand" % getattr(fn, "__name__", fn))
+    try:
+        src = textwrap.dedent(inspect.getsource(inner))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError) as e:
+        raise ConversionError("cannot get source of %r: %s" % (fn, e))
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise ConversionError("source of %r is not a function def" % (fn,))
+    fdef.decorator_list = []  # @to_static etc. must not re-wrap
+    local_names = _assigned_names(fdef.body) | {
+        a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                        + fdef.args.kwonlyargs)}
+    if fdef.args.vararg:
+        local_names.add(fdef.args.vararg.arg)
+    if fdef.args.kwarg:
+        local_names.add(fdef.args.kwarg.arg)
+    tr = _CtrlFlowTransformer(local_names)
+    fdef.body = tr.transform_block(fdef.body)
+    if tr.n == 0:
+        raise ConversionError(
+            "no convertible if/while found in %r"
+            % getattr(fn, "__name__", fn))
+    ast.fix_missing_locations(tree)
+    code = compile(tree, "<dy2static:%s>" % getattr(
+        inner, "__name__", "fn"), "exec")
+    glb = dict(inner.__globals__)
+    glb["__pt_rt_cond"] = _rt_cond
+    glb["__pt_rt_while"] = _rt_while
+    loc: dict = {}
+    exec(code, glb, loc)  # noqa: S102 - recompiling user fn, the reference
+    new_fn = loc[fdef.name]  # ast_transformer.py does the same via exec
+    new_fn.__defaults__ = getattr(inner, "__defaults__", None)
+    new_fn.__kwdefaults__ = getattr(inner, "__kwdefaults__", None)
+    new_fn.__dy2static_converted__ = True
+    return new_fn
+
+
+def hint_for_tracer_error(err: Exception, fn=None) -> str:
+    name = getattr(fn, "__name__", "the function")
+    return (
+        "to_static(%s): a Python `if`/`while` (or bool()/int() call) "
+        "depends on a traced Tensor value, which cannot be evaluated at "
+        "trace time, and the automatic AST conversion could not rewrite "
+        "this site. Rewrite it with paddle_tpu.tensor.cond(pred, true_fn, "
+        "false_fn) / paddle_tpu.tensor.while_loop(cond_fn, body_fn, "
+        "loop_vars), or hoist the condition out of the traced function. "
+        "Original error: %s" % (name, err))

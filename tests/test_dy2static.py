@@ -1,0 +1,188 @@
+"""dy2static control-flow conversion (VERDICT r3 next #8).
+
+Reference behavior matched: ``ifelse_transformer.py``/``loop_transformer.py``
+convert tensor-conditioned Python if/while into cond/while_loop ops;
+unconvertible sites produce a clear error naming the rewrite
+(``error.py`` in the reference's dy2static package).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit import to_static
+from paddle_tpu.jit.dy2static import ConversionError, convert
+
+
+def test_converted_if_matches_eager():
+    def f(x):
+        if pt.tensor.sum(x) > 0:
+            y = x * 2.0
+        else:
+            y = x - 1.0
+        return y + 1.0
+
+    xs = [np.array([1.0, 2.0], np.float32), np.array([-5.0, 1.0], np.float32)]
+    sf = to_static(f)
+    for x in xs:
+        got = sf(pt.to_tensor(x))
+        want = f(pt.to_tensor(x))
+        np.testing.assert_allclose(np.asarray(got.value),
+                                   np.asarray(want.value), rtol=1e-6)
+    # the retry actually converted (not just a lucky trace)
+    assert getattr(sf._function, "__dy2static_converted__", False)
+
+
+def test_converted_if_fresh_variable_both_branches():
+    def f(x):
+        s = pt.tensor.sum(x)
+        if s > 0:
+            sign = s * 0 + 1.0
+            mag = s
+        else:
+            sign = s * 0 - 1.0
+            mag = -s
+        return sign * mag
+
+    sf = to_static(f)
+    for v in ([3.0, 1.0], [-2.0, -2.0]):
+        x = np.asarray(v, np.float32)
+        got = float(sf(pt.to_tensor(x)).value)
+        # sign * mag reconstructs the (signed) sum in both branches
+        assert got == pytest.approx(x.sum(), rel=1e-6), (v, got)
+
+
+def test_converted_while_matches_eager():
+    def f(x):
+        # double until the sum crosses 100 (data-dependent trip count)
+        while pt.tensor.sum(x) < 100.0:
+            x = x * 2.0
+        return x
+
+    sf = to_static(f)
+    x = np.array([1.0, 2.0], np.float32)
+    got = np.asarray(sf(pt.to_tensor(x)).value)
+    want = np.array([1.0, 2.0]) * 2 ** 6  # 3 -> 192 crosses at 6 doublings
+    np.testing.assert_allclose(got, want)
+    assert getattr(sf._function, "__dy2static_converted__", False)
+
+
+def test_converted_while_with_body_temporary():
+    """A loop-local temporary (assigned before use each iteration) must
+    NOT enter the carry — it is unbound at loop entry."""
+    def f(x):
+        while pt.tensor.sum(x) < 100.0:
+            t = x * 2.0
+            x = t + 1.0
+        return x
+
+    sf = to_static(f)
+    x = np.array([1.0, 2.0], np.float32)
+    got = np.asarray(sf(pt.to_tensor(x)).value)
+
+    def ref(a):
+        while a.sum() < 100.0:
+            a = a * 2.0 + 1.0
+        return a
+    np.testing.assert_allclose(got, ref(x.astype(np.float64)), rtol=1e-6)
+    assert getattr(sf._function, "__dy2static_converted__", False)
+
+
+def test_converted_if_nested_in_while():
+    """A tensor-if inside a tensor-while: the generated branch closures
+    must not leak into the while carry."""
+    def f(x):
+        while pt.tensor.sum(x) < 50.0:
+            if pt.tensor.sum(x) < 10.0:
+                x = x * 3.0
+            else:
+                x = x + 5.0
+        return x
+
+    sf = to_static(f)
+    x = np.array([1.0, 1.0], np.float32)
+    got = np.asarray(sf(pt.to_tensor(x)).value)
+
+    def ref(a):
+        while a.sum() < 50.0:
+            a = a * 3.0 if a.sum() < 10.0 else a + 5.0
+        return a
+    np.testing.assert_allclose(got, ref(x.astype(np.float64)), rtol=1e-6)
+
+
+def test_converted_if_inside_layer_method():
+    class M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = pt.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if pt.tensor.mean(h) > 0:
+                out = h * 2.0
+            else:
+                out = h * 0.5
+            return out
+
+    pt.seed(0)
+    m = M()
+    sf = to_static(m)
+    x = np.ones((2, 4), np.float32)
+    got = sf(pt.to_tensor(x))
+    # eager reference on the same weights
+    want = m.forward(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(got.value),
+                               np.asarray(want.value), rtol=1e-5)
+
+
+def test_unconvertible_raises_hint():
+    def f(x):
+        # return inside the branch: outside the minimal pass
+        if pt.tensor.sum(x) > 0:
+            return x * 2.0
+        return x - 1.0
+
+    sf = to_static(f)
+    with pytest.raises(RuntimeError, match="tensor.cond"):
+        sf(pt.to_tensor(np.array([1.0], np.float32)))
+
+
+def test_static_bool_if_untouched():
+    """A python-bool if must keep working without conversion."""
+    def f(x, flag=True):
+        if flag:
+            return x * 2.0
+        return x
+
+    sf = to_static(f)
+    out = sf(pt.to_tensor(np.array([3.0], np.float32)))
+    assert float(out.value[0]) == 6.0
+
+
+def test_convert_rejects_closures():
+    k = 3.0
+
+    def f(x):
+        if pt.tensor.sum(x) > 0:
+            y = x * k
+        else:
+            y = x
+        return y
+
+    with pytest.raises(ConversionError, match="closes over"):
+        convert(f)
+
+
+def test_gradient_through_converted_if():
+    def f(x):
+        if pt.tensor.sum(x) > 0:
+            y = x * 3.0
+        else:
+            y = x * 5.0
+        return pt.tensor.sum(y)
+
+    sf = to_static(f)
+    x = pt.to_tensor(np.array([2.0, 1.0], np.float32))
+    x.stop_gradient = False
+    loss = sf(x)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(x.grad.value), [3.0, 3.0])
