@@ -155,28 +155,47 @@ def bench_resnet50(pt, jax, on_tpu: bool):
 
     pt.seed(0)
     if on_tpu:
-        batches, hw, classes = [64, 128, 256], 224, 1000
+        # sweep layout x batch: NHWC is the TPU-native conv layout
+        # (channels-last lanes); NCHW kept as a fallback leg
+        legs_cfg = [("NHWC", 128), ("NHWC", 256), ("NHWC", 64),
+                    ("NCHW", 128)]
+        hw, classes = 224, 1000
         flops_fwd = RESNET50_FWD_FLOPS
     else:
-        batches, hw, classes = [4], 32, 10
+        legs_cfg = [("NHWC", 4)]
+        hw, classes = 32, 10
         flops_fwd = 1e9  # nominal; CPU smoke only checks the harness runs
 
-    model = resnet50(num_classes=classes)
-    criterion = pt.nn.CrossEntropyLoss()
-    opt = pt.optimizer.Momentum(0.1, parameters=model.parameters())
-    model, opt = pt.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    steps = {}
 
-    def loss_fn(m, x, y):
-        with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
-            return criterion(m(x), y)
+    def get_step(fmt):
+        if fmt not in steps:
+            # one live model at a time: a cached dead-format model would
+            # hold params+optimizer state in HBM through later legs and
+            # can OOM the comparison leg near the spill boundary
+            steps.clear()
+            pt.seed(0)
+            model = resnet50(num_classes=classes, data_format=fmt)
+            criterion = pt.nn.CrossEntropyLoss()
+            opt = pt.optimizer.Momentum(0.1, parameters=model.parameters())
+            model, opt = pt.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
 
-    step = TrainStep(model, loss_fn, opt)  # donated buffers: less HBM
+            def loss_fn(m, x, y):
+                with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+                    return criterion(m(x), y)
+
+            steps[fmt] = TrainStep(model, loss_fn, opt)  # donated buffers
+        return steps[fmt]
+
     rng = np.random.RandomState(0)
 
-    def leg(batch):
+    def leg(cfg):
+        fmt, batch = cfg
         imgs = rng.randn(batch, 3, hw, hw).astype("float32")
         labels = rng.randint(0, classes, (batch,)).astype("int64")
-        dt, loss = _time_steps(step, (imgs, labels), 6 if on_tpu else 2)
+        dt, loss = _time_steps(get_step(fmt), (imgs, labels),
+                               6 if on_tpu else 2)
         ips = batch / dt
         flops_per_step = 3.0 * flops_fwd * batch  # fwd + ~2x bwd
         return {
@@ -185,10 +204,11 @@ def bench_resnet50(pt, jax, on_tpu: bool):
             "step_time_s": dt,
             "mfu": flops_per_step / dt / _peak_flops(jax, on_tpu),
             "batch": batch,
+            "data_format": fmt,
             "loss": loss,
         }
 
-    return _sweep_best(batches, leg)
+    return _sweep_best(legs_cfg, leg)
 
 
 def bench_mnist(pt, jax, on_tpu: bool):

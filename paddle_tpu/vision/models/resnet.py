@@ -1,12 +1,17 @@
 """ResNet family (reference: python/paddle/vision/models/resnet.py).
 
-BASELINE.md config #2's model.  TPU notes: NCHW layout kept for paddle
-parity (XLA lays out conv tensors itself); BatchNorm runs through the
-framework's functional batch_norm whose running stats thread through jit as
-mutable buffers.
+BASELINE.md config #2's model.  TPU notes: the public contract stays NCHW
+(paddle parity — inputs are NCHW and the state_dict is identical either
+way, since Conv2D weights are OIHW in both formats), but the whole compute
+graph can run channels-last with ``data_format="NHWC"``: inputs are
+transposed once at entry and every conv/BN/pool operates NHWC — the
+layout the TPU's conv lowering is native in, sparing XLA per-op logical
+transposes.  BatchNorm runs through the framework's functional batch_norm
+whose running stats thread through jit as mutable buffers.
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Type, Union
 
 from ... import nn
@@ -19,16 +24,19 @@ class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
         if groups != 1 or base_width != 64:
             raise ValueError("BasicBlock only supports groups=1, base_width=64")
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
+                               bias_attr=False, data_format=data_format)
         self.bn1 = norm_layer(planes)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=data_format)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -46,16 +54,21 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        norm_layer = norm_layer or functools.partial(
+            nn.BatchNorm2D, data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=data_format)
         self.bn1 = norm_layer(width)
         self.conv2 = nn.Conv2D(width, width, 3, stride=stride, padding=dilation,
-                               groups=groups, dilation=dilation, bias_attr=False)
+                               groups=groups, dilation=dilation,
+                               bias_attr=False, data_format=data_format)
         self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
+                               bias_attr=False, data_format=data_format)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
@@ -71,11 +84,16 @@ class BottleneckBlock(nn.Layer):
 
 
 class ResNet(nn.Layer):
-    """vision/models/resnet.py ResNet parity."""
+    """vision/models/resnet.py ResNet parity.
+
+    ``data_format="NHWC"`` runs the conv stack channels-last (TPU-native);
+    inputs remain NCHW at the public boundary and are transposed once.
+    """
 
     def __init__(self, block, depth: int = 50,
                  layers: Optional[List[int]] = None, num_classes: int = 1000,
-                 with_pool: bool = True, groups: int = 1, width: int = 64):
+                 with_pool: bool = True, groups: int = 1, width: int = 64,
+                 data_format: str = "NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -83,26 +101,33 @@ class ResNet(nn.Layer):
             raise ValueError(
                 "ResNet depth must be one of %s (or pass layers=), got %r"
                 % (sorted(layer_cfg), depth))
+        if data_format not in ("NCHW", "NHWC"):
+            raise ValueError("data_format must be NCHW or NHWC, got %r"
+                             % (data_format,))
         layers = layers or layer_cfg[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
         self.groups = groups
         self.base_width = width
-        self._norm_layer = nn.BatchNorm2D
+        self.data_format = data_format
+        self._norm_layer = functools.partial(nn.BatchNorm2D,
+                                             data_format=data_format)
         self.inplanes = 64
         self.dilation = 1
 
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
+                               bias_attr=False, data_format=data_format)
         self.bn1 = self._norm_layer(self.inplanes)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1,
+                                    data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1),
+                                                data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
@@ -112,20 +137,29 @@ class ResNet(nn.Layer):
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
+                          stride=stride, bias_attr=False,
+                          data_format=self.data_format),
                 norm_layer(planes * block.expansion),
             )
         layers = [block(self.inplanes, planes, stride, downsample,
-                        self.groups, self.base_width, 1, norm_layer)]
+                        self.groups, self.base_width, 1, norm_layer,
+                        data_format=self.data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes,
                                 groups=self.groups,
                                 base_width=self.base_width,
-                                norm_layer=norm_layer))
+                                norm_layer=norm_layer,
+                                data_format=self.data_format))
         return nn.Sequential(*layers)
 
     def forward(self, x):
+        from ... import tensor as T
+
+        if self.data_format == "NHWC":
+            # public contract stays NCHW; one transpose at entry puts the
+            # whole stack channels-last
+            x = T.transpose(x, [0, 2, 3, 1])
         x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
@@ -134,9 +168,11 @@ class ResNet(nn.Layer):
         x = self.layer4(x)
         if self.with_pool:
             x = self.avgpool(x)
+        if self.data_format == "NHWC":
+            # restore the NCHW public contract before flatten/return, so
+            # feature-extractor outputs and fc weights are layout-invariant
+            x = T.transpose(x, [0, 3, 1, 2])
         if self.num_classes > 0:
-            from ... import tensor as T
-
             x = T.flatten(x, 1)
             x = self.fc(x)
         return x
