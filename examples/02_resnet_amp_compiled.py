@@ -17,9 +17,11 @@ from paddle_tpu.jit import TrainStep
 from paddle_tpu.vision.models import resnet18
 
 
-def main(steps=10, batch=8, hw=32, classes=10):
+def main(steps=10, batch=8, hw=32, classes=10, data_format="NCHW"):
     paddle.seed(0)
-    model = resnet18(num_classes=classes)
+    # --nhwc runs the conv stack channels-last (TPU-native layout); the
+    # input batch and every output stay NCHW either way
+    model = resnet18(num_classes=classes, data_format=data_format)
     criterion = paddle.nn.CrossEntropyLoss()
     opt = paddle.optimizer.Momentum(0.05,
                                     parameters=model.parameters())
@@ -50,5 +52,8 @@ if __name__ == "__main__":
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=10)
     ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--nhwc", action="store_true",
+                    help="run the conv stack channels-last (TPU-native)")
     args = ap.parse_args()
-    main(args.steps, args.batch)
+    main(args.steps, args.batch,
+         data_format="NHWC" if args.nhwc else "NCHW")

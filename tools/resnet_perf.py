@@ -1,0 +1,118 @@
+"""On-chip ResNet50 train-step diagnosis (VERDICT r3 next #1 evidence).
+
+Times the full TrainStep (device-resident inputs, bench.py's own timing
+helper) across layout x batch x precision, and optionally captures a JAX
+profiler trace of the winning configuration.  Writes a JSON report to
+tools/resnet_perf_report.json and prints one line per leg.
+
+Run (on the machine with the TPU tunnel):
+    python tools/resnet_perf.py [--trace]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+from bench import RESNET50_FWD_FLOPS, _peak_flops, _time_steps
+
+
+def build_step(pt, fmt, amp, classes=1000):
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    pt.seed(0)
+    model = resnet50(num_classes=classes, data_format=fmt)
+    criterion = pt.nn.CrossEntropyLoss()
+    opt = pt.optimizer.Momentum(0.1, parameters=model.parameters())
+    if amp:
+        model, opt = pt.amp.decorate(model, opt, level="O2",
+                                     dtype="bfloat16")
+
+        def loss_fn(m, x, y):
+            with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+                return criterion(m(x), y)
+    else:
+        def loss_fn(m, x, y):
+            return criterion(m(x), y)
+    return TrainStep(model, loss_fn, opt)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--trace", action="store_true",
+                    help="capture a jax.profiler trace of the best leg")
+    ap.add_argument("--batches", type=int, nargs="+",
+                    default=[64, 128, 256])
+    args = ap.parse_args()
+
+    import jax
+
+    import paddle_tpu as pt
+
+    on_tpu = jax.default_backend() != "cpu"
+    peak = _peak_flops(jax, on_tpu)
+    rng = np.random.RandomState(0)
+    report = []
+    best = None  # (leg_dict, (fmt, amp, batch)) — config only, no live HBM
+    for fmt in ("NHWC", "NCHW"):
+        for amp in (True, False):
+            step = None
+            for batch in args.batches:
+                imgs = rng.randn(batch, 3, 224, 224).astype("float32")
+                labels = rng.randint(0, 1000, (batch,)).astype("int64")
+                try:
+                    if step is None:
+                        step = build_step(pt, fmt, amp)
+                    dt, _ = _time_steps(step, (imgs, labels),
+                                        6 if on_tpu else 2)
+                except Exception as e:  # noqa: BLE001 - OOM legs
+                    report.append({"fmt": fmt, "amp": amp, "batch": batch,
+                                   "error": str(e)[:160]})
+                    print("%s amp=%s b%d: FAILED %s"
+                          % (fmt, amp, batch, str(e)[:80]), flush=True)
+                    continue
+                mfu = 3 * RESNET50_FWD_FLOPS * batch / dt / peak
+                leg = {"fmt": fmt, "amp": amp, "batch": batch,
+                       "step_s": round(dt, 5),
+                       "imgs_per_sec": round(batch / dt, 1),
+                       "mfu": round(mfu, 4)}
+                report.append(leg)
+                print("%s amp=%s b%d: %.4fs  %.0f img/s  MFU %.3f"
+                      % (fmt, amp, batch, dt, batch / dt, mfu), flush=True)
+                if best is None or leg["mfu"] > best[0]["mfu"]:
+                    best = (leg, (fmt, amp, batch))
+            del step  # one live model at a time (HBM)
+
+    if args.trace and best is not None:
+        leg, (fmt, amp, batch) = best
+        step = build_step(pt, fmt, amp)  # rebuilt: nothing else resident
+        imgs = jax.device_put(
+            rng.randn(batch, 3, 224, 224).astype("float32"))
+        labels = jax.device_put(
+            rng.randint(0, 1000, (batch,)).astype("int64"))
+        tracedir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "resnet_trace")
+        step(imgs, labels)  # compile outside the trace window
+        with jax.profiler.trace(tracedir):
+            for _ in range(3):
+                loss = step(imgs, labels)
+            float(loss.value)
+        print("trace written to", tracedir)
+
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "resnet_perf_report.json")
+    with open(out, "w") as f:
+        json.dump({"backend": jax.default_backend(), "legs": report,
+                   "best": best[0] if best else None}, f, indent=2)
+    print("report:", out)
+
+
+if __name__ == "__main__":
+    main()
