@@ -27,12 +27,16 @@ __all__ = ["recompute", "FS", "LocalFS", "HDFSClient",
            "DistributedInfer"]
 
 
-def _closure_params(fn: Callable) -> List[Parameter]:
-    """Trainable Parameters reachable from ``fn``: closure cells, bound
-    ``__self__``, Layer instances, and functools.partial args/keywords."""
+def _closure_params(fn: Callable):
+    """Trainable Parameters AND buffers reachable from ``fn``: closure
+    cells, bound ``__self__``, Layer instances, functools.partial args.
+    Buffers (BatchNorm running stats) must thread through the checkpoint
+    boundary explicitly — their in-place ``set_value`` updates inside a
+    ``jax.checkpoint`` region would otherwise leak traced values."""
     import functools
 
     found: List[Parameter] = []
+    bufs: List[Tensor] = []
     seen = set()
 
     def add_layer(layer: Layer):
@@ -40,6 +44,10 @@ def _closure_params(fn: Callable) -> List[Parameter]:
             if not p.stop_gradient and id(p) not in seen:
                 seen.add(id(p))
                 found.append(p)
+        for b in layer.buffers():
+            if id(b) not in seen:
+                seen.add(id(b))
+                bufs.append(b)
 
     def visit(obj, depth=0):
         if depth > 3:
@@ -67,36 +75,62 @@ def _closure_params(fn: Callable) -> List[Parameter]:
                     continue
 
     visit(fn)
-    return found
+    return found, bufs
 
 
 def recompute(function: Callable, *args, preserve_rng_state: bool = True, **kwargs):
-    """fleet/utils/recompute.py:171 parity over ``jax.checkpoint``."""
-    params = _closure_params(function)
+    """fleet/utils/recompute.py:171 parity over ``jax.checkpoint``.
+
+    Buffers of reached layers (BatchNorm running stats) thread through the
+    checkpoint as explicit inputs/outputs: the checkpointed body swaps
+    them in, runs, and RETURNS the updated values, which are written back
+    outside the region — so stateful blocks (conv+BN) rematerialize
+    without leaking tracers."""
+    params, bufs = _closure_params(function)
     n = len(params)
+    nb = len(bufs)
 
     def raw_fn(*all_raw):
-        param_vals, raw_args = all_raw[:n], all_raw[n:]
+        param_vals = all_raw[:n]
+        buf_vals = all_raw[n:n + nb]
+        raw_args = all_raw[n + nb:]
         saved = [p._value for p in params]
+        saved_b = [b._value for b in bufs]
         for p, v in zip(params, param_vals):
             p._value = v
+        for b, v in zip(bufs, buf_vals):
+            b._value = v
         try:
             wrapped = [
                 Tensor(a, stop_gradient=False) if isinstance(a, jax.Array) else a
                 for a in raw_args
             ]
             out = function(*wrapped, **kwargs)
-            return jax.tree_util.tree_map(
+            out = jax.tree_util.tree_map(
                 lambda t: t.value if isinstance(t, Tensor) else t,
                 out,
                 is_leaf=lambda t: isinstance(t, Tensor),
             )
+            new_buf_vals = [b._value if isinstance(b._value, jax.Array)
+                            else jax.numpy.asarray(b._value)
+                            for b in bufs]
+            return out, tuple(new_buf_vals)
         finally:
             for p, v in zip(params, saved):
                 p._value = v
+            for b, v in zip(bufs, saved_b):
+                b._value = v
 
+    # the updated buffer values are part of the op's RETURN (not a side
+    # effect inside the traced fn): under eager vjp taping a side-effect
+    # write would leak linearization tracers; as outputs they come back as
+    # primal values and are written back here, outside every trace scope
+    # jax owns
     op = make_op(jax.checkpoint(raw_fn), op_name="recompute")
-    return op(*params, *args)
+    out, new_buf_vals = op(*params, *bufs, *args)
+    for b, v in zip(bufs, new_buf_vals):
+        b._value = v.value if isinstance(v, Tensor) else v
+    return out
 
 
 from .fs import FS, DistributedInfer, HDFSClient, LocalFS  # noqa: E402,F401

@@ -23,12 +23,24 @@ import numpy as np
 from bench import RESNET50_FWD_FLOPS, _peak_flops, _time_steps
 
 
-def build_step(pt, fmt, amp, classes=1000):
+def build_step(pt, fmt, amp, classes=1000, remat=False):
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.vision.models import resnet50
 
     pt.seed(0)
     model = resnet50(num_classes=classes, data_format=fmt)
+    if remat:
+        # re-run each residual block in backward instead of keeping its
+        # activations: trades ~1/3 more FLOPs for the HBM that spills at
+        # batch 256 (VERDICT r3: 6.6 s/step there)
+        from paddle_tpu.distributed.fleet.utils import recompute
+
+        for name, sub in model.named_sublayers():
+            if name.startswith("layer") and name.count(".") == 1:
+                orig = sub.forward
+                sub.forward = (lambda *a, __o=orig, **kw:
+                               recompute(__o, *a) if not kw
+                               else __o(*a, **kw))
     criterion = pt.nn.CrossEntropyLoss()
     opt = pt.optimizer.Momentum(0.1, parameters=model.parameters())
     if amp:
@@ -60,7 +72,7 @@ def main():
     peak = _peak_flops(jax, on_tpu)
     rng = np.random.RandomState(0)
     report = []
-    best = None  # (leg_dict, (fmt, amp, batch)) — config only, no live HBM
+    best = None  # (leg_dict, (fmt, amp, batch, remat)) — config only
     for fmt in ("NHWC", "NCHW"):
         for amp in (True, False):
             step = None
@@ -87,12 +99,45 @@ def main():
                 print("%s amp=%s b%d: %.4fs  %.0f img/s  MFU %.3f"
                       % (fmt, amp, batch, dt, batch / dt, mfu), flush=True)
                 if best is None or leg["mfu"] > best[0]["mfu"]:
-                    best = (leg, (fmt, amp, batch))
+                    best = (leg, (fmt, amp, batch, False))
             del step  # one live model at a time (HBM)
 
+    # remat pass: the large batches that spill without it, using the best
+    # layout/precision found above
+    if best is not None and on_tpu:
+        fmt, amp = best[1][0], best[1][1]
+        step = None
+        # the spill-prone sizes: anything at/above the largest requested
+        # batch, extended one doubling beyond it
+        remat_batches = sorted({max(args.batches), max(args.batches) * 2})
+        for batch in remat_batches:
+            imgs = rng.randn(batch, 3, 224, 224).astype("float32")
+            labels = rng.randint(0, 1000, (batch,)).astype("int64")
+            try:
+                if step is None:
+                    step = build_step(pt, fmt, amp, remat=True)
+                dt, _ = _time_steps(step, (imgs, labels), 6)
+            except Exception as e:  # noqa: BLE001
+                report.append({"fmt": fmt, "amp": amp, "batch": batch,
+                               "remat": True, "error": str(e)[:160]})
+                print("remat %s amp=%s b%d: FAILED %s"
+                      % (fmt, amp, batch, str(e)[:80]), flush=True)
+                continue
+            mfu = 3 * RESNET50_FWD_FLOPS * batch / dt / peak
+            leg = {"fmt": fmt, "amp": amp, "batch": batch, "remat": True,
+                   "step_s": round(dt, 5),
+                   "imgs_per_sec": round(batch / dt, 1),
+                   "mfu": round(mfu, 4)}
+            report.append(leg)
+            print("remat %s amp=%s b%d: %.4fs  %.0f img/s  MFU %.3f"
+                  % (fmt, amp, batch, dt, batch / dt, mfu), flush=True)
+            if leg["mfu"] > best[0]["mfu"]:
+                best = (leg, (fmt, amp, batch, True))
+        del step
+
     if args.trace and best is not None:
-        leg, (fmt, amp, batch) = best
-        step = build_step(pt, fmt, amp)  # rebuilt: nothing else resident
+        leg, (fmt, amp, batch, remat) = best
+        step = build_step(pt, fmt, amp, remat=remat)  # nothing else resident
         imgs = jax.device_put(
             rng.randn(batch, 3, 224, 224).astype("float32"))
         labels = jax.device_put(
