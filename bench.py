@@ -142,6 +142,22 @@ def bench_bert(pt, jax, on_tpu: bool):
     return _sweep_best(batches, leg)
 
 
+def wrap_resnet_remat(model):
+    """Wrap each residual block's forward in fleet.utils.recompute so its
+    activations are replayed in backward instead of held — the batch-256
+    HBM-spill mitigation.  Shared by bench_resnet50 and
+    tools/resnet_perf.py (which imports it from here)."""
+    from paddle_tpu.distributed.fleet.utils import recompute
+
+    for name, sub in model.named_sublayers():
+        if name.startswith("layer") and name.count(".") == 1:
+            orig = sub.forward
+            sub.forward = (lambda *a, __o=orig, **kw:
+                           recompute(__o, *a) if not kw
+                           else __o(*a, **kw))
+    return model
+
+
 def bench_resnet50(pt, jax, on_tpu: bool):
     """Config #2: ResNet50, compiled ("static Executor") path + AMP.
 
@@ -155,27 +171,32 @@ def bench_resnet50(pt, jax, on_tpu: bool):
 
     pt.seed(0)
     if on_tpu:
-        # sweep layout x batch: NHWC is the TPU-native conv layout
-        # (channels-last lanes); NCHW kept as a fallback leg
-        legs_cfg = [("NHWC", 128), ("NHWC", 256), ("NHWC", 64),
-                    ("NCHW", 128)]
+        # sweep layout x batch x remat: NHWC is the TPU-native conv layout
+        # (channels-last lanes); NCHW kept as a fallback leg; the remat leg
+        # trades replayed block FLOPs for the HBM that spills at batch 256
+        legs_cfg = [("NHWC", 128, False), ("NHWC", 256, True),
+                    ("NHWC", 64, False), ("NCHW", 128, False)]
         hw, classes = 224, 1000
         flops_fwd = RESNET50_FWD_FLOPS
     else:
-        legs_cfg = [("NHWC", 4)]
+        # the remat leg keeps the wrapping path exercised off-chip too
+        legs_cfg = [("NHWC", 4, False), ("NHWC", 4, True)]
         hw, classes = 32, 10
         flops_fwd = 1e9  # nominal; CPU smoke only checks the harness runs
 
     steps = {}
 
-    def get_step(fmt):
-        if fmt not in steps:
-            # one live model at a time: a cached dead-format model would
+    def get_step(fmt, remat):
+        key = (fmt, remat)
+        if key not in steps:
+            # one live model at a time: a cached dead-config model would
             # hold params+optimizer state in HBM through later legs and
             # can OOM the comparison leg near the spill boundary
             steps.clear()
             pt.seed(0)
             model = resnet50(num_classes=classes, data_format=fmt)
+            if remat:
+                wrap_resnet_remat(model)
             criterion = pt.nn.CrossEntropyLoss()
             opt = pt.optimizer.Momentum(0.1, parameters=model.parameters())
             model, opt = pt.amp.decorate(model, opt, level="O2",
@@ -185,16 +206,16 @@ def bench_resnet50(pt, jax, on_tpu: bool):
                 with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
                     return criterion(m(x), y)
 
-            steps[fmt] = TrainStep(model, loss_fn, opt)  # donated buffers
-        return steps[fmt]
+            steps[key] = TrainStep(model, loss_fn, opt)  # donated buffers
+        return steps[key]
 
     rng = np.random.RandomState(0)
 
     def leg(cfg):
-        fmt, batch = cfg
+        fmt, batch, remat = cfg
         imgs = rng.randn(batch, 3, hw, hw).astype("float32")
         labels = rng.randint(0, classes, (batch,)).astype("int64")
-        dt, loss = _time_steps(get_step(fmt), (imgs, labels),
+        dt, loss = _time_steps(get_step(fmt, remat), (imgs, labels),
                                6 if on_tpu else 2)
         ips = batch / dt
         flops_per_step = 3.0 * flops_fwd * batch  # fwd + ~2x bwd
@@ -205,6 +226,7 @@ def bench_resnet50(pt, jax, on_tpu: bool):
             "mfu": flops_per_step / dt / _peak_flops(jax, on_tpu),
             "batch": batch,
             "data_format": fmt,
+            "remat": remat,
             "loss": loss,
         }
 

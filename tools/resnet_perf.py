@@ -20,7 +20,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import numpy as np
 
-from bench import RESNET50_FWD_FLOPS, _peak_flops, _time_steps
+from bench import (RESNET50_FWD_FLOPS, _peak_flops, _time_steps,
+                   wrap_resnet_remat)
 
 
 def build_step(pt, fmt, amp, classes=1000, remat=False):
@@ -31,16 +32,8 @@ def build_step(pt, fmt, amp, classes=1000, remat=False):
     model = resnet50(num_classes=classes, data_format=fmt)
     if remat:
         # re-run each residual block in backward instead of keeping its
-        # activations: trades ~1/3 more FLOPs for the HBM that spills at
-        # batch 256 (VERDICT r3: 6.6 s/step there)
-        from paddle_tpu.distributed.fleet.utils import recompute
-
-        for name, sub in model.named_sublayers():
-            if name.startswith("layer") and name.count(".") == 1:
-                orig = sub.forward
-                sub.forward = (lambda *a, __o=orig, **kw:
-                               recompute(__o, *a) if not kw
-                               else __o(*a, **kw))
+        # activations (shared mitigation with the bench's remat leg)
+        wrap_resnet_remat(model)
     criterion = pt.nn.CrossEntropyLoss()
     opt = pt.optimizer.Momentum(0.1, parameters=model.parameters())
     if amp:
