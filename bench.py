@@ -96,25 +96,19 @@ def _time_steps(step, args, iters: int) -> float:
     return (time.perf_counter() - t0) / iters, float(loss)
 
 
-def bench_bert(pt, jax, on_tpu: bool):
+def _lm_leg_runner(pt, jax, on_tpu, cfg, batches, seq, iters,
+                   shift_labels):
+    """Shared causal/masked-LM training leg: TransformerLM + AdamW under
+    bf16 O2 (fp32 master weights, loss math fp32 via the amp black list)
+    through the donated TrainStep, swept over batch sizes.  Used by the
+    bert / gpt-proxy / long-seq legs."""
     from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models import (TransformerLM, TransformerLMCriterion,
-                                   bert_base_config)
+    from paddle_tpu.models import TransformerLM, TransformerLMCriterion
 
     pt.seed(0)
-    cfg = bert_base_config()
-    if not on_tpu:  # CPU smoke: shrink so the harness itself stays testable
-        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
-                   intermediate_size=512, vocab_size=1024)
-    # batch 40 was the measured v5e knee (0.4365 MFU); sweep its
-    # neighborhood in case layout/memory behavior moved
-    batches, seq = ([40, 48, 32], 512) if on_tpu else ([2], 128)
-
     model = TransformerLM(**cfg, dropout=0.0)
-    criterion = TransformerLMCriterion(shift_labels=False)
+    criterion = TransformerLMCriterion(shift_labels=shift_labels)
     opt = pt.optimizer.AdamW(1e-4, parameters=model.parameters())
-    # bf16 mixed precision: params/activations in bf16 (MXU native), fp32
-    # master weights in the optimizer, loss math fp32 via the amp black list
     model, opt = pt.amp.decorate(model, opt, level="O2", dtype="bfloat16")
 
     def loss_fn(m, ids, labels):
@@ -123,23 +117,31 @@ def bench_bert(pt, jax, on_tpu: bool):
 
     step = TrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
+    flops_tok = model.flops_per_token(seq)
 
     def leg(batch):
         ids = rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32")
-        dt, loss = _time_steps(step, (ids, ids), 10 if on_tpu else 3)
+        dt, loss = _time_steps(step, (ids, ids), iters)
         tps = batch * seq / dt
-        flops_per_step = model.flops_per_token(seq) * batch * seq
-        return {
-            "_tps": tps,
-            "tokens_per_sec": tps,
-            "step_time_s": dt,
-            "mfu": flops_per_step / dt / _peak_flops(jax, on_tpu),
-            "batch": batch,
-            "seq": seq,
-            "loss": loss,
-        }
+        return {"_tps": tps, "tokens_per_sec": tps, "step_time_s": dt,
+                "mfu": flops_tok * batch * seq / dt / _peak_flops(jax, on_tpu),
+                "batch": batch, "seq": seq, "loss": loss}
 
     return _sweep_best(batches, leg)
+
+
+def bench_bert(pt, jax, on_tpu: bool):
+    from paddle_tpu.models import bert_base_config
+
+    cfg = bert_base_config()
+    if not on_tpu:  # CPU smoke: shrink so the harness itself stays testable
+        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
+                   intermediate_size=512, vocab_size=1024)
+    # batch 40 was the measured v5e knee (0.4365 MFU); sweep its
+    # neighborhood in case layout/memory behavior moved
+    batches, seq = ([40, 48, 32], 512) if on_tpu else ([2], 128)
+    return _lm_leg_runner(pt, jax, on_tpu, cfg, batches, seq,
+                          10 if on_tpu else 3, shift_labels=False)
 
 
 def wrap_resnet_remat(model):
@@ -325,11 +327,8 @@ def bench_gpt_block(pt, jax, on_tpu: bool):
     and the pipeline timing leg in ``tools/pp_timing.py``; one real chip
     cannot host two pipeline stages, so this leg records the on-chip
     per-block training throughput of the same geometry (tokens/s + MFU)."""
-    from paddle_tpu.jit import TrainStep
-    from paddle_tpu.models import (TransformerLM, TransformerLMCriterion,
-                                   gpt_1p3b_config)
+    from paddle_tpu.models import gpt_1p3b_config
 
-    pt.seed(0)
     cfg = gpt_1p3b_config()
     if on_tpu:
         cfg.update(num_layers=6)
@@ -338,29 +337,31 @@ def bench_gpt_block(pt, jax, on_tpu: bool):
         cfg.update(num_layers=2, hidden_size=128, num_heads=2,
                    intermediate_size=512, vocab_size=1024)
         batches, seq = [2], 128
+    return _lm_leg_runner(pt, jax, on_tpu, cfg, batches, seq,
+                          6 if on_tpu else 2, shift_labels=True)
 
-    model = TransformerLM(**cfg, dropout=0.0)
-    criterion = TransformerLMCriterion(shift_labels=True)
-    opt = pt.optimizer.AdamW(1e-4, parameters=model.parameters())
-    model, opt = pt.amp.decorate(model, opt, level="O2", dtype="bfloat16")
 
-    def loss_fn(m, ids, labels):
-        with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
-            return criterion(m(ids), labels)
-
-    step = TrainStep(model, loss_fn, opt)
-    rng = np.random.RandomState(0)
-    flops_tok = model.flops_per_token(seq)
-
-    def leg(batch):
-        ids = rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32")
-        dt, loss = _time_steps(step, (ids, ids), 6 if on_tpu else 2)
-        tps = batch * seq / dt
-        return {"_tps": tps, "tokens_per_sec": tps, "step_time_s": dt,
-                "mfu": flops_tok * batch * seq / dt / _peak_flops(jax, on_tpu),
-                "batch": batch, "seq": seq, "loss": loss}
-
-    return _sweep_best(batches, leg)
+def bench_longseq_flash(pt, jax, on_tpu: bool):
+    """Long-context leg: causal LM step at seq 8192 — above the measured
+    FLASH_MIN_SEQ crossover, so attention runs through the pallas TPU
+    flash kernel (ops/flash_attention.py).  Records tokens/s + MFU for
+    the long-sequence regime the ring/Ulysses SP path extends across
+    chips (sequence scaling itself needs >1 chip; this is the per-chip
+    kernel-path number)."""
+    if on_tpu:
+        cfg = dict(vocab_size=32000, hidden_size=1024, num_layers=4,
+                   num_heads=8, intermediate_size=4096, max_position=8192,
+                   causal=True)
+        batches, seq = [1, 2], 8192
+    else:
+        # CPU fallback: flash is TPU-gated anyway, so a long sequence
+        # would only burn O(L^2) fallback-attention time; keep it tiny
+        cfg = dict(vocab_size=512, hidden_size=128, num_layers=2,
+                   num_heads=2, intermediate_size=256, max_position=256,
+                   causal=True)
+        batches, seq = [1], 256
+    return _lm_leg_runner(pt, jax, on_tpu, cfg, batches, seq,
+                          4 if on_tpu else 2, shift_labels=True)
 
 
 def _probe_accelerator(timeout_s: int = 180) -> bool:
@@ -464,7 +465,8 @@ def main():
     for name, fn in (("bert", bench_bert), ("resnet50", bench_resnet50),
                      ("mnist_lenet", bench_mnist),
                      ("ernie_sharding", bench_ernie_sharding),
-                     ("gpt_pp_mp", bench_gpt_block)):
+                     ("gpt_pp_mp", bench_gpt_block),
+                     ("longseq_flash_8k", bench_longseq_flash)):
         try:
             legs[name] = fn(pt, jax, on_tpu)
         except Exception as e:  # noqa: BLE001 - keep remaining legs alive
