@@ -485,8 +485,13 @@ def main():
         # lock in the PARENT: a contended lock then costs wall-clock
         # before the child's measurement budget starts, not inside it
         lock_fd = _acquire_chip_lock()
-        if lock_fd is None:
+        if lock_fd is None or not _probe_accelerator():
+            # no lock or dead tunnel: measure on CPU and don't sit on the
+            # lock while doing it
             env["JAX_PLATFORMS"] = "cpu"
+            if lock_fd is not None:
+                os.close(lock_fd)
+                lock_fd = None
         else:
             env["_BENCH_LOCK_HELD"] = "1"
     reason = None
@@ -511,12 +516,20 @@ def main():
             out, err = "", ""
     if err:
         sys.stderr.write(err[-4000:])  # keep leg tracebacks debuggable
-    lines = [l for l in (out or "").strip().splitlines()
-             if l.startswith("{")]
-    if lines:
+    result = None
+    for l in (out or "").strip().splitlines():
+        if not l.startswith("{"):
+            continue
+        try:  # must be OUR result line, not a stray/truncated dict print
+            parsed = json.loads(l)
+        except ValueError:
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            result = l
+    if result is not None:
         # the child's final JSON is the result — accept it even if the
         # process then died/hung in transport teardown
-        print(lines[-1])
+        print(result)
         return
     if reason is None:
         reason = "measurement child exited %d with no JSON" \
@@ -545,6 +558,11 @@ def _measure_and_print():
     if os.environ.get("JAX_PLATFORMS") != "cpu" \
             and os.environ.get("_BENCH_LOCK_HELD") != "1":
         lock_fd = _acquire_chip_lock()
+        if lock_fd is None:
+            # someone else holds the chip past the timeout: NEVER run on
+            # the accelerator unlocked (two processes on one chip is what
+            # wedged the round-3 tunnel) — degrade to CPU
+            os.environ["JAX_PLATFORMS"] = "cpu"
     if os.environ.get("JAX_PLATFORMS") != "cpu":
         if not _probe_accelerator():
             os.environ["JAX_PLATFORMS"] = "cpu"

@@ -505,7 +505,6 @@ class PipelineTrainStep:
             return loss
 
         # shard_map specs (full-rank, shapes known at build time)
-        from jax.experimental.shard_map import shard_map as _shard_map
 
         def _dp_spec(ndim):
             # [M, mb, ...]: microbatch-size axis sharded over dp
@@ -556,9 +555,12 @@ class PipelineTrainStep:
                 pipe_core, mesh=mesh, in_specs=in_specs, out_specs=P(),
                 axis_names=frozenset(manual), check_vma=False)
         else:
-            sharded_core = _shard_map(
-                pipe_core, mesh=mesh, in_specs=in_specs, out_specs=P(),
-                check_rep=False)
+            # version-compat wrapper (check_vma on jax>=0.8, check_rep on
+            # older) — same helper the collectives use
+            from ..collective import shard_map as _compat_shard_map
+
+            sharded_core = _compat_shard_map(
+                pipe_core, mesh=mesh, in_specs=in_specs, out_specs=P())
 
         n_outer = len(self._outer_params)
 
