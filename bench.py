@@ -173,30 +173,33 @@ def bench_resnet50(pt, jax, on_tpu: bool):
 
     pt.seed(0)
     if on_tpu:
-        # sweep layout x batch x remat: NHWC is the TPU-native conv layout
-        # (channels-last lanes); NCHW kept as a fallback leg; the remat leg
-        # trades replayed block FLOPs for the HBM that spills at batch 256
-        legs_cfg = [("NHWC", 128, False), ("NHWC", 256, True),
-                    ("NHWC", 64, False), ("NCHW", 128, False)]
+        # sweep layout x batch x remat x s2d-stem: NHWC is the TPU-native
+        # conv layout (channels-last lanes); NCHW kept as a fallback leg;
+        # the remat leg trades replayed block FLOPs for the HBM that
+        # spills at batch 256; s2d rewrites the MXU-hostile 7x7/3ch stem
+        legs_cfg = [("NHWC", 128, False, True), ("NHWC", 128, False, False),
+                    ("NHWC", 256, True, True), ("NHWC", 64, False, True),
+                    ("NCHW", 128, False, False)]
         hw, classes = 224, 1000
         flops_fwd = RESNET50_FWD_FLOPS
     else:
-        # the remat leg keeps the wrapping path exercised off-chip too
-        legs_cfg = [("NHWC", 4, False), ("NHWC", 4, True)]
+        # the remat/s2d legs keep those paths exercised off-chip too
+        legs_cfg = [("NHWC", 4, False, False), ("NHWC", 4, True, True)]
         hw, classes = 32, 10
         flops_fwd = 1e9  # nominal; CPU smoke only checks the harness runs
 
     steps = {}
 
-    def get_step(fmt, remat):
-        key = (fmt, remat)
+    def get_step(fmt, remat, s2d):
+        key = (fmt, remat, s2d)
         if key not in steps:
             # one live model at a time: a cached dead-config model would
             # hold params+optimizer state in HBM through later legs and
             # can OOM the comparison leg near the spill boundary
             steps.clear()
             pt.seed(0)
-            model = resnet50(num_classes=classes, data_format=fmt)
+            model = resnet50(num_classes=classes, data_format=fmt,
+                             space_to_depth_stem=s2d)
             if remat:
                 wrap_resnet_remat(model)
             criterion = pt.nn.CrossEntropyLoss()
@@ -214,10 +217,10 @@ def bench_resnet50(pt, jax, on_tpu: bool):
     rng = np.random.RandomState(0)
 
     def leg(cfg):
-        fmt, batch, remat = cfg
+        fmt, batch, remat, s2d = cfg
         imgs = rng.randn(batch, 3, hw, hw).astype("float32")
         labels = rng.randint(0, classes, (batch,)).astype("int64")
-        dt, loss = _time_steps(get_step(fmt, remat), (imgs, labels),
+        dt, loss = _time_steps(get_step(fmt, remat, s2d), (imgs, labels),
                                6 if on_tpu else 2)
         ips = batch / dt
         flops_per_step = 3.0 * flops_fwd * batch  # fwd + ~2x bwd
@@ -229,6 +232,7 @@ def bench_resnet50(pt, jax, on_tpu: bool):
             "batch": batch,
             "data_format": fmt,
             "remat": remat,
+            "s2d_stem": s2d,
             "loss": loss,
         }
 

@@ -33,7 +33,7 @@ __all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
 WHITE_LIST = frozenset({
     "matmul", "bmm", "mm", "mv", "addmm", "linear", "einsum",
     "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
-    "conv3d_transpose",
+    "conv3d_transpose", "s2d_stem",
 })
 
 # fp16_lists.py black_list: numerically sensitive → force fp32

@@ -83,17 +83,74 @@ class BottleneckBlock(nn.Layer):
         return self.relu(out + identity)
 
 
+def _space_to_depth_stem(x_nhwc, w_oihw):
+    """The 7x7/s2 stem conv as an MXU-friendly 4x4/s1 conv.
+
+    The stem's 3 input channels starve the MXU's 128-deep contraction
+    lanes (K = 7*7*3 = 147 over a 224x224 window).  The classic TPU
+    rewrite (used by MLPerf ResNet submissions) regroups input pixels by
+    parity — [N,H,W,3] -> [N,H/2,W/2,12] — and scatters the 7x7x3 kernel
+    into an equivalent 4x4x12 one, giving a stride-1 conv with K = 192.
+    Each output pixel sums exactly the same input*weight products as the
+    original conv (summation order differs, so fp32 agreement is ~1e-5;
+    asserted by tests/test_models.py::test_space_to_depth_stem_exact).
+
+    Derivation: original tap kh in [0,7) touches input row 2*ho + kh - 3,
+    whose parity is (kh+1) % 2 and whose s2d row offset is
+    (kh+1)//2 - 2 in [-2,1] — a 4-tap window with asymmetric padding
+    (2, 1).  Same in w.  Weight layout: OIHW in, transformed to HWIO with
+    the s2d channel order (ph, pw, ci).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    # dtype alignment mirrors the conv white-list cast (covers O2-decorated
+    # bf16 weights with fp32 inputs and vice versa)
+    if w_oihw.dtype != x_nhwc.dtype:
+        w_oihw = w_oihw.astype(x_nhwc.dtype)
+    block = 2  # the derivation is FIXED to the 7x7/stride-2/pad-3 stem
+    n, h, w, ci = x_nhwc.shape
+    co = w_oihw.shape[0]
+    k = w_oihw.shape[2]
+    # input: group 2x2 pixel parities into channels -> [N, H/2, W/2, 4*ci]
+    x2 = x_nhwc.reshape(n, h // block, block, w // block, block, ci)
+    x2 = x2.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, h // block, w // block, block * block * ci)
+    # kernel: scatter K[kh,kw] into K2[(kh+1)//2, (kw+1)//2, ph, pw]
+    w_hwio = jnp.transpose(w_oihw, (2, 3, 1, 0))  # [7,7,ci,co]
+    k2 = jnp.zeros((4, 2, 4, 2, ci, co), w_hwio.dtype)
+    kh = jnp.arange(k)
+    d, p = (kh + 1) // 2, (kh + 1) % 2
+    k2 = k2.at[d[:, None], p[:, None], d[None, :], p[None, :]].set(
+        w_hwio)  # [dh, ph, dw, pw, ci, co]
+    k2 = k2.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, block * block * ci, co)
+    dn = lax.conv_dimension_numbers(x2.shape, k2.shape,
+                                    ("NHWC", "HWIO", "NHWC"))
+    return lax.conv_general_dilated(
+        x2, k2, window_strides=(1, 1), padding=((2, 1), (2, 1)),
+        dimension_numbers=dn)
+
+
+from ...framework.dispatch import make_op as _make_op
+
+_s2d_op = _make_op(_space_to_depth_stem, op_name="s2d_stem")
+
+
 class ResNet(nn.Layer):
     """vision/models/resnet.py ResNet parity.
 
     ``data_format="NHWC"`` runs the conv stack channels-last (TPU-native);
     inputs remain NCHW at the public boundary and are transposed once.
+    ``space_to_depth_stem=True`` (NHWC only) rewrites the 7x7/s2 stem as
+    the numerically-equivalent MXU-friendly 4x4/s1 conv over
+    parity-grouped pixels; the state_dict keeps the canonical 7x7 weight.
     """
 
     def __init__(self, block, depth: int = 50,
                  layers: Optional[List[int]] = None, num_classes: int = 1000,
                  with_pool: bool = True, groups: int = 1, width: int = 64,
-                 data_format: str = "NCHW"):
+                 data_format: str = "NCHW",
+                 space_to_depth_stem: bool = False):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -104,6 +161,10 @@ class ResNet(nn.Layer):
         if data_format not in ("NCHW", "NHWC"):
             raise ValueError("data_format must be NCHW or NHWC, got %r"
                              % (data_format,))
+        if space_to_depth_stem and data_format != "NHWC":
+            raise ValueError(
+                "space_to_depth_stem requires data_format='NHWC'")
+        self.space_to_depth_stem = bool(space_to_depth_stem)
         layers = layers or layer_cfg[depth]
         self.num_classes = num_classes
         self.with_pool = with_pool
@@ -160,7 +221,14 @@ class ResNet(nn.Layer):
             # public contract stays NCHW; one transpose at entry puts the
             # whole stack channels-last
             x = T.transpose(x, [0, 2, 3, 1])
-        x = self.relu(self.bn1(self.conv1(x)))
+        # the s2d rewrite needs even spatial dims (parity grouping) and the
+        # canonical 7x7 stem; anything else falls back to the plain conv
+        if self.space_to_depth_stem and x.shape[1] % 2 == 0 \
+                and x.shape[2] % 2 == 0 \
+                and self.conv1.weight.shape[-1] == 7:
+            x = self.relu(self.bn1(_s2d_op(x, self.conv1.weight)))
+        else:
+            x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -184,3 +184,52 @@ def test_resnet_nhwc_feature_extractor_contract():
     # bare blocks constructed directly with NHWC get matching-axis BN
     blk = BasicBlock(8, 8, data_format="NHWC")
     assert blk.bn1._data_format in ("NHWC",)
+
+
+def test_space_to_depth_stem_exact():
+    """The s2d stem rewrite computes the same conv (same products, fp32
+    summation-order tolerance) and trains with gradients flowing through
+    the kernel transform back to the canonical 7x7 weight."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    import paddle_tpu as pt
+    from paddle_tpu.vision.models import resnet18
+    from paddle_tpu.vision.models.resnet import _space_to_depth_stem
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 32, 32, 3).astype("float32"))
+    w = jnp.asarray(rng.randn(64, 3, 7, 7).astype("float32"))
+    dn = lax.conv_dimension_numbers(x.shape, (7, 7, 3, 64),
+                                    ("NHWC", "HWIO", "NHWC"))
+    ref = lax.conv_general_dilated(
+        x, jnp.transpose(w, (2, 3, 1, 0)), (2, 2), ((3, 3), (3, 3)),
+        dimension_numbers=dn)
+    got = _space_to_depth_stem(x, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+    # full model: same weights, same outputs; NHWC-only guard
+    pt.seed(0)
+    m1 = resnet18(num_classes=5, data_format="NHWC")
+    m2 = resnet18(num_classes=5, data_format="NHWC",
+                  space_to_depth_stem=True)
+    m2.set_state_dict(m1.state_dict())
+    m1.eval(); m2.eval()
+    xs = rng.randn(2, 3, 64, 64).astype("float32")
+    o1 = np.asarray(m1(pt.to_tensor(xs)).value)
+    o2 = np.asarray(m2(pt.to_tensor(xs)).value)
+    np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-4)
+    with pytest.raises(ValueError, match="NHWC"):
+        resnet18(space_to_depth_stem=True)  # NCHW default
+
+    # gradients flow to conv1.weight through the transform
+    m2.train()
+    opt = pt.optimizer.SGD(0.01, parameters=m2.parameters())
+    y = np.zeros((2,), "int64")
+    loss = pt.nn.functional.cross_entropy(m2(pt.to_tensor(xs)),
+                                          pt.to_tensor(y))
+    loss.backward()
+    g = m2.conv1.weight.grad
+    assert g is not None and float(np.abs(np.asarray(g.value)).sum()) > 0
+    opt.step()

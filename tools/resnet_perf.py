@@ -24,12 +24,13 @@ from bench import (RESNET50_FWD_FLOPS, _peak_flops, _time_steps,
                    wrap_resnet_remat)
 
 
-def build_step(pt, fmt, amp, classes=1000, remat=False):
+def build_step(pt, fmt, amp, classes=1000, remat=False, s2d=False):
     from paddle_tpu.jit import TrainStep
     from paddle_tpu.vision.models import resnet50
 
     pt.seed(0)
-    model = resnet50(num_classes=classes, data_format=fmt)
+    model = resnet50(num_classes=classes, data_format=fmt,
+                     space_to_depth_stem=s2d)
     if remat:
         # re-run each residual block in backward instead of keeping its
         # activations (shared mitigation with the bench's remat leg)
@@ -65,8 +66,8 @@ def main():
     peak = _peak_flops(jax, on_tpu)
     rng = np.random.RandomState(0)
     report = []
-    best = None  # (leg_dict, (fmt, amp, batch, remat)) — config only
-    for fmt in ("NHWC", "NCHW"):
+    best = None  # (leg_dict, (fmt, amp, batch, remat, s2d)) — config only
+    for fmt, s2d in (("NHWC", True), ("NHWC", False), ("NCHW", False)):
         for amp in (True, False):
             step = None
             for batch in args.batches:
@@ -74,31 +75,32 @@ def main():
                 labels = rng.randint(0, 1000, (batch,)).astype("int64")
                 try:
                     if step is None:
-                        step = build_step(pt, fmt, amp)
+                        step = build_step(pt, fmt, amp, s2d=s2d)
                     dt, _ = _time_steps(step, (imgs, labels),
                                         6 if on_tpu else 2)
                 except Exception as e:  # noqa: BLE001 - OOM legs
                     report.append({"fmt": fmt, "amp": amp, "batch": batch,
-                                   "error": str(e)[:160]})
-                    print("%s amp=%s b%d: FAILED %s"
-                          % (fmt, amp, batch, str(e)[:80]), flush=True)
+                                   "s2d": s2d, "error": str(e)[:160]})
+                    print("%s s2d=%s amp=%s b%d: FAILED %s"
+                          % (fmt, s2d, amp, batch, str(e)[:80]), flush=True)
                     continue
                 mfu = 3 * RESNET50_FWD_FLOPS * batch / dt / peak
-                leg = {"fmt": fmt, "amp": amp, "batch": batch,
+                leg = {"fmt": fmt, "amp": amp, "batch": batch, "s2d": s2d,
                        "step_s": round(dt, 5),
                        "imgs_per_sec": round(batch / dt, 1),
                        "mfu": round(mfu, 4)}
                 report.append(leg)
-                print("%s amp=%s b%d: %.4fs  %.0f img/s  MFU %.3f"
-                      % (fmt, amp, batch, dt, batch / dt, mfu), flush=True)
+                print("%s s2d=%s amp=%s b%d: %.4fs  %.0f img/s  MFU %.3f"
+                      % (fmt, s2d, amp, batch, dt, batch / dt, mfu),
+                      flush=True)
                 if best is None or leg["mfu"] > best[0]["mfu"]:
-                    best = (leg, (fmt, amp, batch, False))
+                    best = (leg, (fmt, amp, batch, False, s2d))
             del step  # one live model at a time (HBM)
 
     # remat pass: the large batches that spill without it, using the best
     # layout/precision found above
     if best is not None and on_tpu:
-        fmt, amp = best[1][0], best[1][1]
+        fmt, amp, s2d = best[1][0], best[1][1], best[1][4]
         step = None
         # the spill-prone sizes: anything at/above the largest requested
         # batch, extended one doubling beyond it
@@ -108,29 +110,30 @@ def main():
             labels = rng.randint(0, 1000, (batch,)).astype("int64")
             try:
                 if step is None:
-                    step = build_step(pt, fmt, amp, remat=True)
+                    step = build_step(pt, fmt, amp, remat=True, s2d=s2d)
                 dt, _ = _time_steps(step, (imgs, labels), 6)
             except Exception as e:  # noqa: BLE001
                 report.append({"fmt": fmt, "amp": amp, "batch": batch,
-                               "remat": True, "error": str(e)[:160]})
+                               "remat": True, "s2d": s2d,
+                               "error": str(e)[:160]})
                 print("remat %s amp=%s b%d: FAILED %s"
                       % (fmt, amp, batch, str(e)[:80]), flush=True)
                 continue
             mfu = 3 * RESNET50_FWD_FLOPS * batch / dt / peak
             leg = {"fmt": fmt, "amp": amp, "batch": batch, "remat": True,
-                   "step_s": round(dt, 5),
+                   "s2d": s2d, "step_s": round(dt, 5),
                    "imgs_per_sec": round(batch / dt, 1),
                    "mfu": round(mfu, 4)}
             report.append(leg)
             print("remat %s amp=%s b%d: %.4fs  %.0f img/s  MFU %.3f"
                   % (fmt, amp, batch, dt, batch / dt, mfu), flush=True)
             if leg["mfu"] > best[0]["mfu"]:
-                best = (leg, (fmt, amp, batch, True))
+                best = (leg, (fmt, amp, batch, True, s2d))
         del step
 
     if args.trace and best is not None:
-        leg, (fmt, amp, batch, remat) = best
-        step = build_step(pt, fmt, amp, remat=remat)  # nothing else resident
+        leg, (fmt, amp, batch, remat, s2d) = best
+        step = build_step(pt, fmt, amp, remat=remat, s2d=s2d)
         imgs = jax.device_put(
             rng.randn(batch, 3, 224, 224).astype("float32"))
         labels = jax.device_put(
