@@ -50,6 +50,24 @@ def build_step(pt, fmt, amp, classes=1000, remat=False, s2d=False):
     return TrainStep(model, loss_fn, opt)
 
 
+def measure_leg(pt, jax, fmt, amp, batch, s2d=False, remat=False,
+                iters=6, rng=None):
+    """Build + time one ResNet50 TrainStep config; returns the leg dict
+    (shared by the sweep below and tools/grab_resnet_onchip.py so the
+    timing/MFU conventions cannot diverge)."""
+    if rng is None:
+        rng = np.random.RandomState(0)
+    imgs = rng.randn(batch, 3, 224, 224).astype("float32")
+    labels = rng.randint(0, 1000, (batch,)).astype("int64")
+    step = build_step(pt, fmt, amp, remat=remat, s2d=s2d)
+    dt, _ = _time_steps(step, (imgs, labels), iters)
+    peak = _peak_flops(jax, jax.default_backend() != "cpu")
+    return {"fmt": fmt, "amp": amp, "batch": batch, "s2d": s2d,
+            "remat": remat, "step_s": round(dt, 5),
+            "imgs_per_sec": round(batch / dt, 1),
+            "mfu": round(3 * RESNET50_FWD_FLOPS * batch / dt / peak, 4)}
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--trace", action="store_true",
