@@ -287,6 +287,44 @@ class _CtrlFlowTransformer:
         return [cond_def, body_def, call]
 
 
+class _IfExpTransformer(ast.NodeTransformer):
+    """``a if pred else b`` ->
+    ``__pt_rt_cond(pred, lambda: a, (), lambda: b, ())``.
+
+    Expression-level and scope-safe: the lambdas only READ enclosing
+    variables, so no parameter/carry analysis is needed, and with a
+    Python-bool predicate the runtime keeps lazy single-branch
+    evaluation.  Branches containing a walrus (NamedExpr) — wrapping
+    would move the binding into the lambda scope — or await/yield
+    (illegal/behavior-changing inside a lambda) are left untouched.
+    ``n`` counts only rewrites whose predicate LOOKS tensor-capable
+    (contains a comparison/call/binop), so a pure-Python string ternary
+    alone never makes convert() claim success."""
+
+    _UNWRAPPABLE = (ast.NamedExpr, ast.Await, ast.Yield, ast.YieldFrom)
+
+    def __init__(self):
+        self.n = 0
+
+    def visit_IfExp(self, node: ast.IfExp):
+        self.generic_visit(node)
+        for sub in (node.body, node.orelse):
+            if any(isinstance(x, self._UNWRAPPABLE) for x in ast.walk(sub)):
+                return node
+        if any(isinstance(x, (ast.Compare, ast.Call, ast.BinOp))
+               for x in ast.walk(node.test)):
+            self.n += 1
+        empty = ast.Tuple(elts=[], ctx=ast.Load())
+        return ast.Call(
+            func=ast.Name(id="__pt_rt_cond", ctx=ast.Load()),
+            args=[node.test,
+                  ast.Lambda(args=_make_args([]), body=node.body),
+                  empty,
+                  ast.Lambda(args=_make_args([]), body=node.orelse),
+                  ast.Tuple(elts=[], ctx=ast.Load())],
+            keywords=[])
+
+
 def _make_args(names: List[str]) -> ast.arguments:
     return ast.arguments(
         posonlyargs=[], args=[ast.arg(arg=n) for n in names], vararg=None,
@@ -322,7 +360,9 @@ def convert(fn: Callable) -> Callable:
         local_names.add(fdef.args.kwarg.arg)
     tr = _CtrlFlowTransformer(local_names)
     fdef.body = tr.transform_block(fdef.body)
-    if tr.n == 0:
+    te = _IfExpTransformer()
+    te.visit(fdef)
+    if tr.n == 0 and te.n == 0:
         raise ConversionError(
             "no convertible if/while found in %r"
             % getattr(fn, "__name__", fn))

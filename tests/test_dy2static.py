@@ -186,3 +186,35 @@ def test_gradient_through_converted_if():
     loss = sf(x)
     loss.backward()
     np.testing.assert_allclose(np.asarray(x.grad.value), [3.0, 3.0])
+
+
+def test_converted_ternary_ifexp():
+    """`a if pred else b` with a tensor predicate converts via the
+    expression-level pass (the most common tensor-conditioned shape)."""
+    def f(x):
+        y = x * 2.0 if pt.tensor.sum(x) > 0 else x - 1.0
+        return y + 1.0
+
+    sf = to_static(f)
+    for v, want in (([1.0, 2.0], [3.0, 5.0]), ([-5.0, 1.0], [-5.0, 1.0])):
+        x = np.asarray(v, np.float32)
+        got = np.asarray(sf(pt.to_tensor(x)).value)
+        np.testing.assert_allclose(got, np.asarray(want), rtol=1e-6)
+    assert getattr(sf._function, "__dy2static_converted__", False)
+
+
+def test_ternary_inside_while():
+    def f(x):
+        while pt.tensor.sum(x) < 20.0:
+            x = x * 3.0 if pt.tensor.sum(x) < 5.0 else x + 4.0
+        return x
+
+    sf = to_static(f)
+    x = np.array([1.0, 1.0], np.float32)
+    got = np.asarray(sf(pt.to_tensor(x)).value)
+
+    def ref(a):
+        while a.sum() < 20.0:
+            a = a * 3.0 if a.sum() < 5.0 else a + 4.0
+        return a
+    np.testing.assert_allclose(got, ref(x.astype(np.float64)), rtol=1e-6)
