@@ -43,17 +43,49 @@ def _record(leg: dict) -> None:
     print(leg, flush=True)
 
 
+CONFIGS = (("NHWC", True), ("NHWC", False), ("NCHW", False))
+
+
+def _captured() -> set:
+    """(fmt, s2d) combos already successfully recorded."""
+    got = set()
+    try:
+        with open(OUT) as f:
+            for line in f:
+                d = json.loads(line)
+                if "error" not in d and "fmt" in d:
+                    got.add((d["fmt"], bool(d.get("s2d"))))
+    except FileNotFoundError:
+        pass
+    return got
+
+
 def measure() -> int:
-    """Run the minimal comparison in THIS process. Returns #legs done."""
+    """Measure the not-yet-captured configs in THIS process.
+
+    The persistent jax compilation cache (set below, before the first jax
+    import) makes compiles survive across tunnel windows: a window too
+    short to compile+measure still banks the compile, and the next
+    window's retry skips straight to measurement (~5 min windows were
+    observed; a cold resnet50 TrainStep compile alone can eat most of
+    one).  Returns #legs done this call."""
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), "jax_cache"))
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
     import jax
 
     import paddle_tpu as pt
     from resnet_perf import measure_leg
 
     done = 0
-    for fmt, s2d in (("NHWC", True), ("NHWC", False), ("NCHW", False)):
+    have = _captured()
+    for fmt, s2d in CONFIGS:
+        if (fmt, s2d) in have:
+            continue
         try:
-            _record(measure_leg(pt, jax, fmt, True, 128, s2d=s2d))
+            _record(measure_leg(pt, jax, fmt, True, 128, s2d=s2d, iters=4))
             done += 1
         except Exception as e:  # noqa: BLE001 - record and keep going
             _record({"fmt": fmt, "s2d": s2d, "error": str(e)[:200]})
@@ -64,7 +96,8 @@ def main():
     if "--measure-once" in sys.argv:
         # child mode: one measurement attempt, exit 0 if any leg landed
         try:
-            return 0 if measure() > 0 else 1
+            measure()
+            return 0 if len(_captured()) >= len(CONFIGS) else 1
         except Exception as e:  # noqa: BLE001 - tunnel died mid-setup
             _record({"error": "measure() aborted: %s" % str(e)[:200]})
             return 1
@@ -78,14 +111,16 @@ def main():
             try:
                 # a wedged backend hangs jax calls forever; the child is
                 # killable, the loop is not — so measure in a child
-                r = subprocess.run(
+                subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
                      "--measure-once"], timeout=1500)
-                if r.returncode == 0:
-                    return 0
             except subprocess.TimeoutExpired:
                 _record({"error": "measure child timed out (tunnel wedge)"})
-            print("no leg succeeded; keep waiting", flush=True)
+            if len(_captured()) >= len(CONFIGS):
+                print("all configs captured", flush=True)
+                return 0
+            print("captured %d/%d; keep waiting"
+                  % (len(_captured()), len(CONFIGS)), flush=True)
         time.sleep(150)
     print("gave up waiting for the tunnel", flush=True)
     return 1
