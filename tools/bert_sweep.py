@@ -1,15 +1,19 @@
 """Manual BERT throughput sweep on the attached chip.
 
-Usage: python tools/bert_sweep.py [batch ...]   (defaults: 16 24 32 48)
+Usage: python tools/bert_sweep.py [--seq N] [batch ...]   (defaults: 16 24 32 48)
 Used to locate the v5e throughput knee (batch 40, MFU 0.4365) that
 bench.py's sweep now centers on.
 """
-import time, numpy as np, jax
+import os, sys, numpy as np, jax
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
 import paddle_tpu as pt
+from bench import _peak_flops, _time_steps
 from paddle_tpu.jit import TrainStep
 from paddle_tpu.models import TransformerLM, TransformerLMCriterion, bert_base_config
 
-def run(batch, seq=512):
+def run(batch, seq=512, iters=10):
     pt.seed(0)
     cfg = bert_base_config()
     model = TransformerLM(**cfg, dropout=0.0)
@@ -22,23 +26,35 @@ def run(batch, seq=512):
     step = TrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32")
-    for _ in range(2):
-        loss = step(ids, ids)
-    float(loss)
-    iters = 10
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, ids)
-    float(loss)
-    dt = (time.perf_counter() - t0) / iters
+    # _time_steps stages inputs on device and amortizes the end-of-loop
+    # host fetch — the same timing convention as every bench.py leg
+    dt, _ = _time_steps(step, (ids, ids), iters)
     flops = model.flops_per_token(seq) * batch * seq
-    mfu = flops / dt / 197e12
+    mfu = flops / dt / _peak_flops(jax, jax.default_backend() != "cpu")
     print(f"batch={batch} seq={seq}: {dt*1e3:.1f} ms  {batch*seq/dt:,.0f} tok/s  MFU={mfu:.4f}", flush=True)
     return mfu
 
-import sys
-for b in [int(a) for a in sys.argv[1:]] or [16, 24, 32, 48]:
+if __name__ == "__main__":
+    # single-flight on the one chip (the round-3 tunnel wedge was two
+    # processes contending for the accelerator transport)
+    from bench import _acquire_chip_lock
+    if _acquire_chip_lock(timeout_s=600.0) is None:
+        sys.exit("another process holds the chip lock; not contending")
+    argv = sys.argv[1:]
+    seq = 512
+    if "--seq" in argv:
+        i = argv.index("--seq")
+        try:
+            seq = int(argv[i + 1])
+        except (IndexError, ValueError):
+            sys.exit("usage: bert_sweep.py [--seq N] [batch ...]")
+        del argv[i:i + 2]
     try:
-        run(b)
-    except Exception as e:
-        print(f"batch={b}: FAILED {str(e)[:120]}", flush=True)
+        batches = [int(a) for a in argv] or [16, 24, 32, 48]
+    except ValueError:
+        sys.exit("usage: bert_sweep.py [--seq N] [batch ...]")
+    for b in batches:
+        try:
+            run(b, seq=seq)
+        except Exception as e:
+            print(f"batch={b}: FAILED {str(e)[:120]}", flush=True)

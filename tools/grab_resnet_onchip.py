@@ -24,7 +24,28 @@ OUT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                    "resnet_onchip_grab.jsonl")
 
 
+def _lock_free() -> bool:
+    """True when no other process holds the bench chip lock (checked by
+    briefly acquiring it) — probing the accelerator transport while a
+    bench run owns the chip is the documented tunnel-wedge scenario."""
+    import fcntl
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), ".."))
+    from bench import _LOCKFILE
+    fd = os.open(_LOCKFILE, os.O_CREAT | os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        fcntl.flock(fd, fcntl.LOCK_UN)
+        return True
+    except OSError:
+        return False
+    finally:
+        os.close(fd)
+
+
 def probe(timeout_s=90) -> bool:
+    if not _lock_free():
+        return False
     try:
         r = subprocess.run(
             [sys.executable, "-c",
@@ -47,13 +68,19 @@ CONFIGS = (("NHWC", True), ("NHWC", False), ("NCHW", False))
 
 
 def _captured() -> set:
-    """(fmt, s2d) combos already successfully recorded."""
+    """(fmt, s2d) combos already successfully recorded.
+
+    Only counts legs measured under the current accounting
+    (``mfu_convention == 2``, set by resnet_perf.measure_leg): legs from
+    before the 2-FLOPs-per-MAC fix understate MFU 2x and must be
+    re-measured, not skipped."""
     got = set()
     try:
         with open(OUT) as f:
             for line in f:
                 d = json.loads(line)
-                if "error" not in d and "fmt" in d:
+                if ("error" not in d and "fmt" in d
+                        and d.get("mfu_convention") == 2):
                     got.add((d["fmt"], bool(d.get("s2d"))))
     except FileNotFoundError:
         pass
@@ -74,6 +101,10 @@ def measure() -> int:
                               os.path.abspath(__file__)), "jax_cache"))
     os.environ.setdefault(
         "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+    from bench import _acquire_chip_lock
+    if _acquire_chip_lock(timeout_s=600.0) is None:
+        raise RuntimeError("another process holds the chip lock")
+
     import jax
 
     import paddle_tpu as pt
@@ -85,7 +116,7 @@ def measure() -> int:
         if (fmt, s2d) in have:
             continue
         try:
-            _record(measure_leg(pt, jax, fmt, True, 128, s2d=s2d, iters=4))
+            _record(measure_leg(pt, jax, fmt, True, 128, s2d=s2d))
             done += 1
         except Exception as e:  # noqa: BLE001 - record and keep going
             _record({"fmt": fmt, "s2d": s2d, "error": str(e)[:200]})
