@@ -36,8 +36,18 @@ _LOCKFILE = os.path.join(_REPO, ".bench.lock")
 # ResNet50 ImageNet-224 analytic forward FLOPs per image. The commonly
 # quoted 4.089e9 counts multiply-ACCUMULATES; the MFU convention (and the
 # BERT leg's PaLM-style flops_per_token) counts 2 FLOPs per MAC, so the
-# forward pass is 2x that. Backward ~= 2x forward (the callers' 3x).
+# forward pass is 2x that. Backward ~= 2x forward (resnet50_mfu's 3x).
 RESNET50_FWD_FLOPS = 2 * 4.089e9
+
+# Bumped when the accounting above changes; stamped on every resnet leg
+# record so history consumers can reject stale-convention lines.
+RESNET_MFU_CONVENTION = 2
+
+
+def resnet50_mfu(batch: int, step_s: float, peak: float) -> float:
+    """The ONE ResNet50 train-step MFU formula (fwd + ~2x bwd), shared by
+    bench_resnet50 and tools/resnet_perf so the convention cannot fork."""
+    return 3.0 * RESNET50_FWD_FLOPS * batch / step_s / peak
 
 
 def _peak_flops(jax, on_tpu: bool) -> float:
@@ -227,16 +237,18 @@ def bench_resnet50(pt, jax, on_tpu: bool):
         dt, loss = _time_steps(get_step(fmt, remat, s2d), (imgs, labels),
                                12 if on_tpu else 2)
         ips = batch / dt
-        flops_per_step = 3.0 * flops_fwd * batch  # fwd + ~2x bwd
+        mfu = (resnet50_mfu(batch, dt, _peak_flops(jax, on_tpu))
+               if on_tpu else
+               3.0 * flops_fwd * batch / dt / _peak_flops(jax, on_tpu))
         return {
             "_tps": ips,
             "imgs_per_sec": ips,
             "step_time_s": dt,
-            "mfu": flops_per_step / dt / _peak_flops(jax, on_tpu),
-            # legs without mfu_convention==2 predate the 2-FLOPs-per-MAC
+            "mfu": mfu,
+            # legs without the current marker predate the 2-FLOPs-per-MAC
             # accounting fix and understate MFU exactly 2x (see
-            # RESNET50_FWD_FLOPS); the marker disambiguates history lines
-            "mfu_convention": 2,
+            # RESNET50_FWD_FLOPS); it disambiguates history lines
+            "mfu_convention": RESNET_MFU_CONVENTION,
             "batch": batch,
             "data_format": fmt,
             "remat": remat,

@@ -72,6 +72,12 @@ from . import quantization  # noqa: F401
 from . import static  # noqa: F401
 from . import text  # noqa: F401
 from . import vision  # noqa: F401
+from . import compat  # noqa: F401
+from . import dataset  # noqa: F401
+from . import device  # noqa: F401
+from . import hub  # noqa: F401
+from . import reader  # noqa: F401
+from . import sysconfig  # noqa: F401
 from .hapi import Model  # noqa: F401
 from .hapi import flops, summary  # noqa: F401
 from . import utils  # noqa: F401

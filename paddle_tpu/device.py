@@ -1,0 +1,49 @@
+"""Device namespace (``paddle.device``).
+
+Reference: ``python/paddle/device.py:25-208``. The implementations live in
+``paddle_tpu.core.device`` (the Place/set_device machinery); this module
+is the public namespace that re-exports them plus the vendor-probe
+predicates. On this backend the answer to every CUDA/ROCm/XPU/NPU build
+probe is ``False`` and ``get_cudnn_version()`` is ``None`` — code that
+branches on them falls through to the portable path, which is the TPU
+path here.
+"""
+from __future__ import annotations
+
+from .core.device import (  # noqa: F401
+    XPUPlace,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+
+__all__ = [
+    "get_cudnn_version",
+    "set_device",
+    "get_device",
+    "XPUPlace",
+    "is_compiled_with_xpu",
+    "is_compiled_with_cuda",
+    "is_compiled_with_rocm",
+    "is_compiled_with_npu",
+    "is_compiled_with_tpu",
+]
+
+
+def get_cudnn_version():
+    """None: no cuDNN in a TPU build (reference returns the version int
+    only under a CUDA build, ``device.py:88``)."""
+    return None
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_npu() -> bool:
+    return False

@@ -1,0 +1,51 @@
+/* C inference API for paddle_tpu (capi_exp analog).
+ *
+ * Link against libpaddle_tpu_c.so (paddle_tpu.capi.build() compiles it;
+ * paddle_tpu.sysconfig.get_lib() returns its directory). The library
+ * embeds a CPython interpreter running the paddle_tpu runtime; all entry
+ * points are GIL-guarded and safe to call from a single host thread.
+ *
+ * Reference surface: paddle/fluid/inference/capi_exp/pd_inference_api.h
+ * (Config/Predictor verticals; this header is the TPU-native reduction).
+ */
+#ifndef PADDLE_TPU_C_H_
+#define PADDLE_TPU_C_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Start the embedded runtime. extra_sys_paths: ':'-separated directories
+ * prepended to sys.path (pass the repo root when running from a source
+ * tree), or NULL. Returns 0 on success. */
+int PD_Init(const char* extra_sys_paths);
+
+/* Version string of the C API (static storage; do not free). */
+const char* PD_GetVersion(void);
+
+/* Load a saved StableHLO inference artifact (paddle_tpu.jit.save prefix).
+ * Returns an opaque predictor handle, or NULL on failure. */
+void* PD_PredictorCreate(const char* model_prefix);
+
+/* Run the predictor on a float32 input of the given shape.
+ *   data/shape/ndim:     input buffer and its dimensions
+ *   out/out_capacity:    caller-allocated output buffer (element count)
+ *   out_shape/out_ndim:  receive the output dimensions
+ * Returns the number of output elements written, or <0 on failure
+ * (-1 bad handle, -2..: runtime error, see stderr). */
+long long PD_PredictorRunFloat(void* handle, const float* data,
+                               const long long* shape, int ndim, float* out,
+                               long long out_capacity, long long* out_shape,
+                               int* out_ndim);
+
+/* Release a predictor handle. */
+void PD_PredictorDestroy(void* handle);
+
+/* Shut down the embedded runtime. PD_Init afterwards is unsupported. */
+void PD_Finalize(void);
+
+#ifdef __cplusplus
+}  /* extern "C" */
+#endif
+
+#endif  /* PADDLE_TPU_C_H_ */

@@ -1,0 +1,24 @@
+"""Build-integration paths (``paddle.sysconfig``).
+
+Reference: ``python/paddle/sysconfig.py:20-52``. ``get_include`` serves
+the C API header (``paddle_tpu_c.h``); ``get_lib`` the directory holding
+``libpaddle_tpu_c.so`` (built on demand by ``paddle_tpu.capi.build()``).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory containing the paddle_tpu C/C++ header files."""
+    return os.path.join(_PKG, "include")
+
+
+def get_lib() -> str:
+    """Directory containing ``libpaddle_tpu_c.so`` (call
+    ``paddle_tpu.capi.build()`` first to compile it)."""
+    return os.path.join(_PKG, "capi", "_build")

@@ -1,0 +1,351 @@
+"""Legacy namespace parity: paddle.reader / compat / device / sysconfig /
+hub / dataset (reference python/paddle/{reader,compat,device,sysconfig,
+hub}.py and python/paddle/dataset/).
+"""
+import gzip
+import io
+import os
+import pickle
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import reader as R
+from paddle_tpu.dataset import common as dcommon
+
+
+# -- reader decorators ------------------------------------------------------
+
+def c10():
+    return iter(range(10))
+
+
+def test_reader_basics():
+    assert list(R.firstn(c10, 3)()) == [0, 1, 2]
+    assert list(R.chain(c10, c10)()) == list(range(10)) * 2
+    assert sorted(R.shuffle(c10, 4)()) == list(range(10))
+    assert list(R.buffered(c10, 2)()) == list(range(10))
+    assert list(R.map_readers(lambda a, b: a + b, c10, c10)()) \
+        == [2 * i for i in range(10)]
+
+
+def test_reader_cache_replays():
+    calls = []
+
+    def creator():
+        calls.append(1)
+        return iter(range(5))
+
+    cached = R.cache(creator)
+    assert list(cached()) == list(range(5))
+    assert list(cached()) == list(range(5))
+    assert len(calls) == 1  # second pass came from memory
+
+
+def test_reader_compose_alignment():
+    assert list(R.compose(c10, c10)()) == [(i, i) for i in range(10)]
+    # flattening: tuple outputs splice, scalars wrap
+    two = R.compose(lambda: iter([(1, 2)]), lambda: iter([3]))
+    assert list(two()) == [(1, 2, 3)]
+    with pytest.raises(R.ComposeNotAligned):
+        list(R.compose(c10, lambda: iter(range(5)))())
+    # check_alignment=False truncates instead
+    out = list(R.compose(c10, lambda: iter(range(5)),
+                         check_alignment=False)())
+    assert len(out) == 5
+
+
+def test_reader_xmap_ordered_and_not():
+    doubled = [i * 2 for i in range(10)]
+    assert sorted(R.xmap_readers(lambda x: x * 2, c10, 3, 4)()) == doubled
+    assert list(R.xmap_readers(lambda x: x * 2, c10, 3, 4,
+                               order=True)()) == doubled
+
+
+def test_reader_multiprocess_merge():
+    out = sorted(R.multiprocess_reader([c10, c10])())
+    assert out == sorted(list(range(10)) * 2)
+    with pytest.raises(ValueError):
+        R.multiprocess_reader([])
+
+
+def _boom_reader():
+    yield 1
+    raise RuntimeError("shard corrupt")
+
+
+def test_reader_worker_errors_propagate():
+    # a failing mapper must raise in the consumer, not deadlock
+    with pytest.raises(ZeroDivisionError):
+        list(R.xmap_readers(lambda x: 1 // x,
+                            lambda: iter([1, 0, 2]), 2, 4)())
+    # a failing source reader must raise too (feed-side path)
+    with pytest.raises(RuntimeError, match="shard corrupt"):
+        list(R.xmap_readers(lambda x: x, _boom_reader, 2, 4)())
+    # buffered / multiprocess must NOT truncate silently
+    with pytest.raises(RuntimeError, match="shard corrupt"):
+        list(R.buffered(_boom_reader, 2)())
+    with pytest.raises(RuntimeError, match="shard corrupt"):
+        list(R.multiprocess_reader([c10, _boom_reader])())
+
+
+def test_reader_cache_discards_abandoned_pass():
+    cached = R.cache(lambda: iter(range(5)))
+    next(iter(cached()))  # abandon after one sample
+    assert list(cached()) == list(range(5))  # full pass, no duplicates
+    assert list(cached()) == list(range(5))  # replay from memory
+
+
+# -- compat -----------------------------------------------------------------
+
+def test_compat_text_bytes_round():
+    C = pt.compat
+    assert C.to_text(b"abc") == "abc"
+    assert C.to_text(["a", b"b"]) == ["a", "b"]
+    assert C.to_text({b"k": b"v"}) == {"k": "v"}
+    assert C.to_bytes("abc") == b"abc"
+    assert C.to_bytes({"a", "b"}) == {b"a", b"b"}
+    lst = [b"x"]
+    assert C.to_text(lst, inplace=True) is lst and lst == ["x"]
+    # py2-style half-away-from-zero (python3's round(2.5) == 2)
+    assert C.round(2.5) == 3.0
+    assert C.round(-2.5) == -3.0
+    assert C.round(2.345, 2) == 2.35
+    assert C.floor_division(7, 2) == 3
+    assert C.get_exception_message(ValueError("boom")) == "boom"
+
+
+# -- device / sysconfig -----------------------------------------------------
+
+def test_device_namespace():
+    D = pt.device
+    assert D.get_cudnn_version() is None
+    assert D.is_compiled_with_npu() is False
+    assert D.is_compiled_with_xpu() is False
+    assert D.is_compiled_with_rocm() is False
+    assert isinstance(D.get_device(), str)
+    assert D.set_device is pt.set_device
+
+
+def test_sysconfig_paths():
+    inc = pt.sysconfig.get_include()
+    assert os.path.isfile(os.path.join(inc, "paddle_tpu_c.h"))
+    assert os.path.basename(pt.sysconfig.get_lib()) == "_build"
+
+
+# -- hub --------------------------------------------------------------------
+
+def test_hub_local(tmp_path):
+    with open(tmp_path / "hubconf.py", "w") as f:
+        f.write("dependencies = ['os']\n"
+                "def net(scale=1):\n"
+                "    'builds a net'\n"
+                "    return scale * 2\n"
+                "def _hidden():\n"
+                "    pass\n")
+    d = str(tmp_path)
+    assert pt.hub.list(d, source="local") == ["net"]
+    assert pt.hub.help(d, "net", source="local") == "builds a net"
+    assert pt.hub.load(d, "net", source="local", scale=3) == 6
+    with pytest.raises(RuntimeError, match="Cannot find callable"):
+        pt.hub.load(d, "missing", source="local")
+    with pytest.raises(ValueError, match="Unknown source"):
+        pt.hub.list(d, source="bitbucket")
+
+
+def test_hub_missing_deps(tmp_path):
+    with open(tmp_path / "hubconf.py", "w") as f:
+        f.write("dependencies = ['not_a_real_module_xyz']\n")
+    with pytest.raises(RuntimeError, match="Missing dependencies"):
+        pt.hub.list(str(tmp_path), source="local")
+
+
+def test_hub_remote_is_gated(tmp_path):
+    with pytest.raises(RuntimeError, match="no.*egress|cache miss"):
+        pt.hub.load("owner/repo", "net", source="github")
+
+
+# -- dataset.common ---------------------------------------------------------
+
+@pytest.fixture()
+def data_home(tmp_path, monkeypatch):
+    monkeypatch.setattr(dcommon, "DATA_HOME", str(tmp_path))
+    return tmp_path
+
+
+def test_common_download_gate(data_home):
+    mod = data_home / "mod"
+    mod.mkdir()
+    with pytest.raises(Exception, match="place the file"):
+        dcommon.download("http://x/file.bin", "mod", "")
+    (mod / "file.bin").write_bytes(b"hello")
+    path = dcommon.download("http://x/file.bin", "mod", "")
+    assert path.endswith("file.bin")
+    good = dcommon.md5file(path)
+    assert dcommon.download("http://x/file.bin", "mod", good) == path
+    with pytest.raises(Exception, match="md5"):
+        dcommon.download("http://x/file.bin", "mod", "0" * 32)
+
+
+def test_common_split_and_cluster_reader(data_home, tmp_path):
+    os.chdir(tmp_path)
+    n = dcommon.split(c10, 4, suffix=str(tmp_path / "part-%05d.pickle"))
+    assert n == 3
+    r0 = dcommon.cluster_files_reader(str(tmp_path / "part-*.pickle"), 2, 0)
+    r1 = dcommon.cluster_files_reader(str(tmp_path / "part-*.pickle"), 2, 1)
+    assert sorted(list(r0()) + list(r1())) == list(range(10))
+
+
+# -- dataset.mnist ----------------------------------------------------------
+
+def _write_idx(dirpath, stem, n):
+    imgs = (np.arange(n * 28 * 28) % 255).astype(np.uint8)
+    with gzip.open(os.path.join(dirpath, "%s-images-idx3-ubyte.gz" % stem),
+                   "wb") as f:
+        f.write((2051).to_bytes(4, "big") + n.to_bytes(4, "big")
+                + (28).to_bytes(4, "big") + (28).to_bytes(4, "big")
+                + imgs.tobytes())
+    with gzip.open(os.path.join(dirpath, "%s-labels-idx1-ubyte.gz" % stem),
+                   "wb") as f:
+        f.write((2049).to_bytes(4, "big") + n.to_bytes(4, "big")
+                + bytes(range(n)))
+
+
+def test_legacy_mnist(data_home):
+    d = data_home / "mnist"
+    d.mkdir()
+    _write_idx(str(d), "train", 6)
+    _write_idx(str(d), "t10k", 4)
+    from paddle_tpu.dataset import mnist
+
+    train = list(mnist.train()())
+    test = list(mnist.test()())
+    assert len(train) == 6 and len(test) == 4
+    img, label = train[3]
+    assert img.shape == (784,) and img.dtype == np.float32
+    assert -1.0 <= img.min() and img.max() <= 1.0  # [-1, 1] scaling
+    assert label == 3
+
+
+# -- dataset.cifar ----------------------------------------------------------
+
+def test_legacy_cifar10(data_home):
+    d = data_home / "cifar"
+    d.mkdir()
+    rng = np.random.RandomState(0)
+    path = str(d / "cifar-10-python.tar.gz")
+    with tarfile.open(path, "w:gz") as tf:
+        for name in ["data_batch_%d" % i for i in range(1, 6)] \
+                + ["test_batch"]:
+            batch = {b"data": rng.randint(0, 255, (4, 3072), np.uint8),
+                     b"labels": list(rng.randint(0, 10, 4))}
+            blob = pickle.dumps(batch)
+            info = tarfile.TarInfo("cifar-10-batches-py/" + name)
+            info.size = len(blob)
+            tf.addfile(info, io.BytesIO(blob))
+    from paddle_tpu.dataset import cifar
+
+    train = list(cifar.train10()())
+    test = list(cifar.test10()())
+    assert len(train) == 20 and len(test) == 4
+    img, label = train[0]
+    assert img.shape == (3072,) and img.dtype == np.float32
+    assert img.max() <= 1.0 and 0 <= label <= 9
+
+
+# -- dataset.uci_housing ----------------------------------------------------
+
+def test_legacy_uci_housing(data_home):
+    d = data_home / "uci_housing"
+    d.mkdir()
+    arr = np.random.RandomState(0).rand(20, 14)
+    with open(d / "housing.data", "w") as f:
+        for row in arr:
+            f.write(" ".join("%f" % v for v in row) + "\n")
+    from paddle_tpu.dataset import uci_housing
+
+    uci_housing._cache.clear()
+    train = list(uci_housing.train()())
+    test = list(uci_housing.test()())
+    assert len(train) == 16 and len(test) == 4  # 80/20 cut
+    feats, price = train[0]
+    assert feats.shape == (13,) and price.shape == (1,)
+    # features are mean-centered over the FULL file
+    all_feats = np.stack([s[0] for s in train + test])
+    assert abs(all_feats.mean()) < 0.2
+
+
+# -- dataset.imdb -----------------------------------------------------------
+
+def _add_text(tf, name, text):
+    data = text.encode("utf-8")
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tf.addfile(info, io.BytesIO(data))
+
+
+def test_legacy_imdb(data_home):
+    d = data_home / "imdb"
+    d.mkdir()
+    with tarfile.open(d / "aclImdb_v1.tar.gz", "w:gz") as tf:
+        for i in range(3):
+            _add_text(tf, "aclImdb/train/pos/%d.txt" % i,
+                      "great movie, really great!")
+            _add_text(tf, "aclImdb/train/neg/%d.txt" % i,
+                      "bad movie, really bad.")
+            _add_text(tf, "aclImdb/test/pos/%d.txt" % i, "great really")
+            _add_text(tf, "aclImdb/test/neg/%d.txt" % i, "bad really")
+    from paddle_tpu.dataset import imdb
+
+    word_idx = imdb.build_dict(
+        __import__("re").compile(r"aclImdb/train/.*\.txt$"), 2)
+    # punctuation stripped, freq > cutoff kept, <unk> last
+    assert b"great" in word_idx and b"movie" in word_idx
+    assert word_idx[b"<unk>"] == len(word_idx) - 1
+    train = list(imdb.train(word_idx)())
+    assert len(train) == 6
+    # legacy label convention: pos=0 then neg=1
+    assert [label for _, label in train] == [0, 0, 0, 1, 1, 1]
+    ids, _ = train[0]
+    assert all(isinstance(i, int) for i in ids)
+
+
+# -- dataset.imikolov -------------------------------------------------------
+
+def test_legacy_imikolov(data_home):
+    d = data_home / "imikolov"
+    d.mkdir()
+    lines = "the cat sat\nthe dog sat\n"
+    with tarfile.open(d / "simple-examples.tgz", "w:gz") as tf:
+        for split in ("train", "valid"):
+            _add_text(tf, "./simple-examples/data/ptb.%s.txt" % split, lines)
+    from paddle_tpu.dataset import imikolov
+
+    word_idx = imikolov.build_dict(min_word_freq=1)
+    assert b"<unk>" in word_idx and b"the" in word_idx
+    grams = list(imikolov.train(word_idx, 3)())
+    # each 5-token line (<s> w w w <e>) gives three 3-grams
+    assert len(grams) == 6 and all(len(g) == 3 for g in grams)
+    seqs = list(imikolov.train(word_idx, -1,
+                               imikolov.DataType.SEQ)())
+    src, trg = seqs[0]
+    assert src[0] == word_idx[b"<s>"] and trg[-1] == word_idx[b"<e>"]
+
+
+# -- dataset.image ----------------------------------------------------------
+
+def test_legacy_image_helpers():
+    from paddle_tpu.dataset import image as I
+
+    im = np.arange(40 * 30 * 3, dtype=np.uint8).reshape(40, 30, 3)
+    r = I.resize_short(im, 20)
+    assert min(r.shape[:2]) == 20
+    assert I.to_chw(im).shape == (3, 40, 30)
+    assert I.center_crop(im, 16).shape == (16, 16, 3)
+    assert I.random_crop(im, 16).shape == (16, 16, 3)
+    assert np.array_equal(I.left_right_flip(im), im[:, ::-1, :])
+    out = I.simple_transform(im, 24, 16, is_train=False,
+                             mean=[1.0, 2.0, 3.0])
+    assert out.shape == (3, 16, 16) and out.dtype == np.float32

@@ -91,6 +91,9 @@ def main() -> int:
     import paddle_tpu.static
     import paddle_tpu.text
     import paddle_tpu.utils
+    import paddle_tpu.device
+    import paddle_tpu.hub
+    import paddle_tpu.sysconfig
     import paddle_tpu.vision
 
     audits = [
@@ -122,6 +125,9 @@ def main() -> int:
         ("inference/__init__.py", pt.inference, "paddle.inference"),
         ("onnx/__init__.py", pt.onnx, "paddle.onnx"),
         ("utils/__init__.py", pt.utils, "paddle.utils"),
+        ("device.py", pt.device, "paddle.device"),
+        ("sysconfig.py", pt.sysconfig, "paddle.sysconfig"),
+        ("hub.py", pt.hub, "paddle.hub"),
     ]
     total_missing = 0
     for ref_file, mod, label in audits:

@@ -29,8 +29,7 @@ def _lock_free() -> bool:
     briefly acquiring it) — probing the accelerator transport while a
     bench run owns the chip is the documented tunnel-wedge scenario."""
     import fcntl
-    sys.path.insert(0, os.path.join(
-        os.path.dirname(os.path.abspath(__file__)), ".."))
+
     from bench import _LOCKFILE
     fd = os.open(_LOCKFILE, os.O_CREAT | os.O_RDWR)
     try:
@@ -71,16 +70,17 @@ def _captured() -> set:
     """(fmt, s2d) combos already successfully recorded.
 
     Only counts legs measured under the current accounting
-    (``mfu_convention == 2``, set by resnet_perf.measure_leg): legs from
-    before the 2-FLOPs-per-MAC fix understate MFU 2x and must be
-    re-measured, not skipped."""
+    (``mfu_convention`` == bench.RESNET_MFU_CONVENTION, stamped by
+    resnet_perf.leg_dict): legs from before the 2-FLOPs-per-MAC fix
+    understate MFU 2x and must be re-measured, not skipped."""
+    from bench import RESNET_MFU_CONVENTION
     got = set()
     try:
         with open(OUT) as f:
             for line in f:
                 d = json.loads(line)
                 if ("error" not in d and "fmt" in d
-                        and d.get("mfu_convention") == 2):
+                        and d.get("mfu_convention") == RESNET_MFU_CONVENTION):
                     got.add((d["fmt"], bool(d.get("s2d"))))
     except FileNotFoundError:
         pass

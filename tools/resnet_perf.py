@@ -20,8 +20,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import numpy as np
 
-from bench import (RESNET50_FWD_FLOPS, _acquire_chip_lock, _peak_flops,
-                   _time_steps, wrap_resnet_remat)
+from bench import (RESNET_MFU_CONVENTION, _acquire_chip_lock, _peak_flops,
+                   _time_steps, resnet50_mfu, wrap_resnet_remat)
 
 
 def build_step(pt, fmt, amp, classes=1000, remat=False, s2d=False):
@@ -53,15 +53,15 @@ def build_step(pt, fmt, amp, classes=1000, remat=False, s2d=False):
 def leg_dict(fmt, amp, batch, s2d, remat, dt, peak):
     """The one leg-record shape (sweep, measure_leg, grabber all use it).
 
-    mfu_convention=2 marks legs recorded after the 2-FLOPs-per-MAC
-    accounting fix (and the iters=12 fetch amortization); consumers —
-    e.g. grab_resnet_onchip._captured — reject older-convention records
-    by its absence."""
+    MFU comes from bench.resnet50_mfu — the same formula and
+    mfu_convention stamp as bench_resnet50's records, so history
+    consumers (e.g. grab_resnet_onchip._captured, which rejects
+    stale-convention lines by the marker) see one convention."""
     return {"fmt": fmt, "amp": amp, "batch": batch, "s2d": s2d,
             "remat": remat, "step_s": round(dt, 5),
             "imgs_per_sec": round(batch / dt, 1),
-            "mfu": round(3 * RESNET50_FWD_FLOPS * batch / dt / peak, 4),
-            "mfu_convention": 2}
+            "mfu": round(resnet50_mfu(batch, dt, peak), 4),
+            "mfu_convention": RESNET_MFU_CONVENTION}
 
 
 def measure_leg(pt, jax, fmt, amp, batch, s2d=False, remat=False,
