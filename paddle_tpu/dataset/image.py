@@ -138,9 +138,11 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
 
     out_path = "%s_batch" % data_file
     meta_file = os.path.join(out_path, "%s_batch.meta" % dataset_name)
-    if os.path.exists(out_path):
+    # the meta file is written LAST, so its presence means a complete
+    # build; a bare directory from a crashed run is rebuilt, not trusted
+    if os.path.exists(meta_file):
         return meta_file
-    os.makedirs(out_path)
+    os.makedirs(out_path, exist_ok=True)
 
     labels, data, file_id = [], [], 0
     with tarfile.open(data_file) as tf:
@@ -162,8 +164,8 @@ def batch_images_from_tar(data_file, dataset_name, img2label,
                                % (dataset_name, file_id)), "wb") as f:
             pickle.dump(output, f, protocol=2)
 
-    with open(meta_file, "a") as meta:
-        for file in os.listdir(out_path):
+    with open(meta_file, "w") as meta:  # "w": a rebuild must not append
+        for file in sorted(os.listdir(out_path)):
             if not file.endswith(".meta"):
                 meta.write(os.path.abspath(
                     os.path.join(out_path, file)) + "\n")

@@ -286,6 +286,11 @@ _BINOPS = {
     "__mod__": "mod", "__pow__": "pow", "__matmul__": "matmul",
     "__eq__": "equal", "__ne__": "not_equal", "__lt__": "less_than",
     "__le__": "less_equal", "__gt__": "greater_than", "__ge__": "greater_equal",
+    # bitwise dunders (math_op_patch.py parity): on bool tensors these are
+    # the composable logical connectives (used by converted control flow)
+    "__and__": "bitwise_and", "__rand__": "bitwise_and",
+    "__or__": "bitwise_or", "__ror__": "bitwise_or",
+    "__xor__": "bitwise_xor", "__rxor__": "bitwise_xor",
 }
 
 

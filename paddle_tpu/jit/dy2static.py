@@ -87,6 +87,22 @@ def _rt_while(cond_fn, body_fn, carry):
     return carry
 
 
+def _rt_range3(start, stop, step):
+    """Normalize ``range()`` bounds for a converted ``for`` loop.
+
+    When any bound is a traced value, the python numbers among them are
+    promoted to arrays so the while_loop carry keeps ONE dtype across
+    iterations (``i = 0`` then ``i += step_tensor`` would otherwise
+    change the carry structure between trace passes)."""
+    vals = (start, stop, step)
+    if any(_is_tensorish(x) for x in vals):
+        import jax.numpy as jnp
+
+        vals = tuple(x if _is_tensorish(x) else jnp.asarray(x)
+                     for x in vals)
+    return vals
+
+
 # ---------------------------------------------------------------------------
 # scope analysis (never descends into nested function/class bodies)
 # ---------------------------------------------------------------------------
@@ -171,6 +187,36 @@ def _convertible_body(stmts) -> bool:
     return not any(isinstance(n, _BANNED) for n in _shallow_walk(stmts))
 
 
+def _definite_binds(s) -> Set[str]:
+    """Names statement ``s`` binds on EVERY control path through it
+    (loops may run zero times -> nothing; if needs both branches)."""
+    if isinstance(s, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+        return _assigned_names([s])
+    if isinstance(s, ast.If) and s.orelse:
+        return (_definite_binds_block(s.body)
+                & _definite_binds_block(s.orelse))
+    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return {s.name}
+    if isinstance(s, (ast.Import, ast.ImportFrom)):
+        return {(a.asname or a.name).split(".")[0] for a in s.names}
+    if isinstance(s, ast.With):
+        names = _definite_binds_block(s.body)
+        for item in s.items:
+            if item.optional_vars is not None:
+                names |= _assigned_names([ast.Assign(
+                    targets=[item.optional_vars],
+                    value=ast.Constant(value=None))])
+        return names
+    return set()
+
+
+def _definite_binds_block(stmts) -> Set[str]:
+    out: Set[str] = set()
+    for s in stmts:
+        out |= _definite_binds(s)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # the transformer
 # ---------------------------------------------------------------------------
@@ -190,34 +236,54 @@ class _CtrlFlowTransformer:
     the loop — instead of every body temporary (which would be unbound at
     loop entry)."""
 
-    def __init__(self, local_names: Set[str]):
+    def __init__(self, local_names: Set[str], arg_names: Set[str]):
         self.locals = set(local_names)
+        # names definitely bound at function entry; transform_block threads
+        # a definitely-bound set past each statement so loop conversion can
+        # refuse a carry that would be unbound at loop entry
+        self.entry_bound = set(arg_names)
         self.n = 0
 
     def _tuple(self, names, ctx) -> ast.expr:
         return ast.Tuple(
             elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
 
-    def transform_block(self, stmts: List[ast.stmt]) -> List[ast.stmt]:
+    def transform_block(self, stmts: List[ast.stmt],
+                        bound: Set[str] = None) -> List[ast.stmt]:
+        """``bound``: names DEFINITELY bound before the first statement
+        (function args at top level). Threaded past each statement —
+        conservatively: compound statements contribute nothing, converted
+        if/while/for contribute the names their call assigns — so loop
+        conversion can refuse a carry unbound at loop entry."""
+        bound = set(self.entry_bound if bound is None else bound)
         out: List[ast.stmt] = []
         for idx, s in enumerate(stmts):
             succ = stmts[idx + 1:]
             if isinstance(s, ast.If):
-                out.extend(self._transform_if(s))
+                out.extend(self._transform_if(s, bound))
+                bound |= _definite_binds(s)
             elif isinstance(s, ast.While):
-                out.extend(self._transform_while(s, succ))
+                out.extend(self._transform_while(s, succ, bound))
+                bound |= _definite_binds(s)
+            elif isinstance(s, ast.For) and \
+                    (lowered := self._lower_for_range(s, succ,
+                                                      bound)) is not None:
+                out.extend(lowered)
+                bound |= _definite_binds(s)
             else:
                 for field in ("body", "orelse", "finalbody"):
                     sub = getattr(s, field, None)
                     if isinstance(sub, list) and sub and isinstance(
                             sub[0], ast.stmt):
-                        setattr(s, field, self.transform_block(sub))
+                        setattr(s, field, self.transform_block(sub, bound))
                 out.append(s)
+                bound |= _definite_binds(s)
         return out
 
-    def _transform_if(self, node: ast.If) -> List[ast.stmt]:
-        node.body = self.transform_block(node.body)
-        node.orelse = self.transform_block(node.orelse)
+    def _transform_if(self, node: ast.If,
+                      bound: Set[str] = None) -> List[ast.stmt]:
+        node.body = self.transform_block(node.body, bound)
+        node.orelse = self.transform_block(node.orelse, bound)
         if not (_convertible_body(node.body)
                 and _convertible_body(node.orelse)):
             return [node]
@@ -251,9 +317,91 @@ class _CtrlFlowTransformer:
                            args=call_args, keywords=[]))
         return defs + [call]
 
+    def _lower_for_range(self, node: ast.For, successors,
+                         bound: Set[str] = None):
+        """``for i in range(...)`` -> hidden-counter ``while`` (then the
+        while conversion makes it a lax.while_loop when the bounds are
+        traced).  The counter is hidden so body writes to the target do
+        not perturb iteration, matching python ``for`` semantics; the
+        target keeps its last value after the loop (and is pre-seeded
+        with ``start`` so a zero-trip loop leaves it defined — a
+        documented delta from python, which leaves it unbound).  Returns
+        None (leave untouched) for non-range iterables, starred/keyword
+        args, tuple targets, or bodies with break/continue/return.
+
+        Reference: the for→while transformer of
+        ``dygraph_to_static/loop_transformer.py:52``."""
+        it = node.iter
+        if not (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and not any(isinstance(a, ast.Starred) for a in it.args)
+                and isinstance(node.target, ast.Name)
+                and _convertible_body(node.body)):
+            return None
+        args = list(it.args)
+        if len(args) == 1:
+            start, stop = ast.Constant(value=0), args[0]
+            step = ast.Constant(value=1)
+        elif len(args) == 2:
+            (start, stop), step = args, ast.Constant(value=1)
+        else:
+            start, stop, step = args
+        self.n += 1
+        i = self.n
+        cnt, stop_n, step_n = ("__pt_fi_%d" % i, "__pt_fstop_%d" % i,
+                               "__pt_fstep_%d" % i)
+        # generated names must count as locals so the while conversion
+        # includes them in its carry/parameter analysis
+        self.locals |= {cnt, stop_n, step_n}
+        pre = [ast.Assign(
+            targets=[self._tuple([cnt, stop_n, step_n], ast.Store)],
+            value=ast.Call(
+                func=ast.Name(id="__pt_rt_range3", ctx=ast.Load()),
+                args=[start, stop, step], keywords=[])),
+            # pre-seed the target so it is bound even for zero-trip loops
+            # (lets the while conversion carry it when read after the loop)
+            ast.Assign(targets=[ast.Name(id=node.target.id,
+                                         ctx=ast.Store())],
+                       value=ast.Name(id=cnt, ctx=ast.Load()))]
+
+        def cmp(op, a, b):
+            return ast.Compare(left=ast.Name(id=a, ctx=ast.Load()),
+                               ops=[op()],
+                               comparators=[b if isinstance(b, ast.expr)
+                                            else ast.Name(id=b,
+                                                          ctx=ast.Load())])
+
+        # ((step > 0) & (i < stop)) | ((step < 0) & (i > stop)) — bitwise
+        # ops so traced scalars compose; python bools are ints, same result
+        test = ast.BinOp(
+            left=ast.BinOp(left=cmp(ast.Gt, step_n, ast.Constant(value=0)),
+                           op=ast.BitAnd(), right=cmp(ast.Lt, cnt, stop_n)),
+            op=ast.BitOr(),
+            right=ast.BinOp(left=cmp(ast.Lt, step_n, ast.Constant(value=0)),
+                            op=ast.BitAnd(),
+                            right=cmp(ast.Gt, cnt, stop_n)))
+        body = ([ast.Assign(targets=[ast.Name(id=node.target.id,
+                                              ctx=ast.Store())],
+                            value=ast.Name(id=cnt, ctx=ast.Load()))]
+                + list(node.body)
+                + [ast.AugAssign(target=ast.Name(id=cnt, ctx=ast.Store()),
+                                 op=ast.Add(),
+                                 value=ast.Name(id=step_n, ctx=ast.Load()))])
+        wh = ast.While(test=test, body=body, orelse=[])
+        post = list(node.orelse)  # no break in convertible bodies, so the
+        #                           else clause always runs, after the loop
+        inner_bound = None if bound is None else (
+            set(bound) | {cnt, stop_n, step_n, node.target.id})
+        return (pre
+                + self._transform_while(wh, post + list(successors),
+                                        inner_bound)
+                + self.transform_block(post, inner_bound))
+
     def _transform_while(self, node: ast.While,
-                         successors: List[ast.stmt]) -> List[ast.stmt]:
-        node.body = self.transform_block(node.body)
+                         successors: List[ast.stmt],
+                         bound: Set[str] = None) -> List[ast.stmt]:
+        node.body = self.transform_block(node.body, bound)
         if node.orelse or not _convertible_body(node.body):
             return [node]
         assigned = _user_names(_assigned_names(node.body))
@@ -265,6 +413,13 @@ class _CtrlFlowTransformer:
                           & self.locals))
         if not (assigned & live):
             return [node]  # nothing loop-carried: leave untouched
+        if bound is not None and not set(carry) <= set(bound):
+            # a carry name first assigned INSIDE the loop and read after it
+            # has no pre-loop value to seed the while_loop carry with; a
+            # converted loop would hit UnboundLocalError building the
+            # initial carry tuple. Left unconverted: the tracer error (with
+            # the define-before-loop rewrite hint) is the honest outcome.
+            return [node]
         self.n += 1
         i = self.n
         cname, bname = "__pt_wcond_%d" % i, "__pt_wbody_%d" % i
@@ -351,14 +506,14 @@ def convert(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ConversionError("source of %r is not a function def" % (fn,))
     fdef.decorator_list = []  # @to_static etc. must not re-wrap
-    local_names = _assigned_names(fdef.body) | {
-        a.arg for a in (fdef.args.posonlyargs + fdef.args.args
-                        + fdef.args.kwonlyargs)}
+    arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
+                                 + fdef.args.kwonlyargs)}
     if fdef.args.vararg:
-        local_names.add(fdef.args.vararg.arg)
+        arg_names.add(fdef.args.vararg.arg)
     if fdef.args.kwarg:
-        local_names.add(fdef.args.kwarg.arg)
-    tr = _CtrlFlowTransformer(local_names)
+        arg_names.add(fdef.args.kwarg.arg)
+    local_names = _assigned_names(fdef.body) | arg_names
+    tr = _CtrlFlowTransformer(local_names, arg_names)
     fdef.body = tr.transform_block(fdef.body)
     te = _IfExpTransformer()
     te.visit(fdef)
@@ -372,6 +527,7 @@ def convert(fn: Callable) -> Callable:
     glb = dict(inner.__globals__)
     glb["__pt_rt_cond"] = _rt_cond
     glb["__pt_rt_while"] = _rt_while
+    glb["__pt_rt_range3"] = _rt_range3
     loc: dict = {}
     exec(code, glb, loc)  # noqa: S102 - recompiling user fn, the reference
     new_fn = loc[fdef.name]  # ast_transformer.py does the same via exec

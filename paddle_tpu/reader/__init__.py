@@ -36,22 +36,26 @@ class _RaisedInWorker:
 def cache(reader):
     """Cache the first COMPLETE pass in memory; later passes replay it.
 
-    An abandoned first pass (early break, firstn) is discarded rather
-    than memoized, so a later full pass cannot replay duplicated leading
-    samples. Reference: ``reader/decorator.py:52``.
+    Each running pass fills its own local buffer and commits only on
+    completion, so an abandoned pass (early break, firstn) or two
+    interleaved iterations (the same cached reader zipped with itself)
+    can never memoize duplicated or dropped samples.
+    Reference: ``reader/decorator.py:52``.
     """
     memory = []
     filled = []
 
     def cached():
-        if not filled:
-            memory.clear()  # drop any abandoned partial pass
-            for item in reader():
-                memory.append(item)
-                yield item
-            filled.append(True)
-        else:
+        if filled:
             yield from memory
+            return
+        local = []
+        for item in reader():
+            local.append(item)
+            yield item
+        if not filled:  # first COMPLETE pass wins
+            memory[:] = local
+            filled.append(True)
 
     return cached
 

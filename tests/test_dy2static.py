@@ -218,3 +218,89 @@ def test_ternary_inside_while():
             a = a * 3.0 if a.sum() < 5.0 else a + 4.0
         return a
     np.testing.assert_allclose(got, ref(x.astype(np.float64)), rtol=1e-6)
+
+
+def test_converted_for_range_tensor_stop():
+    def f(x):
+        n = pt.tensor.cast(pt.tensor.sum(x), "int32")  # data-dependent
+        s = x * 0.0
+        for i in range(n):
+            s = s + x + pt.tensor.cast(i, "float32") * 0.0
+        return s
+
+    sf = to_static(f)
+    for v in ([2.0, 1.0], [1.0, 1.0]):  # trip counts 3 and 2
+        x = np.asarray(v, np.float32)
+        got = np.asarray(sf(pt.to_tensor(x)).value)
+        np.testing.assert_allclose(got, x * x.sum(), rtol=1e-6)
+    assert getattr(sf._function, "__dy2static_converted__", False)
+
+
+def test_converted_for_range_start_stop_step():
+    def f(x):
+        n = pt.tensor.cast(pt.tensor.sum(x), "int32")
+        acc = pt.tensor.cast(x[0] * 0, "int32")
+        for i in range(1, n, 2):  # 1, 3, 5, ... < n
+            acc = acc + i
+        return acc
+
+    sf = to_static(f)
+    x = np.asarray([4.0, 4.0], np.float32)  # n=8 -> 1+3+5+7 = 16
+    assert int(sf(pt.to_tensor(x)).value) == 16
+
+
+def test_for_target_reads_inside_body():
+    def f(x):
+        n = pt.tensor.cast(pt.tensor.sum(x), "int32")
+        s = pt.tensor.cast(x[0] * 0, "int32")
+        for i in range(n):
+            s = s + i * i
+        return s
+
+    sf = to_static(f)
+    x = np.asarray([2.0, 2.0], np.float32)  # n=4 -> 0+1+4+9 = 14
+    assert int(sf(pt.to_tensor(x)).value) == 14
+
+
+def test_python_for_range_still_unrolls():
+    def f(x):
+        if pt.tensor.sum(x) > 0:  # forces the conversion retry
+            y = x * 1.0
+        else:
+            y = x * -1.0
+        for i in range(3):  # static range: still correct after conversion
+            y = y + 1.0
+        return y
+
+    sf = to_static(f)
+    x = np.asarray([1.0, 2.0], np.float32)
+    np.testing.assert_allclose(np.asarray(sf(pt.to_tensor(x)).value),
+                               x + 3.0, rtol=1e-6)
+
+
+def test_for_target_read_after_loop():
+    def f(x):
+        n = pt.tensor.cast(pt.tensor.sum(x), "int32")
+        s = x * 0.0
+        for i in range(n):
+            s = s + x
+        return s + pt.tensor.cast(i, "float32")  # target read after loop
+
+    sf = to_static(f)
+    x = np.asarray([2.0, 1.0], np.float32)  # n=3 -> i ends at 2
+    got = np.asarray(sf(pt.to_tensor(x)).value)
+    np.testing.assert_allclose(got, x * 3 + 2.0, rtol=1e-6)
+
+
+def test_while_body_fresh_var_read_after_falls_back():
+    # `t` is first assigned INSIDE the loop and read after it: there is no
+    # pre-loop carry value, so conversion must refuse (hint, not a
+    # misleading UnboundLocalError)
+    def f(x):
+        while pt.tensor.sum(x) < 10.0:
+            t = x * 2.0
+            x = t
+        return t
+
+    with pytest.raises(RuntimeError, match="cond|while_loop|hoist"):
+        to_static(f)(pt.to_tensor(np.asarray([1.0], np.float32)))

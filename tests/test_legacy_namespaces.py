@@ -98,6 +98,14 @@ def test_reader_cache_discards_abandoned_pass():
     assert list(cached()) == list(range(5))  # replay from memory
 
 
+def test_reader_cache_interleaved_passes():
+    # the same cached reader zipped with itself (what compose/map_readers
+    # produce) must memoize ONE clean pass, not an interleaved mixture
+    cached = R.cache(lambda: iter([1, 2, 3]))
+    assert list(zip(cached(), cached())) == [(1, 1), (2, 2), (3, 3)]
+    assert list(cached()) == [1, 2, 3]
+
+
 # -- compat -----------------------------------------------------------------
 
 def test_compat_text_bytes_round():

@@ -180,3 +180,18 @@ class TestRandomOps:
         probs = pt.to_tensor([0.1, 0.2, 0.3, 0.4])
         s = pt.tensor.multinomial(probs, 4, replacement=False)
         assert sorted(_np(s).tolist()) == [0, 1, 2, 3]
+
+
+def test_bitwise_dunders_math_op_patch_parity():
+    # math_op_patch.py parity: &, |, ^ route to bitwise_* (on bool
+    # tensors these are the logical connectives converted control flow
+    # composes); reflected forms coerce the python operand
+    a = pt.to_tensor(np.array([True, False]))
+    b = pt.to_tensor(np.array([True, True]))
+    assert list(np.asarray((a & b).value)) == [True, False]
+    assert list(np.asarray((a | b).value)) == [True, True]
+    assert list(np.asarray((a ^ b).value)) == [False, True]
+    assert list(np.asarray((~a).value)) == [False, True]
+    x = pt.to_tensor(np.array([6, 3]))
+    assert list(np.asarray((x & 2).value)) == [2, 2]
+    assert list(np.asarray((2 | x).value)) == [6, 3]
