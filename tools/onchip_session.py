@@ -1,0 +1,122 @@
+"""One-window on-chip measurement session for a flapping tunnel.
+
+Probes the accelerator (lock-aware); when it answers, runs the round's
+remaining on-chip work, each phase as a killable subprocess with its own
+timeout and durable completion marker, so a window too short for
+everything still banks whatever finished:
+
+1. resnet grab  — tools/grab_resnet_onchip.py --measure-once
+                  (done when its jsonl holds all 3 layout configs)
+2. full bench   — bench.py (banks TPU_MEASUREMENT.json + history;
+                  done when the stored record's git_rev is HEAD)
+3. bert sweep   — tools/bert_sweep.py 40 48 56 64 80 (knee hunt past
+                  batch 48; output banked to tools/bert_sweep_onchip.log)
+
+Run:  python tools/onchip_session.py [--max-wait 10800]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, HERE)
+sys.path.insert(0, REPO)
+
+from grab_resnet_onchip import CONFIGS, _captured, probe  # noqa: E402
+
+SWEEP_LOG = os.path.join(HERE, "bert_sweep_onchip.log")
+SWEEP_BATCHES = ["40", "48", "56", "64", "80"]
+
+
+def _head_rev() -> str:
+    try:
+        return subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                              cwd=REPO, capture_output=True,
+                              text=True).stdout.strip()
+    except Exception:  # noqa: BLE001
+        return ""
+
+
+def grab_done() -> bool:
+    return len(_captured()) >= len(CONFIGS)
+
+
+def bench_done() -> bool:
+    try:
+        with open(os.path.join(REPO, "TPU_MEASUREMENT.json")) as f:
+            return json.load(f).get("git_rev") == _head_rev()
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def sweep_done() -> bool:
+    try:
+        with open(SWEEP_LOG) as f:
+            text = f.read()
+        return all(("batch=%s " % b) in text for b in SWEEP_BATCHES)
+    except FileNotFoundError:
+        return False
+
+
+def _run(phase, argv, timeout_s, log_path=None):
+    print("[onchip] %s: %s" % (phase, " ".join(argv)), flush=True)
+    out = open(log_path, "a") if log_path else None
+    try:
+        subprocess.run([sys.executable] + argv, cwd=REPO, timeout=timeout_s,
+                       stdout=out or None, stderr=subprocess.STDOUT
+                       if out else None)
+    except subprocess.TimeoutExpired:
+        print("[onchip] %s timed out (%ds)" % (phase, timeout_s), flush=True)
+    finally:
+        if out:
+            out.close()
+
+
+PHASES = (
+    ("resnet-grab", grab_done,
+     lambda: _run("resnet-grab",
+                  [os.path.join(HERE, "grab_resnet_onchip.py"),
+                   "--measure-once"], 1500)),
+    ("bench", bench_done,
+     lambda: _run("bench", [os.path.join(REPO, "bench.py")], 3000)),
+    ("bert-sweep", sweep_done,
+     lambda: _run("bert-sweep",
+                  [os.path.join(HERE, "bert_sweep.py")] + SWEEP_BATCHES,
+                  1800, log_path=SWEEP_LOG)),
+)
+
+
+def main() -> int:
+    max_wait = 10800.0
+    if "--max-wait" in sys.argv:
+        max_wait = float(sys.argv[sys.argv.index("--max-wait") + 1])
+    deadline = time.time() + max_wait
+    while time.time() < deadline:
+        todo = [name for name, done, _ in PHASES if not done()]
+        if not todo:
+            print("[onchip] all phases banked", flush=True)
+            return 0
+        if probe():
+            print("[onchip] tunnel up; remaining: %s" % todo, flush=True)
+            for name, done, run in PHASES:
+                if not done():
+                    run()
+                    if not done():
+                        # phase failed/timed out: tunnel likely flapped —
+                        # go back to probing rather than burning the rest
+                        # of the window on dead phases
+                        break
+        else:
+            time.sleep(150)
+    print("[onchip] gave up; remaining: %s"
+          % [n for n, done, _ in PHASES if not done()], flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
