@@ -106,6 +106,10 @@ class Config:
         self._enable_memory_optim = True
 
     def switch_ir_optim(self, x: bool = True):
+        """Accepted for parity; XLA always optimizes at compile. The
+        reference's const-fold/conv-bn-fuse ir passes have a save-time
+        analog here: export with ``jit.save(..., params_const=True)`` so
+        weights are program constants XLA can fold through."""
         self._switch_ir_optim = x
 
     def set_cpu_math_library_num_threads(self, n: int):

@@ -542,6 +542,17 @@ def save(layer, path: str, input_spec=None, **config) -> None:
     Writes three files: ``<path>.pdmodel.stablehlo`` (serialized StableHLO
     program via jax.export — the ProgramDesc analog), ``<path>.pdiparams.npz``
     (parameters + persistable buffers), ``<path>.pdmodel.json`` (metadata).
+
+    ``params_const=True`` bakes parameters/buffers into the program as
+    constants instead of runtime arguments. This is the TPU-native
+    analog of the reference's inference fusion/const-fold pass family
+    (``framework/ir/conv_bn_fuse_pass.cc:1`` and friends): with weights
+    constant, XLA's simplifier can fold eval-mode BatchNorm scales into
+    the preceding conv/matmul weights and pre-evaluate every
+    param-only subexpression at compile time — none of which is legal
+    when params arrive as arguments. The artifact is self-contained;
+    ``set_state_dict`` on the loaded layer cannot retarget it (weights
+    live in the program), which ``jit.load`` enforces.
     """
     from jax import export as jax_export
 
@@ -582,21 +593,31 @@ def save(layer, path: str, input_spec=None, **config) -> None:
             binding.swap_out(saved)
         return out_raw
 
+    params_const = bool(config.pop("params_const", False))
+
     was_training = [l.training for l in binding.sublayers]
     if owner is not None:
         owner.eval()
     try:
         # Multi-platform lowering: the artifact must load on any backend
         # (train on TPU, serve on CPU — AnalysisPredictor portability parity).
+        if params_const:
+            # closing over the concrete arrays embeds them as program
+            # constants — the whole point (see docstring)
+            fn_to_export = jax.jit(
+                lambda *args: infer(param_vals, buf_vals, *args))
+            export_specs = tuple(arg_specs)
+        else:
+            fn_to_export = jax.jit(infer)
+            export_specs = (
+                [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals],
+                [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in buf_vals],
+            ) + tuple(arg_specs)
         try:
-            exporter = jax_export.export(jax.jit(infer), platforms=("cpu", "tpu", "cuda"))
+            exporter = jax_export.export(fn_to_export, platforms=("cpu", "tpu", "cuda"))
         except TypeError:  # pragma: no cover - older jax.export signature
-            exporter = jax_export.export(jax.jit(infer))
-        exported = exporter(
-            [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in param_vals],
-            [jax.ShapeDtypeStruct(v.shape, v.dtype) for v in buf_vals],
-            *arg_specs,
-        )
+            exporter = jax_export.export(fn_to_export)
+        exported = exporter(*export_specs)
     finally:
         for l, t in zip(binding.sublayers, was_training):
             l.training = t
@@ -604,8 +625,15 @@ def save(layer, path: str, input_spec=None, **config) -> None:
     os.makedirs(os.path.dirname(os.path.abspath(path)) or ".", exist_ok=True)
     with open(path + _ARTIFACT_SUFFIX, "wb") as f:
         f.write(exported.serialize())
-    arrays = {"param:" + n: np.asarray(v) for n, v in zip(param_names, param_vals)}
-    arrays.update({"buffer:" + n: np.asarray(v) for n, v in zip(buffer_names, buf_vals)})
+    if params_const:
+        # weights already live inside the program; an .npz copy would
+        # double the artifact on disk and, at load, in device memory
+        arrays = {}
+    else:
+        arrays = {"param:" + n: np.asarray(v)
+                  for n, v in zip(param_names, param_vals)}
+        arrays.update({"buffer:" + n: np.asarray(v)
+                       for n, v in zip(buffer_names, buf_vals)})
     np.savez(path + _PARAMS_SUFFIX, **arrays)
     meta = {
         "format": "paddle_tpu.jit/1",
@@ -613,6 +641,7 @@ def save(layer, path: str, input_spec=None, **config) -> None:
         "param_names": param_names,
         "buffer_names": buffer_names,
         "n_inputs": len(arg_specs),
+        "params_const": params_const,
     }
     with open(path + _META_SUFFIX, "w") as f:
         json.dump(meta, f)
@@ -630,6 +659,12 @@ class TranslatedLayer(Layer):
         super().__init__()
         self._exported = exported
         self._meta = meta
+        if meta.get("params_const"):
+            # weights are program constants: registering the (absent) .npz
+            # copies would only duplicate them in device memory
+            self._param_keys = []
+            self._buffer_keys = []
+            return
         self._param_keys = [n.replace(".", "__") for n in meta["param_names"]]
         self._buffer_keys = [n.replace(".", "__") for n in meta["buffer_names"]]
         for key, v in zip(self._param_keys, param_arrays):
@@ -639,11 +674,29 @@ class TranslatedLayer(Layer):
 
     def forward(self, *args):
         raw = [_unwrap(a) for a in args]
+        if self._meta.get("params_const"):
+            # weights live INSIDE the program (jit.save(params_const=True))
+            out = self._exported.call(*raw)
+            return _wrap_outputs(out)
         # read live state so set_state_dict takes effect
         param_vals = [self._parameters[k]._value for k in self._param_keys]
         buf_vals = [self._buffers[k]._value for k in self._buffer_keys]
         out = self._exported.call(param_vals, buf_vals, *raw)
         return _wrap_outputs(out)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        if self._meta.get("params_const"):
+            raise InvalidArgumentError(
+                "this artifact was saved with params_const=True: its "
+                "weights are program constants and cannot be retargeted; "
+                "re-export with params_const=False for a swappable-weights "
+                "artifact")
+        return super().set_state_dict(state_dict, *args, **kwargs)
+
+    # rebind the paddle-parity aliases: the base class binds them to ITS
+    # set_state_dict, which would silently bypass the const-artifact guard
+    set_dict = set_state_dict
+    load_dict = set_state_dict
 
 
 def load(path: str, **config) -> TranslatedLayer:
@@ -654,9 +707,12 @@ def load(path: str, **config) -> TranslatedLayer:
         meta = json.load(f)
     with open(path + _ARTIFACT_SUFFIX, "rb") as f:
         exported = jax_export.deserialize(f.read())
-    data = np.load(path + _PARAMS_SUFFIX)
-    params = [data["param:" + n] for n in meta["param_names"]]
-    buffers = [data["buffer:" + n] for n in meta["buffer_names"]]
+    if meta.get("params_const"):
+        params, buffers = [], []  # weights live inside the program
+    else:
+        data = np.load(path + _PARAMS_SUFFIX)
+        params = [data["param:" + n] for n in meta["param_names"]]
+        buffers = [data["buffer:" + n] for n in meta["buffer_names"]]
     return TranslatedLayer(exported, params, buffers, meta)
 
 

@@ -302,3 +302,86 @@ class TestSaveLoad:
             want = model(paddle.to_tensor(x_np)).numpy()
             got = loaded(paddle.to_tensor(x_np)).numpy()
             np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestParamsConstArtifact:
+    """jit.save(params_const=True): weights baked into the program — the
+    XLA-native analog of the reference's inference const-fold / conv-bn
+    fuse passes (framework/ir/conv_bn_fuse_pass.cc)."""
+
+    def _net(self):
+        paddle.seed(0)
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 8, 3, padding=1)
+                self.bn = nn.BatchNorm2D(8)
+                self.act = nn.ReLU()
+
+            def forward(self, x):
+                return self.act(self.bn(self.conv(x)))
+
+        net = Net()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 16, 16).astype("float32"))
+        net.train()
+        for _ in range(3):  # make BN running stats non-trivial
+            net(x)
+        net.eval()
+        return net, x
+
+    def test_const_artifact_matches_and_is_self_contained(self, tmp_path):
+        net, x = self._net()
+        want = net(x).numpy()
+        pa = str(tmp_path / "args")
+        pc = str(tmp_path / "const")
+        spec = [InputSpec([2, 3, 16, 16], "float32")]
+        paddle.jit.save(net, pa, input_spec=spec)
+        paddle.jit.save(net, pc, input_spec=spec, params_const=True)
+        la, lc = paddle.jit.load(pa), paddle.jit.load(pc)
+        np.testing.assert_allclose(la(x).numpy(), want, rtol=1e-5)
+        np.testing.assert_allclose(lc(x).numpy(), want, rtol=1e-5)
+        # the const program takes ONLY the data input; weights are inside
+        assert len(lc._exported.in_avals) == 1
+        assert len(la._exported.in_avals) > 1
+
+    def test_const_artifact_rejects_retarget(self, tmp_path):
+        net, x = self._net()
+        pc = str(tmp_path / "const")
+        paddle.jit.save(net, pc, input_spec=[
+            InputSpec([2, 3, 16, 16], "float32")], params_const=True)
+        lc = paddle.jit.load(pc)
+        # all three public spellings must hit the guard (set_dict and
+        # load_dict are class-body aliases — rebinding them on the
+        # subclass is what keeps them from bypassing it)
+        with pytest.raises(Exception, match="params_const"):
+            lc.set_state_dict({})
+        with pytest.raises(Exception, match="params_const"):
+            lc.set_dict({})
+        with pytest.raises(Exception, match="params_const"):
+            lc.load_dict({})
+
+    def test_const_artifact_stores_weights_once(self, tmp_path):
+        net, x = self._net()
+        pc = str(tmp_path / "const")
+        paddle.jit.save(net, pc, input_spec=[
+            InputSpec([2, 3, 16, 16], "float32")], params_const=True)
+        # weights live only in the program: no .npz copy, no dead
+        # device-resident Parameters at load
+        data = np.load(pc + ".pdiparams.npz")
+        assert len(data.files) == 0
+        lc = paddle.jit.load(pc)
+        assert lc.state_dict() == {}
+
+    def test_predictor_over_const_artifact(self, tmp_path):
+        from paddle_tpu.inference import Config, create_predictor
+
+        net, x = self._net()
+        want = net(x).numpy()
+        pc = str(tmp_path / "const")
+        paddle.jit.save(net, pc, input_spec=[
+            InputSpec([2, 3, 16, 16], "float32")], params_const=True)
+        pred = create_predictor(Config(pc))
+        out = pred.run([x.numpy()])
+        np.testing.assert_allclose(out[0], want, rtol=1e-5)
