@@ -8,12 +8,13 @@ from __future__ import annotations
 
 import os
 import time
+import warnings
 from typing import List, Optional
 
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "CallbackList"]
+           "EarlyStopping", "ReduceLROnPlateau", "CallbackList"]
 
 
 class Callback:
@@ -84,6 +85,25 @@ class CallbackList:
                 getattr(cb, name)(*args, **kwargs)
 
         return fire
+
+
+def _resolve_mode(monitor: str, mode: str, warn_unknown: bool = False) -> str:
+    """'auto' -> 'max' for accuracy-like monitors else 'min' (the
+    reference's rule, shared by EarlyStopping and ReduceLROnPlateau)."""
+    if mode not in ("auto", "min", "max"):
+        if warn_unknown:
+            warnings.warn("Learning rate reduction mode %s is unknown, "
+                          "fallback to auto mode." % mode)
+        mode = "auto"
+    if mode == "auto":
+        mode = "max" if "acc" in monitor else "min"
+    return mode
+
+
+def _is_better(cur: float, best: float, mode: str, min_delta: float) -> bool:
+    if mode == "min":
+        return cur < best - min_delta
+    return cur > best + min_delta
 
 
 class ProgBarLogger(Callback):
@@ -186,11 +206,7 @@ class EarlyStopping(Callback):
         self.min_delta = abs(min_delta)
         self.baseline = baseline
         self.save_best_model = save_best_model
-        if mode not in ("auto", "min", "max"):
-            mode = "auto"
-        if mode == "auto":
-            mode = "max" if "acc" in monitor else "min"
-        self.mode = mode
+        self.mode = _resolve_mode(monitor, mode)
         self.stopped_epoch = 0
 
     def on_train_begin(self, logs=None):
@@ -200,9 +216,7 @@ class EarlyStopping(Callback):
         self.model.stop_training = False
 
     def _better(self, cur):
-        if self.mode == "min":
-            return cur < self.best - self.min_delta
-        return cur > self.best + self.min_delta
+        return _is_better(cur, self.best, self.mode, self.min_delta)
 
     def on_eval_end(self, logs=None):
         logs = logs or {}
@@ -224,6 +238,87 @@ class EarlyStopping(Callback):
                 if self.verbose:
                     print("Early stopping: %s did not improve beyond %.5f"
                           % (self.monitor, self.best))
+
+
+class ReduceLROnPlateau(Callback):
+    """callbacks.py:956 parity: cut the optimizer lr by ``factor`` after
+    ``patience`` evals without ``min_delta`` improvement on ``monitor``,
+    with a ``cooldown`` before watching again and a ``min_lr`` floor.
+    Works on float learning rates (the reference warns and bails on
+    scheduler-driven lrs; same here — use an lr scheduler instead)."""
+
+    def __init__(self, monitor: str = "loss", factor: float = 0.1,
+                 patience: int = 10, verbose: int = 1, mode: str = "auto",
+                 min_delta: float = 1e-4, cooldown: int = 0,
+                 min_lr: float = 0.0):
+        super().__init__()
+        if factor >= 1.0:
+            raise ValueError(
+                "ReduceLROnPlateau does not support a factor >= 1.0.")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.mode = _resolve_mode(monitor, mode, warn_unknown=True)
+        self.min_delta = abs(min_delta)
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self._reset()
+
+    def _reset(self):
+        self.best = np.inf if self.mode == "min" else -np.inf
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.epoch = 0
+
+    def _better(self, cur):
+        return _is_better(cur, self.best, self.mode, self.min_delta)
+
+    def on_train_begin(self, logs=None):
+        self._reset()
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            warnings.warn(
+                "Monitor of ReduceLROnPlateau should be loss or metric "
+                "name.")
+            return
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is None:
+            return
+        from ..optimizer.lr import LRScheduler as Sched
+
+        if isinstance(getattr(opt, "_learning_rate", None), Sched):
+            warnings.warn("ReduceLROnPlateau expects a float learning "
+                          "rate; the optimizer uses an LRScheduler — use "
+                          "optimizer.lr.ReduceOnPlateau instead.")
+            return
+        if isinstance(cur, (list, tuple)):
+            cur = cur[0]
+        cur = float(cur)
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                old_lr = opt.get_lr()
+                if old_lr > self.min_lr:
+                    new_lr = max(old_lr * self.factor, self.min_lr)
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print("Epoch %d: ReduceLROnPlateau reducing "
+                              "learning rate to %s." % (self.epoch, new_lr))
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
 
 
 class VisualDL(Callback):

@@ -180,3 +180,26 @@ def test_summary_and_flops():
     f = pt.flops(net, (1, 1, 28, 28))
     # conv1: 28*28*6*25 + conv2: 10*10*16*150 + fc MACs ≈ 3.5e5
     assert 3e5 < f < 4e5, f
+
+
+def test_reduce_lr_on_plateau(rng):
+    xs, ys = _clf_data(rng)
+    model = _make_model()
+    lr0 = model._optimizer.get_lr()
+    cb = pt.callbacks.ReduceLROnPlateau(
+        monitor="eval_loss", factor=0.5, patience=1, verbose=0,
+        min_delta=10.0, cooldown=1, min_lr=lr0 * 0.2)
+    # min_delta=10 -> nothing ever "improves": lr halves after patience=1
+    # evals, then again after the cooldown expires, clamped at min_lr
+    model.fit((xs, ys), eval_data=(xs, ys), batch_size=16, epochs=6,
+              verbose=0, callbacks=[cb])
+    lr = model._optimizer.get_lr()
+    assert lr < lr0
+    assert lr >= lr0 * 0.2 - 1e-12  # min_lr floor respected
+
+
+def test_reduce_lr_on_plateau_rejects_bad_factor():
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        pt.callbacks.ReduceLROnPlateau(factor=1.5)
