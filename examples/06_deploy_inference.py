@@ -1,0 +1,134 @@
+"""Deployment walk-through: train → export → serve (python + C).
+
+The export/serve half of the reference's story (train in python, serve
+via AnalysisPredictor / the C API):
+
+1. train a small classifier eagerly;
+2. ``jit.save(..., params_const=True)`` — weights baked into the
+   StableHLO program as constants, the save-time analog of the
+   reference's const-fold/conv-bn-fuse inference passes (XLA folds
+   through constants at serving compile);
+3. serve it from python with ``paddle_tpu.inference`` Config/Predictor;
+4. (``--c-host``) compile and run a real C program against
+   ``libpaddle_tpu_c.so``, header and library located via
+   ``paddle_tpu.sysconfig`` — the full embedded-runtime path.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import argparse
+import subprocess
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.jit import InputSpec
+
+
+def train_and_export(workdir):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(
+        paddle.nn.Conv2D(1, 8, 3, padding=1), paddle.nn.BatchNorm2D(8),
+        paddle.nn.ReLU(), paddle.nn.Flatten(),
+        paddle.nn.Linear(8 * 28 * 28, 10))
+    opt = paddle.optimizer.Adam(1e-3, parameters=net.parameters())
+    loss_fn = paddle.nn.CrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    xs = rng.rand(64, 1, 28, 28).astype("float32")
+    ys = rng.randint(0, 10, (64,)).astype("int64")
+    net.train()
+    for step in range(5):
+        loss = loss_fn(net(paddle.to_tensor(xs)), paddle.to_tensor(ys))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    net.eval()
+    prefix = os.path.join(workdir, "clf")
+    paddle.jit.save(net, prefix,
+                    input_spec=[InputSpec([1, 1, 28, 28], "float32")],
+                    params_const=True)
+    print("exported:", prefix, "(self-contained, const weights)")
+    return net, prefix, xs
+
+
+def serve_python(prefix, x):
+    from paddle_tpu.inference import Config, create_predictor
+
+    pred = create_predictor(Config(prefix))
+    out = pred.run([x])
+    print("python predictor output[0][:5]:", np.asarray(out[0])[0, :5])
+    return out[0]
+
+
+C_HOST = r"""
+#include <stdio.h>
+#include "paddle_tpu_c.h"
+
+int main(int argc, char** argv) {
+  if (PD_Init(argv[1])) return 1;
+  void* p = PD_PredictorCreate(argv[2]);
+  if (!p) return 2;
+  float in[784]; long long shape[4] = {1, 1, 28, 28};
+  for (int i = 0; i < 784; ++i) in[i] = (float)i / 784.0f;
+  float out[10]; long long oshape[8]; int ondim = 0;
+  /* returns 0 on success; positive = required capacity; negative = error */
+  long long rc = PD_PredictorRunFloat(p, in, shape, 4, out, 10, oshape, &ondim);
+  if (rc != 0) return 3;
+  long long n = 1;
+  for (int i = 0; i < ondim; ++i) n *= oshape[i];
+  printf("c host got %lld outputs, first=%f\n", n, out[0]);
+  PD_PredictorDestroy(p);
+  PD_Finalize();
+  return 0;
+}
+"""
+
+
+def serve_c(prefix):
+    import paddle_tpu.capi as capi
+    import paddle_tpu.sysconfig as sysconfig
+
+    so = capi.build()
+    with tempfile.TemporaryDirectory() as d:
+        src = os.path.join(d, "host.c")
+        with open(src, "w") as f:
+            f.write(C_HOST)
+        exe = os.path.join(d, "host")
+        subprocess.run(
+            ["gcc", src, "-I", sysconfig.get_include(),
+             "-L", sysconfig.get_lib(), "-lpaddle_tpu_c",
+             "-Wl,-rpath," + sysconfig.get_lib(), "-o", exe], check=True)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # the embedded interpreter needs the venv site-packages + repo on
+        # sys.path (it does not inherit this process's virtualenv)
+        site = [q for q in sys.path if q.endswith("site-packages")]
+        sys_paths = ":".join([repo] + site)
+        r = subprocess.run([exe, sys_paths, prefix], capture_output=True,
+                           text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                "C host failed (rc=%d)\n%s" % (r.returncode,
+                                                r.stdout + r.stderr))
+        print(r.stdout.strip())
+
+
+def main(c_host=False):
+    with tempfile.TemporaryDirectory() as workdir:
+        net, prefix, xs = train_and_export(workdir)
+        got = serve_python(prefix, xs[:1])
+        want = net(paddle.to_tensor(xs[:1])).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+        print("python predictor matches eager eval")
+        if c_host:
+            serve_c(prefix)
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--c-host", action="store_true",
+                    help="also compile+run the C embedding example")
+    args = ap.parse_args()
+    main(args.c_host)

@@ -31,8 +31,9 @@ void* PD_PredictorCreate(const char* model_prefix);
  *   data/shape/ndim:     input buffer and its dimensions
  *   out/out_capacity:    caller-allocated output buffer (element count)
  *   out_shape/out_ndim:  receive the output dimensions
- * Returns the number of output elements written, or <0 on failure
- * (-1 bad handle, -2..: runtime error, see stderr). */
+ * Returns 0 on success (output in out/out_shape); a POSITIVE value is
+ * the required out_capacity (grow the buffer and retry); negative is an
+ * error (-1 bad handle, -2..-8 runtime errors, details on stderr). */
 long long PD_PredictorRunFloat(void* handle, const float* data,
                                const long long* shape, int ndim, float* out,
                                long long out_capacity, long long* out_shape,

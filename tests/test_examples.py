@@ -1,5 +1,8 @@
-"""The examples/ scripts (BASELINE.md's five configs) must stay runnable:
-each executes as a real subprocess on the 8-device CPU mesh."""
+"""The examples/ scripts (BASELINE.md's five configs + the deployment
+walk-through) must stay runnable: each executes as a real subprocess on
+the 8-device CPU mesh. Example 06 runs its python half here; its
+--c-host path (gcc + embedded runtime) is covered by test_capi.py's
+slow-marked suite."""
 import os
 import subprocess
 import sys
@@ -14,6 +17,9 @@ SCRIPTS = [
     ("03_bert_pretrain_dp.py", ["--steps", "3"]),
     ("04_ernie_finetune_sharding.py", ["--steps", "3"]),
     ("05_gpt_pipeline_tp.py", ["--steps", "2"]),
+    # python half only: the --c-host gcc/embedding path is test_capi's
+    # slow-marked territory
+    ("06_deploy_inference.py", []),
 ]
 
 
