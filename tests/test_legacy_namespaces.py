@@ -357,3 +357,72 @@ def test_legacy_image_helpers():
     out = I.simple_transform(im, 24, 16, is_train=False,
                              mean=[1.0, 2.0, 3.0])
     assert out.shape == (3, 16, 16) and out.dtype == np.float32
+
+
+# -- dataset.movielens ------------------------------------------------------
+
+def test_legacy_movielens(data_home, monkeypatch):
+    import zipfile
+
+    d = data_home / "movielens"
+    d.mkdir()
+    # 17 rating lines: with the reference's per-line RandomState(0)
+    # split, draws 15-17 fall below test_ratio=0.1, so the TEST reader
+    # path is genuinely exercised (14 train / 3 test)
+    ratings = "".join("%d::%d::%d::%d\n"
+                      % (1 + i % 2, 1 + (i // 2) % 2, 1 + i % 5, 1000 + i)
+                      for i in range(17))
+    with zipfile.ZipFile(d / "ml-1m.zip", "w") as z:
+        z.writestr("ml-1m/movies.dat",
+                   "1::Toy Story (1995)::Animation|Comedy\n"
+                   "2::Jumanji (1995)::Adventure\n")
+        z.writestr("ml-1m/users.dat",
+                   "1::M::25::4::90210\n2::F::35::7::10001\n")
+        z.writestr("ml-1m/ratings.dat", ratings)
+    from paddle_tpu.dataset import movielens
+
+    # monkeypatch so teardown restores the cache sentinel (a bare
+    # assignment would leak this fixture's dicts into later tests)
+    monkeypatch.setattr(movielens, "MOVIE_INFO", None)
+    monkeypatch.setattr(movielens, "USER_INFO", None)
+    assert movielens.max_movie_id() == 2
+    assert movielens.max_user_id() == 2
+    assert movielens.max_job_id() == 7
+    cats = movielens.movie_categories()
+    assert set(cats) == {"Animation", "Comedy", "Adventure"}
+    title_dict = movielens.get_movie_title_dict()
+    assert "toy" in title_dict and "(1995)" not in " ".join(title_dict)
+    train = list(movielens.train()())
+    test = list(movielens.test()())
+    assert len(train) == 14 and len(test) == 3
+    # usr.value() + mov.value() + [[rating]]: rating rescaled r*2-5;
+    # first train sample is deterministically ratings line 1 (rating 1),
+    # first test sample is line 15 (rating 1 + 14%5 = 5)
+    assert train[0][-1][0] == 1 * 2 - 5.0
+    assert test[0][-1][0] == 5 * 2 - 5.0
+    s = train[0]
+    assert isinstance(s[5], list) and isinstance(s[6], list)  # cats, title
+
+
+# -- dataset.wmt16 ----------------------------------------------------------
+
+def test_legacy_wmt16(data_home):
+    d = data_home / "wmt16"
+    d.mkdir()
+    pairs = "hello world\thallo welt\ngood day\tguten tag\n"
+    with tarfile.open(d / "wmt16.tar.gz", "w:gz") as tf:
+        for split in ("train", "test", "val"):
+            _add_text(tf, "wmt16/%s" % split, pairs)
+    from paddle_tpu.dataset import wmt16
+
+    train = list(wmt16.train(10, 10)())
+    assert len(train) == 2
+    src, trg, trg_next = train[0]
+    # <s>-framed source, trg_next ends with <e>
+    assert src[0] == 0 and trg[0] == 0 and trg_next[-1] == 1
+    en = wmt16.get_dict("en", 10)
+    assert en["<s>"] == 0 and en["<e>"] == 1 and en["<unk>"] == 2
+    rev = wmt16.get_dict("en", 10, reverse=True)
+    assert rev[0] == "<s>"
+    with pytest.raises(ValueError, match="language"):
+        wmt16.train(10, 10, src_lang="fr")
