@@ -14,13 +14,23 @@ built-in op, so they are taped in eager, differentiable, and jittable.
 """
 from . import autograd  # noqa: F401
 from . import auto_checkpoint  # noqa: F401
+from . import operators  # noqa: F401
 from .auto_checkpoint import AutoCheckpoint, train_epoch_range  # noqa: F401
 from .custom_op import (  # noqa: F401
     get_custom_op,
     register_custom_op,
     registered_custom_ops,
 )
+from .operators import (  # noqa: F401
+    softmax_mask_fuse,
+    softmax_mask_fuse_upper_triangle,
+)
+# reference incubate/__init__.py re-exports the optimizer wrappers too
+from ..optimizer import Lookahead as LookAhead  # noqa: F401
+from ..optimizer import ModelAverage  # noqa: F401
 
 __all__ = ["autograd", "auto_checkpoint", "AutoCheckpoint",
            "train_epoch_range", "get_custom_op", "register_custom_op",
-           "registered_custom_ops"]
+           "registered_custom_ops", "LookAhead", "ModelAverage",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle",
+           "operators"]

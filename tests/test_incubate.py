@@ -140,3 +140,49 @@ def test_custom_op_registry_semantics(scale_mul):
         register_custom_op("scale_mul2", _pallas_scale_mul)
     with pytest.raises(Exception, match="no custom op"):
         get_custom_op("never_registered")
+
+
+def test_softmax_mask_fuse_ops_torch_parity():
+    """incubate/operators parity: the CUDA-fused kernels' math, expressed
+    as XLA-fusable traced ops (softmax_mask_fuse_upper_triangle.py:33)."""
+    import torch
+
+    x = np.random.RandomState(0).randn(2, 3, 5, 5).astype("float32")
+    got = pt.incubate.softmax_mask_fuse_upper_triangle(
+        pt.to_tensor(x)).numpy()
+    t = torch.from_numpy(x)
+    causal = torch.tril(torch.ones(5, 5, dtype=torch.bool))
+    ref = torch.softmax(t.masked_fill(~causal, float("-inf")), dim=-1)
+    np.testing.assert_allclose(got, ref.numpy(), rtol=1e-5, atol=1e-6)
+    # rows attend only to keys <= their own position
+    assert np.allclose(np.triu(got[0, 0], k=1), 0.0)
+
+    m = np.random.RandomState(1).randn(2, 3, 5, 5).astype("float32")
+    got2 = pt.incubate.softmax_mask_fuse(
+        pt.to_tensor(x), pt.to_tensor(m)).numpy()
+    ref2 = torch.softmax(torch.from_numpy(x + m), dim=-1).numpy()
+    np.testing.assert_allclose(got2, ref2, rtol=1e-5, atol=1e-6)
+
+
+def test_incubate_reexports_optimizer_wrappers():
+    assert pt.incubate.LookAhead is pt.optimizer.Lookahead
+    assert pt.incubate.ModelAverage is pt.optimizer.ModelAverage
+
+
+def test_softmax_mask_fuse_upper_triangle_rejects_lq_gt_lk():
+    x = np.zeros((1, 1, 6, 4), "float32")
+    with pytest.raises(Exception, match="Lk >= Lq"):
+        pt.incubate.softmax_mask_fuse_upper_triangle(pt.to_tensor(x))
+
+
+def test_softmax_mask_fuse_upper_triangle_kv_cache_offset():
+    import torch
+
+    # Lk > Lq: decode-style scores; row i may attend keys <= i + (Lk-Lq)
+    x = np.random.RandomState(2).randn(1, 2, 3, 5).astype("float32")
+    got = pt.incubate.softmax_mask_fuse_upper_triangle(
+        pt.to_tensor(x)).numpy()
+    t = torch.from_numpy(x)
+    keep = torch.tril(torch.ones(3, 5, dtype=torch.bool), diagonal=2)
+    ref = torch.softmax(t.masked_fill(~keep, float("-inf")), dim=-1)
+    np.testing.assert_allclose(got, ref.numpy(), rtol=1e-5, atol=1e-6)

@@ -198,7 +198,6 @@ def test_common_download_gate(data_home):
 
 
 def test_common_split_and_cluster_reader(data_home, tmp_path):
-    os.chdir(tmp_path)
     n = dcommon.split(c10, 4, suffix=str(tmp_path / "part-%05d.pickle"))
     assert n == 3
     r0 = dcommon.cluster_files_reader(str(tmp_path / "part-*.pickle"), 2, 0)

@@ -128,6 +128,7 @@ def main() -> int:
         ("device.py", pt.device, "paddle.device"),
         ("sysconfig.py", pt.sysconfig, "paddle.sysconfig"),
         ("hub.py", pt.hub, "paddle.hub"),
+        ("incubate/__init__.py", pt.incubate, "paddle.incubate"),
     ]
     total_missing = 0
     for ref_file, mod, label in audits:
