@@ -10,10 +10,11 @@ import importlib
 import warnings
 
 from . import cpp_extension  # noqa: F401
+from . import download  # noqa: F401
 from . import unique_name  # noqa: F401
 
 __all__ = ["deprecated", "run_check", "require_version", "try_import",
-           "unique_name", "cpp_extension"]
+           "unique_name", "cpp_extension", "download"]
 
 
 def deprecated(update_to: str = "", since: str = "", reason: str = "",

@@ -128,3 +128,79 @@ def test_cpp_extension_compile_error(tmp_path):
         cpp_extension.load(name="bad_ext", sources=[str(bad)],
                            functions={"x": {"out_shape": lambda s: s}},
                            build_directory=str(tmp_path))
+
+
+def test_download_paths_no_egress(tmp_path, monkeypatch):
+    """utils.download parity: conventional-path resolution, md5 check and
+    in-place decompression — without any network (download.py:66-265)."""
+    import tarfile
+
+    from paddle_tpu.utils import download as D
+
+    assert D.is_url("https://x/y.pdparams") and not D.is_url("/tmp/y")
+    url = "https://paddle-hapi.bj.bcebos.com/models/lenet.pdparams"
+    monkeypatch.setattr(D, "WEIGHTS_HOME", str(tmp_path))
+    # cache miss names the exact expected path
+    with pytest.raises(Exception, match="lenet.pdparams"):
+        D.get_weights_path_from_url(url)
+    target = tmp_path / "lenet.pdparams"
+    target.write_bytes(b"weights!")
+    assert D.get_weights_path_from_url(url) == str(target)
+    import hashlib
+    good = hashlib.md5(b"weights!").hexdigest()
+    assert D.get_weights_path_from_url(url, md5sum=good) == str(target)
+    with pytest.raises(Exception, match="md5"):
+        D.get_weights_path_from_url(url, md5sum="0" * 32)
+    # archive resolution decompresses in place and returns the root dir
+    adir = tmp_path / "arch"
+    adir.mkdir()
+    with tarfile.open(adir / "model.tar", "w") as tf:
+        import io as _io
+        data = b"inner"
+        info = tarfile.TarInfo("model/weights.bin")
+        info.size = len(data)
+        tf.addfile(info, _io.BytesIO(data))
+    out = D.get_path_from_url("https://x/model.tar", str(adir))
+    assert out == str(adir / "model") and (adir / "model" / "weights.bin").exists()
+
+
+def test_download_decompress_edge_layouts(tmp_path):
+    """_decompress must return a real extraction root for './'-prefixed,
+    flat, and single-dir archives, and must not re-extract on a second
+    call (review findings)."""
+    import io as _io
+    import tarfile
+
+    from paddle_tpu.utils import download as D
+
+    def make_tar(path, members):
+        with tarfile.open(path, "w") as tf:
+            for name in members:
+                data = b"x"
+                info = tarfile.TarInfo(name)
+                info.size = len(data)
+                tf.addfile(info, _io.BytesIO(data))
+
+    # './'-prefixed single-root archive -> <root>/model, not <root>/.
+    d1 = tmp_path / "a"; d1.mkdir()
+    make_tar(d1 / "m.tar", ["./model/w.bin"])
+    out = D.get_path_from_url("https://x/m.tar", str(d1))
+    assert out == str(d1 / "model") and (d1 / "model" / "w.bin").exists()
+
+    # flat archive -> directory named after the stem, not the .tar path
+    d2 = tmp_path / "b"; d2.mkdir()
+    make_tar(d2 / "flat.tar", ["w1.bin", "w2.bin"])
+    out = D.get_path_from_url("https://x/flat.tar", str(d2))
+    assert out == str(d2 / "flat") and (d2 / "flat" / "w1.bin").exists()
+
+    # second call short-circuits instead of clobbering the tree
+    marker = d2 / "flat" / "w1.bin"
+    marker.write_bytes(b"modified")
+    out2 = D.get_path_from_url("https://x/flat.tar", str(d2))
+    assert out2 == out and marker.read_bytes() == b"modified"
+
+    # md5 is enforced even with check_exist=False (no-egress degrade)
+    import pytest as _pytest
+    with _pytest.raises(Exception, match="md5"):
+        D.get_path_from_url("https://x/flat.tar", str(d2),
+                            md5sum="0" * 32, check_exist=False)

@@ -129,6 +129,7 @@ def main() -> int:
         ("sysconfig.py", pt.sysconfig, "paddle.sysconfig"),
         ("hub.py", pt.hub, "paddle.hub"),
         ("incubate/__init__.py", pt.incubate, "paddle.incubate"),
+        ("utils/download.py", pt.utils.download, "paddle.utils.download"),
     ]
     total_missing = 0
     for ref_file, mod, label in audits:
