@@ -236,12 +236,19 @@ class _CtrlFlowTransformer:
     the loop — instead of every body temporary (which would be unbound at
     loop entry)."""
 
-    def __init__(self, local_names: Set[str], arg_names: Set[str]):
+    def __init__(self, local_names: Set[str], arg_names: Set[str],
+                 loaded_names: Set[str] = None):
         self.locals = set(local_names)
         # names definitely bound at function entry; transform_block threads
         # a definitely-bound set past each statement so loop conversion can
         # refuse a carry that would be unbound at loop entry
         self.entry_bound = set(arg_names)
+        # every Name read ANYWHERE in the function (full walk, including
+        # nested defs that may close over locals): a branch-assigned name
+        # absent from this set can never be observed after the branch, so
+        # the if conversion may drop it from the joined outputs
+        self.loaded = (set(loaded_names) if loaded_names is not None
+                       else None)
         self.n = 0
 
     def _tuple(self, names, ctx) -> ast.expr:
@@ -250,26 +257,25 @@ class _CtrlFlowTransformer:
 
     def transform_block(self, stmts: List[ast.stmt],
                         bound: Set[str] = None) -> List[ast.stmt]:
-        """``bound``: names DEFINITELY bound before the first statement
-        (function args at top level). Threaded past each statement —
-        conservatively: compound statements contribute nothing, converted
-        if/while/for contribute the names their call assigns — so loop
-        conversion can refuse a carry unbound at loop entry."""
+        """``bound``: names POSSIBLY bound before the first statement
+        (function args at top level; every name any preceding statement
+        may assign, loop/branch bodies included). The loop/if guards use
+        it to refuse conversion only for names bound NOWHERE earlier —
+        there conversion is impossible; for merely conditionally-bound
+        names eager python itself raises UnboundLocalError on the
+        unlucky path, so converting preserves behavior."""
         bound = set(self.entry_bound if bound is None else bound)
         out: List[ast.stmt] = []
         for idx, s in enumerate(stmts):
             succ = stmts[idx + 1:]
             if isinstance(s, ast.If):
                 out.extend(self._transform_if(s, bound))
-                bound |= _definite_binds(s)
             elif isinstance(s, ast.While):
                 out.extend(self._transform_while(s, succ, bound))
-                bound |= _definite_binds(s)
             elif isinstance(s, ast.For) and \
                     (lowered := self._lower_for_range(s, succ,
                                                       bound)) is not None:
                 out.extend(lowered)
-                bound |= _definite_binds(s)
             else:
                 for field in ("body", "orelse", "finalbody"):
                     sub = getattr(s, field, None)
@@ -277,7 +283,7 @@ class _CtrlFlowTransformer:
                             sub[0], ast.stmt):
                         setattr(s, field, self.transform_block(sub, bound))
                 out.append(s)
-                bound |= _definite_binds(s)
+            bound |= _assigned_names([s])
         return out
 
     def _transform_if(self, node: ast.If,
@@ -289,6 +295,25 @@ class _CtrlFlowTransformer:
             return [node]
         outs = sorted(_user_names(
             _assigned_names(list(node.body) + list(node.orelse))))
+        if self.loaded is not None:
+            # a name assigned in a branch but read nowhere in the whole
+            # function is unobservable — dropping it avoids forcing the
+            # OTHER branch to return a value it never had (e.g. the
+            # pre-seeded target of a converted for inside one branch)
+            outs = [o for o in outs if o in self.loaded]
+        if bound is not None:
+            # must-assign on BOTH branches (a name only conditionally
+            # assigned inside a nested loop of a branch does not count)
+            both = _user_names(
+                _definite_binds_block(node.body)
+                & _definite_binds_block(node.orelse))
+            for o in outs:
+                if o not in bound and o not in both:
+                    # one branch reads o as a free parameter while the
+                    # other assigns it, and no pre-if value exists: a
+                    # converted cond would hit UnboundLocalError; leave
+                    # it for the tracer hint (define o before the if)
+                    return [node]
         self.n += 1
         i = self.n
         defs, branches = [], []
@@ -513,7 +538,14 @@ def convert(fn: Callable) -> Callable:
     if fdef.args.kwarg:
         arg_names.add(fdef.args.kwarg.arg)
     local_names = _assigned_names(fdef.body) | arg_names
-    tr = _CtrlFlowTransformer(local_names, arg_names)
+    loaded = {n.id for n in ast.walk(fdef)
+              if isinstance(n, ast.Name)
+              and isinstance(n.ctx, (ast.Load, ast.Del))}
+    for n in ast.walk(fdef):  # AugAssign targets are read-then-written
+        if isinstance(n, ast.AugAssign):
+            loaded |= {t.id for t in ast.walk(n.target)
+                       if isinstance(t, ast.Name)}
+    tr = _CtrlFlowTransformer(local_names, arg_names, loaded)
     fdef.body = tr.transform_block(fdef.body)
     te = _IfExpTransformer()
     te.visit(fdef)

@@ -304,3 +304,130 @@ def test_while_body_fresh_var_read_after_falls_back():
 
     with pytest.raises(RuntimeError, match="cond|while_loop|hoist"):
         to_static(f)(pt.to_tensor(np.asarray([1.0], np.float32)))
+
+
+def test_for_else_clause_runs_after_loop():
+    def f(x):
+        n = pt.tensor.cast(pt.tensor.sum(x), "int32")
+        s = x * 0.0
+        for i in range(n):
+            s = s + x
+        else:  # no break possible in convertible bodies: always runs
+            s = s + 100.0
+        return s
+
+    x = np.asarray([1.0, 1.0], np.float32)
+    got = np.asarray(to_static(f)(pt.to_tensor(x)).value)
+    np.testing.assert_allclose(got, x * 2 + 100.0, rtol=1e-6)
+
+
+def test_for_nested_inside_tensor_if():
+    # the loop target is assigned only in the true branch; being read
+    # nowhere else in the function, the if conversion must not force the
+    # false branch to produce it
+    def f(x):
+        n = pt.tensor.cast(pt.tensor.sum(x), "int32")
+        s = x * 0.0
+        if pt.tensor.sum(x) > 0:
+            for i in range(n):
+                s = s + x
+        else:
+            s = s - x
+        return s
+
+    x = np.asarray([1.0, 1.0], np.float32)
+    got = np.asarray(to_static(f)(pt.to_tensor(x)).value)
+    np.testing.assert_allclose(got, x * 2, rtol=1e-6)
+    got = np.asarray(to_static(f)(pt.to_tensor(-x)).value)
+    np.testing.assert_allclose(got, x, rtol=1e-6)  # else branch: -(-x)
+
+
+def test_while_nested_inside_for():
+    def f(x):
+        n = pt.tensor.cast(pt.tensor.sum(x), "int32")
+        acc = pt.tensor.cast(x[0] * 0, "float32")
+        for i in range(n):
+            t = x[0] * 0 + 1.0
+            while t < 3.0:
+                t = t * 2.0
+            acc = acc + t
+        return acc
+
+    x = np.asarray([1.0, 1.0], np.float32)
+    assert float(to_static(f)(pt.to_tensor(x)).value) == 8.0
+
+
+def test_for_negative_constant_step():
+    def f(x):
+        n = pt.tensor.cast(pt.tensor.sum(x), "int32")
+        acc = pt.tensor.cast(x[0] * 0, "int32")
+        for i in range(n, 0, -1):
+            acc = acc + i
+        return acc
+
+    x = np.asarray([1.0, 1.0], np.float32)
+    assert int(to_static(f)(pt.to_tensor(x)).value) == 3
+
+
+def test_if_branch_asymmetric_read_falls_back():
+    # `t` is assigned only in the true branch but read after the if with
+    # no pre-if binding: an honest hint, not UnboundLocalError
+    def f(x):
+        if pt.tensor.sum(x) > 0:
+            t = x * 2.0
+        else:
+            pass
+        return t
+
+    with pytest.raises(RuntimeError, match="cond|hoist"):
+        to_static(f)(pt.to_tensor(np.asarray([1.0], np.float32)))
+
+
+def test_loop_bound_var_then_asymmetric_if_converts():
+    # `t` is bound by a preceding loop (may-bind), so the asymmetric if
+    # may convert — eager python would equally UnboundLocalError only on
+    # a zero-trip loop, so conversion preserves behavior
+    def f(x):
+        for k in range(2):
+            t = x * 1.0
+        if pt.tensor.sum(x) > 0:
+            t = t * 2.0
+        else:
+            pass
+        return t
+
+    got = np.asarray(to_static(f)(
+        pt.to_tensor(np.asarray([1.0], np.float32))).value)
+    np.testing.assert_allclose(got, [2.0], rtol=1e-6)
+
+
+def test_if_out_observed_only_via_augassign():
+    # AugAssign reads its target: `s` must stay in the joined outputs
+    def f(x):
+        if pt.tensor.sum(x) > 0:
+            s = x
+        else:
+            s = -x
+        s += 1.0
+        return x * 2.0 + s * 0.0
+
+    got = np.asarray(to_static(f)(
+        pt.to_tensor(np.asarray([1.0], np.float32))).value)
+    np.testing.assert_allclose(got, [2.0], rtol=1e-6)
+
+
+def test_if_conditionally_assigned_in_both_branches_falls_back():
+    # assigned only inside nested (possibly zero-trip) loops of each
+    # branch: not a definite bind, so the guard must refuse with the
+    # hint instead of converting into an UnboundLocalError
+    def f(x):
+        if pt.tensor.sum(x) > 0:
+            while False:
+                t = x
+        else:
+            while False:
+                t = -x
+        return t
+
+    with pytest.raises(RuntimeError, match="cond|hoist"):
+        to_static(f)(pt.to_tensor(np.asarray([1.0], np.float32)))
