@@ -96,7 +96,7 @@ def _cast_model_keep_norms(model, dtype) -> None:
         for b in layer._buffers.values():
             if b is not None and jnp.issubdtype(b.value.dtype, jnp.floating):
                 b._replace_value(b.value.astype(dtype))
-        layer._dtype = dtype
+        layer._dtype = np.dtype(dtype).name  # Layer._dtype is a string
 
 
 def _install_save_dtype(model, save_dtype) -> None:

@@ -69,7 +69,11 @@ def set_default_dtype(d: Any) -> None:
 
 
 def get_default_dtype():
-    return _default_dtype
+    """The default float dtype as its canonical STRING name ('float32'),
+    matching the reference (`framework.py:69` returns the string form);
+    ported code compares it against 'float32'/'float64' literals. The
+    string is a valid dtype argument everywhere jnp/numpy take one."""
+    return np.dtype(_default_dtype).name
 
 
 def _x64_enabled() -> bool:

@@ -73,7 +73,10 @@ class Layer:
 
     def __init__(self, name_scope: Optional[str] = None, dtype=None):
         self.training = True
-        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        # canonical string always (paddle's Layer._dtype is a string;
+        # ported code compares it to 'float32'-style literals)
+        self._dtype = np.dtype(convert_dtype(dtype)).name if dtype \
+            is not None else get_default_dtype()
         self._parameters: Dict[str, Optional[Parameter]] = collections.OrderedDict()
         self._sub_layers: Dict[str, Optional["Layer"]] = collections.OrderedDict()
         self._buffers: Dict[str, Optional[Tensor]] = collections.OrderedDict()
@@ -330,7 +333,7 @@ class Layer:
                 if jnp.issubdtype(b.value.dtype, jnp.floating):
                     b._replace_value(b.value.astype(dtype))
             for layer in self.sublayers(include_self=True):
-                layer._dtype = dtype
+                layer._dtype = np.dtype(dtype).name
         return self
 
     def astype(self, dtype) -> "Layer":

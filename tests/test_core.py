@@ -20,9 +20,12 @@ def test_device_api():
 
 
 def test_default_dtype():
-    assert pt.get_default_dtype() == jnp.float32
+    # paddle returns the canonical STRING form (framework.py:69) — ported
+    # code compares against 'float32' literals
+    assert pt.get_default_dtype() == "float32"
     pt.set_default_dtype("bfloat16")
     try:
+        assert pt.get_default_dtype() == "bfloat16"
         x = pt.ones([2, 2])
         assert x.dtype == jnp.bfloat16
     finally:
