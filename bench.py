@@ -476,30 +476,65 @@ def _primary(bert_leg, extra):
 
 
 def _stored_bert():
-    """(stored_record, bert_leg) from the last verified on-chip run;
-    handles the legacy record shape."""
+    """(stored_record, bert_leg, rejected_reason) from the last verified
+    on-chip run; handles the legacy record shape.  The bert leg is gated
+    by _leg_promotable like any other: a stored headline that cannot
+    prove it measured the chip is not promoted — but the reason is
+    returned so the fallback output says 'bert leg rejected: <why>'
+    rather than pretending no record exists."""
     stored = _load_tpu_record()
     bert = (stored or {}).get("legs", {}).get("bert") or \
         (stored or {}).get("bert")
-    return stored, bert
+    reason = None
+    if bert is not None:
+        ok, why = _leg_promotable("bert", bert)
+        if not ok:
+            bert, reason = None, why
+    return stored, bert, reason
+
+
+def _leg_promotable(name: str, leg: dict):
+    """(ok, reason) gate every stored leg must pass before promotion.
+
+    Round 4 published a resnet leg that timed the axon tunnel (77 MB/step
+    of host->device transfer) instead of the chip; this gate makes that
+    class of number structurally unpromotable: a leg must either have been
+    timed with device-staged inputs (``input_staged``) or carry an explicit
+    ``transfer_note`` showing the transfer bias is negligible, and resnet
+    legs must be stamped with the current MFU convention (pre-fix records
+    understate MFU exactly 2x — see RESNET50_FWD_FLOPS)."""
+    if not isinstance(leg, dict):
+        return False, "malformed leg"
+    if leg.get("invalid_reason"):
+        return False, leg["invalid_reason"]
+    if not leg.get("input_staged") and not leg.get("transfer_note"):
+        return False, ("no input_staged stamp or transfer_note: cannot "
+                       "rule out tunnel-transfer-bound timing")
+    if name == "resnet50" and \
+            leg.get("mfu_convention") != RESNET_MFU_CONVENTION:
+        return False, ("mfu_convention %r != %d: pre-convention-fix MFU "
+                       "understates 2x" % (leg.get("mfu_convention"),
+                                           RESNET_MFU_CONVENTION))
+    return True, ""
 
 
 def _promote_stored_legs(stored):
-    """Stored legs for the fallback output, with pre-convention-fix
-    resnet records annotated rather than silently presented: their 'mfu'
-    divides by the MAC count, understating exactly 2x (see
-    RESNET50_FWD_FLOPS)."""
-    legs = dict((stored or {}).get("legs") or stored or {})
-    res = legs.get("resnet50")
-    if isinstance(res, dict) and \
-            res.get("mfu_convention") != RESNET_MFU_CONVENTION:
-        legs["resnet50"] = dict(
-            res,
-            mfu_corrected=round(2 * res.get("mfu", 0.0), 4),
-            mfu_note="recorded pre-convention-fix: 'mfu' counts "
-                     "1 FLOP/MAC; mfu_corrected is the honest "
-                     "2-FLOPs-per-MAC figure")
-    return legs
+    """(legs, rejected) for the fallback output, gated by
+    _leg_promotable: a leg that fails the gate lands in ``rejected``
+    (name -> reason) instead of being presented as a healthy number.
+    Legacy-shape records (legs at top level) carry metadata strings
+    alongside the leg dicts; only dict values are legs."""
+    raw = (stored or {}).get("legs") or stored or {}
+    legs, rejected = {}, {}
+    for name, leg in raw.items():
+        if not isinstance(leg, dict):
+            continue  # legacy-shape metadata (measured_at/note/...)
+        ok, reason = _leg_promotable(name, leg)
+        if ok:
+            legs[name] = leg
+        else:
+            rejected[name] = reason
+    return legs, rejected
 
 
 def main():
@@ -577,15 +612,17 @@ def main():
         reason = "measurement child exited %d with no JSON" \
             % proc.returncode
 
-    stored, stored_bert = _stored_bert()
+    stored, stored_bert, bert_rejected = _stored_bert()
     if stored_bert:
+        legs, rejected = _promote_stored_legs(stored)
         print(json.dumps(_primary(stored_bert, {
             "backend": "tpu (stored)",
             "provenance": "last_verified_tpu_watchdog",
             "watchdog_reason": reason,
             "measured_at": (stored or {}).get("measured_at"),
             "git_rev": (stored or {}).get("git_rev"),
-            "stored_legs": _promote_stored_legs(stored),
+            "stored_legs": legs,
+            "rejected_stored_legs": rejected or None,
             "stored_note": (stored or {}).get("note"),
         })))
     else:
@@ -593,6 +630,7 @@ def main():
             "metric": "bert_base_tokens_per_sec_per_chip",
             "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,
             "extra": {"provenance": "watchdog_no_stored_record",
+                      "bert_rejected_reason": bert_rejected,
                       "watchdog_reason": reason}}))
 
 
@@ -642,8 +680,11 @@ def _measure_and_print():
         now, rev = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), _git_rev()
         prev = _load_tpu_record() or {}
         # each leg carries its own provenance so an inherited leg is never
-        # re-stamped with a rev/timestamp at which it did not actually run
-        fresh = {k: dict(v, measured_at=now, git_rev=rev)
+        # re-stamped with a rev/timestamp at which it did not actually run;
+        # input_staged is literal truth: _time_steps device_puts args
+        # before the clock starts, so no fresh leg times the tunnel
+        fresh = {k: dict(v, measured_at=now, git_rev=rev,
+                         input_staged=True)
                  for k, v in legs.items()}
         merged = dict((prev.get("legs") or {}), **fresh)
         if "bert" not in merged and prev.get("bert"):
@@ -667,21 +708,24 @@ def _measure_and_print():
         # tunnel down (or a bert failure on-chip): promote the most recent
         # VERIFIED on-chip measurement as the primary metric; this run's
         # legs are attached subordinate with their true backend label.
-        stored, stored_bert = _stored_bert()
+        stored, stored_bert, bert_rejected = _stored_bert()
         this_run = {"backend": jax.default_backend(), "legs": legs,
                     "leg_errors": errors or None}
         if stored_bert:
+            promoted, rejected = _promote_stored_legs(stored)
             out = _primary(stored_bert, {
                 "backend": "tpu (stored)",
                 "provenance": "last_verified_tpu",
                 "measured_at": stored.get("measured_at"),
                 "git_rev": stored.get("git_rev"),
-                "stored_legs": _promote_stored_legs(stored),
+                "stored_legs": promoted,
+                "rejected_stored_legs": rejected or None,
                 "stored_note": stored.get("note"),
                 "this_run": this_run})
         elif "bert" in legs:
             out = _primary(legs["bert"], dict(
-                this_run, provenance="no_stored_tpu_record"))
+                this_run, provenance="no_stored_tpu_record",
+                bert_rejected_reason=bert_rejected))
         else:
             out = {"metric": "bert_base_tokens_per_sec_per_chip",
                    "value": 0.0, "unit": "tokens/s", "vs_baseline": 0.0,

@@ -5,7 +5,13 @@ Reference: ``python/paddle/dataset/movielens.py:45-300``. Samples are
 [-5, 5] by ``r*2-5`` and a per-line random train/test split. Place
 ``ml-1m.zip`` in ``DATA_HOME/movielens/``. Delta vs the reference:
 title-word and category ids are assigned in sorted order (its set
-iteration order is interpreter-dependent).
+iteration order is interpreter-dependent). The train/test split stream
+is NOT a delta: the reference seeds the global numpy RNG
+(``np.random.seed(rand_seed)`` then ``np.random.random()``,
+``python/paddle/dataset/movielens.py:152,157``) and a fresh
+``np.random.RandomState(rand_seed).random_sample()`` yields the
+bit-identical MT19937 sequence — same per-line membership — without
+mutating global RNG state.
 """
 from __future__ import annotations
 
@@ -94,6 +100,8 @@ def _init():
 
 def _reader(rand_seed=0, test_ratio=0.1, is_test=False):
     fn = _init()
+    # same MT19937 stream as the reference's np.random.seed(rand_seed) +
+    # np.random.random() split, without touching global RNG state
     rng = np.random.RandomState(rand_seed)
     with zipfile.ZipFile(fn) as package:
         with package.open("ml-1m/ratings.dat") as f:

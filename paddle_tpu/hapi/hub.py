@@ -57,7 +57,10 @@ def _resolve_repo(repo, source, force_reload):
     else:
         branch = "main" if source == "github" else "master"
     owner, _, name = repo.partition("/")
-    cached = os.path.join(HUB_DIR, "_".join([owner, name, branch]))
+    # branch refs like "feature/x" flatten to one path component, matching
+    # the reference's ~/.cache/paddle/hub/<owner>_<name>_<branch> layout
+    cached = os.path.join(
+        HUB_DIR, "_".join([owner, name, branch.replace("/", "_")]))
     if os.path.isdir(cached):
         # zero-egress build: force_reload cannot re-download, so the
         # existing checkout is served either way

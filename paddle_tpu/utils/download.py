@@ -48,7 +48,15 @@ def _extraction_plan(fullpath: str, names):
     it — so the returned root is always a real extraction root, never
     the cache root or the archive itself."""
     parent = osp.dirname(fullpath)
-    clean = [n.lstrip("./") for n in names if n.lstrip("./")]
+
+    def _strip_dot_slash(n):
+        # strip only literal leading "./" prefixes: lstrip("./") strips a
+        # character SET and would mangle names like "..data/x"
+        while n.startswith("./"):
+            n = n[2:]
+        return n
+
+    clean = [s for s in (_strip_dot_slash(n) for n in names) if s]
     roots = {n.split("/")[0] for n in clean}
     if len(roots) == 1 and all("/" in n for n in clean):
         target = osp.join(parent, next(iter(roots)))

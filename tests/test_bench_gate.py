@@ -1,0 +1,95 @@
+"""Evidence-promotion discipline for the bench harness (VERDICT r4 #2).
+
+Round 4 published a ResNet leg that timed the axon tunnel's host->device
+transfer (45 imgs/s) instead of the chip. These tests pin the structural
+fix: bench.py must refuse to promote any stored leg that cannot prove it
+measured compute — no input-staging stamp, no transfer-bias note, or a
+stale ResNet MFU convention.
+
+Reference analog: the CI perf-gate discipline of
+/root/reference/tools/test_model_benchmark.sh:22-44 (a PR number is only
+comparable when measured under the same conditions as develop's).
+"""
+import json
+
+import bench
+
+
+def test_unstamped_leg_rejected():
+    ok, why = bench._leg_promotable("mnist_lenet", {"imgs_per_sec": 5610.0})
+    assert not ok and "input_staged" in why
+
+
+def test_invalid_reason_rejected():
+    ok, why = bench._leg_promotable(
+        "mnist_lenet", {"imgs_per_sec": 5610.0, "input_staged": True,
+                        "invalid_reason": "transfer-bound"})
+    assert not ok and why == "transfer-bound"
+
+
+def test_stale_resnet_convention_rejected():
+    leg = {"imgs_per_sec": 985.0, "mfu": 0.09, "input_staged": True,
+           "mfu_convention": 1}
+    ok, why = bench._leg_promotable("resnet50", leg)
+    assert not ok and "mfu_convention" in why
+
+
+def test_staged_current_convention_resnet_promotes():
+    leg = {"imgs_per_sec": 1483.2, "mfu": 0.1847, "input_staged": True,
+           "mfu_convention": bench.RESNET_MFU_CONVENTION}
+    ok, why = bench._leg_promotable("resnet50", leg)
+    assert ok, why
+
+
+def test_transfer_note_leg_promotes():
+    # LM legs with negligible, documented transfer bias stand
+    leg = {"tokens_per_sec": 120062.0, "mfu": 0.43,
+           "transfer_note": "~8 ms of a 171 ms step; <5% bias"}
+    assert bench._leg_promotable("bert", leg)[0]
+
+
+def test_promote_stored_legs_moves_rejects_aside():
+    stored = {"legs": {
+        "bert": {"tokens_per_sec": 1.0, "transfer_note": "negligible"},
+        "resnet50": {"imgs_per_sec": 45.3, "mfu": 0.0028},
+    }}
+    legs, rejected = bench._promote_stored_legs(stored)
+    assert "bert" in legs and "resnet50" not in legs
+    assert "resnet50" in rejected
+
+
+def test_promote_legacy_shape_skips_metadata():
+    # legacy records keep legs at top level next to metadata strings;
+    # metadata must not be reported as rejected measurements
+    stored = {"measured_at": "2026-01-01T00:00:00Z", "note": "x",
+              "bert": {"tokens_per_sec": 1.0, "transfer_note": "ok"}}
+    legs, rejected = bench._promote_stored_legs(stored)
+    assert list(legs) == ["bert"] and rejected == {}
+
+
+def test_repo_record_carries_no_unflagged_corrupt_leg():
+    """The checked-in TPU_MEASUREMENT.json must never again present a
+    tunnel-bound number as healthy: every leg either passes the gate or
+    carries an explicit invalid_reason."""
+    with open(bench._TPU_RECORD) as f:
+        record = json.load(f)
+    for name, leg in record["legs"].items():
+        ok, why = bench._leg_promotable(name, leg)
+        assert ok or leg.get("invalid_reason"), (name, why)
+        # the corrected resnet leg specifically must be promotable at the
+        # current convention with staged inputs
+    res = record["legs"]["resnet50"]
+    assert res["input_staged"] is True
+    assert res["mfu_convention"] == bench.RESNET_MFU_CONVENTION
+    assert res["imgs_per_sec"] > 1000  # not the 45 imgs/s artifact
+
+
+def test_stored_bert_gate_blocks_unproven_headline():
+    saved = bench._load_tpu_record
+    try:
+        bench._load_tpu_record = lambda: {
+            "legs": {"bert": {"tokens_per_sec": 999999.0}}}
+        _, bert, why = bench._stored_bert()
+        assert bert is None and "input_staged" in why
+    finally:
+        bench._load_tpu_record = saved
