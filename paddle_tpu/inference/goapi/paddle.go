@@ -1,0 +1,165 @@
+// Package paddle wraps the paddle_tpu C inference API for Go programs.
+//
+// Reference surface: paddle/fluid/inference/goapi/paddle.go:1 (the
+// Config/Predictor/Tensor verticals over capi_exp). This is the
+// TPU-native reduction over include/paddle_tpu_c.h: a Config names the
+// saved StableHLO artifact, a Predictor runs float32 batches, and the
+// auto-grow output protocol of PD_PredictorRunFloat is hidden behind a
+// plain ([]float32, shape) return.
+//
+// Build (wherever a Go toolchain exists; none ships in this build
+// image — see goapi/README.md):
+//
+//	CGO_CFLAGS="-I${PADDLE_TPU}/paddle_tpu/include" \
+//	CGO_LDFLAGS="-L$(python -c 'import paddle_tpu.sysconfig as s; print(s.get_lib())') -lpaddle_tpu_c" \
+//	go build ./...
+package paddle
+
+/*
+#cgo LDFLAGS: -lpaddle_tpu_c
+#include <stdlib.h>
+#include "paddle_tpu_c.h"
+*/
+import "C"
+
+import (
+	"fmt"
+	"runtime"
+	"sync"
+	"unsafe"
+)
+
+var initOnce sync.Once
+var initErr error
+
+// Init starts the embedded paddle_tpu runtime. extraSysPaths is a
+// ':'-separated list of directories prepended to the interpreter's
+// sys.path (pass the repo root when running from a source tree), or "".
+// Safe to call more than once; only the first call's paths apply.
+func Init(extraSysPaths string) error {
+	initOnce.Do(func() {
+		var cs *C.char
+		if extraSysPaths != "" {
+			cs = C.CString(extraSysPaths)
+			defer C.free(unsafe.Pointer(cs))
+		}
+		if rc := C.PD_Init(cs); rc != 0 {
+			initErr = fmt.Errorf("paddle: PD_Init failed (rc=%d)", int(rc))
+		}
+	})
+	return initErr
+}
+
+// Version reports the C API version string.
+func Version() string {
+	return C.GoString(C.PD_GetVersion())
+}
+
+// Finalize shuts the embedded runtime down. No paddle call is valid
+// afterwards (PD_Init cannot be re-entered).
+func Finalize() {
+	C.PD_Finalize()
+}
+
+// Config describes a saved inference artifact (the goapi Config
+// vertical, reduced: the StableHLO artifact is ahead-of-time compiled,
+// so the reference's gpu/ir/memory toggles have no analog here).
+type Config struct {
+	// ModelPrefix is the path prefix passed to paddle_tpu.jit.save
+	// (expands to <prefix>.pdmodel.stablehlo + .pdiparams.npz).
+	ModelPrefix string
+	// ExtraSysPaths seeds Init when the runtime is not yet started.
+	ExtraSysPaths string
+}
+
+// Predictor runs a loaded artifact. Not safe for concurrent Run calls;
+// clone one Predictor per goroutine (matching the reference's
+// per-thread predictor discipline).
+type Predictor struct {
+	handle unsafe.Pointer
+}
+
+// NewPredictor loads the artifact named by cfg.
+func NewPredictor(cfg *Config) (*Predictor, error) {
+	if err := Init(cfg.ExtraSysPaths); err != nil {
+		return nil, err
+	}
+	cs := C.CString(cfg.ModelPrefix)
+	defer C.free(unsafe.Pointer(cs))
+	h := C.PD_PredictorCreate(cs)
+	if h == nil {
+		return nil, fmt.Errorf("paddle: failed to load %q (details on stderr)",
+			cfg.ModelPrefix)
+	}
+	p := &Predictor{handle: h}
+	runtime.SetFinalizer(p, func(p *Predictor) { p.Destroy() })
+	return p, nil
+}
+
+// Run executes the predictor on a float32 input of the given shape and
+// returns the output buffer with its shape. The output allocation is
+// retried once when the C layer reports a larger required capacity.
+func (p *Predictor) Run(data []float32, shape []int64) ([]float32, []int64, error) {
+	if p.handle == nil {
+		return nil, nil, fmt.Errorf("paddle: predictor already destroyed")
+	}
+	n := int64(1)
+	for _, d := range shape {
+		n *= d
+	}
+	if int64(len(data)) != n {
+		return nil, nil, fmt.Errorf("paddle: data length %d != shape volume %d",
+			len(data), n)
+	}
+	if n == 0 {
+		return nil, nil, fmt.Errorf("paddle: empty input (zero-volume shape %v)",
+			shape)
+	}
+	cshape := make([]C.longlong, len(shape))
+	for i, d := range shape {
+		cshape[i] = C.longlong(d)
+	}
+	capacity := int64(len(data)) // first guess: output as big as input
+	if capacity == 0 {
+		capacity = 1
+	}
+	const maxNDim = 16
+	outShape := make([]C.longlong, maxNDim)
+	var outNDim C.int
+	for attempt := 0; attempt < 2; attempt++ {
+		out := make([]float32, capacity)
+		rc := C.PD_PredictorRunFloat(p.handle,
+			(*C.float)(unsafe.Pointer(&data[0])),
+			&cshape[0], C.int(len(shape)),
+			(*C.float)(unsafe.Pointer(&out[0])), C.longlong(capacity),
+			&outShape[0], &outNDim)
+		// the finalizer must not Destroy the handle while the C call
+		// above is still in flight
+		runtime.KeepAlive(p)
+		switch {
+		case rc == 0:
+			dims := make([]int64, int(outNDim))
+			vol := int64(1)
+			for i := range dims {
+				dims[i] = int64(outShape[i])
+				vol *= dims[i]
+			}
+			return out[:vol], dims, nil
+		case rc > 0:
+			capacity = int64(rc) // grow to the reported requirement
+		default:
+			return nil, nil, fmt.Errorf(
+				"paddle: PD_PredictorRunFloat failed (rc=%d, details on stderr)",
+				int64(rc))
+		}
+	}
+	return nil, nil, fmt.Errorf("paddle: output capacity still insufficient after retry")
+}
+
+// Destroy releases the predictor. Idempotent.
+func (p *Predictor) Destroy() {
+	if p.handle != nil {
+		C.PD_PredictorDestroy(p.handle)
+		p.handle = nil
+	}
+}

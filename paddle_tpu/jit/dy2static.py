@@ -13,16 +13,30 @@ trace time.  This module provides the two halves of the reference's story:
 1. :func:`convert` — an AST pass rewriting the COMMON control-flow shapes,
    the same shapes the reference's ifelse/loop transformers target:
 
-   - ``if <pred>: ... [else: ...]`` with plain-assignment branches (no
-     return/break/continue) becomes a pair of branch functions taking
-     their free reads as parameters and returning the assigned names,
-     joined by a runtime dispatch that uses ``tensor.cond`` for traced
-     predicates and a plain Python branch otherwise;
-   - ``while <pred>: ...`` with a plain-assignment body becomes a
-     carry-tuple ``tensor.while_loop``.
+   - ``if <pred>: ... [else: ...]`` with plain-assignment branches
+     becomes a pair of branch functions taking their free reads as
+     parameters and returning the assigned names, joined by a runtime
+     dispatch that uses ``tensor.cond`` for traced predicates and a
+     plain Python branch otherwise;
+   - ``while <pred>: ...`` becomes a carry-tuple ``tensor.while_loop``;
+   - ``break``/``continue`` in loop bodies are lowered to guard flags
+     first (the reference's ``break_continue_transformer.py:1`` scheme):
+     ``break`` -> ``flag = True`` with the loop test strengthened to
+     ``test & ~flag``, ``continue`` -> a per-iteration flag, and the
+     statements a taken jump would skip are wrapped in ``if ~flag``
+     guards — all of which then convert through the if/while machinery;
+   - early ``return`` inside ``if`` ladders is normalized away before
+     conversion (the reference's ``return_transformer.py:1`` analog):
+     an ``if`` whose branch returns has the post-if continuation folded
+     into its other branch, every former return site assigns one result
+     variable, and the function ends with a single ``return`` of it —
+     if-else nesting rather than the reference's return-flag guards, so
+     both ``lax.cond`` branches yield the SAME result structure instead
+     of a None-seeded carry.
 
    Unconvertible shapes are left untouched (a static-bool ``if`` still
-   traces fine as-is).
+   traces fine as-is); returns inside loop bodies and jumps inside
+   ``try`` blocks stay with the sound fallback + hint.
 
 2. :func:`hint_for_tracer_error` — the message ``to_static`` attaches when
    tracing still hits a tracer-boolean error (used by
@@ -74,17 +88,37 @@ def _rt_cond(pred, true_fn, true_args, false_fn, false_args):
 
 def _rt_while(cond_fn, body_fn, carry):
     """Tensor-predicated while -> tensor.while_loop; python predicate ->
-    plain loop.  ``carry`` is always a tuple."""
+    plain loop.  ``carry`` is always a tuple.
+
+    The predicate is re-checked for tensor-ness every iteration, not just
+    once: a ``while True: ... if p: break`` lowering starts with a python
+    ``True & ~False`` test that only becomes traced after the first body
+    evaluation sets the break flag to a tensor — the loop then hands the
+    current carry to ``while_loop`` (one peeled iteration) instead of
+    failing a python bool() on a tracer."""
     probe = cond_fn(*carry)
+    while not _is_tensorish(probe) and probe:
+        out = body_fn(*carry)
+        carry = tuple(out) if isinstance(out, (tuple, list)) else (out,)
+        probe = cond_fn(*carry)
     if _is_tensorish(probe):
         from ..tensor.control_flow import while_loop
 
         return tuple(while_loop(cond_fn, body_fn, list(carry)))
-    while probe:
-        out = body_fn(*carry)
-        carry = tuple(out) if isinstance(out, (tuple, list)) else (out,)
-        probe = cond_fn(*carry)
     return carry
+
+
+def _rt_not(x):
+    """Logical not that composes with traced booleans."""
+    return ~x if _is_tensorish(x) else (not x)
+
+
+def _rt_and(a, b):
+    """Logical and that composes with traced booleans (loop test &
+    not-break-flag conjunction)."""
+    if _is_tensorish(a) or _is_tensorish(b):
+        return a & b
+    return bool(a) and bool(b)
 
 
 def _rt_range3(start, stop, step):
@@ -187,6 +221,13 @@ def _convertible_body(stmts) -> bool:
     return not any(isinstance(n, _BANNED) for n in _shallow_walk(stmts))
 
 
+def _no_return_yield(stmts) -> bool:
+    """Loop-body gate: break/continue ARE convertible (lowered to guard
+    flags first), only return/yield force the fallback."""
+    return not any(isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom))
+                   for n in _shallow_walk(stmts))
+
+
 def _definite_binds(s) -> Set[str]:
     """Names statement ``s`` binds on EVERY control path through it
     (loops may run zero times -> nothing; if needs both branches)."""
@@ -218,6 +259,213 @@ def _definite_binds_block(stmts) -> Set[str]:
 
 
 # ---------------------------------------------------------------------------
+# early-return normalization (reference return_transformer.py:1 analog)
+# ---------------------------------------------------------------------------
+
+_RV = "_pt_d2s_rv"  # single-underscore: must survive _user_names filtering
+
+
+class _Unsupported(Exception):
+    """A return shape the normalization pass refuses (return inside a
+    loop/try/with): the caller skips the pass and keeps the fallback."""
+
+
+def _assign_node(name: str, value: ast.expr) -> ast.stmt:
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=value)
+
+
+def _has_return(stmts) -> bool:
+    return any(isinstance(n, ast.Return) for n in _shallow_walk(stmts))
+
+
+def _return_in_if(stmts) -> bool:
+    """True when a Return sits under an If (at any non-scope depth) —
+    the trigger for normalization; plain tail returns need nothing."""
+    stack = [(s, False) for s in stmts]
+    while stack:
+        s, in_if = stack.pop()
+        if isinstance(s, ast.Return) and in_if:
+            return True
+        if isinstance(s, _SCOPE_BARRIERS):
+            continue
+        for c in ast.iter_child_nodes(s):
+            stack.append((c, in_if or isinstance(s, ast.If)))
+    return False
+
+
+def _terminates(stmts) -> bool:
+    """Control cannot fall off the end of this statement list."""
+    for s in stmts:
+        if isinstance(s, (ast.Return, ast.Raise)):
+            return True
+        if isinstance(s, ast.If) and s.orelse and \
+                _terminates(s.body) and _terminates(s.orelse):
+            return True
+    return False
+
+
+def _norm_block(stmts) -> list:
+    """Statements where EVERY path assigns ``_RV`` (or raises)."""
+    new, term = _norm_tail(list(stmts))
+    if not term:
+        # falling off the end of a tail block is python's implicit
+        # `return None`
+        new = new + [_assign_node(_RV, ast.Constant(value=None))]
+    return new
+
+
+def _norm_tail(stmts):
+    """Rewrite a TAIL-position statement list (falling off its end ends
+    the function): every ``return e`` becomes ``_RV = e``, and an ``if``
+    whose branch returns absorbs the post-if continuation into whichever
+    branches fall through — so both sides of the eventual ``lax.cond``
+    compute a real result value instead of a None placeholder.  Returns
+    (new_stmts, terminates)."""
+    out = []
+    for idx, s in enumerate(stmts):
+        rest = stmts[idx + 1:]
+        if isinstance(s, ast.Return):
+            out.append(_assign_node(
+                _RV, s.value if s.value is not None
+                else ast.Constant(value=None)))
+            return out, True  # anything after is unreachable
+        if isinstance(s, ast.Raise):
+            out.append(s)
+            return out, True
+        if _has_return([s]):
+            if not isinstance(s, ast.If):
+                # return inside for/while/try/with: a while_loop carry
+                # would need a pre-seeded result of unknowable structure;
+                # the sound fallback (tracer hint) is the honest outcome
+                raise _Unsupported(type(s).__name__)
+            import copy
+
+            # each branch gets its OWN copy of the continuation: later
+            # passes mutate statements in place (loop jump lowering
+            # rewrites a While's test/body), and a node aliased into
+            # both branches would be seen pre-lowered by one and
+            # already-lowered by the other
+            body = list(s.body) if _terminates(s.body) \
+                else list(s.body) + copy.deepcopy(rest)
+            orelse = list(s.orelse) if s.orelse and _terminates(s.orelse) \
+                else list(s.orelse) + copy.deepcopy(rest)
+            out.append(ast.If(test=s.test, body=_norm_block(body),
+                              orelse=_norm_block(orelse)))
+            return out, True
+        out.append(s)
+    return out, False
+
+
+def _normalize_returns(fdef) -> bool:
+    """Apply return normalization to a function body in place; True when
+    the pass ran.  The body afterwards has exactly one ``return _RV`` at
+    the end and no Return anywhere else (outside nested scopes)."""
+    if not _return_in_if(fdef.body):
+        return False
+    body = _norm_block(fdef.body)
+    new = body + [ast.Return(value=ast.Name(id=_RV, ctx=ast.Load()))]
+    # continuation duplication is linear for return ladders but can
+    # compound for deeply nested fall-through returns; refuse pathological
+    # blowup rather than compile a megabyte of AST
+    if sum(1 for _ in ast.walk(ast.Module(body=new, type_ignores=[]))) > 20000:
+        raise _Unsupported("normalized AST too large")
+    fdef.body = new
+    return True
+
+
+# ---------------------------------------------------------------------------
+# break/continue lowering (reference break_continue_transformer.py:1 analog)
+# ---------------------------------------------------------------------------
+
+def _jumps_at_level(stmts) -> bool:
+    """True when a Break/Continue belongs to THIS loop body (nested
+    loops own theirs)."""
+    stack = list(stmts)
+    while stack:
+        s = stack.pop()
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, (ast.For, ast.AsyncFor, ast.While,
+                          *_SCOPE_BARRIERS)):
+            continue
+        stack.extend(ast.iter_child_nodes(s))
+    return False
+
+
+def _not_flags(names) -> ast.expr:
+    """``__pt_rt_not(f1 | f2 | ...)`` — composes for python bools and
+    traced booleans alike."""
+    expr = ast.Name(id=names[0], ctx=ast.Load())
+    for n in names[1:]:
+        expr = ast.BinOp(left=expr, op=ast.BitOr(),
+                         right=ast.Name(id=n, ctx=ast.Load()))
+    return ast.Call(func=ast.Name(id="__pt_rt_not", ctx=ast.Load()),
+                    args=[expr], keywords=[])
+
+
+class _JumpLower:
+    """Rewrites one loop body's break/continue into flag assignments,
+    wrapping the statements a taken jump would skip in ``if ~flag``
+    guards (which the if-conversion then turns into conds).  The caller
+    initializes the break flag before the loop, resets the continue flag
+    each iteration, and strengthens the loop test with ``& ~brk``."""
+
+    def __init__(self, brk: str, cnt: str):
+        self.brk, self.cnt = brk, cnt
+        self.has_brk = self.has_cnt = False
+        self.unsupported = None
+
+    def block(self, stmts):
+        """-> (new_stmts, flags_possibly_set)."""
+        out, all_sets = [], set()
+        for idx, s in enumerate(stmts):
+            s2, sets = self.stmt(s)
+            out.append(s2)
+            all_sets |= sets
+            rest = stmts[idx + 1:]
+            if sets and rest:
+                inner, inner_sets = self.block(rest)
+                all_sets |= inner_sets
+                out.append(ast.If(test=_not_flags(sorted(sets)),
+                                  body=inner, orelse=[]))
+                break
+        return out, all_sets
+
+    def stmt(self, s):
+        if isinstance(s, ast.Break):
+            self.has_brk = True
+            return _assign_node(self.brk, ast.Constant(value=True)), \
+                {self.brk}
+        if isinstance(s, ast.Continue):
+            self.has_cnt = True
+            return _assign_node(self.cnt, ast.Constant(value=True)), \
+                {self.cnt}
+        if isinstance(s, (ast.For, ast.AsyncFor, ast.While,
+                          *_SCOPE_BARRIERS)):
+            return s, set()  # inner loop's jumps belong to it
+        if isinstance(s, ast.Try):
+            if _jumps_at_level([s]):
+                # a jump out of an except/finally interacts with the
+                # handler machinery; not lowered
+                self.unsupported = "break/continue inside try"
+            return s, set()
+        if isinstance(s, ast.If):
+            nb, sb = self.block(s.body)
+            no, so = self.block(s.orelse)
+            if sb | so:
+                return ast.If(test=s.test, body=nb, orelse=no), sb | so
+            return s, set()
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            nb, sb = self.block(s.body)
+            if sb:
+                s2 = type(s)(items=s.items, body=nb)
+                return s2, sb
+            return s, set()
+        return s, set()
+
+
+# ---------------------------------------------------------------------------
 # the transformer
 # ---------------------------------------------------------------------------
 
@@ -237,7 +485,8 @@ class _CtrlFlowTransformer:
     loop entry)."""
 
     def __init__(self, local_names: Set[str], arg_names: Set[str],
-                 loaded_names: Set[str] = None):
+                 loaded_names: Set[str] = None,
+                 closure_reads: Set[str] = frozenset()):
         self.locals = set(local_names)
         # names definitely bound at function entry; transform_block threads
         # a definitely-bound set past each statement so loop conversion can
@@ -249,6 +498,10 @@ class _CtrlFlowTransformer:
         # the if conversion may drop it from the joined outputs
         self.loaded = (set(loaded_names) if loaded_names is not None
                        else None)
+        # names read inside nested defs/lambdas anywhere in the function:
+        # successor-liveness analysis skips those scopes, so a name a later
+        # closure reads must always count as live
+        self.closure_reads = set(closure_reads)
         self.n = 0
 
     def _tuple(self, names, ctx) -> ast.expr:
@@ -256,20 +509,27 @@ class _CtrlFlowTransformer:
             elts=[ast.Name(id=n, ctx=ctx()) for n in names], ctx=ctx())
 
     def transform_block(self, stmts: List[ast.stmt],
-                        bound: Set[str] = None) -> List[ast.stmt]:
+                        bound: Set[str] = None,
+                        after: List[ast.stmt] = ()) -> List[ast.stmt]:
         """``bound``: names POSSIBLY bound before the first statement
         (function args at top level; every name any preceding statement
         may assign, loop/branch bodies included). The loop/if guards use
         it to refuse conversion only for names bound NOWHERE earlier —
         there conversion is impossible; for merely conditionally-bound
         names eager python itself raises UnboundLocalError on the
-        unlucky path, so converting preserves behavior."""
+        unlucky path, so converting preserves behavior.
+
+        ``after``: the statements that execute AFTER this block completes
+        (the enclosing continuation) — threaded so liveness analysis for
+        nested if/while conversion sees reads beyond the current
+        statement list (a carry read only after the enclosing branch
+        still counts as live)."""
         bound = set(self.entry_bound if bound is None else bound)
         out: List[ast.stmt] = []
         for idx, s in enumerate(stmts):
-            succ = stmts[idx + 1:]
+            succ = stmts[idx + 1:] + list(after)
             if isinstance(s, ast.If):
-                out.extend(self._transform_if(s, bound))
+                out.extend(self._transform_if(s, bound, succ))
             elif isinstance(s, ast.While):
                 out.extend(self._transform_while(s, succ, bound))
             elif isinstance(s, ast.For) and \
@@ -277,19 +537,30 @@ class _CtrlFlowTransformer:
                                                       bound)) is not None:
                 out.extend(lowered)
             else:
+                # a try body's continuation includes its handlers: any
+                # point in the body may jump there, so names the handler
+                # reads must count as live for nested conversions
+                handler_stmts = [st for h in getattr(s, "handlers", [])
+                                 for st in h.body]
                 for field in ("body", "orelse", "finalbody"):
                     sub = getattr(s, field, None)
                     if isinstance(sub, list) and sub and isinstance(
                             sub[0], ast.stmt):
-                        setattr(s, field, self.transform_block(sub, bound))
+                        after_f = (handler_stmts + succ
+                                   if field == "body" and handler_stmts
+                                   else succ)
+                        setattr(s, field,
+                                self.transform_block(sub, bound, after_f))
+                for h in getattr(s, "handlers", []):
+                    h.body = self.transform_block(h.body, bound, succ)
                 out.append(s)
             bound |= _assigned_names([s])
         return out
 
-    def _transform_if(self, node: ast.If,
-                      bound: Set[str] = None) -> List[ast.stmt]:
-        node.body = self.transform_block(node.body, bound)
-        node.orelse = self.transform_block(node.orelse, bound)
+    def _transform_if(self, node: ast.If, bound: Set[str] = None,
+                      successors: List[ast.stmt] = ()) -> List[ast.stmt]:
+        node.body = self.transform_block(node.body, bound, successors)
+        node.orelse = self.transform_block(node.orelse, bound, successors)
         if not (_convertible_body(node.body)
                 and _convertible_body(node.orelse)):
             return [node]
@@ -307,13 +578,25 @@ class _CtrlFlowTransformer:
             both = _user_names(
                 _definite_binds_block(node.body)
                 & _definite_binds_block(node.orelse))
-            for o in outs:
+            live_after = (_free_reads(list(successors))
+                          | self.closure_reads)
+            # a free read by either branch also forces the refusal: the
+            # dispatch evaluates every branch's free params up front, so
+            # an unbound one would NameError even on the assigning path
+            branch_free = _free_reads(node.body) | _free_reads(node.orelse)
+            for o in list(outs):
                 if o not in bound and o not in both:
-                    # one branch reads o as a free parameter while the
-                    # other assigns it, and no pre-if value exists: a
-                    # converted cond would hit UnboundLocalError; leave
-                    # it for the tracer hint (define o before the if)
-                    return [node]
+                    if o in live_after or o in branch_free:
+                        # one branch reads o as a free parameter while
+                        # the other assigns it, and no pre-if value
+                        # exists: a converted cond would hit
+                        # UnboundLocalError; leave it for the tracer
+                        # hint (define o before the if)
+                        return [node]
+                    # dead after the if (a branch-local temporary, e.g.
+                    # introduced by return normalization folding the
+                    # continuation into one branch): not an output
+                    outs.remove(o)
         self.n += 1
         i = self.n
         defs, branches = [], []
@@ -362,8 +645,32 @@ class _CtrlFlowTransformer:
                 and 1 <= len(it.args) <= 3
                 and not any(isinstance(a, ast.Starred) for a in it.args)
                 and isinstance(node.target, ast.Name)
-                and _convertible_body(node.body)):
+                and _no_return_yield(node.body)):
             return None
+        # break/continue: lowered on the raw body BEFORE the hidden
+        # counter increment is appended, so a `continue` skips the rest
+        # of the USER body but never the increment (which would spin the
+        # counter loop forever)
+        body_core, jump_init, test_guard = list(node.body), [], None
+        flag_names: List[str] = []
+        if _jumps_at_level(body_core):
+            brk, cntf = self._new_flags()
+            lw = _JumpLower(brk, cntf)
+            body_core, _ = lw.block(body_core)
+            if lw.unsupported:
+                return None
+            if lw.has_cnt:
+                body_core = [_assign_node(cntf,
+                                          ast.Constant(value=False))] \
+                    + body_core
+                self._register_flag(cntf)
+                flag_names.append(cntf)
+            if lw.has_brk:
+                jump_init.append(_assign_node(brk,
+                                              ast.Constant(value=False)))
+                test_guard = brk
+                self._register_flag(brk)
+                flag_names.append(brk)
         args = list(it.args)
         if len(args) == 1:
             start, stop = ast.Constant(value=0), args[0]
@@ -406,45 +713,116 @@ class _CtrlFlowTransformer:
             right=ast.BinOp(left=cmp(ast.Lt, step_n, ast.Constant(value=0)),
                             op=ast.BitAnd(),
                             right=cmp(ast.Gt, cnt, stop_n)))
+        if test_guard is not None:
+            test = ast.Call(
+                func=ast.Name(id="__pt_rt_and", ctx=ast.Load()),
+                args=[test, _not_flags([test_guard])], keywords=[])
         body = ([ast.Assign(targets=[ast.Name(id=node.target.id,
                                               ctx=ast.Store())],
                             value=ast.Name(id=cnt, ctx=ast.Load()))]
-                + list(node.body)
+                + body_core
                 + [ast.AugAssign(target=ast.Name(id=cnt, ctx=ast.Store()),
                                  op=ast.Add(),
                                  value=ast.Name(id=step_n, ctx=ast.Load()))])
         wh = ast.While(test=test, body=body, orelse=[])
-        post = list(node.orelse)  # no break in convertible bodies, so the
-        #                           else clause always runs, after the loop
+        post = list(node.orelse)
+        if post and test_guard is not None:
+            # python runs a for's else iff no break fired: exactly the
+            # lowered break flag's negation
+            post = [ast.If(test=_not_flags([test_guard]), body=post,
+                           orelse=[])]
         inner_bound = None if bound is None else (
-            set(bound) | {cnt, stop_n, step_n, node.target.id})
-        return (pre
+            set(bound) | {cnt, stop_n, step_n, node.target.id}
+            | set(flag_names))
+        return (pre + jump_init
                 + self._transform_while(wh, post + list(successors),
                                         inner_bound)
-                + self.transform_block(post, inner_bound))
+                + self.transform_block(post, inner_bound,
+                                       list(successors)))
+
+    def _new_flags(self):
+        """Fresh (brk, cnt) flag names, registered as locals AND as
+        loaded names: flags flow through converted-if outputs (so the
+        loaded-names unobservability filter must keep them) and through
+        the while carry."""
+        self.n += 1
+        names = ("_pt_d2s_brk_%d" % self.n, "_pt_d2s_cnt_%d" % self.n)
+        return names
+
+    def _register_flag(self, name: str):
+        self.locals.add(name)
+        if self.loaded is not None:
+            self.loaded.add(name)
+
+    def _lower_loop_jumps(self, node: ast.While, bound):
+        """Lower this while's break/continue into guard flags, mutating
+        ``node`` in place.  Returns (pre_stmts, bound) — pre_stmts seed
+        the break flag before the loop — or None when the shape is
+        refused (loop else, jump inside try), leaving the node
+        untouched."""
+        if not _jumps_at_level(node.body):
+            return [], bound
+        if node.orelse:
+            # python runs a while's else only when no break fired; the
+            # lowered loop cannot skip it, so leave the loop eager
+            return None
+        brk, cnt = self._new_flags()
+        lw = _JumpLower(brk, cnt)
+        new_body, _ = lw.block(node.body)
+        if lw.unsupported:
+            return None
+        pre = []
+        if lw.has_cnt:
+            # reset each iteration: continue only skips the CURRENT
+            # iteration's remainder
+            new_body = [_assign_node(cnt, ast.Constant(value=False))] \
+                + new_body
+            self._register_flag(cnt)
+        if lw.has_brk:
+            pre.append(_assign_node(brk, ast.Constant(value=False)))
+            node.test = ast.Call(
+                func=ast.Name(id="__pt_rt_and", ctx=ast.Load()),
+                args=[node.test, _not_flags([brk])], keywords=[])
+            self._register_flag(brk)
+        node.body = new_body
+        if bound is not None:
+            bound = set(bound) | {n for n, h in
+                                  ((brk, lw.has_brk), (cnt, lw.has_cnt))
+                                  if h}
+        return pre, bound
 
     def _transform_while(self, node: ast.While,
                          successors: List[ast.stmt],
                          bound: Set[str] = None) -> List[ast.stmt]:
-        node.body = self.transform_block(node.body, bound)
+        pre = []
+        lowered = self._lower_loop_jumps(node, bound)
+        if lowered is not None:
+            pre, bound = lowered
+        # the body's continuation is the next iteration (test + body) or
+        # the loop exit (successors)
+        node.body = self.transform_block(
+            node.body, bound,
+            [ast.Expr(value=node.test)] + list(node.body)
+            + list(successors))
         if node.orelse or not _convertible_body(node.body):
-            return [node]
+            return pre + [node]
         assigned = _user_names(_assigned_names(node.body))
         live = (_free_reads([ast.Expr(value=node.test)])  # loop test
                 | _free_reads(node.body)                  # loop-carried
-                | _free_reads(successors)) & self.locals  # read after loop
+                | _free_reads(successors)                 # read after loop
+                | self.closure_reads) & self.locals
         carry = sorted(assigned & live
                        | (_free_reads([ast.Expr(value=node.test)])
                           & self.locals))
         if not (assigned & live):
-            return [node]  # nothing loop-carried: leave untouched
+            return pre + [node]  # nothing loop-carried: leave untouched
         if bound is not None and not set(carry) <= set(bound):
             # a carry name first assigned INSIDE the loop and read after it
             # has no pre-loop value to seed the while_loop carry with; a
             # converted loop would hit UnboundLocalError building the
             # initial carry tuple. Left unconverted: the tracer error (with
             # the define-before-loop rewrite hint) is the honest outcome.
-            return [node]
+            return pre + [node]
         self.n += 1
         i = self.n
         cname, bname = "__pt_wcond_%d" % i, "__pt_wbody_%d" % i
@@ -464,7 +842,7 @@ class _CtrlFlowTransformer:
                       ast.Name(id=bname, ctx=ast.Load()),
                       self._tuple(carry, ast.Load)],
                 keywords=[]))
-        return [cond_def, body_def, call]
+        return pre + [cond_def, body_def, call]
 
 
 class _IfExpTransformer(ast.NodeTransformer):
@@ -531,6 +909,13 @@ def convert(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ConversionError("source of %r is not a function def" % (fn,))
     fdef.decorator_list = []  # @to_static etc. must not re-wrap
+    returns_normalized = False
+    try:
+        # before name analysis: the pass introduces _RV reads/stores that
+        # the locals/loaded sets must see
+        returns_normalized = _normalize_returns(fdef)
+    except _Unsupported:
+        pass  # e.g. return inside a loop: keep the sound fallback
     arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
                                  + fdef.args.kwonlyargs)}
     if fdef.args.vararg:
@@ -545,11 +930,20 @@ def convert(fn: Callable) -> Callable:
         if isinstance(n, ast.AugAssign):
             loaded |= {t.id for t in ast.walk(n.target)
                        if isinstance(t, ast.Name)}
-    tr = _CtrlFlowTransformer(local_names, arg_names, loaded)
+    # names read inside nested defs/lambdas: always live (a later closure
+    # may observe them even when no successor statement reads them)
+    closure_reads: Set[str] = set()
+    for n in _shallow_walk(fdef.body):
+        if isinstance(n, _SCOPE_BARRIERS):
+            closure_reads |= {m.id for m in ast.walk(n)
+                              if isinstance(m, ast.Name)
+                              and isinstance(m.ctx, ast.Load)}
+    tr = _CtrlFlowTransformer(local_names, arg_names, loaded,
+                              closure_reads)
     fdef.body = tr.transform_block(fdef.body)
     te = _IfExpTransformer()
     te.visit(fdef)
-    if tr.n == 0 and te.n == 0:
+    if tr.n == 0 and te.n == 0 and not returns_normalized:
         raise ConversionError(
             "no convertible if/while found in %r"
             % getattr(fn, "__name__", fn))
@@ -560,6 +954,8 @@ def convert(fn: Callable) -> Callable:
     glb["__pt_rt_cond"] = _rt_cond
     glb["__pt_rt_while"] = _rt_while
     glb["__pt_rt_range3"] = _rt_range3
+    glb["__pt_rt_not"] = _rt_not
+    glb["__pt_rt_and"] = _rt_and
     loc: dict = {}
     exec(code, glb, loc)  # noqa: S102 - recompiling user fn, the reference
     new_fn = loc[fdef.name]  # ast_transformer.py does the same via exec

@@ -1,0 +1,246 @@
+"""Is the ~0.42-0.53 measured MFU a chip ceiling or a tunnel artifact?
+
+VERDICT r4 next #6: BERT/ERNIE/GPT train steps measure 0.43-0.53 MFU
+against a bare-matmul chain that itself measures ~0.42 through this
+tunnel — so either the chip tops out there, or every per-call timing
+carries enough axon-transport overhead (~70 ms RPC per host fetch,
+20 MB/s uplink) to depress all of them equally.
+
+Two experiments, both designed so the transport term CANCELS:
+
+1. **Matmul chains at >=3 lengths** (default N = 8, 32, 128, 512
+   dependent 8192x4096 @ 4096x4096 matmuls inside ONE jit via
+   ``lax.fori_loop``).  Total wall time is ``t(N) = overhead + N*dt``;
+   the MARGINAL per-matmul time between successive lengths
+   ``(t(N2)-t(N1))/(N2-N1)`` is pure compute, whatever the overhead.
+   The marginal MFU at the longest pair IS the chip's dense ceiling
+   here — transport cannot contribute to it.
+
+2. **K-step BERT training driver** (K = 1, 4, 16 train steps in ONE
+   jit, fori_loop over the donated functional step).  If the per-step
+   marginal time at K=16 beats the K=1 time materially, the stored
+   0.43 BERT leg was transport-depressed and the marginal number is
+   the honest chip figure; if they match, the leg was already
+   compute-bound and the ceiling is the chip's.
+
+Reference analog: the per-op latency harness of
+``/root/reference/paddle/fluid/operators/benchmark/op_tester.cc:1``
+(config-driven repeat counts amortizing launch overhead).
+
+Run: python tools/ceiling_probe.py [--chains 8 32 128 512] [--ksteps 1 4 16]
+Writes tools/ceiling_report.json; prints one line per leg.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+REPORT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "ceiling_report.json")
+
+M, K_DIM, N_DIM = 8192, 4096, 4096
+CHAIN_FLOPS = 2.0 * M * K_DIM * N_DIM  # per matmul, 2 FLOPs/MAC
+
+
+def _marginal(xs, ts):
+    """Per-unit marginal times between successive (count, time) pairs."""
+    out = []
+    for (n1, t1), (n2, t2) in zip(zip(xs, ts), zip(xs[1:], ts[1:])):
+        out.append({"from": n1, "to": n2,
+                    "dt": (t2 - t1) / (n2 - n1)})
+    return out
+
+
+def matmul_chains(jax, jnp, lax, peak, lengths, dtype):
+    """Time dependent-matmul chains of each length inside one jit."""
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=(2,))
+    def chain(x, w, n):
+        def body(_, acc):
+            # scale keeps values finite over 512 multiplies
+            return (acc @ w) * (1.0 / N_DIM)
+        return lax.fori_loop(0, n, body, x)
+
+    rng = np.random.RandomState(0)
+    x = jax.device_put(jnp.asarray(
+        rng.randn(M, K_DIM).astype("float32"), dtype=dtype))
+    w = jax.device_put(jnp.asarray(
+        rng.randn(K_DIM, N_DIM).astype("float32"), dtype=dtype))
+    legs = []
+    for n in lengths:
+        _ = float(jnp.sum(chain(x, w, n)))  # compile + warm
+        t0 = time.perf_counter()
+        out = chain(x, w, n)
+        s = float(jnp.sum(out))  # host fetch = the synchronization point
+        t = time.perf_counter() - t0
+        legs.append({"n": n, "total_s": round(t, 5),
+                     "per_matmul_s": round(t / n, 6),
+                     "raw_mfu": round(CHAIN_FLOPS * n / t / peak, 4),
+                     "checksum": s})
+        print("chain dtype=%s n=%-4d total %.4fs  raw MFU %.3f"
+              % (dtype, n, t, legs[-1]["raw_mfu"]), flush=True)
+    marg = _marginal([l["n"] for l in legs], [l["total_s"] for l in legs])
+    for m in marg:
+        m["mfu"] = round(CHAIN_FLOPS / m["dt"] / peak, 4) \
+            if m["dt"] > 0 else None  # sub-tick timing (CPU smoke)
+        m["dt"] = round(m["dt"], 6)
+        print("  marginal %d->%d: %.4f ms/matmul  MFU %s"
+              % (m["from"], m["to"], m["dt"] * 1e3, m["mfu"]), flush=True)
+    return {"legs": legs, "marginal": marg, "dtype": str(dtype)}
+
+
+def bert_ksteps(pt, jax, jnp, lax, peak, ks, batch=40, seq=512):
+    """K fully-donated BERT train steps inside one jit; marginal per-step
+    time across K separates transport overhead from train-step compute."""
+    from paddle_tpu.jit import TrainStep
+    from paddle_tpu.models import (TransformerLM, TransformerLMCriterion,
+                                   bert_base_config)
+
+    pt.seed(0)
+    cfg = bert_base_config()
+    model = TransformerLM(**cfg, dropout=0.0)
+    criterion = TransformerLMCriterion(shift_labels=False)
+    opt = pt.optimizer.AdamW(1e-4, parameters=model.parameters())
+    model, opt = pt.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+
+    def loss_fn(m, ids, labels):
+        with pt.amp.auto_cast(level="O1", dtype="bfloat16"):
+            return criterion(m(ids), labels)
+
+    ts = TrainStep(model, loss_fn, opt, donate=False)
+    binding = ts._binding
+    mode = binding.mode_token()
+    rng = np.random.RandomState(0)
+    ids = jax.device_put(
+        rng.randint(0, cfg["vocab_size"], (batch, seq)).astype("int32"))
+    lr = jnp.asarray(opt.get_lr(), jnp.float32)
+    flops_step = model.flops_per_token(seq) * batch * seq
+
+    import functools
+
+    @functools.partial(jax.jit, static_argnums=(4,),
+                       donate_argnums=(0, 1, 2))
+    def multi(par, st, bufs, key, k):
+        def body(_, carry):
+            par, st, bufs, key = carry
+            key, sub = jax.random.split(key)
+            loss, par, st, bufs = ts._step(par, st, bufs, sub, lr, mode,
+                                           [ids, ids])
+            return (par, st, bufs, key)
+        par, st, bufs, key = lax.fori_loop(0, k, body,
+                                           (par, st, bufs, key))
+        # the loss of a final extra step is the host-visible sync value
+        loss, par, st, bufs = ts._step(par, st, bufs, key, lr, mode,
+                                       [ids, ids])
+        return loss, par, st, bufs
+
+    from paddle_tpu.core.random import next_key
+
+    legs = []
+    # extracted ONCE: every multi() call donates the state and returns
+    # the successor buffers, which the next call consumes — the model
+    # object's own references are dead after the first call by design
+    par = [p._value for p in binding.params]
+    st = [opt._states[p.name] for p in ts._opt_params]
+    bufs = [b._value for b in binding.buffers]
+    key = next_key()
+    for steps in ks:
+        if steps < 1:
+            continue
+        k = steps - 1  # fori count; multi() runs one final step on top
+        loss, par, st, bufs = multi(par, st, bufs, key, k)  # compile+warm
+        float(loss)
+        t0 = time.perf_counter()
+        loss, par, st, bufs = multi(par, st, bufs, key, k)
+        float(loss)
+        t = time.perf_counter() - t0
+        legs.append({"k": steps, "total_s": round(t, 5),
+                     "per_step_s": round(t / steps, 5),
+                     "raw_mfu": round(flops_step * steps / t / peak, 4)})
+        print("bert ksteps=%-3d total %.4fs  %.4f s/step  raw MFU %.3f"
+              % (steps, t, t / steps, legs[-1]["raw_mfu"]), flush=True)
+    marg = _marginal([l["k"] for l in legs], [l["total_s"] for l in legs])
+    for m in marg:
+        m["mfu"] = round(flops_step / m["dt"] / peak, 4) \
+            if m["dt"] > 0 else None
+        m["dt"] = round(m["dt"], 5)
+        print("  marginal %d->%d: %.4f s/step  MFU %s"
+              % (m["from"], m["to"], m["dt"], m["mfu"]), flush=True)
+    return {"legs": legs, "marginal": marg, "batch": batch, "seq": seq}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--chains", type=int, nargs="+",
+                    default=[8, 32, 128, 512])
+    ap.add_argument("--ksteps", type=int, nargs="+", default=[1, 4, 16],
+                    help="TOTAL train steps per jit call (each leg)")
+    ap.add_argument("--cpu-smoke", action="store_true",
+                    help="tiny shapes on CPU to exercise the harness")
+    args = ap.parse_args()
+
+    from bench import _acquire_chip_lock, _peak_flops
+    if not args.cpu_smoke and _acquire_chip_lock(timeout_s=600.0) is None:
+        sys.exit("another process holds the chip lock; not contending")
+
+    if args.cpu_smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        global M, K_DIM, N_DIM, CHAIN_FLOPS
+        M = K_DIM = N_DIM = 128
+        CHAIN_FLOPS = 2.0 * M * K_DIM * N_DIM
+        args.chains = args.chains if args.chains != [8, 32, 128, 512] \
+            else [2, 4]
+        args.ksteps = [1, 2]
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import paddle_tpu as pt
+
+    on_tpu = jax.default_backend() != "cpu"
+    if not on_tpu and not args.cpu_smoke:
+        sys.exit("accelerator not reachable; refusing to 'measure' CPU")
+    peak = _peak_flops(jax, on_tpu)
+    report = {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+              "backend": jax.devices()[0].device_kind,
+              "peak_flops": peak}
+    if args.cpu_smoke:
+        cfgs = [("float32", jnp.float32)]
+    else:
+        cfgs = [("bfloat16", jnp.bfloat16), ("float32", jnp.float32)]
+    report["matmul_chains"] = {
+        name: matmul_chains(jax, jnp, lax, peak, args.chains, dt)
+        for name, dt in cfgs}
+    if args.cpu_smoke:
+        # shrink the model drastically for the harness smoke
+        import paddle_tpu.models as _m
+        base = _m.bert_base_config
+        _m.bert_base_config = lambda: dict(
+            base(), num_layers=2, hidden_size=64, num_heads=2,
+            intermediate_size=128, vocab_size=256)
+        try:
+            report["bert_ksteps"] = bert_ksteps(pt, jax, jnp, lax, peak,
+                                                args.ksteps, batch=2, seq=32)
+        finally:
+            _m.bert_base_config = base
+    else:
+        report["bert_ksteps"] = bert_ksteps(pt, jax, jnp, lax, peak,
+                                            args.ksteps)
+    with open(REPORT, "w") as f:
+        json.dump(report, f, indent=2)
+    print("report:", REPORT)
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,9 @@ everything still banks whatever finished:
                   done when the stored record's git_rev is HEAD)
 3. bert sweep   — tools/bert_sweep.py 40 48 56 64 80 (knee hunt past
                   batch 48; output banked to tools/bert_sweep_onchip.log)
+4. ceiling      — tools/ceiling_probe.py (marginal-time matmul chains +
+                  K-step BERT driver: chip ceiling vs tunnel RPC; done
+                  when ceiling_report.json carries a TPU backend)
 
 Run:  python tools/onchip_session.py [--max-wait 10800]
 """
@@ -63,6 +66,16 @@ def sweep_done() -> bool:
         return False
 
 
+def ceiling_done() -> bool:
+    try:
+        with open(os.path.join(HERE, "ceiling_report.json")) as f:
+            rep = json.load(f)
+        return "cpu" not in rep.get("backend", "cpu").lower() \
+            and "bert_ksteps" in rep
+    except Exception:  # noqa: BLE001
+        return False
+
+
 def _run(phase, argv, timeout_s, log_path=None):
     print("[onchip] %s: %s" % (phase, " ".join(argv)), flush=True)
     out = open(log_path, "a") if log_path else None
@@ -88,6 +101,9 @@ PHASES = (
      lambda: _run("bert-sweep",
                   [os.path.join(HERE, "bert_sweep.py")] + SWEEP_BATCHES,
                   1800, log_path=SWEEP_LOG)),
+    ("ceiling", ceiling_done,
+     lambda: _run("ceiling", [os.path.join(HERE, "ceiling_probe.py")],
+                  1800)),
 )
 
 
