@@ -1,0 +1,95 @@
+"""Durable completion markers of the on-chip measurement orchestrator.
+
+tools/onchip_session.py banks per-phase progress across tunnel windows;
+these tests pin the marker predicates (pure logic, no chip): a phase
+must read as done exactly when its artifact proves the work happened
+under the CURRENT measurement conventions.
+"""
+import json
+import os
+
+import pytest
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture()
+def session(monkeypatch):
+    monkeypatch.syspath_prepend(TOOLS)
+    monkeypatch.syspath_prepend(os.path.dirname(TOOLS))
+    import onchip_session
+    return onchip_session
+
+
+def test_grab_done_requires_current_convention(session, monkeypatch,
+                                               tmp_path):
+    import bench
+    import grab_resnet_onchip as grab
+    out = tmp_path / "grab.jsonl"
+    monkeypatch.setattr(grab, "OUT", str(out))
+    # legs recorded under a STALE convention must not count as captured
+    with open(out, "w") as f:
+        for fmt, s2d in grab.CONFIGS:
+            f.write(json.dumps({"fmt": fmt, "s2d": s2d, "mfu": 0.09,
+                                "mfu_convention": 1}) + "\n")
+    assert grab._captured() == set()
+    assert not session.grab_done()
+    # same legs at the current convention complete the phase
+    with open(out, "w") as f:
+        for fmt, s2d in grab.CONFIGS:
+            f.write(json.dumps(
+                {"fmt": fmt, "s2d": s2d, "mfu": 0.3,
+                 "mfu_convention": bench.RESNET_MFU_CONVENTION}) + "\n")
+    assert grab._captured() == {(f, bool(s)) for f, s in grab.CONFIGS}
+    assert session.grab_done()
+
+
+def test_grab_error_lines_do_not_count(session, monkeypatch, tmp_path):
+    import bench
+    import grab_resnet_onchip as grab
+    out = tmp_path / "grab.jsonl"
+    monkeypatch.setattr(grab, "OUT", str(out))
+    with open(out, "w") as f:
+        f.write(json.dumps({"error": "measure child timed out"}) + "\n")
+        f.write(json.dumps({"fmt": "NHWC", "s2d": True, "error": "OOM",
+                            "mfu_convention":
+                                bench.RESNET_MFU_CONVENTION}) + "\n")
+    assert grab._captured() == set()
+
+
+def test_bench_done_tracks_head_rev(session, monkeypatch, tmp_path):
+    rec = tmp_path / "TPU_MEASUREMENT.json"
+    monkeypatch.setattr(session, "REPO", str(tmp_path))
+    monkeypatch.setattr(session, "_head_rev", lambda: "abc1234")
+    rec.write_text(json.dumps({"git_rev": "abc1234"}))
+    assert session.bench_done()
+    # a record banked at an older rev means the bench must re-run
+    rec.write_text(json.dumps({"git_rev": "0000000"}))
+    assert not session.bench_done()
+
+
+def test_ceiling_done_requires_tpu_backend(session, monkeypatch,
+                                           tmp_path):
+    rep = tmp_path / "ceiling_report.json"
+    monkeypatch.setattr(session, "HERE", str(tmp_path))
+    assert not session.ceiling_done()  # no report yet
+    rep.write_text(json.dumps({"backend": "cpu", "bert_ksteps": {}}))
+    assert not session.ceiling_done()  # CPU smoke must not satisfy it
+    rep.write_text(json.dumps({"backend": "TPU v5 lite",
+                               "bert_ksteps": {"legs": []}}))
+    assert session.ceiling_done()
+    rep.write_text(json.dumps({"backend": "TPU v5 lite"}))
+    assert not session.ceiling_done()  # chains alone are not the phase
+
+
+def test_sweep_done_needs_every_batch(session, monkeypatch, tmp_path):
+    log = tmp_path / "sweep.log"
+    monkeypatch.setattr(session, "SWEEP_LOG", str(log))
+    assert not session.sweep_done()
+    log.write_text("".join("batch=%s seq=512: 100 tok/s\n" % b
+                           for b in session.SWEEP_BATCHES[:-1]))
+    assert not session.sweep_done()
+    log.write_text("".join("batch=%s seq=512: 100 tok/s\n" % b
+                           for b in session.SWEEP_BATCHES))
+    assert session.sweep_done()
