@@ -43,6 +43,7 @@ from ..nn.layer.layers import Layer
 
 __all__ = [
     "to_static", "not_to_static", "StaticFunction", "InputSpec", "TrainStep",
+    "MultiStepTrainStep",
     "save", "load", "TranslatedLayer", "ProgramTranslator", "TracedLayer",
     "set_code_level", "set_verbosity", "enable_to_static",
 ]
@@ -397,6 +398,7 @@ class TrainStep:
             for v in jax.tree.leaves(optimizer._states[p.name]))
         donate_argnums = ((0, 2) if states_offloaded else (0, 1, 2)) \
             if donate else ()
+        self._donate_argnums = donate_argnums
         self._jitted = jax.jit(self._step, static_argnums=(5,), donate_argnums=donate_argnums)
 
     def _step(self, param_vals, opt_states, buf_vals, key, lr, mode, batch_leaves):
@@ -480,6 +482,80 @@ class TrainStep:
         for b, v in zip(binding.buffers, new_bufs):
             b._replace_value(v)
         return Tensor(loss, stop_gradient=True)
+
+
+class MultiStepTrainStep(TrainStep):
+    """K optimizer steps per dispatch, inside ONE jitted call.
+
+    ``lax.scan`` over the leading axis of every batch leaf: each batch
+    input is stacked ``[K, ...]`` and the parameters/optimizer
+    states/buffers thread through the scan carry, fully donated, with the
+    per-step RNG keys split from one dispatch key.  Returns the ``[K]``
+    per-step losses.
+
+    TPU-native rationale: a single-step dispatch pays host→device launch
+    latency per optimizer step; over a thin transport (the tunneled-chip
+    regime ``tools/ceiling_probe.py`` measures) that latency can dominate
+    a ~50 ms step.  Batching K steps amortizes it to 1/K without changing
+    the math — the same trick the reference's Executor achieves by
+    running a multi-iteration Program per ``run()``
+    (``fluid/executor.py:1`` run-loop semantics).
+
+    Caveats: the learning rate is read once per DISPATCH, so an
+    LRScheduler advances per K steps (call ``scheduler.step(K)`` or keep
+    K small relative to the schedule's granularity); per-step host-side
+    callbacks cannot observe intermediate states.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 steps_per_call: int, donate: Optional[bool] = None):
+        if steps_per_call < 1:
+            raise InvalidArgumentError(
+                "MultiStepTrainStep: steps_per_call must be >= 1, got %r"
+                % (steps_per_call,))
+        super().__init__(model, loss_fn, optimizer, donate=donate)
+        if any(getattr(getattr(v, "sharding", None), "memory_kind", None)
+               == "pinned_host"
+               for p in self._opt_params
+               for v in jax.tree.leaves(optimizer._states[p.name])):
+            # _step's in-trace device_put of offloaded states would make
+            # the scan carry's input and output memory kinds disagree
+            raise InvalidArgumentError(
+                "MultiStepTrainStep does not support pinned_host "
+                "(ZeRO-offload) optimizer states; use TrainStep for the "
+                "offloaded path")
+        self.steps_per_call = steps_per_call
+        self._jitted = jax.jit(self._multi, static_argnums=(5,),
+                               donate_argnums=self._donate_argnums)
+
+    def _multi(self, param_vals, opt_states, buf_vals, key, lr, mode,
+               batch_leaves):
+        def body(carry, leaves):
+            pv, st, bv, key = carry
+            key, sub = jax.random.split(key)
+            loss, pv, st, bv = self._step(pv, st, bv, sub, lr, mode,
+                                          list(leaves))
+            return (pv, st, bv, key), loss
+
+        (pv, st, bv, _), losses = jax.lax.scan(
+            body, (param_vals, opt_states, buf_vals, key), batch_leaves)
+        return losses, pv, st, bv
+
+    def __call__(self, *batch):
+        k = self.steps_per_call
+        for i, b in enumerate(batch):
+            shape = getattr(_unwrap(b), "shape", None)
+            if shape is None or len(shape) == 0:
+                raise InvalidArgumentError(
+                    "MultiStepTrainStep: batch input %d is a scalar; "
+                    "scan needs a [%d, ...] leading step axis — stack "
+                    "it, or close over constants in loss_fn" % (i, k))
+            if shape[0] != k:
+                raise InvalidArgumentError(
+                    "MultiStepTrainStep(steps_per_call=%d): batch input "
+                    "%d must be stacked [%d, ...], got shape %s"
+                    % (k, i, k, shape))
+        return super().__call__(*batch)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,124 @@
+"""MultiStepTrainStep: K donated optimizer steps per jitted dispatch.
+
+Semantics pinned against the single-step TrainStep: with dropout off
+(RNG-independent loss), K stacked batches through one multi-step
+dispatch must land on the same parameters and losses as K sequential
+single-step calls on the same batches.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit import MultiStepTrainStep, TrainStep
+
+
+def _build(seed=0):
+    pt.seed(seed)
+    model = pt.nn.Sequential(
+        pt.nn.Linear(8, 16), pt.nn.ReLU(), pt.nn.Linear(16, 4))
+    criterion = pt.nn.CrossEntropyLoss()
+    opt = pt.optimizer.Momentum(0.1, parameters=model.parameters())
+    return model, (lambda m, x, y: criterion(m(x), y)), opt
+
+
+def test_matches_sequential_single_steps():
+    k, batch = 3, 16
+    rng = np.random.RandomState(0)
+    xs = rng.randn(k, batch, 8).astype("float32")
+    ys = rng.randint(0, 4, (k, batch)).astype("int64")
+
+    model_a, loss_a, opt_a = _build()
+    single = TrainStep(model_a, loss_a, opt_a, donate=False)
+    seq_losses = [float(single(xs[i], ys[i]).value) for i in range(k)]
+
+    model_b, loss_b, opt_b = _build()
+    multi = MultiStepTrainStep(model_b, loss_b, opt_b, steps_per_call=k,
+                               donate=False)
+    losses = np.asarray(multi(xs, ys).value)
+    assert losses.shape == (k,)
+    np.testing.assert_allclose(losses, seq_losses, rtol=1e-5)
+
+    for pa, pb in zip(model_a.parameters(), model_b.parameters()):
+        np.testing.assert_allclose(np.asarray(pa.value),
+                                   np.asarray(pb.value), rtol=1e-5,
+                                   atol=1e-6)
+
+
+def test_consecutive_dispatches_continue_training():
+    k = 2
+    rng = np.random.RandomState(1)
+    model, loss_fn, opt = _build()
+    multi = MultiStepTrainStep(model, loss_fn, opt, steps_per_call=k,
+                               donate=False)
+    first = last = None
+    for it in range(4):
+        xs = rng.randn(k, 16, 8).astype("float32")
+        ys = rng.randint(0, 4, (k, 16)).astype("int64")
+        losses = np.asarray(multi(xs, ys).value)
+        if first is None:
+            first = losses[0]
+        last = losses[-1]
+    assert last < first  # it actually optimizes across dispatches
+
+
+def test_rejects_unstacked_batch():
+    model, loss_fn, opt = _build()
+    multi = MultiStepTrainStep(model, loss_fn, opt, steps_per_call=4,
+                               donate=False)
+    xs = np.random.randn(3, 8, 8).astype("float32")  # leading dim 3 != 4
+    ys = np.random.randint(0, 4, (3, 8)).astype("int64")
+    with pytest.raises(Exception, match="stacked"):
+        multi(xs, ys)
+
+
+def test_rejects_bad_steps_per_call():
+    model, loss_fn, opt = _build()
+    with pytest.raises(Exception, match="steps_per_call"):
+        MultiStepTrainStep(model, loss_fn, opt, steps_per_call=0)
+
+
+def test_donated_buffers_path():
+    # the donated default must work across dispatches (fresh leaves are
+    # threaded back into the model by __call__'s bookkeeping)
+    k = 2
+    rng = np.random.RandomState(2)
+    model, loss_fn, opt = _build()
+    multi = MultiStepTrainStep(model, loss_fn, opt, steps_per_call=k)
+    for _ in range(2):
+        xs = rng.randn(k, 8, 8).astype("float32")
+        ys = rng.randint(0, 4, (k, 8)).astype("int64")
+        losses = multi(xs, ys)
+    assert np.asarray(losses.value).shape == (k,)
+
+
+def test_rejects_scalar_batch_input():
+    model, loss_fn, opt = _build()
+    multi = MultiStepTrainStep(
+        model, lambda m, x, y, w: loss_fn(m, x, y) * w, opt,
+        steps_per_call=2, donate=False)
+    xs = np.random.randn(2, 8, 8).astype("float32")
+    ys = np.random.randint(0, 4, (2, 8)).astype("int64")
+    with pytest.raises(Exception, match="scalar"):
+        multi(xs, ys, np.float32(0.5))
+
+
+def test_rejects_offloaded_states():
+    model, loss_fn, opt = _build()
+    # fabricate a pinned_host-shaded state leaf the guard must detect
+    p = [q for q in model.parameters() if not q.stop_gradient][0]
+    opt._state_for(p)
+
+    class _FakeSharding:
+        memory_kind = "pinned_host"
+
+    class _FakeLeaf:
+        sharding = _FakeSharding()
+
+    states = opt._states[p.name]
+    opt._states[p.name] = {"fake": _FakeLeaf(), "real": states}
+    try:
+        with pytest.raises(Exception, match="pinned_host"):
+            MultiStepTrainStep(model, loss_fn, opt, steps_per_call=2,
+                               donate=False)
+    finally:
+        opt._states[p.name] = states
