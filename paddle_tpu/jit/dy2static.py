@@ -34,9 +34,15 @@ trace time.  This module provides the two halves of the reference's story:
      both ``lax.cond`` branches yield the SAME result structure instead
      of a None-seeded carry.
 
+   ``return`` inside a LOOP also converts when the returned expression
+   reads only pre-loop-bound names: it lowers to ``_rv``-assign + flag +
+   ``break`` with the result carry seeded pre-loop by the same
+   expression (structure only — the seed value is dead unless selected),
+   and the post-loop continuation guarded on the flag's negation.
+
    Unconvertible shapes are left untouched (a static-bool ``if`` still
-   traces fine as-is); returns inside loop bodies and jumps inside
-   ``try`` blocks stay with the sound fallback + hint.
+   traces fine as-is); loop returns reading loop-fresh names and jumps
+   inside ``try`` blocks stay with the sound fallback + hint.
 
 2. :func:`hint_for_tracer_error` — the message ``to_static`` attaches when
    tracing still hits a tracer-boolean error (used by
@@ -279,18 +285,20 @@ def _has_return(stmts) -> bool:
     return any(isinstance(n, ast.Return) for n in _shallow_walk(stmts))
 
 
-def _return_in_if(stmts) -> bool:
-    """True when a Return sits under an If (at any non-scope depth) —
-    the trigger for normalization; plain tail returns need nothing."""
+def _return_nested(stmts) -> bool:
+    """True when a Return sits under an If or a loop (at any non-scope
+    depth) — the trigger for normalization; plain tail returns need
+    nothing."""
     stack = [(s, False) for s in stmts]
     while stack:
-        s, in_if = stack.pop()
-        if isinstance(s, ast.Return) and in_if:
+        s, nested = stack.pop()
+        if isinstance(s, ast.Return) and nested:
             return True
         if isinstance(s, _SCOPE_BARRIERS):
             continue
         for c in ast.iter_child_nodes(s):
-            stack.append((c, in_if or isinstance(s, ast.If)))
+            stack.append((c, nested or isinstance(
+                s, (ast.If, ast.For, ast.AsyncFor, ast.While))))
     return False
 
 
@@ -305,9 +313,9 @@ def _terminates(stmts) -> bool:
     return False
 
 
-def _norm_block(stmts) -> list:
+def _norm_block(stmts, bound, local_names) -> list:
     """Statements where EVERY path assigns ``_RV`` (or raises)."""
-    new, term = _norm_tail(list(stmts))
+    new, term = _norm_tail(list(stmts), bound, local_names)
     if not term:
         # falling off the end of a tail block is python's implicit
         # `return None`
@@ -315,14 +323,121 @@ def _norm_block(stmts) -> list:
     return new
 
 
-def _norm_tail(stmts):
+_LOOP_LEVEL_BARRIERS = (ast.For, ast.AsyncFor, ast.While, *_SCOPE_BARRIERS)
+
+
+def _at_loop_level(stmts, types):
+    """Nodes of the given types belonging to THIS loop body — the walk
+    every loop-level analysis shares: nested loops and nested scopes own
+    their jumps/returns, so the traversal never descends into them."""
+    out = []
+    stack = list(stmts)
+    while stack:
+        s = stack.pop()
+        if isinstance(s, types):
+            out.append(s)
+            continue
+        if isinstance(s, _LOOP_LEVEL_BARRIERS):
+            continue
+        stack.extend(ast.iter_child_nodes(s))
+    return out
+
+
+def _has_user_break(stmts) -> bool:
+    """A Break written by the USER at this loop's level (checked before
+    return lowering introduces its own breaks)."""
+    return bool(_at_loop_level(stmts, ast.Break))
+
+
+def _returns_at_loop_level(stmts):
+    """Return nodes belonging to THIS loop body (not nested loops')."""
+    return _at_loop_level(stmts, ast.Return)
+
+
+class _LoopReturnLower(ast.NodeTransformer):
+    """``return e`` inside one loop's body -> ``_RV = e; flag = True;
+    break`` (the break_continue machinery then converts the exit)."""
+
+    def __init__(self, flag):
+        self.flag = flag
+
+    def visit(self, node):
+        if isinstance(node, (ast.For, ast.AsyncFor, ast.While,
+                             *_SCOPE_BARRIERS)):
+            return node  # nested loops/scopes own their returns
+        return super().visit(node)
+
+    def visit_Return(self, node: ast.Return):
+        return [_assign_node(_RV, node.value if node.value is not None
+                             else ast.Constant(value=None)),
+                _assign_node(self.flag, ast.Constant(value=True)),
+                ast.Break()]
+
+
+def _lower_loop_returns(s, bound, flag, local_names, allow_bare=False):
+    """Rewrite a loop statement whose body returns: (pre_stmts, loop').
+    Raises _Unsupported for shapes that cannot seed the result carry."""
+    rets = _returns_at_loop_level(s.body)
+    total = sum(1 for n in _shallow_walk(s.body)
+                if isinstance(n, ast.Return))
+    if not rets or total != len(rets):
+        # returns inside NESTED loops of this body (alone or alongside
+        # loop-level ones): the lowerer would leave a raw Return behind;
+        # one level is supported, deeper nesting keeps the fallback
+        raise _Unsupported("return in nested loop")
+    if s.orelse:
+        raise _Unsupported("return in loop with else")
+    vals = [r.value for r in rets]
+    if any(v is None for v in vals) and any(v is not None for v in vals):
+        raise _Unsupported("mixed bare and value returns in loop")
+    if vals[0] is None and not allow_bare:
+        # bare returns seed _RV=None; a reachable continuation returning
+        # a VALUE would then join mismatching structures at the guard
+        # cond — keep the curated fallback instead of an opaque error
+        raise _Unsupported("bare return in loop with a reachable "
+                           "continuation")
+    # the while carry needs _RV bound BEFORE the loop with the same
+    # structure the in-loop returns produce: seed it by evaluating the
+    # first return's expression on the pre-loop values (pure tensor
+    # math; its value is dead unless the loop never rebinds _RV, which
+    # implies the flag stayed False and the seed is never selected).
+    # Only FUNCTION-LOCAL reads need a pre-loop binding — globals and
+    # builtins (pt, np, helper fns) resolve at runtime regardless.
+    seed = vals[0] if vals[0] is not None else ast.Constant(value=None)
+    free = _free_reads([ast.Expr(value=seed)]) & set(local_names)
+    if not free <= bound:
+        raise _Unsupported(
+            "loop return value reads locals unbound before the loop: %s"
+            % sorted(free - bound))
+    import copy
+
+    pre = [_assign_node(flag, ast.Constant(value=False)),
+           _assign_node(_RV, copy.deepcopy(seed))]
+    loop = copy.deepcopy(s)
+    lower = _LoopReturnLower(flag)
+    # transform the BODY's statements (the visitor's loop/scope guard
+    # would otherwise skip the loop node we are lowering)
+    new_body = []
+    for st in loop.body:
+        r = lower.visit(st)
+        new_body.extend(r if isinstance(r, list) else [r])
+    loop.body = new_body
+    ast.fix_missing_locations(loop)
+    return pre, loop
+
+
+def _norm_tail(stmts, bound, local_names):
     """Rewrite a TAIL-position statement list (falling off its end ends
-    the function): every ``return e`` becomes ``_RV = e``, and an ``if``
+    the function): every ``return e`` becomes ``_RV = e``, an ``if``
     whose branch returns absorbs the post-if continuation into whichever
     branches fall through — so both sides of the eventual ``lax.cond``
-    compute a real result value instead of a None placeholder.  Returns
-    (new_stmts, terminates)."""
+    compute a real result value instead of a None placeholder — and a
+    LOOP whose body returns is lowered to ``_RV``-assign + flag + break
+    with the continuation guarded on the flag's negation.  ``bound``:
+    names possibly bound before the first statement (for the loop-return
+    seed check).  Returns (new_stmts, terminates)."""
     out = []
+    bound = set(bound)
     for idx, s in enumerate(stmts):
         rest = stmts[idx + 1:]
         if isinstance(s, ast.Return):
@@ -334,13 +449,40 @@ def _norm_tail(stmts):
             out.append(s)
             return out, True
         if _has_return([s]):
-            if not isinstance(s, ast.If):
-                # return inside for/while/try/with: a while_loop carry
-                # would need a pre-seeded result of unknowable structure;
-                # the sound fallback (tracer hint) is the honest outcome
-                raise _Unsupported(type(s).__name__)
             import copy
 
+            if isinstance(s, (ast.For, ast.While)):
+                # `while <truthy constant>` whose ONLY exit is the
+                # lowered return: the continuation is unreachable —
+                # emitting its implicit rv=None would poison the cond
+                # structure
+                only_exit_is_return = (
+                    isinstance(s, ast.While)
+                    and isinstance(s.test, ast.Constant)
+                    and bool(s.test.value)
+                    and not _has_user_break(s.body))
+                flag = "_pt_d2s_lret_%d" % (idx + len(out) + 1)
+                pre, loop = _lower_loop_returns(
+                    s, bound, flag, local_names,
+                    allow_bare=only_exit_is_return)
+                out.extend(pre)
+                out.append(loop)
+                if only_exit_is_return:
+                    return out, True
+                # the continuation runs only when the loop exited
+                # without returning; its paths all assign _RV, while the
+                # taken-return path keeps the loop's _RV
+                cont_bound = bound | _assigned_names([loop]) | {flag, _RV}
+                out.append(ast.If(
+                    test=_not_flags([flag]),
+                    body=_norm_block(copy.deepcopy(rest), cont_bound,
+                                     local_names),
+                    orelse=[]))
+                return out, True
+            if not isinstance(s, ast.If):
+                # return inside try/with: handler interactions are not
+                # modeled; the sound fallback (tracer hint) remains
+                raise _Unsupported(type(s).__name__)
             # each branch gets its OWN copy of the continuation: later
             # passes mutate statements in place (loop jump lowering
             # rewrites a While's test/body), and a node aliased into
@@ -350,20 +492,26 @@ def _norm_tail(stmts):
                 else list(s.body) + copy.deepcopy(rest)
             orelse = list(s.orelse) if s.orelse and _terminates(s.orelse) \
                 else list(s.orelse) + copy.deepcopy(rest)
-            out.append(ast.If(test=s.test, body=_norm_block(body),
-                              orelse=_norm_block(orelse)))
+            branch_bound = bound
+            out.append(ast.If(test=s.test,
+                              body=_norm_block(body, branch_bound,
+                                               local_names),
+                              orelse=_norm_block(orelse, branch_bound,
+                                                 local_names)))
             return out, True
         out.append(s)
+        bound |= _assigned_names([s])
     return out, False
 
 
-def _normalize_returns(fdef) -> bool:
+def _normalize_returns(fdef, arg_names) -> bool:
     """Apply return normalization to a function body in place; True when
     the pass ran.  The body afterwards has exactly one ``return _RV`` at
     the end and no Return anywhere else (outside nested scopes)."""
-    if not _return_in_if(fdef.body):
+    if not _return_nested(fdef.body):
         return False
-    body = _norm_block(fdef.body)
+    local_names = _assigned_names(fdef.body) | set(arg_names)
+    body = _norm_block(fdef.body, set(arg_names), local_names)
     new = body + [ast.Return(value=ast.Name(id=_RV, ctx=ast.Load()))]
     # continuation duplication is linear for return ladders but can
     # compound for deeply nested fall-through returns; refuse pathological
@@ -381,16 +529,7 @@ def _normalize_returns(fdef) -> bool:
 def _jumps_at_level(stmts) -> bool:
     """True when a Break/Continue belongs to THIS loop body (nested
     loops own theirs)."""
-    stack = list(stmts)
-    while stack:
-        s = stack.pop()
-        if isinstance(s, (ast.Break, ast.Continue)):
-            return True
-        if isinstance(s, (ast.For, ast.AsyncFor, ast.While,
-                          *_SCOPE_BARRIERS)):
-            continue
-        stack.extend(ast.iter_child_nodes(s))
-    return False
+    return bool(_at_loop_level(stmts, (ast.Break, ast.Continue)))
 
 
 def _not_flags(names) -> ast.expr:
@@ -909,19 +1048,19 @@ def convert(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         raise ConversionError("source of %r is not a function def" % (fn,))
     fdef.decorator_list = []  # @to_static etc. must not re-wrap
-    returns_normalized = False
-    try:
-        # before name analysis: the pass introduces _RV reads/stores that
-        # the locals/loaded sets must see
-        returns_normalized = _normalize_returns(fdef)
-    except _Unsupported:
-        pass  # e.g. return inside a loop: keep the sound fallback
     arg_names = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args
                                  + fdef.args.kwonlyargs)}
     if fdef.args.vararg:
         arg_names.add(fdef.args.vararg.arg)
     if fdef.args.kwarg:
         arg_names.add(fdef.args.kwarg.arg)
+    returns_normalized = False
+    try:
+        # before name analysis: the pass introduces _RV reads/stores that
+        # the locals/loaded sets must see
+        returns_normalized = _normalize_returns(fdef, arg_names)
+    except _Unsupported:
+        pass  # e.g. unseedable loop return: keep the sound fallback
     local_names = _assigned_names(fdef.body) | arg_names
     loaded = {n.id for n in ast.walk(fdef)
               if isinstance(n, ast.Name)
