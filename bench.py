@@ -338,6 +338,29 @@ def bench_mnist(pt, jax, on_tpu: bool):
     return _sweep_best(batches, leg)
 
 
+def bench_mnist_multistep(pt, jax, on_tpu: bool):
+    """MNIST LeNet with 32 scanned steps per dispatch: a sub-millisecond
+    step is dispatch-latency-bound no matter how inputs are staged, so
+    the honest steps/sec for tiny models comes from the multi-step
+    driver (tagged steps_per_call; compare against mnist_lenet)."""
+    from paddle_tpu.jit import MultiStepTrainStep
+    from paddle_tpu.vision.models import LeNet
+
+    pt.seed(0)
+    k, batch, iters = (32, 2048, 4) if on_tpu else (4, 64, 2)
+    model = LeNet()
+    criterion = pt.nn.CrossEntropyLoss()
+    opt = pt.optimizer.Adam(1e-3, parameters=model.parameters())
+    step = MultiStepTrainStep(model, lambda m, x, y: criterion(m(x), y),
+                              opt, steps_per_call=k)
+    rng = np.random.RandomState(0)
+    imgs = rng.rand(k, batch, 1, 28, 28).astype("float32")
+    labels = rng.randint(0, 10, (k, batch)).astype("int64")
+    dt, loss = _time_steps(step, (imgs, labels), iters)
+    return {"imgs_per_sec": k * batch / dt, "step_time_s": dt / k,
+            "steps_per_call": k, "batch": batch, "loss": loss}
+
+
 def bench_ernie_sharding(pt, jax, on_tpu: bool):
     """Config #4: ERNIE-base fine-tune through the ZeRO stage-2 sharding
     machinery (single-chip timing: the sharding group is the 1-device mesh,
@@ -718,7 +741,8 @@ def _measure_and_print():
                      ("ernie_sharding", bench_ernie_sharding),
                      ("gpt_pp_mp", bench_gpt_block),
                      ("longseq_flash_8k", bench_longseq_flash),
-                     ("bert_k8_multistep", bench_bert_multistep)):
+                     ("bert_k8_multistep", bench_bert_multistep),
+                     ("mnist_k32_multistep", bench_mnist_multistep)):
         try:
             legs[name] = fn(pt, jax, on_tpu)
         except Exception as e:  # noqa: BLE001 - keep remaining legs alive
