@@ -112,13 +112,22 @@ def main() -> int:
     if "--max-wait" in sys.argv:
         max_wait = float(sys.argv[sys.argv.index("--max-wait") + 1])
     deadline = time.time() + max_wait
+    probes = 0
+    last_beat = time.time()
+    print("[onchip] started %s; max-wait %.0fs"
+          % (time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), max_wait),
+          flush=True)
     while time.time() < deadline:
         todo = [name for name, done, _ in PHASES if not done()]
         if not todo:
             print("[onchip] all phases banked", flush=True)
             return 0
         if probe():
-            print("[onchip] tunnel up; remaining: %s" % todo, flush=True)
+            print("[onchip] %s tunnel UP after %d down-probes; remaining: %s"
+                  % (time.strftime("%H:%M:%SZ", time.gmtime()), probes, todo),
+                  flush=True)
+            probes = 0
+            last_beat = time.time()  # a fresh outage, a fresh half-hour
             for name, done, run in PHASES:
                 if not done():
                     run()
@@ -128,6 +137,15 @@ def main() -> int:
                         # of the window on dead phases
                         break
         else:
+            probes += 1
+            if time.time() - last_beat >= 1800:
+                # heartbeat: an empty log is indistinguishable from a
+                # dead watcher; the window postmortem needs the denial
+                # evidence too
+                print("[onchip] %s still down (%d probes so far)"
+                      % (time.strftime("%H:%M:%SZ", time.gmtime()), probes),
+                      flush=True)
+                last_beat = time.time()
             time.sleep(150)
     print("[onchip] gave up; remaining: %s"
           % [n for n, done, _ in PHASES if not done()], flush=True)
