@@ -1,0 +1,53 @@
+"""Harness test for the chip-ceiling probe (VERDICT r4 next #6 tool).
+
+Runs the probe's CPU smoke in a subprocess (tiny shapes) and pins the
+report contract the on-chip session's `ceiling` phase consumes:
+chain legs with marginal entries, K-step legs keyed by TOTAL steps, and
+a backend field the phase marker uses to reject CPU-smoke reports.
+"""
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE = os.path.join(REPO, "tools", "ceiling_probe.py")
+REPORT = os.path.join(REPO, "tools", "ceiling_report.json")
+
+
+def test_cpu_smoke_report_contract(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # a banked ON-CHIP report must survive this test: stash and restore
+    stash = None
+    if os.path.exists(REPORT):
+        stash = tmp_path / "ceiling_report.orig.json"
+        os.replace(REPORT, stash)
+    try:
+        proc = subprocess.run(
+            [sys.executable, PROBE, "--cpu-smoke"], cwd=REPO, env=env,
+            capture_output=True, text=True, timeout=280)
+        assert proc.returncode == 0, proc.stderr[-1500:]
+        with open(REPORT) as f:
+            rep = json.load(f)
+        assert rep["backend"] == "cpu" or "cpu" in rep["backend"].lower()
+        chains = rep["matmul_chains"]["float32"]
+        assert len(chains["legs"]) >= 2
+        assert len(chains["marginal"]) == len(chains["legs"]) - 1
+        for leg in chains["legs"]:
+            assert leg["total_s"] > 0 and leg["per_matmul_s"] > 0
+        ks = rep["bert_ksteps"]
+        # --cpu-smoke pins TOTAL steps [1, 2]
+        assert [leg["k"] for leg in ks["legs"]] == [1, 2]
+        for leg in ks["legs"]:
+            assert leg["per_step_s"] > 0
+        # the onchip session's marker must NOT treat this as the banked
+        # on-chip ceiling
+        sys.path.insert(0, os.path.join(REPO, "tools"))
+        sys.path.insert(0, REPO)
+        import onchip_session
+        assert not onchip_session.ceiling_done()
+    finally:
+        if os.path.exists(REPORT):
+            os.remove(REPORT)  # never leave a CPU report for the driver
+        if stash is not None:
+            os.replace(stash, REPORT)
