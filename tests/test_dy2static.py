@@ -831,3 +831,48 @@ def test_bare_loop_return_with_continuation_falls_back():
 
     with pytest.raises(RuntimeError, match="cond|hoist"):
         to_static(f)(_t([1.0]))
+
+
+def test_break_inside_layer_method_converts():
+    # jump lowering through the method-conversion path (bound self)
+    class M(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = pt.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.lin(x)
+            s = h * 0.0
+            i = pt.to_tensor(np.asarray(0, np.int32))
+            while i < 8:
+                if pt.tensor.sum(s) > 10.0:
+                    break
+                s = s + pt.tensor.abs(h) + 1.0
+                i = i + 1
+            return s
+
+    pt.seed(0)
+    m = M()
+    x = np.ones((1, 4), np.float32)
+    got = to_static(m)(pt.to_tensor(x))
+    want = m.forward(pt.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(got.value),
+                               np.asarray(want.value), rtol=1e-5)
+
+
+def test_continue_under_tensor_if_converts():
+    # a continue whose guard is itself tensor-predicated: flag assign
+    # flows through the converted cond into the loop carry analysis
+    def f(x):
+        s = x * 0.0
+        t = x * 0.0
+        for i in range(6):
+            s = s + x
+            if pt.tensor.sum(s) > 3.0:
+                continue
+            t = t + x
+        return t
+
+    got = np.asarray(to_static(f)(_t([1.0])).value)
+    # t accumulates only while s <= 3: iterations 0,1,2 -> 3.0
+    np.testing.assert_allclose(got, [3.0], rtol=1e-6)
