@@ -93,3 +93,13 @@ def test_stored_bert_gate_blocks_unproven_headline():
         assert bert is None and "input_staged" in why
     finally:
         bench._load_tpu_record = saved
+
+
+def test_resnet_mfu_formula_pinned():
+    """The one shared MFU formula (2 FLOPs/MAC, fwd + ~2x bwd): the
+    staged-input measurement of 2026-07-30 (batch 128, 0.0863 s on the
+    197 TFLOP/s v5e) must evaluate to the 0.1847 recorded in
+    TPU_MEASUREMENT.json — pinning the convention the gate enforces."""
+    assert bench.RESNET50_FWD_FLOPS == 2 * 4.089e9
+    got = bench.resnet50_mfu(128, 0.0863, 197e12)
+    assert abs(got - 0.1847) < 2e-4, got
