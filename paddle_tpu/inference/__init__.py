@@ -26,7 +26,8 @@ from ..core.errors import InvalidArgumentError
 __all__ = ["Config", "Predictor", "PredictorTensor", "Tensor",
            "create_predictor", "PredictorPool", "get_version",
            "DataType", "PlaceType", "PrecisionType",
-           "get_num_bytes_of_data_type"]
+           "get_num_bytes_of_data_type",
+           "GenerationPool", "create_generation_pool"]
 
 
 class DataType:
@@ -244,3 +245,18 @@ class PredictorPool:
                 "PredictorPool index %d out of range [0, %d)"
                 % (idx, len(self._predictors)))
         return self._predictors[idx]
+
+
+# -- the serving engine: KV-cached continuous-batching generation ----------
+# The artifact Predictor above runs a FIXED exported program; generation
+# needs the cache-threaded forward of a live model, so the pool owns the
+# model (docs/DESIGN.md "prefill/decode split").
+from .generation import GenerationPool  # noqa: E402,F401
+
+
+def create_generation_pool(model, max_len: int, **kwargs) -> GenerationPool:
+    """Build a :class:`GenerationPool` over a live cached-decode model
+    (``models.TransformerLM``): slot-based continuous batching, one
+    batched decode step per tick, bucketed prefill — the serving analog
+    of ``create_predictor`` for autoregressive generation."""
+    return GenerationPool(model, max_len, **kwargs)

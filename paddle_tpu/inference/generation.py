@@ -1,0 +1,262 @@
+"""Slot-based continuous batching over the KV-cached decode engine.
+
+``GenerationPool`` is the serving front of ``jit.DecodeSession``: N cache
+SLOTS share ONE batched decode step (the slot-batched ``DecodeCache``
+layout whose index is a per-row ``[slots]`` vector), concurrent requests
+are packed into the slots, and a slot freed by a finished sequence is
+refilled from the request queue — so throughput stays at the batched
+decode rate regardless of request length skew, the continuous-batching
+scheme production LLM servers use (PAPERS.md: compiler-first O(1)
+autoregressive caching; the batching analog of the reference's
+``PredictorPool``, which multiplexes predictors rather than cache slots).
+
+Dataflow per ``step()``:
+
+1. free slots are refilled: each queued request runs a BUCKETED batch-1
+   prefill (compiled once per bucket, shared with every later request),
+   and its row cache is spliced into the slot by a tiny jitted insert
+   (slot id is a traced scalar — one compile total);
+2. one batched decode dispatch advances EVERY active slot a token;
+   inactive slots are masked — their cache index does not advance;
+3. the sampled token ids (the only host round-trip) are appended
+   per-request; rows hitting EOS or their token budget release the slot.
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..jit.decode import DecodeSession
+
+__all__ = ["GenerationPool"]
+
+_Request = collections.namedtuple(
+    "_Request", ["rid", "ids", "max_new_tokens"])
+
+
+class _SlotState:
+    __slots__ = ("rid", "tokens", "remaining")
+
+    def __init__(self, rid, first_token: int, remaining: int):
+        self.rid = rid
+        self.tokens = [first_token]
+        self.remaining = remaining
+
+
+class GenerationPool:
+    """Continuous batching: submit prompts, drain one decode step at a
+    time, collect per-request token arrays.
+
+    ``model`` is a live cached-decode model (``models.TransformerLM``);
+    the artifact-serving ``Predictor`` stays a fixed-program runner —
+    generation needs the cache-threaded forward, so the pool owns the
+    model directly (see docs/DESIGN.md, prefill/decode split).
+    """
+
+    def __init__(self, model, max_len: int, slots: int = 4,
+                 buckets: Optional[Sequence[int]] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, eos_id: Optional[int] = None,
+                 cache_dtype="float32", donate: Optional[bool] = None,
+                 seed: int = 0):
+        if slots < 1:
+            raise InvalidArgumentError("GenerationPool needs slots >= 1")
+        # the session owns the model binding, the sampling config and the
+        # bucketed batch-1 prefill; the pool adds the slot-batched layer
+        self._session = DecodeSession(
+            model, max_len, buckets=buckets, temperature=temperature,
+            top_k=top_k, top_p=top_p, cache_dtype=cache_dtype,
+            donate=donate)
+        self._model = model
+        self.slots = int(slots)
+        self.max_len = int(max_len)
+        self.eos_id = eos_id
+        self._cache = model.gen_decode_cache(self.slots, self.max_len,
+                                             cache_dtype, per_slot=True)
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        self._decode_jit = jax.jit(self._pool_decode,
+                                   donate_argnums=(2,) if donate else ())
+        # donate the POOL cache (argnum 0) to the insert too: the splice
+        # is in-place
+        self._insert_jit = jax.jit(self._insert,
+                                   donate_argnums=(0,) if donate else ())
+        self._key = jax.random.PRNGKey(seed)
+        self._queue: collections.deque = collections.deque()
+        self._active: Dict[int, _SlotState] = {}
+        self._free: List[int] = list(range(self.slots))
+        self._last_tok = np.zeros(self.slots, np.int32)
+        # device-resident copies of the step inputs: in steady state the
+        # decoded token vector feeds straight back and the active mask is
+        # unchanged, so the only per-step host traffic is the DOWNLOAD of
+        # the sampled ids; membership changes (refill/finish) mark these
+        # dirty for a one-off re-upload
+        self._tok_dev = None
+        self._active_dev = None
+        self._membership_dirty = True
+        self._results: Dict[object, np.ndarray] = {}
+        # ids currently queued/active/uncollected, maintained
+        # incrementally so submit stays O(1) in a long-lived pool
+        self._used_rids: set = set()
+        self._next_rid = 0
+        # parameter/buffer value lists are rebuilt lazily, not per token:
+        # the per-step python cost of walking a deep model's parameters
+        # would sit on the decode hot path
+        self._state_cache = None
+
+    # -- traced bodies ---------------------------------------------------
+    def _insert(self, pool_cache, row_cache, slot, length):
+        """Splice a batch-1 prefilled row cache into ``slot``; the slot
+        id and true length are traced scalars, so every refill reuses one
+        compilation."""
+        out = []
+        for cp, cr in zip(pool_cache, row_cache):
+            out.append(type(cp)(
+                cp.k.at[slot].set(cr.k[0].astype(cp.k.dtype)),
+                cp.v.at[slot].set(cr.v[0].astype(cp.v.dtype)),
+                cp.index.at[slot].set(jnp.asarray(length, jnp.int32))))
+        return out
+
+    def _pool_decode(self, param_vals, buf_vals, cache, toks, active, key):
+        """One batched decode step over every slot; inactive slots are
+        frozen (their cache index does not advance, their token output is
+        forced to 0) so a free slot can never creep past max_len."""
+        sess = self._session
+        logits, new_cache = sess._run_model(param_vals, buf_vals,
+                                            toks[:, None], cache)
+        tok, key = sess._sample(logits[:, 0], key)
+        new_cache = [type(c)(c.k, c.v,
+                             jnp.where(active, c.index, old.index))
+                     for c, old in zip(new_cache, cache)]
+        return new_cache, jnp.where(active, tok, 0), key
+
+    # -- host API --------------------------------------------------------
+    def submit(self, input_ids, max_new_tokens: int, request_id=None):
+        """Queue one prompt (1-D ids); returns the request id."""
+        ids = np.asarray(getattr(input_ids, "value", input_ids))
+        if ids.ndim != 1:
+            raise InvalidArgumentError(
+                "GenerationPool.submit takes ONE prompt (1-D ids, got "
+                "shape %s); batch parallelism comes from the slots"
+                % (ids.shape,))
+        if len(ids) < 1:
+            raise InvalidArgumentError(
+                "prompt must contain at least one token")
+        if len(ids) + max_new_tokens > self.max_len:
+            raise InvalidArgumentError(
+                "prompt %d + max_new_tokens %d exceeds cache max_len %d"
+                % (len(ids), max_new_tokens, self.max_len))
+        if max_new_tokens < 1:
+            raise InvalidArgumentError("max_new_tokens must be >= 1")
+        # fail at SUBMIT time, not mid-refill: a prompt no bucket covers
+        # would otherwise raise after the slot bookkeeping started
+        self._session._bucket_for(len(ids))
+        # one id namespace for explicit and auto ids: explicit duplicates
+        # are rejected, auto-assignment skips ids a caller already took
+        # (a collision would silently overwrite the earlier results);
+        # collected ids (returned by run()) become reusable
+        if request_id is not None:
+            if request_id in self._used_rids:
+                raise InvalidArgumentError(
+                    "request_id %r is already queued, active, or "
+                    "awaiting collection" % (request_id,))
+            rid = request_id
+        else:
+            while self._next_rid in self._used_rids:
+                self._next_rid += 1
+            rid = self._next_rid
+            self._next_rid += 1
+        self._used_rids.add(rid)
+        self._queue.append(_Request(rid, ids.astype(np.int32),
+                                    int(max_new_tokens)))
+        return rid
+
+    def _finish(self, slot: int):
+        state = self._active.pop(slot)
+        self._results[state.rid] = np.asarray(state.tokens, np.int32)
+        self._free.append(slot)
+        self._membership_dirty = True
+
+    def _refill(self):
+        while self._queue and self._free:
+            req = self._queue.popleft()
+            # bucketed batch-1 prefill (compiled per bucket, shared with
+            # DecodeSession.generate) emits the request's FIRST token;
+            # runs BEFORE the slot is popped so a prefill failure can
+            # never leak a slot
+            row_cache, tok, self._key = self._session.prefill(
+                req.ids[None], self._key)
+            slot = self._free.pop()
+            first = int(np.asarray(tok)[0])
+            self._cache = self._insert_jit(
+                self._cache, row_cache, jnp.asarray(slot, jnp.int32),
+                jnp.asarray(len(req.ids), jnp.int32))
+            self._active[slot] = _SlotState(req.rid, first,
+                                            req.max_new_tokens - 1)
+            self._last_tok[slot] = first
+            self._membership_dirty = True
+            if self._active[slot].remaining == 0 or \
+                    (self.eos_id is not None and first == self.eos_id):
+                self._finish(slot)
+
+    def step(self) -> bool:
+        """Refill free slots, run ONE batched decode step; False when the
+        pool is drained (no queued or active requests)."""
+        self._refill()
+        if not self._active:
+            return bool(self._queue)
+        if self._membership_dirty:
+            active = np.zeros(self.slots, bool)
+            active[list(self._active)] = True
+            self._tok_dev = jnp.asarray(self._last_tok)
+            self._active_dev = jnp.asarray(active)
+            self._membership_dirty = False
+        if self._state_cache is None:
+            self._state_cache = self._session._state_vals()
+        params, bufs = self._state_cache
+        self._cache, tok_dev, self._key = self._decode_jit(
+            params, bufs, self._cache, self._tok_dev, self._active_dev,
+            self._key)
+        self._tok_dev = tok_dev  # feeds straight back next step
+        tok = np.asarray(tok_dev)
+        self._last_tok = tok.astype(np.int32)
+        for slot in list(self._active):
+            state = self._active[slot]
+            t = int(tok[slot])
+            state.tokens.append(t)
+            state.remaining -= 1
+            if state.remaining == 0 or \
+                    (self.eos_id is not None and t == self.eos_id):
+                self._finish(slot)
+        return bool(self._active or self._queue)
+
+    def refresh_weights(self):
+        """Drop the cached parameter/buffer value lists — call after
+        mutating the model's weights (e.g. ``set_state_dict``) so later
+        decode steps see the new values."""
+        self._state_cache = None
+
+    def run(self) -> Dict[object, np.ndarray]:
+        """Drain queue + slots; {request_id: np.int32 token array}."""
+        while self.step():
+            pass
+        out, self._results = self._results, {}
+        self._used_rids -= set(out)  # collected ids become reusable
+        return out
+
+    def generate(self, prompts, max_new_tokens: int) -> List[np.ndarray]:
+        """Convenience: submit all, drain, return in submission order."""
+        rids = [self.submit(p, max_new_tokens) for p in prompts]
+        results = self.run()
+        return [results[r] for r in rids]
+
+    def compile_counts(self) -> dict:
+        counts = self._session.compile_counts()
+        counts["pool_decode"] = int(self._decode_jit._cache_size())
+        counts["slot_insert"] = int(self._insert_jit._cache_size())
+        return counts

@@ -43,7 +43,7 @@ from ..nn.layer.layers import Layer
 
 __all__ = [
     "to_static", "not_to_static", "StaticFunction", "InputSpec", "TrainStep",
-    "MultiStepTrainStep",
+    "MultiStepTrainStep", "DecodeSession", "sample_logits",
     "save", "load", "TranslatedLayer", "ProgramTranslator", "TracedLayer",
     "set_code_level", "set_verbosity", "enable_to_static",
 ]
@@ -541,6 +541,18 @@ class MultiStepTrainStep(TrainStep):
             body, (param_vals, opt_states, buf_vals, key), batch_leaves)
         return losses, pv, st, bv
 
+    # the K-stacking contract, spelled out in every shape error so the
+    # batch==K aliasing case is diagnosable from the message alone
+    # (ADVICE r5 low: an unstacked [batch, ...] input whose batch
+    # happens to equal K passes the leading-dim check and silently
+    # scans over the BATCH axis, training on single examples)
+    _STACK_CONTRACT = (
+        "each batch input must be K per-STEP batches stacked along a NEW "
+        "leading axis (np.stack -> [K, batch, ...]); a plain [batch, ...] "
+        "input is never valid here — if your per-step batch size equals "
+        "K, the leading dim would alias the batch axis and the scan "
+        "would train on single examples")
+
     def __call__(self, *batch):
         k = self.steps_per_call
         for i, b in enumerate(batch):
@@ -548,13 +560,14 @@ class MultiStepTrainStep(TrainStep):
             if shape is None or len(shape) == 0:
                 raise InvalidArgumentError(
                     "MultiStepTrainStep: batch input %d is a scalar; "
-                    "scan needs a [%d, ...] leading step axis — stack "
-                    "it, or close over constants in loss_fn" % (i, k))
+                    "scan needs a [%d, ...] leading step axis — %s "
+                    "(or close over constants in loss_fn)"
+                    % (i, k, self._STACK_CONTRACT))
             if shape[0] != k:
                 raise InvalidArgumentError(
                     "MultiStepTrainStep(steps_per_call=%d): batch input "
-                    "%d must be stacked [%d, ...], got shape %s"
-                    % (k, i, k, shape))
+                    "%d has shape %s, leading dim %s != K=%d; %s"
+                    % (k, i, shape, shape[0], k, self._STACK_CONTRACT))
         return super().__call__(*batch)
 
 
@@ -852,3 +865,8 @@ class TracedLayer:
         specs = [InputSpec.from_tensor(t) if hasattr(t, "value") else t
                  for t in self._examples]
         save(self._fn, path, input_spec=specs)
+
+
+# the decode engine imports _StateBinding back from this module, so it
+# loads after everything above is defined
+from .decode import DecodeSession, sample_logits  # noqa: E402,F401

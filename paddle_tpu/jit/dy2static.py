@@ -69,6 +69,19 @@ class ConversionError(Exception):
     """Raised when the minimal AST pass cannot convert the function."""
 
 
+class _SeedEvalError(Exception):
+    """Pre-loop evaluation of a loop-return result seed raised.
+
+    The loop-return lowering binds ``_RV`` before the loop by evaluating
+    the first return expression on PRE-loop values (structure only — the
+    value is dead unless the loop never returns).  Eager Python never
+    evaluates that expression there, so it may raise where the original
+    function would not (``return 1/i`` with ``i == 0`` before the loop).
+    The converted function signals this instead of leaking the bogus
+    exception; ``convert`` catches it and falls back to the unconverted
+    function."""
+
+
 # ---------------------------------------------------------------------------
 # runtime helpers the rewritten source calls
 # ---------------------------------------------------------------------------
@@ -125,6 +138,17 @@ def _rt_and(a, b):
     if _is_tensorish(a) or _is_tensorish(b):
         return a & b
     return bool(a) and bool(b)
+
+
+def _rt_loop_seed(thunk):
+    """Evaluate a loop-return ``_RV`` seed expression, converting any
+    exception into :class:`_SeedEvalError` so the caller can fall back to
+    the unconverted function (whose eager loop never evaluates the seed
+    on pre-loop values)."""
+    try:
+        return thunk()
+    except Exception as e:  # noqa: BLE001 - any eval failure means fallback
+        raise _SeedEvalError(e) from e
 
 
 def _rt_range3(start, stop, step):
@@ -374,6 +398,22 @@ class _LoopReturnLower(ast.NodeTransformer):
                 ast.Break()]
 
 
+def _eval_safe_seed(e) -> bool:
+    """True for seed expressions whose pre-loop evaluation cannot raise
+    beyond NameError (which the bound-names check already rules out):
+    bare names, constants, unary +/- of those, and tuples/lists of
+    them.  Anything else (arithmetic, calls, subscripts) may raise or
+    side-effect when evaluated on PRE-loop values — ``return 1/i`` with
+    ``i == 0`` before the loop — so it gets the runtime seed guard."""
+    if isinstance(e, (ast.Constant, ast.Name)):
+        return True
+    if isinstance(e, ast.UnaryOp) and isinstance(e.op, (ast.USub, ast.UAdd)):
+        return _eval_safe_seed(e.operand)
+    if isinstance(e, (ast.Tuple, ast.List)):
+        return all(_eval_safe_seed(x) for x in e.elts)
+    return False
+
+
 def _lower_loop_returns(s, bound, flag, local_names, allow_bare=False):
     """Rewrite a loop statement whose body returns: (pre_stmts, loop').
     Raises _Unsupported for shapes that cannot seed the result carry."""
@@ -411,8 +451,20 @@ def _lower_loop_returns(s, bound, flag, local_names, allow_bare=False):
             % sorted(free - bound))
     import copy
 
+    if _eval_safe_seed(seed):
+        seed_value = copy.deepcopy(seed)
+    else:
+        # evaluation-UNSAFE seed (ADVICE r5 medium): wrap it so a runtime
+        # exception becomes _SeedEvalError and convert()'s wrapper falls
+        # back to the unconverted function instead of raising where eager
+        # code never evaluates
+        seed_value = ast.Call(
+            func=ast.Name(id="__pt_rt_loop_seed", ctx=ast.Load()),
+            args=[ast.Lambda(args=_make_args([]),
+                             body=copy.deepcopy(seed))],
+            keywords=[])
     pre = [_assign_node(flag, ast.Constant(value=False)),
-           _assign_node(_RV, copy.deepcopy(seed))]
+           _assign_node(_RV, seed_value)]
     loop = copy.deepcopy(s)
     lower = _LoopReturnLower(flag)
     # transform the BODY's statements (the visitor's loop/scope guard
@@ -1095,12 +1147,38 @@ def convert(fn: Callable) -> Callable:
     glb["__pt_rt_range3"] = _rt_range3
     glb["__pt_rt_not"] = _rt_not
     glb["__pt_rt_and"] = _rt_and
+    glb["__pt_rt_loop_seed"] = _rt_loop_seed
     loc: dict = {}
     exec(code, glb, loc)  # noqa: S102 - recompiling user fn, the reference
     new_fn = loc[fdef.name]  # ast_transformer.py does the same via exec
     new_fn.__defaults__ = getattr(inner, "__defaults__", None)
     new_fn.__kwdefaults__ = getattr(inner, "__kwdefaults__", None)
     new_fn.__dy2static_converted__ = True
+    if any(isinstance(n, ast.Name) and n.id == "__pt_rt_loop_seed"
+           for n in ast.walk(fdef)):
+        # an evaluation-unsafe loop-return seed is guarded at runtime:
+        # if seeding raises, run the ORIGINAL function — eager Python
+        # never evaluates the seed expression before the loop, so the
+        # unconverted body is the correct semantics (and if it then hits
+        # a tracer error, StaticFunction's hint path reports it).
+        # Documented delta (like the both-branches-execute delta above):
+        # statements BEFORE the failing seed have already run once in the
+        # converted body, so pre-loop side effects (list mutation, I/O)
+        # are applied twice on this fallback path; pure tensor code —
+        # the conversion's target domain — is unaffected
+        orig = getattr(inner, "__func__", inner)
+        converted = new_fn
+
+        def new_fn(*args, **kwargs):
+            try:
+                return converted(*args, **kwargs)
+            except _SeedEvalError:
+                return orig(*args, **kwargs)
+
+        new_fn.__name__ = converted.__name__
+        new_fn.__qualname__ = getattr(converted, "__qualname__",
+                                      converted.__name__)
+        new_fn.__dy2static_converted__ = True
     return new_fn
 
 

@@ -17,6 +17,7 @@ from typing import Optional
 import jax.numpy as jnp
 
 from .. import tensor as T
+from ..core.errors import InvalidArgumentError
 from ..framework.tensor import Tensor
 from ..nn import functional as F
 from ..nn.layer.common import Dropout, Embedding, Linear
@@ -132,14 +133,52 @@ class TransformerLM(Layer):
         allow = idx[None, :] <= idx[:, None]
         return jnp.where(allow, 0.0, jnp.finfo(jnp.float32).min).astype(dtype)
 
-    def encode(self, input_ids, attn_mask=None, token_type_ids=None):
-        """Final hidden states [B, L, H] (the backbone for task heads)."""
+    def gen_decode_cache(self, batch_size: int, max_length: int,
+                         dtype="float32", per_slot: bool = False):
+        """Per-layer preallocated KV decode cache (see
+        ``MultiHeadAttention.gen_decode_cache``); thread it through
+        ``forward(..., cache=...)`` for O(1)-per-token generation.
+
+        Causal models only: the cached path masks attention causally over
+        the prefix, which for a bidirectional (``causal=False``) encoder
+        would silently CHANGE the math rather than just the cost — and
+        incremental decoding is ill-defined there anyway (every new token
+        would retroactively change all earlier hidden states)."""
+        if not self.causal:
+            raise InvalidArgumentError(
+                "decode caching requires a causal model: a "
+                "causal=False (bidirectional) encoder cannot decode "
+                "incrementally — new tokens would change every earlier "
+                "position's hidden state")
+        return self.encoder.gen_decode_cache(batch_size, max_length, dtype,
+                                             per_slot)
+
+    def encode(self, input_ids, attn_mask=None, token_type_ids=None,
+               cache=None):
+        """Final hidden states [B, L, H] (the backbone for task heads).
+
+        With ``cache`` (a ``gen_decode_cache`` pytree) the input is an
+        incremental chunk: positions start at the cache index, causality
+        over the cached prefix is enforced INSIDE the attention (no
+        [L, L] mask is built), and ``(hidden, new_cache)`` is returned.
+        """
         seq_len = input_ids.shape[1]
-        pos = T.arange(0, seq_len, dtype="int64")
+        if cache is not None:
+            idx = jnp.asarray(cache[0].index, jnp.int32)
+            step = jnp.arange(seq_len, dtype=jnp.int32)
+            # scalar index -> [L]; per-slot [B] index -> [B, L]
+            pos = Tensor(idx + step if idx.ndim == 0
+                         else idx[:, None] + step[None, :],
+                         stop_gradient=True)
+        else:
+            pos = T.arange(0, seq_len, dtype="int64")
         h = self.word_embeddings(input_ids) + self.position_embeddings(pos)
         if self.token_type_embeddings is not None and token_type_ids is not None:
             h = h + self.token_type_embeddings(token_type_ids)
         h = self.embed_dropout(h)
+        if cache is not None:
+            h, new_cache = self.encoder(h, attn_mask, cache)
+            return self.final_norm(h), new_cache
         if attn_mask is None and self.causal and not self._sequence_parallel:
             attn_mask = Tensor(
                 self._causal_mask(seq_len, h.value.dtype), stop_gradient=True
@@ -147,7 +186,14 @@ class TransformerLM(Layer):
         h = self.encoder(h, attn_mask)
         return self.final_norm(h)
 
-    def forward(self, input_ids, attn_mask=None, token_type_ids=None):
+    def forward(self, input_ids, attn_mask=None, token_type_ids=None,
+                cache=None):
+        if cache is not None:
+            h, new_cache = self.encode(input_ids, attn_mask, token_type_ids,
+                                       cache)
+            logits = T.matmul(h, self.word_embeddings.weight,
+                              transpose_y=True)
+            return logits, new_cache
         h = self.encode(input_ids, attn_mask, token_type_ids)
         # tied LM head: logits = h @ E^T
         logits = T.matmul(h, self.word_embeddings.weight, transpose_y=True)

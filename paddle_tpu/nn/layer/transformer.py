@@ -38,6 +38,14 @@ class MultiHeadAttention(Layer):
 
     Cache = collections.namedtuple("Cache", ["k", "v"])
     StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+    # Decode-engine cache (jit/decode.py): PREALLOCATED [B, H, max_len, D]
+    # K/V buffers + a cache index (scalar int32, or [B] int32 for
+    # slot-batched serving).  Unlike ``Cache`` (which concatenates and so
+    # changes shape — retracing every step), writes go through
+    # lax.dynamic_update_slice and the index advances, so every decode
+    # step has IDENTICAL shapes: one XLA compilation, donate-able
+    # buffers, O(1) per-token attention against the valid prefix.
+    DecodeCache = collections.namedtuple("DecodeCache", ["k", "v", "index"])
 
     def __init__(
         self,
@@ -145,12 +153,99 @@ class MultiHeadAttention(Layer):
             return self.Cache(k, v)
         return self.Cache(key, value)
 
+    def gen_decode_cache(self, batch_size: int, max_length: int,
+                         dtype="float32", per_slot: bool = False):
+        """Preallocated decode cache: zeroed [B, H, max_len, D] K/V plus
+        index 0 (scalar, or [B] when ``per_slot`` — the GenerationPool's
+        slot-batched layout where each row decodes at its own position).
+        Leaves are RAW jax arrays (not Tensors): the cache threads through
+        jitted prefill/decode as a donated pytree."""
+        import jax.numpy as jnp
+
+        shape = (batch_size, self.num_heads, max_length, self.head_dim)
+        index = (jnp.zeros((batch_size,), jnp.int32) if per_slot
+                 else jnp.zeros((), jnp.int32))
+        return self.DecodeCache(jnp.zeros(shape, dtype),
+                                jnp.zeros(shape, dtype), index)
+
+    def _decode_forward(self, q, k_new, v_new, attn_mask, cache):
+        """Shape-static cached attention: write the new K/V chunk into the
+        preallocated buffers at ``cache.index``, attend the queries over
+        the valid prefix (causal across prefix + chunk), advance the
+        index.  Returns (raw attention out [B, H, L, D], new cache)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...framework.tensor import Tensor as _T
+        from ...ops.flash_attention import decode_attention
+
+        def raw(x):
+            return x.value if isinstance(x, _T) else jnp.asarray(x)
+
+        q_, k_new, v_new = raw(q), raw(k_new), raw(v_new)
+        k_buf, v_buf = raw(cache.k), raw(cache.v)
+        idx = jnp.asarray(cache.index, jnp.int32)
+        b, _, length, _ = q_.shape
+        max_len = k_buf.shape[2]
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, q_.dtype)
+        if idx.ndim == 0:
+            # aligned batch (DecodeSession): one slice write for the chunk
+            k_buf = jax.lax.dynamic_update_slice(
+                k_buf, k_new.astype(k_buf.dtype), (0, 0, idx, 0))
+            v_buf = jax.lax.dynamic_update_slice(
+                v_buf, v_new.astype(v_buf.dtype), (0, 0, idx, 0))
+            q_pos = idx + jnp.arange(length)                    # [L]
+            allow = jnp.arange(max_len)[None, :] <= q_pos[:, None]
+            bias = jnp.where(allow, 0.0, neg)[None, None]       # [1,1,L,S]
+        else:
+            # slot-batched decode: each row writes ONE token at its own
+            # position (scatter); chunked prefill stays per-request
+            if length != 1:
+                raise InvalidArgumentError(
+                    "per-slot DecodeCache decodes one token per step "
+                    "(query length 1), got query length %d; prefill each "
+                    "request with a scalar-index cache and insert it "
+                    "into the slot" % length)
+            rows = jnp.arange(b)
+            k_buf = k_buf.at[rows, :, idx, :].set(
+                k_new[:, :, 0, :].astype(k_buf.dtype))
+            v_buf = v_buf.at[rows, :, idx, :].set(
+                v_new[:, :, 0, :].astype(v_buf.dtype))
+            allow = (jnp.arange(max_len)[None, None, :]
+                     <= idx[:, None, None])                     # [B,1,S]
+            bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,1,S]
+        if attn_mask is not None:
+            # a caller's mask is keyed to the CHUNK length while the
+            # score axis here is the cache length max_len — combining
+            # them would mis-broadcast; the cached path derives its own
+            # causal-prefix mask from the index
+            raise InvalidArgumentError(
+                "decode-cache attention derives its mask from the cache "
+                "index (causal over the valid prefix); additive "
+                "attn_mask is not supported with a DecodeCache — pass "
+                "attn_mask=None, or use the uncached forward")
+        out = decode_attention(q_, k_buf, v_buf, bias=bias)
+        return out, self.DecodeCache(k_buf, v_buf,
+                                     idx + (length if idx.ndim == 0 else 1))
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         from ... import tensor as T
 
         key = query if key is None else key
         value = key if value is None else value
         q = self._split_heads(self.q_proj(query))
+        if isinstance(cache, self.DecodeCache):
+            from ...framework.tensor import Tensor as _T
+
+            k_new = self._split_heads(self.k_proj(key))
+            v_new = self._split_heads(self.v_proj(value))
+            out_raw, cache = self._decode_forward(q, k_new, v_new,
+                                                  attn_mask, cache)
+            out = self.out_proj(self._merge_heads(
+                _T(out_raw, stop_gradient=True)))
+            if self.need_weights:
+                return out, None, cache
+            return out, cache
         if isinstance(cache, self.StaticCache):
             k, v = cache.k, cache.v
         else:
@@ -241,6 +336,11 @@ class TransformerEncoderLayer(Layer):
     def gen_cache(self, src):
         return self.self_attn.gen_cache(src)
 
+    def gen_decode_cache(self, batch_size: int, max_length: int,
+                         dtype="float32", per_slot: bool = False):
+        return self.self_attn.gen_decode_cache(batch_size, max_length,
+                                               dtype, per_slot)
+
 
 class TransformerEncoder(Layer):
     """transformer.py:622 parity."""
@@ -270,6 +370,12 @@ class TransformerEncoder(Layer):
 
     def gen_cache(self, src):
         return [layer.gen_cache(src) for layer in self.layers]
+
+    def gen_decode_cache(self, batch_size: int, max_length: int,
+                         dtype="float32", per_slot: bool = False):
+        return [layer.gen_decode_cache(batch_size, max_length, dtype,
+                                       per_slot)
+                for layer in self.layers]
 
 
 class TransformerDecoderLayer(Layer):

@@ -7,6 +7,12 @@ TPU kernels (SURVEY §7 MFU target): flash attention keeps the [L, L] score
 matrix out of HBM entirely, which is the bandwidth win that decides MFU at
 long sequence length.
 """
-from .flash_attention import flash_attention, flash_attention_supported  # noqa: F401
+from .flash_attention import (  # noqa: F401
+    decode_attention,
+    decode_attention_supported,
+    flash_attention,
+    flash_attention_supported,
+)
 
-__all__ = ["flash_attention", "flash_attention_supported"]
+__all__ = ["flash_attention", "flash_attention_supported",
+           "decode_attention", "decode_attention_supported"]

@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["flash_attention", "flash_attention_supported"]
+__all__ = ["flash_attention", "flash_attention_supported",
+           "decode_attention", "decode_attention_supported"]
 
 _SUPPORTED_DTYPES = (jnp.float32, jnp.bfloat16)
 
@@ -130,6 +131,68 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
                                       if segment_ids is not None else None),
                          causal=causal,
                          sm_scale=float(sm_scale), block_sizes=block_sizes)
+
+
+# ---------------------------------------------------------------------------
+# decode-time attention: one (or few) query positions against a
+# preallocated KV cache
+# ---------------------------------------------------------------------------
+
+# Same measured-crossover discipline as FLASH_MIN_SEQ: a kernel only
+# replaces the XLA composition where a measurement says it wins.  The
+# pallas flash kernel is shape-gated to Lq % 128 == 0, so a single-query
+# decode step can NEVER take it; the decode-step composition below is a
+# batched GEMV + softmax + GEMV that XLA fuses into one HBM pass over the
+# cache, and no shipped kernel has beaten that below this cache length.
+# When a paged/splash single-query kernel lands, its measured crossover
+# replaces this constant the same way FLASH_MIN_SEQ was established.
+DECODE_FLASH_MIN_CACHE = 16384
+
+
+def decode_attention_supported(q_shape, kv_len: int, dtype) -> bool:
+    """Gate for a future single-query pallas decode kernel: TPU backend,
+    4-D [B, H, Lq, D] with a short query chunk, MXU-tileable head_dim and
+    a cache long enough to beat the fused XLA composition.  Currently no
+    such kernel ships, so the gate's callers always take the composition
+    path below the crossover — the gate exists so the routing discipline
+    (and its tests) are already in place when one lands."""
+    if jax.default_backend() != "tpu":
+        return False
+    if len(q_shape) != 4 or q_shape[2] > 8:
+        return False
+    if q_shape[3] not in (64, 128, 256):
+        return False
+    if kv_len < DECODE_FLASH_MIN_CACHE:
+        return False
+    return jnp.dtype(dtype) in _SUPPORTED_DTYPES
+
+
+def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None):
+    """Decode-step attention: [B, H, Lq, D] queries against a FULL
+    preallocated cache [B, H, S, D] (S = max_len), with ``bias`` masking
+    the invalid tail (positions at or beyond the cache index) to -inf.
+
+    Lq is the current chunk (1 for autoregressive decode); the math is
+    deliberately identical to the XLA fallback in
+    ``F.scaled_dot_product_attention`` so cached and uncached logits
+    agree to float-reduction noise.  Masked (garbage) cache positions
+    contribute exp(-inf) == 0 to the softmax, so preallocation never
+    changes the result, only the reduction shape — which XLA keeps
+    shape-static across every decode step."""
+    d = q.shape[-1]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(d))
+    if decode_attention_supported(q.shape, k.shape[2], q.dtype):
+        # reserved routing slot: a paged/splash single-query kernel lands
+        # here once a measured crossover justifies it; until then even a
+        # gate-passing shape falls through to the fused composition
+        pass
+    scores = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(
+        sm_scale, q.dtype)
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    weights = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("...qk,...kd->...qd", weights, v)
 
 
 # id(mask) → (weakref(mask), verdict); masks are immutable jax arrays built

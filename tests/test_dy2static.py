@@ -876,3 +876,39 @@ def test_continue_under_tensor_if_converts():
     got = np.asarray(to_static(f)(_t([1.0])).value)
     # t accumulates only while s <= 3: iterations 0,1,2 -> 3.0
     np.testing.assert_allclose(got, [3.0], rtol=1e-6)
+
+
+def test_loop_return_seed_not_pre_evaluated():
+    # ADVICE r5 medium (dy2static.py loop-return lowering): the pre-loop
+    # _RV seed used to EVALUATE the first return expression on pre-loop
+    # values, so `return 1/i` raised ZeroDivisionError with i=0 even
+    # though eager code never evaluates it there.  The seed is now
+    # runtime-guarded and falls back to the unconverted function.
+    def f():
+        i = 0
+        while i < 3:
+            i += 1
+            if i == 3:
+                return 1 / i
+        return 0.0
+
+    assert f() == pytest.approx(1.0 / 3.0)
+    assert convert(f)() == pytest.approx(1.0 / 3.0)
+
+
+def test_loop_return_guarded_seed_still_converts_tensor_loop():
+    # the guard must not regress the traced path: an arithmetic seed
+    # that CAN evaluate pre-loop still converts to a while_loop
+    def f(x):
+        i = pt.to_tensor(np.asarray(0, np.int32))
+        s = x * 0.0
+        while i < 10:
+            if pt.tensor.sum(s) > 2.5:
+                return s / (s + 1.0)
+            s = s + x
+            i = i + 1
+        return s
+
+    sf = to_static(f)
+    np.testing.assert_allclose(
+        np.asarray(sf(_t([1.0])).value), [3.0 / 4.0], rtol=1e-6)

@@ -20,6 +20,7 @@ SCRIPTS = [
     # python half only: the --c-host gcc/embedding path is test_capi's
     # slow-marked territory
     ("06_deploy_inference.py", []),
+    ("08_generate_serving.py", ["--tokens", "8"]),
 ]
 
 

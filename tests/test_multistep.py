@@ -176,3 +176,19 @@ def test_amp_o2_path():
     l2 = np.asarray(multi(xs, ys).value)
     assert l1.shape == (3,) and np.isfinite(l2).all()
     assert l2[-1] < l1[0]  # optimizes across dispatches under AMP
+
+
+def test_shape_error_spells_out_stacking_contract():
+    # ADVICE r5 low: the batch==K aliasing case (an unstacked [batch, ...]
+    # input with batch == K) is undetectable at runtime, so the shape
+    # error must carry the full K-stacking contract for diagnosability
+    model, loss_fn, opt = _build()
+    multi = MultiStepTrainStep(model, loss_fn, opt, steps_per_call=4,
+                               donate=False)
+    xs = np.random.randn(3, 8, 8).astype("float32")
+    ys = np.random.randint(0, 4, (3, 8)).astype("int64")
+    with pytest.raises(Exception) as ei:
+        multi(xs, ys)
+    msg = str(ei.value)
+    assert "NEW" in msg and "np.stack" in msg
+    assert "batch size equals" in msg  # names the aliasing trap

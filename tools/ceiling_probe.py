@@ -59,6 +59,21 @@ def _marginal(xs, ts):
     return out
 
 
+# ADVICE r5 low: the marginals that decide 'chip ceiling vs tunnel
+# artifact' are DIFFERENCES of leg totals, so one scheduler hiccup in a
+# single-sample leg can flip the verdict.  Every leg is timed REPEATS
+# times; the median total feeds the marginal computation and the raw
+# samples + spread are recorded so the report shows its own noise floor.
+REPEATS = 3
+
+
+def _timed_samples(run_leg, repeats=None):
+    """Run ``run_leg() -> wall_s`` N times; (median, samples, spread)."""
+    samples = [run_leg() for _ in range(repeats or REPEATS)]
+    return (float(np.median(samples)), [round(s, 6) for s in samples],
+            round(max(samples) - min(samples), 6))
+
+
 def matmul_chains(jax, jnp, lax, peak, lengths, dtype):
     """Time dependent-matmul chains of each length inside one jit."""
     import functools
@@ -78,14 +93,21 @@ def matmul_chains(jax, jnp, lax, peak, lengths, dtype):
     legs = []
     for n in lengths:
         _ = float(jnp.sum(chain(x, w, n)))  # compile + warm
-        t0 = time.perf_counter()
-        out = chain(x, w, n)
-        s = float(jnp.sum(out))  # host fetch = the synchronization point
-        t = time.perf_counter() - t0
+        checksum = []
+
+        def run_leg():
+            t0 = time.perf_counter()
+            out = chain(x, w, n)
+            # host fetch = the synchronization point
+            checksum.append(float(jnp.sum(out)))
+            return time.perf_counter() - t0
+
+        t, samples, spread = _timed_samples(run_leg)
         legs.append({"n": n, "total_s": round(t, 5),
+                     "samples_s": samples, "spread_s": spread,
                      "per_matmul_s": round(t / n, 6),
                      "raw_mfu": round(CHAIN_FLOPS * n / t / peak, 4),
-                     "checksum": s})
+                     "checksum": checksum[-1]})
         print("chain dtype=%s n=%-4d total %.4fs  raw MFU %.3f"
               % (dtype, n, t, legs[-1]["raw_mfu"]), flush=True)
     marg = _marginal([l["n"] for l in legs], [l["total_s"] for l in legs])
@@ -159,11 +181,17 @@ def bert_ksteps(pt, jax, jnp, lax, peak, ks, batch=40, seq=512):
         k = steps - 1  # fori count; multi() runs one final step on top
         loss, par, st, bufs = multi(par, st, bufs, key, k)  # compile+warm
         float(loss)
-        t0 = time.perf_counter()
-        loss, par, st, bufs = multi(par, st, bufs, key, k)
-        float(loss)
-        t = time.perf_counter() - t0
+
+        def run_leg():
+            nonlocal par, st, bufs
+            t0 = time.perf_counter()
+            loss, par, st, bufs = multi(par, st, bufs, key, k)
+            float(loss)
+            return time.perf_counter() - t0
+
+        t, samples, spread = _timed_samples(run_leg)
         legs.append({"k": steps, "total_s": round(t, 5),
+                     "samples_s": samples, "spread_s": spread,
                      "per_step_s": round(t / steps, 5),
                      "raw_mfu": round(flops_step * steps / t / peak, 4)})
         print("bert ksteps=%-3d total %.4fs  %.4f s/step  raw MFU %.3f"

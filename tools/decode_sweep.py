@@ -1,0 +1,133 @@
+"""Decode-engine batch/bucket sweep: where does tokens/s/chip saturate?
+
+The decode step is bandwidth-bound (each token re-reads the whole KV
+cache plus the weights), so throughput scales with batch until the cache
+reads dominate HBM; the prefill is compute-bound and scales with bucket
+length.  This sweep measures both axes of ``jit.DecodeSession``:
+
+- per-token decode time at batch x cache-length points (the marginal
+  t(N_tokens) discipline of ``ceiling_probe.py``: a 1-token generation
+  isolates the prefill term, differences isolate pure decode);
+- prefill latency per bucket (one compile per bucket — the compile
+  counts are recorded so a bucket-policy regression is visible in the
+  report).
+
+Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
+     [--gen 64] [--cpu-smoke]
+Writes tools/decode_sweep.json; prints one line per leg.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+REPORT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "decode_sweep.json")
+
+REPEATS = 3  # median-of-N, same noise discipline as ceiling_probe.py
+
+
+def sweep(pt, cfg, batches, buckets, gen):
+    from bench import measure_decode_marginal  # THE shared timing recipe
+    from paddle_tpu.jit import DecodeSession
+    from paddle_tpu.models import TransformerLM
+
+    pt.seed(0)
+    model = TransformerLM(**cfg, dropout=0.0)
+    rng = np.random.RandomState(0)
+    legs = []
+    compiles = {}
+    for bucket in buckets:
+        # one session PER bucket with max_len = bucket + gen: the dense
+        # decode step always scans the full max_len cache, so a shared
+        # max(buckets)-sized session would make every bucket leg measure
+        # the SAME cache length and the cache-length axis would be
+        # fiction
+        sess = DecodeSession(model, max_len=bucket + gen,
+                             buckets=[bucket])
+        for batch in batches:
+            ids = rng.randint(0, cfg["vocab_size"],
+                              (batch, bucket)).astype("int32")
+            m = measure_decode_marginal(sess, ids, gen, repeats=REPEATS)
+            leg = dict(m, batch=batch, prefill=bucket, generated=gen,
+                       cache_len=bucket + gen,
+                       decode_tokens_per_sec=round(
+                           batch / m["per_token_s"], 1))
+            legs.append(leg)
+            print("bucket %-5d batch %-3d  prefill %.4fs  "
+                  "%.3f ms/tok  %.1f tok/s"
+                  % (bucket, batch, m["prefill_s"],
+                     m["per_token_s"] * 1e3,
+                     leg["decode_tokens_per_sec"]), flush=True)
+        compiles["bucket_%d" % bucket] = sess.compile_counts()
+    return legs, compiles
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
+    ap.add_argument("--buckets", type=int, nargs="+",
+                    default=[128, 256, 512])
+    ap.add_argument("--gen", type=int, default=64,
+                    help="tokens generated per timed leg")
+    ap.add_argument("--cpu-smoke", action="store_true",
+                    help="tiny model on CPU to exercise the harness")
+    args = ap.parse_args()
+
+    from bench import _acquire_chip_lock, _peak_flops
+
+    if not args.cpu_smoke and _acquire_chip_lock(timeout_s=600.0) is None:
+        sys.exit("another process holds the chip lock; not contending")
+    if args.cpu_smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    import paddle_tpu as pt
+    from paddle_tpu.models import gpt_1p3b_config
+
+    on_tpu = jax.default_backend() != "cpu"
+    if not on_tpu and not args.cpu_smoke:
+        sys.exit("accelerator not reachable; refusing to 'measure' CPU")
+
+    cfg = gpt_1p3b_config()
+    if args.cpu_smoke:
+        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
+                   intermediate_size=512, vocab_size=1024,
+                   max_position=1024)
+        if args.buckets == [128, 256, 512]:
+            args.buckets = [32, 64]
+        if args.batches == [1, 2, 4, 8]:
+            args.batches = [1, 2]
+        args.gen = min(args.gen, 8)
+    else:
+        cfg.update(num_layers=6)  # the one-chip GPT geometry (bench leg)
+    # the marginal recipe differences against a 1-token generation
+    args.gen = max(args.gen, 2)
+
+    legs, compiles = sweep(pt, cfg, args.batches, args.buckets, args.gen)
+    report = {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                           time.gmtime()),
+              "backend": jax.devices()[0].device_kind,
+              "peak_flops": _peak_flops(jax, on_tpu),
+              "model": {k: cfg[k] for k in
+                        ("hidden_size", "num_layers", "num_heads",
+                         "vocab_size")},
+              "repeats": REPEATS,
+              "compile_counts": compiles,
+              "legs": legs}
+    with open(REPORT, "w") as f:
+        json.dump(report, f, indent=2)
+    print("report:", REPORT)
+
+
+if __name__ == "__main__":
+    main()
