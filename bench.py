@@ -507,14 +507,27 @@ def measure_decode_marginal(sess, ids, gen: int, repeats: int = 3) -> dict:
     }
 
 
+DECODE_BLOCK_SIZE = 32  # default KV block for the paged-layout legs
+
+
 def bench_decode(pt, jax, on_tpu: bool):
     """L7 serving leg: KV-cached autoregressive decode (jit.DecodeSession,
-    prefill 512 + 128 generated) at batch 1 and 8 — tokens/s/chip of the
-    steady-state decode step, the number a token-serving deployment lives
-    on.  Timing via measure_decode_marginal (median-of-3 marginal decode
-    time).  The prompt upload happens inside the timed generate calls, so
-    this leg does NOT claim input_staged; its transfer bias is bounded in
-    transfer_note instead (the gate accepts either)."""
+    prefill 512 + 128 generated) at batch 1 and 8, for BOTH cache
+    layouts (dense preallocation vs paged block-table) — tokens/s/chip
+    of the steady-state decode step, the number a token-serving
+    deployment lives on.  Every timed sub-leg records its
+    ``cache_layout`` and the KV-cache bytes reachable per step at the
+    leg's occupancy (the _leg_promotable gate REJECTS decode legs
+    without the layout stamp, so a paged-vs-dense number can never be
+    presented without its provenance); ``kv_bytes_by_occupancy``
+    quantifies the paged HBM win across fill levels instead of
+    asserting it, and ``block_size_sweep`` records paged tokens/s
+    against the block-size axis.  Timing via measure_decode_marginal
+    (median-of-3 marginal decode time).  The prompt upload happens
+    inside the timed generate calls, so this leg does NOT claim
+    input_staged; its transfer bias is bounded in transfer_note instead
+    (the gate accepts either)."""
+    from paddle_tpu.inference.generation import kv_reachable_bytes
     from paddle_tpu.jit import DecodeSession
     from paddle_tpu.models import TransformerLM, gpt_1p3b_config
 
@@ -529,23 +542,68 @@ def bench_decode(pt, jax, on_tpu: bool):
 
     pt.seed(0)
     model = TransformerLM(**cfg, dropout=0.0)
-    sess = DecodeSession(model, max_len=prefill + gen, buckets=[prefill])
+    max_len = prefill + gen
+    dims = dict(max_len=max_len, num_layers=cfg["num_layers"],
+                num_heads=cfg["num_heads"],
+                head_dim=cfg["hidden_size"] // cfg["num_heads"])
     rng = np.random.RandomState(0)
     legs = {}
     best_tps = 0.0
-    for batch in (1, 8):
-        ids = rng.randint(0, cfg["vocab_size"],
-                          (batch, prefill)).astype("int32")
-        m = measure_decode_marginal(sess, ids, gen)
-        tps = batch / m["per_token_s"]
-        legs["batch%d" % batch] = dict(
-            m, decode_tokens_per_sec=round(tps, 1))
-        best_tps = max(best_tps, tps)
+    compile_counts = {}
+    for layout in ("dense", "paged"):
+        sess = DecodeSession(model, max_len=max_len, buckets=[prefill],
+                             cache_layout=layout,
+                             block_size=DECODE_BLOCK_SIZE)
+        for batch in (1, 8):
+            ids = rng.randint(0, cfg["vocab_size"],
+                              (batch, prefill)).astype("int32")
+            m = measure_decode_marginal(sess, ids, gen)
+            tps = batch / m["per_token_s"]
+            legs["%s_batch%d" % (layout, batch)] = dict(
+                m, cache_layout=layout,
+                decode_tokens_per_sec=round(tps, 1),
+                kv_reachable_bytes=kv_reachable_bytes(
+                    [max_len] * batch, layout=layout,
+                    block_size=DECODE_BLOCK_SIZE, **dims))
+            best_tps = max(best_tps, tps)
+        compile_counts[layout] = sess.compile_counts()
+    # the paged win quantified across fill levels: reachable KV bytes at
+    # batch-8 occupancy fractions of max_len (dense pins the full slab
+    # whatever the occupancy; paged maps only ceil(tokens/bs) blocks)
+    occupancy = []
+    for frac in (0.125, 0.25, 0.5, 0.75, 1.0):
+        tokens = max(1, int(max_len * frac))
+        occupancy.append({
+            "tokens_per_slot": tokens, "slots": 8,
+            "dense_bytes": kv_reachable_bytes([tokens] * 8,
+                                              layout="dense", **dims),
+            "paged_bytes": kv_reachable_bytes(
+                [tokens] * 8, layout="paged",
+                block_size=DECODE_BLOCK_SIZE, **dims)})
+    # tokens/s against the block-size axis (batch 1, short generation:
+    # the axis's effect is on the gather/scatter addressing, visible
+    # without a long run) — the CPU record the ROADMAP item asks for,
+    # and the same axis tools/decode_sweep.py sweeps at scale
+    sweep_gen = min(gen, 32)
+    sweep_ids = rng.randint(0, cfg["vocab_size"],
+                            (1, prefill)).astype("int32")
+    block_sweep = []
+    for bs in (16, 32, 64, 128):
+        s = DecodeSession(model, max_len=max_len, buckets=[prefill],
+                          cache_layout="paged", block_size=bs)
+        m = measure_decode_marginal(s, sweep_ids, sweep_gen)
+        block_sweep.append(dict(
+            m, cache_layout="paged", block_size=bs,
+            decode_tokens_per_sec=round(1.0 / m["per_token_s"], 1)))
     out = {
         "tokens_per_sec": best_tps,
         "prefill": prefill,
         "generated": gen,
-        "compile_counts": sess.compile_counts(),
+        "cache_layouts": ["dense", "paged"],
+        "block_size": DECODE_BLOCK_SIZE,
+        "kv_bytes_by_occupancy": occupancy,
+        "block_size_sweep": block_sweep,
+        "compile_counts": compile_counts,
         # prompt ids are uploaded INSIDE the timed region: never claim
         # the staged-input stamp (the blanket stamper respects this)
         "input_staged": False,
@@ -686,6 +744,18 @@ def _leg_promotable(name: str, leg: dict):
         return False, ("mfu_convention %r != %d: pre-convention-fix MFU "
                        "understates 2x" % (leg.get("mfu_convention"),
                                            RESNET_MFU_CONVENTION))
+    if name == "decode":
+        # a decode number without its cache-layout stamp cannot say
+        # whether it measured the dense or the paged path (they differ in
+        # reachable HBM by up to max_len/actual-tokens): unpromotable
+        timed = {k: v for k, v in leg.items()
+                 if isinstance(v, dict) and "per_token_s" in v}
+        missing = sorted(k for k, v in timed.items()
+                         if not v.get("cache_layout"))
+        if not timed or missing:
+            return False, ("decode leg missing cache_layout on %s: "
+                           "dense-vs-paged provenance unknown"
+                           % (missing or "every timed sub-leg"))
     return True, ""
 
 

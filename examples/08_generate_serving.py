@@ -8,6 +8,10 @@ The serving path (docs/DESIGN.md "The prefill/decode split"):
 2. ``inference.GenerationPool`` — N cache slots share ONE batched decode
    step; mixed-length requests are packed in and finished slots are
    refilled from the queue (continuous batching).
+3. ``cache_layout="paged"`` — the same pool over a block-table KV cache
+   (docs/DESIGN.md §5b): cache HBM scales with the token budget
+   (``num_blocks``), not max_len x slots, and greedy output stays
+   token-identical to the dense layout.
 
 Run: python examples/08_generate_serving.py [--tokens 16]
 """
@@ -70,6 +74,23 @@ def main():
     for i, (p, o) in enumerate(zip(prompts, outs)):
         print("request %d (prompt %2d): %s..." % (i, len(p), o[:8].tolist()))
     print("pool compiles:", pool.compile_counts())
+
+    # -- paged KV cache: HBM scales with the token budget ---------------
+    # 8 allocatable blocks x 32 = a 256-token budget instead of pinning
+    # slots x max_len = 512 positions like the dense layout; requests
+    # that would overrun the budget simply WAIT in the queue (admission
+    # control), and greedy tokens match the dense pool exactly
+    paged = GenerationPool(model, max_len=256, slots=2, buckets=[64, 128],
+                           cache_layout="paged", block_size=32,
+                           num_blocks=9)
+    paged_outs = paged.generate(prompts, args.tokens)
+    for o, d in zip(paged_outs, outs):
+        assert np.array_equal(o, d), "paged must match dense"
+    stats = paged.cache_stats()
+    print("paged matches dense; cache stats:",
+          {k: stats[k] for k in ("cache_layout", "block_size",
+                                 "num_blocks", "dense_equiv_bytes",
+                                 "pool_bytes")})
 
 
 if __name__ == "__main__":

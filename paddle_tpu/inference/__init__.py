@@ -27,7 +27,8 @@ __all__ = ["Config", "Predictor", "PredictorTensor", "Tensor",
            "create_predictor", "PredictorPool", "get_version",
            "DataType", "PlaceType", "PrecisionType",
            "get_num_bytes_of_data_type",
-           "GenerationPool", "create_generation_pool"]
+           "GenerationPool", "create_generation_pool",
+           "kv_reachable_bytes"]
 
 
 class DataType:
@@ -251,7 +252,7 @@ class PredictorPool:
 # The artifact Predictor above runs a FIXED exported program; generation
 # needs the cache-threaded forward of a live model, so the pool owns the
 # model (docs/DESIGN.md "prefill/decode split").
-from .generation import GenerationPool  # noqa: E402,F401
+from .generation import GenerationPool, kv_reachable_bytes  # noqa: E402,F401
 
 
 def create_generation_pool(model, max_len: int, **kwargs) -> GenerationPool:

@@ -20,6 +20,16 @@ Dataflow per ``step()``:
    inactive slots are masked — their cache index does not advance;
 3. the sampled token ids (the only host round-trip) are appended
    per-request; rows hitting EOS or their token budget release the slot.
+
+``cache_layout="paged"`` swaps the dense per-slot K/V slabs for the
+vLLM block-table scheme (docs/DESIGN.md §5b): K/V live in a global pool
+of fixed-size blocks, each slot owns a row of a ``[slots, max_blocks]``
+block table, and the pool runs a host-side FREE-LIST allocator — a
+request reserves its worst-case block span at admission (so decode never
+runs out mid-request), the FIFO head defers when blocks are scarce, and
+``_finish`` returns blocks for reuse.  Cache HBM then scales with the
+token budget (``num_blocks``), not max_len × slots, while every shape
+stays static and greedy results stay token-identical to dense.
 """
 from __future__ import annotations
 
@@ -33,7 +43,40 @@ import numpy as np
 from ..core.errors import InvalidArgumentError
 from ..jit.decode import DecodeSession
 
-__all__ = ["GenerationPool"]
+__all__ = ["GenerationPool", "kv_reachable_bytes"]
+
+
+def kv_reachable_bytes(tokens, max_len: int, num_layers: int,
+                       num_heads: int, head_dim: int,
+                       layout: str = "dense", block_size: int = 32,
+                       dtype="float32") -> int:
+    """KV-cache bytes a decode step can actually READ for the given
+    per-row token counts (``tokens``: an int or a sequence, one entry
+    per slot/row).
+
+    Dense preallocation reaches ``rows * max_len`` positions whatever
+    the real occupancy; the paged layout reaches only the MAPPED blocks,
+    ``sum(ceil(t / block_size)) * block_size`` positions capped at
+    ``max_len`` per row (the reserved scratch block is excluded, and so
+    is a ragged final block's over-hang past max_len: both can be
+    gathered but every read of them is masked, so they never feed a
+    softmax — the cap keeps the "paged <= dense below full occupancy"
+    contract even for block sizes that do not divide max_len).  This is
+    the quantity the ROADMAP item names — cache HBM scaling with actual
+    tokens, not max_len × slots — and what bench.py's decode leg
+    records per layout."""
+    toks = [int(t) for t in
+            (tokens if hasattr(tokens, "__len__") else [tokens])]
+    per_token = 2 * num_layers * num_heads * head_dim * \
+        np.dtype(dtype).itemsize
+    if layout == "dense":
+        return len(toks) * int(max_len) * per_token
+    if layout != "paged":
+        raise InvalidArgumentError(
+            "layout must be 'dense' or 'paged', got %r" % (layout,))
+    bs = int(block_size)
+    return sum(min(-(-t // bs) * bs, int(max_len))
+               for t in toks) * per_token
 
 _Request = collections.namedtuple(
     "_Request", ["rid", "ids", "max_new_tokens"])
@@ -63,21 +106,50 @@ class GenerationPool:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, eos_id: Optional[int] = None,
                  cache_dtype="float32", donate: Optional[bool] = None,
-                 seed: int = 0):
+                 seed: int = 0, cache_layout: str = "dense",
+                 block_size: int = 32, num_blocks: Optional[int] = None):
         if slots < 1:
             raise InvalidArgumentError("GenerationPool needs slots >= 1")
         # the session owns the model binding, the sampling config and the
-        # bucketed batch-1 prefill; the pool adds the slot-batched layer
+        # bucketed batch-1 prefill; the pool adds the slot-batched layer.
+        # The session shares the pool's cache layout so a paged pool gets
+        # paged (identity-tabled, batch-1) row caches from prefill whose
+        # blocks splice straight into the pool's global block pool.
         self._session = DecodeSession(
             model, max_len, buckets=buckets, temperature=temperature,
             top_k=top_k, top_p=top_p, cache_dtype=cache_dtype,
-            donate=donate)
+            donate=donate, cache_layout=cache_layout,
+            block_size=block_size)
         self._model = model
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.eos_id = eos_id
-        self._cache = model.gen_decode_cache(self.slots, self.max_len,
-                                             cache_dtype, per_slot=True)
+        self.cache_layout = cache_layout
+        self._block_size = int(block_size)
+        # paged: ceil so a ragged final block still holds max_len
+        self._max_blocks = -(-self.max_len // self._block_size)
+        if cache_layout == "paged":
+            # physical block 0 is the reserved scratch block — unmapped
+            # table entries point at it, inactive-slot writes land in it;
+            # default pool size is FULL capacity (every slot at max_len);
+            # a smaller num_blocks is the point of paging: HBM scales
+            # with the token budget, and admission control (below) defers
+            # refills that couldn't finish within the remaining blocks
+            if num_blocks is None:
+                num_blocks = 1 + self.slots * self._max_blocks
+            num_blocks = int(num_blocks)
+            self._num_blocks = num_blocks
+            self._free_blocks: List[int] = list(range(1, num_blocks))
+            self._slot_blocks: Dict[int, List[int]] = {}
+        elif num_blocks is not None:
+            raise InvalidArgumentError(
+                "num_blocks is a paged-cache knob; pass "
+                "cache_layout='paged' (got %r)" % (cache_layout,))
+        self._cache = model.gen_decode_cache(
+            self.slots, self.max_len, cache_dtype, per_slot=True,
+            layout=cache_layout, block_size=block_size,
+            num_blocks=(self._num_blocks if cache_layout == "paged"
+                        else None))
         if donate is None:
             donate = jax.default_backend() != "cpu"
         self._decode_jit = jax.jit(self._pool_decode,
@@ -110,28 +182,52 @@ class GenerationPool:
         self._state_cache = None
 
     # -- traced bodies ---------------------------------------------------
-    def _insert(self, pool_cache, row_cache, slot, length):
+    def _insert(self, pool_cache, row_cache, slot, length, blocks=None):
         """Splice a batch-1 prefilled row cache into ``slot``; the slot
-        id and true length are traced scalars, so every refill reuses one
-        compilation."""
+        id, true length and (paged) block ids are traced, so every refill
+        reuses one compilation.
+
+        Paged: the row cache is an identity-tabled batch-1 pool (row
+        block 1+j holds logical block j — see ``gen_decode_cache``), so
+        the splice is ONE scatter copying every logical block to the
+        physical ids in ``blocks``; entries past the request's
+        reservation are 0, harmlessly dumping their (pad-garbage) blocks
+        into the scratch block.  The slot's table row then IS ``blocks``.
+        """
         out = []
         for cp, cr in zip(pool_cache, row_cache):
-            out.append(type(cp)(
-                cp.k.at[slot].set(cr.k[0].astype(cp.k.dtype)),
-                cp.v.at[slot].set(cr.v[0].astype(cp.v.dtype)),
-                cp.index.at[slot].set(jnp.asarray(length, jnp.int32))))
+            if hasattr(cp, "table"):
+                out.append(cp._replace(
+                    k=cp.k.at[blocks].set(cr.k[1:].astype(cp.k.dtype)),
+                    v=cp.v.at[blocks].set(cr.v[1:].astype(cp.v.dtype)),
+                    table=cp.table.at[slot].set(blocks),
+                    index=cp.index.at[slot].set(
+                        jnp.asarray(length, jnp.int32))))
+            else:
+                out.append(type(cp)(
+                    cp.k.at[slot].set(cr.k[0].astype(cp.k.dtype)),
+                    cp.v.at[slot].set(cr.v[0].astype(cp.v.dtype)),
+                    cp.index.at[slot].set(jnp.asarray(length, jnp.int32))))
         return out
 
     def _pool_decode(self, param_vals, buf_vals, cache, toks, active, key):
         """One batched decode step over every slot; inactive slots are
         frozen (their cache index does not advance, their token output is
-        forced to 0) so a free slot can never creep past max_len."""
+        forced to 0) so a free slot can never creep past max_len.
+
+        Paged: an inactive slot's table row is zeroed BEFORE the step so
+        its (discarded) write lands in the scratch block — its old blocks
+        may already belong to a refilled request, and a stale-table write
+        would corrupt that request's cache."""
         sess = self._session
+        if self.cache_layout == "paged":
+            cache = [c._replace(table=jnp.where(active[:, None],
+                                                c.table, 0))
+                     for c in cache]
         logits, new_cache = sess._run_model(param_vals, buf_vals,
                                             toks[:, None], cache)
         tok, key = sess._sample(logits[:, 0], key)
-        new_cache = [type(c)(c.k, c.v,
-                             jnp.where(active, c.index, old.index))
+        new_cache = [c._replace(index=jnp.where(active, c.index, old.index))
                      for c, old in zip(new_cache, cache)]
         return new_cache, jnp.where(active, tok, 0), key
 
@@ -156,6 +252,19 @@ class GenerationPool:
         # fail at SUBMIT time, not mid-refill: a prompt no bucket covers
         # would otherwise raise after the slot bookkeeping started
         self._session._bucket_for(len(ids))
+        if self.cache_layout == "paged":
+            # a request must fit an EMPTY pool, else _refill could never
+            # admit it and the pool would stall forever on a full queue
+            need = self._blocks_needed(len(ids), max_new_tokens)
+            if need > self._num_blocks - 1:
+                raise InvalidArgumentError(
+                    "request needs %d KV blocks (prompt %d + "
+                    "max_new_tokens %d at block_size %d) but the pool "
+                    "has only %d allocatable blocks (num_blocks=%d "
+                    "minus the reserved scratch block); raise "
+                    "num_blocks or lower max_new_tokens"
+                    % (need, len(ids), max_new_tokens, self._block_size,
+                       self._num_blocks - 1, self._num_blocks))
         # one id namespace for explicit and auto ids: explicit duplicates
         # are rejected, auto-assignment skips ids a caller already took
         # (a collision would silently overwrite the earlier results);
@@ -176,14 +285,36 @@ class GenerationPool:
                                     int(max_new_tokens)))
         return rid
 
+    def _blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
+        """Blocks a request reserves at ADMISSION: its worst-case token
+        span (prompt + generated; submit caps it at max_len).  Reserving
+        up front means a mid-decode step can never run out of blocks —
+        the allocator's no-preemption invariant."""
+        span = min(prompt_len + max_new_tokens, self.max_len)
+        return -(-span // self._block_size)
+
     def _finish(self, slot: int):
         state = self._active.pop(slot)
         self._results[state.rid] = np.asarray(state.tokens, np.int32)
         self._free.append(slot)
+        if self.cache_layout == "paged":
+            # returned blocks are immediately reusable: the slot's stale
+            # table row is masked to the scratch block inside every
+            # decode step until a refill overwrites it
+            self._free_blocks.extend(self._slot_blocks.pop(slot, ()))
         self._membership_dirty = True
 
     def _refill(self):
         while self._queue and self._free:
+            if self.cache_layout == "paged":
+                # admission control: FIFO head waits until enough blocks
+                # are free for its whole reservation (skipping ahead to a
+                # smaller later request would starve long prompts)
+                head = self._queue[0]
+                need = self._blocks_needed(len(head.ids),
+                                           head.max_new_tokens)
+                if need > len(self._free_blocks):
+                    break
             req = self._queue.popleft()
             # bucketed batch-1 prefill (compiled per bucket, shared with
             # DecodeSession.generate) emits the request's FIRST token;
@@ -193,9 +324,22 @@ class GenerationPool:
                 req.ids[None], self._key)
             slot = self._free.pop()
             first = int(np.asarray(tok)[0])
-            self._cache = self._insert_jit(
-                self._cache, row_cache, jnp.asarray(slot, jnp.int32),
-                jnp.asarray(len(req.ids), jnp.int32))
+            if self.cache_layout == "paged":
+                blocks = [self._free_blocks.pop() for _ in range(need)]
+                self._slot_blocks[slot] = blocks
+                # pad the table row to max_blocks with the scratch block:
+                # unreserved logical blocks are never read (masked past
+                # the request's span) and their splice writes are trash
+                padded = np.zeros(self._max_blocks, np.int32)
+                padded[:need] = blocks
+                self._cache = self._insert_jit(
+                    self._cache, row_cache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(len(req.ids), jnp.int32),
+                    jnp.asarray(padded))
+            else:
+                self._cache = self._insert_jit(
+                    self._cache, row_cache, jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(len(req.ids), jnp.int32))
             self._active[slot] = _SlotState(req.rid, first,
                                             req.max_new_tokens - 1)
             self._last_tok[slot] = first
@@ -260,3 +404,36 @@ class GenerationPool:
         counts["pool_decode"] = int(self._decode_jit._cache_size())
         counts["slot_insert"] = int(self._insert_jit._cache_size())
         return counts
+
+    def cache_stats(self) -> dict:
+        """Live KV-cache accounting: layout, allocator occupancy, and
+        the bytes a decode step can reach RIGHT NOW vs what a dense
+        preallocation of the same pool would pin — the paged win,
+        quantified from the allocator state rather than asserted."""
+        first = self._cache[0]
+        dims = dict(max_len=self.max_len, num_layers=len(self._cache),
+                    num_heads=first.k.shape[1], head_dim=first.k.shape[3],
+                    dtype=first.k.dtype)
+        dense_bytes = kv_reachable_bytes([self.max_len] * self.slots,
+                                         layout="dense", **dims)
+        stats = {"cache_layout": self.cache_layout,
+                 "dense_equiv_bytes": dense_bytes}
+        if self.cache_layout == "paged":
+            bs = self._block_size
+            stats.update(
+                block_size=bs,
+                num_blocks=self._num_blocks,
+                free_blocks=len(self._free_blocks),
+                mapped_blocks=self._num_blocks - 1 -
+                len(self._free_blocks),
+                # tokens = each slot's mapped span: ONE formula with the
+                # bench/sweep records (incl. the ragged-final-block cap)
+                reachable_bytes=kv_reachable_bytes(
+                    [len(b) * bs for b in self._slot_blocks.values()],
+                    layout="paged", block_size=bs, **dims),
+                pool_bytes=self._num_blocks * bs *
+                dense_bytes // (self.slots * self.max_len))
+        else:
+            stats.update(reachable_bytes=dense_bytes,
+                         pool_bytes=dense_bytes)
+        return stats

@@ -116,7 +116,8 @@ class DecodeSession:
                  buckets: Optional[Sequence[int]] = None,
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, cache_dtype="float32",
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None,
+                 cache_layout: str = "dense", block_size: int = 32):
         from . import _StateBinding
 
         if not hasattr(model, "gen_decode_cache"):
@@ -160,6 +161,21 @@ class DecodeSession:
                 "(0, 1]; got temperature=%r top_p=%r"
                 % (temperature, top_p))
         self._cache_dtype = cache_dtype
+        # "dense" preallocates [B, H, max_len, D] per row; "paged" stores
+        # K/V in fixed-size blocks addressed through a block table
+        # (identity-mapped here — the aligned batch needs no allocator;
+        # inference.GenerationPool runs a real free-list over the same
+        # layout).  Both compile exactly two functions per bucket and are
+        # token-identical under greedy decoding.
+        if cache_layout not in ("dense", "paged"):
+            raise InvalidArgumentError(
+                "cache_layout must be 'dense' or 'paged', got %r"
+                % (cache_layout,))
+        if int(block_size) < 1:
+            raise InvalidArgumentError(
+                "block_size must be >= 1, got %r" % (block_size,))
+        self.cache_layout = cache_layout
+        self.block_size = int(block_size)
         if donate is None:
             donate = jax.default_backend() != "cpu"
         # argnum 2 = the cache pytree: every decode step consumes its
@@ -208,11 +224,12 @@ class DecodeSession:
         ``true_len``, overwriting pad garbage first.
         """
         b = ids.shape[0]
-        cache = self._model.gen_decode_cache(b, self.max_len,
-                                             self._cache_dtype)
+        cache = self._model.gen_decode_cache(
+            b, self.max_len, self._cache_dtype,
+            layout=self.cache_layout, block_size=self.block_size)
         logits, cache = self._run_model(param_vals, buf_vals, ids, cache)
         true_len = jnp.asarray(true_len, jnp.int32)
-        cache = [type(c)(c.k, c.v, true_len) for c in cache]
+        cache = [c._replace(index=true_len) for c in cache]
         last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                             keepdims=False)  # [B, V]
         tok, key = self._sample(last, key)
@@ -230,9 +247,16 @@ class DecodeSession:
         for b in self.buckets:
             if b >= length:
                 return b
+        # name the available buckets: the caller can act on this from
+        # the exception alone (shorten the prompt, or construct the
+        # session/pool with a bucket >= the prompt length)
         raise InvalidArgumentError(
             "prompt length %d exceeds the largest prefill bucket %d "
-            "(max_len=%d)" % (length, self.buckets[-1], self.max_len))
+            "(available buckets: %s, max_len=%d); shorten the prompt or "
+            "construct the session/pool with buckets=[..., %d] (any "
+            "bucket >= the prompt length, capped by max_len)"
+            % (length, self.buckets[-1], self.buckets, self.max_len,
+               length))
 
     def _state_vals(self):
         return ([p._value for p in self._binding.params],

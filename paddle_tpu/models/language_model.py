@@ -134,10 +134,14 @@ class TransformerLM(Layer):
         return jnp.where(allow, 0.0, jnp.finfo(jnp.float32).min).astype(dtype)
 
     def gen_decode_cache(self, batch_size: int, max_length: int,
-                         dtype="float32", per_slot: bool = False):
+                         dtype="float32", per_slot: bool = False,
+                         layout: str = "dense", block_size: int = 32,
+                         num_blocks: Optional[int] = None):
         """Per-layer preallocated KV decode cache (see
         ``MultiHeadAttention.gen_decode_cache``); thread it through
         ``forward(..., cache=...)`` for O(1)-per-token generation.
+        ``layout="paged"`` selects the block-table cache
+        (``PagedDecodeCache``) whose HBM scales with allocated tokens.
 
         Causal models only: the cached path masks attention causally over
         the prefix, which for a bidirectional (``causal=False``) encoder
@@ -151,7 +155,8 @@ class TransformerLM(Layer):
                 "incrementally — new tokens would change every earlier "
                 "position's hidden state")
         return self.encoder.gen_decode_cache(batch_size, max_length, dtype,
-                                             per_slot)
+                                             per_slot, layout, block_size,
+                                             num_blocks)
 
     def encode(self, input_ids, attn_mask=None, token_type_ids=None,
                cache=None):

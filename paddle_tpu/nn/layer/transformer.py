@@ -46,6 +46,16 @@ class MultiHeadAttention(Layer):
     # step has IDENTICAL shapes: one XLA compilation, donate-able
     # buffers, O(1) per-token attention against the valid prefix.
     DecodeCache = collections.namedtuple("DecodeCache", ["k", "v", "index"])
+    # Paged decode cache (vLLM block-table scheme): K/V live in a GLOBAL
+    # pool of fixed-size blocks [num_blocks, H, block_size, D] and each
+    # row owns a [max_blocks] int32 row of ``table`` mapping its logical
+    # block j to a physical pool row.  Physical block 0 is a reserved
+    # scratch block unmapped logical blocks point at.  All shapes stay
+    # static — only table VALUES vary — so the "exactly two compiles"
+    # contract of the dense cache is preserved while cache HBM scales
+    # with ALLOCATED tokens, not max_len × rows.
+    PagedDecodeCache = collections.namedtuple(
+        "PagedDecodeCache", ["k", "v", "table", "index"])
 
     def __init__(
         self,
@@ -154,19 +164,59 @@ class MultiHeadAttention(Layer):
         return self.Cache(key, value)
 
     def gen_decode_cache(self, batch_size: int, max_length: int,
-                         dtype="float32", per_slot: bool = False):
-        """Preallocated decode cache: zeroed [B, H, max_len, D] K/V plus
-        index 0 (scalar, or [B] when ``per_slot`` — the GenerationPool's
-        slot-batched layout where each row decodes at its own position).
-        Leaves are RAW jax arrays (not Tensors): the cache threads through
-        jitted prefill/decode as a donated pytree."""
+                         dtype="float32", per_slot: bool = False,
+                         layout: str = "dense", block_size: int = 32,
+                         num_blocks: Optional[int] = None):
+        """Preallocated decode cache; leaves are RAW jax arrays (not
+        Tensors) so the cache threads through jitted prefill/decode as a
+        donated pytree.  The index is 0 (scalar, or [B] when
+        ``per_slot`` — the GenerationPool's slot-batched layout where
+        each row decodes at its own position).
+
+        ``layout="dense"``: zeroed [B, H, max_len, D] K/V buffers.
+
+        ``layout="paged"``: a global block pool
+        [num_blocks, H, block_size, D] plus a [B, max_blocks] int32 block
+        table (``PagedDecodeCache``).  Physical block 0 is reserved as a
+        scratch block.  With ``num_blocks=None`` the pool is sized to
+        full capacity (1 + B * max_blocks) and the table is the IDENTITY
+        mapping — self-managed, no allocator needed (DecodeSession's
+        aligned batches).  An EXPLICIT ``num_blocks`` means an external
+        allocator (inference.GenerationPool) owns the mapping: the table
+        starts all-zeros (everything unmapped → scratch) and the
+        allocator writes rows as it maps blocks."""
         import jax.numpy as jnp
 
-        shape = (batch_size, self.num_heads, max_length, self.head_dim)
+        if layout not in ("dense", "paged"):
+            raise InvalidArgumentError(
+                "cache layout must be 'dense' or 'paged', got %r"
+                % (layout,))
         index = (jnp.zeros((batch_size,), jnp.int32) if per_slot
                  else jnp.zeros((), jnp.int32))
-        return self.DecodeCache(jnp.zeros(shape, dtype),
-                                jnp.zeros(shape, dtype), index)
+        if layout == "dense":
+            shape = (batch_size, self.num_heads, max_length, self.head_dim)
+            return self.DecodeCache(jnp.zeros(shape, dtype),
+                                    jnp.zeros(shape, dtype), index)
+        block_size = int(block_size)
+        if block_size < 1:
+            raise InvalidArgumentError(
+                "paged cache needs block_size >= 1, got %d" % block_size)
+        max_blocks = -(-int(max_length) // block_size)
+        if num_blocks is None:
+            num_blocks = 1 + batch_size * max_blocks
+            table = 1 + jnp.arange(batch_size * max_blocks,
+                                   dtype=jnp.int32).reshape(batch_size,
+                                                            max_blocks)
+        else:
+            num_blocks = int(num_blocks)
+            if num_blocks < 2:
+                raise InvalidArgumentError(
+                    "paged cache needs num_blocks >= 2 (block 0 is the "
+                    "reserved scratch block), got %d" % num_blocks)
+            table = jnp.zeros((batch_size, max_blocks), jnp.int32)
+        shape = (num_blocks, self.num_heads, block_size, self.head_dim)
+        return self.PagedDecodeCache(jnp.zeros(shape, dtype),
+                                     jnp.zeros(shape, dtype), table, index)
 
     def _decode_forward(self, q, k_new, v_new, attn_mask, cache):
         """Shape-static cached attention: write the new K/V chunk into the
@@ -228,19 +278,87 @@ class MultiHeadAttention(Layer):
         return out, self.DecodeCache(k_buf, v_buf,
                                      idx + (length if idx.ndim == 0 else 1))
 
+    def _paged_decode_forward(self, q, k_new, v_new, attn_mask, cache):
+        """Block-table cached attention: the new K/V chunk is scattered
+        into the global block pool THROUGH the row's block table, queries
+        attend over the gathered valid prefix, the index advances.  Same
+        masking/ordering discipline as ``_decode_forward`` — the layouts
+        are token-identical under greedy decoding — but writes address
+        ``pool[table[row, pos // bs], :, pos % bs, :]`` so the bytes a
+        step touches are the row's MAPPED blocks, not a dense
+        [B, H, max_len, D] slab."""
+        import jax.numpy as jnp
+
+        from ...framework.tensor import Tensor as _T
+        from ...ops.flash_attention import paged_decode_attention
+
+        def raw(x):
+            return x.value if isinstance(x, _T) else jnp.asarray(x)
+
+        if attn_mask is not None:
+            raise InvalidArgumentError(
+                "decode-cache attention derives its mask from the cache "
+                "index (causal over the valid prefix); additive "
+                "attn_mask is not supported with a DecodeCache — pass "
+                "attn_mask=None, or use the uncached forward")
+        q_, k_new, v_new = raw(q), raw(k_new), raw(v_new)
+        k_pool, v_pool = raw(cache.k), raw(cache.v)
+        table = jnp.asarray(cache.table, jnp.int32)
+        idx = jnp.asarray(cache.index, jnp.int32)
+        b, _, length, _ = q_.shape
+        bs = k_pool.shape[2]
+        s = table.shape[1] * bs
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, q_.dtype)
+        if idx.ndim == 0:
+            # aligned batch (DecodeSession): every row writes the same
+            # chunk positions; one scatter over [B, L] (pos, block) pairs
+            pos = idx + jnp.arange(length)                      # [L]
+            phys = table[:, pos // bs]                          # [B, L]
+            off = jnp.broadcast_to((pos % bs)[None, :], (b, length))
+            k_pool = k_pool.at[phys, :, off, :].set(
+                k_new.transpose(0, 2, 1, 3).astype(k_pool.dtype))
+            v_pool = v_pool.at[phys, :, off, :].set(
+                v_new.transpose(0, 2, 1, 3).astype(v_pool.dtype))
+            allow = jnp.arange(s)[None, :] <= pos[:, None]
+            bias = jnp.where(allow, 0.0, neg)[None, None]       # [1,1,L,S]
+        else:
+            # slot-batched decode: ONE token per row at its own position
+            if length != 1:
+                raise InvalidArgumentError(
+                    "per-slot DecodeCache decodes one token per step "
+                    "(query length 1), got query length %d; prefill each "
+                    "request with a scalar-index cache and insert it "
+                    "into the slot" % length)
+            rows = jnp.arange(b)
+            phys = table[rows, idx // bs]                       # [B]
+            off = idx % bs
+            k_pool = k_pool.at[phys, :, off, :].set(
+                k_new[:, :, 0, :].astype(k_pool.dtype))
+            v_pool = v_pool.at[phys, :, off, :].set(
+                v_new[:, :, 0, :].astype(v_pool.dtype))
+            allow = (jnp.arange(s)[None, None, :]
+                     <= idx[:, None, None])                     # [B,1,S]
+            bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,1,S]
+        out = paged_decode_attention(q_, k_pool, v_pool, table, bias=bias)
+        return out, cache._replace(
+            k=k_pool, v=v_pool,
+            index=idx + (length if idx.ndim == 0 else 1))
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         from ... import tensor as T
 
         key = query if key is None else key
         value = key if value is None else value
         q = self._split_heads(self.q_proj(query))
-        if isinstance(cache, self.DecodeCache):
+        if isinstance(cache, (self.DecodeCache, self.PagedDecodeCache)):
             from ...framework.tensor import Tensor as _T
 
             k_new = self._split_heads(self.k_proj(key))
             v_new = self._split_heads(self.v_proj(value))
-            out_raw, cache = self._decode_forward(q, k_new, v_new,
-                                                  attn_mask, cache)
+            fwd = (self._decode_forward
+                   if isinstance(cache, self.DecodeCache)
+                   else self._paged_decode_forward)
+            out_raw, cache = fwd(q, k_new, v_new, attn_mask, cache)
             out = self.out_proj(self._merge_heads(
                 _T(out_raw, stop_gradient=True)))
             if self.need_weights:
@@ -337,9 +455,12 @@ class TransformerEncoderLayer(Layer):
         return self.self_attn.gen_cache(src)
 
     def gen_decode_cache(self, batch_size: int, max_length: int,
-                         dtype="float32", per_slot: bool = False):
+                         dtype="float32", per_slot: bool = False,
+                         layout: str = "dense", block_size: int = 32,
+                         num_blocks: Optional[int] = None):
         return self.self_attn.gen_decode_cache(batch_size, max_length,
-                                               dtype, per_slot)
+                                               dtype, per_slot, layout,
+                                               block_size, num_blocks)
 
 
 class TransformerEncoder(Layer):
@@ -372,9 +493,12 @@ class TransformerEncoder(Layer):
         return [layer.gen_cache(src) for layer in self.layers]
 
     def gen_decode_cache(self, batch_size: int, max_length: int,
-                         dtype="float32", per_slot: bool = False):
+                         dtype="float32", per_slot: bool = False,
+                         layout: str = "dense", block_size: int = 32,
+                         num_blocks: Optional[int] = None):
         return [layer.gen_decode_cache(batch_size, max_length, dtype,
-                                       per_slot)
+                                       per_slot, layout, block_size,
+                                       num_blocks)
                 for layer in self.layers]
 
 

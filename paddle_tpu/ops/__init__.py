@@ -12,7 +12,10 @@ from .flash_attention import (  # noqa: F401
     decode_attention_supported,
     flash_attention,
     flash_attention_supported,
+    paged_decode_attention,
+    paged_decode_attention_supported,
 )
 
 __all__ = ["flash_attention", "flash_attention_supported",
-           "decode_attention", "decode_attention_supported"]
+           "decode_attention", "decode_attention_supported",
+           "paged_decode_attention", "paged_decode_attention_supported"]

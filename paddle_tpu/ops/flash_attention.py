@@ -21,7 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["flash_attention", "flash_attention_supported",
-           "decode_attention", "decode_attention_supported"]
+           "decode_attention", "decode_attention_supported",
+           "paged_decode_attention", "paged_decode_attention_supported"]
 
 _SUPPORTED_DTYPES = (jnp.float32, jnp.bfloat16)
 
@@ -193,6 +194,83 @@ def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None):
         scores = scores + bias.astype(scores.dtype)
     weights = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("...qk,...kd->...qd", weights, v)
+
+
+# ---------------------------------------------------------------------------
+# paged decode attention: block-table KV cache (vLLM scheme, static shapes)
+# ---------------------------------------------------------------------------
+
+
+def paged_decode_attention_supported(q_shape, block_size: int,
+                                     num_blocks: int, dtype) -> bool:
+    """Gate for a future single-query pallas PAGED decode kernel, mirroring
+    ``decode_attention_supported``: TPU backend, short query chunk,
+    MXU-tileable head_dim, sublane-aligned block_size, and a pool big
+    enough that a hand-tiled gather kernel could beat the XLA
+    gather+composition.  No such kernel ships yet — callers always fall
+    through to the composition — but the routing discipline (and its
+    tests) are in place for when one measures in."""
+    if jax.default_backend() != "tpu":
+        return False
+    if len(q_shape) != 4 or q_shape[2] > 8:
+        return False
+    if q_shape[3] not in (64, 128, 256):
+        return False
+    if block_size < 8 or block_size % 8 != 0:
+        return False
+    if block_size * num_blocks < DECODE_FLASH_MIN_CACHE:
+        return False
+    return jnp.dtype(dtype) in _SUPPORTED_DTYPES
+
+
+def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
+                           sm_scale: Optional[float] = None):
+    """Decode-step attention against a BLOCK-TABLE KV cache.
+
+    ``q``: [B, H, Lq, D] queries (Lq = 1 for autoregressive decode).
+    ``k_pool``/``v_pool``: [num_blocks, H, block_size, D] global block
+    pools shared by every row.  ``table``: [B, max_blocks] int32 — row
+    b's logical block j lives in physical pool row ``table[b, j]``
+    (physical block 0 is by convention a scratch/trash block that
+    unmapped logical blocks point at).  ``lengths``: optional scalar or
+    [B] int32 count of VALID tokens per row; positions at or beyond it
+    are masked to -inf.  ``bias`` is an extra additive mask
+    broadcastable to [B, H, Lq, S] with S = max_blocks * block_size
+    (callers that already know their causal-prefix mask pass it here and
+    skip ``lengths``).
+
+    All shapes are static — only the TABLE VALUES vary per step — so one
+    XLA compilation serves every allocation state, the same
+    compiler-first caching discipline as the dense ``decode_attention``
+    (which this reduces to after the gather: the math is shared so paged
+    and dense logits agree to float-reduction noise).  The pool rows a
+    step can READ are exactly the mapped blocks, so cache HBM scales
+    with allocated tokens, not max_len × rows.
+    """
+    b, mb = table.shape
+    nb, h, bs, d = k_pool.shape
+    s = mb * bs
+    # gather the row's blocks: [B, MB, H, bs, D] -> [B, H, MB*bs, D];
+    # XLA lowers the fancy-index to one gather over the pool's leading
+    # axis, the only data-dependent op in the step
+    tbl = jnp.asarray(table, jnp.int32)
+    k = k_pool[tbl].transpose(0, 2, 1, 3, 4).reshape(b, h, s, d)
+    v = v_pool[tbl].transpose(0, 2, 1, 3, 4).reshape(b, h, s, d)
+    if lengths is not None:
+        lengths = jnp.asarray(lengths, jnp.int32)
+        if lengths.ndim == 0:
+            allow = (jnp.arange(s) < lengths)[None, None, None, :]
+        else:
+            allow = (jnp.arange(s)[None, :]
+                     < lengths[:, None])[:, None, None, :]
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, q.dtype)
+        len_bias = jnp.where(allow, 0.0, neg)
+        bias = len_bias if bias is None else bias + len_bias
+    if paged_decode_attention_supported(q.shape, bs, nb, q.dtype):
+        # reserved routing slot: a pallas paged/splash kernel that tiles
+        # the gather lands here once a measured crossover justifies it
+        pass
+    return decode_attention(q, k, v, bias=bias, sm_scale=sm_scale)
 
 
 # id(mask) → (weakref(mask), verdict); masks are immutable jax arrays built

@@ -95,6 +95,30 @@ def test_stored_bert_gate_blocks_unproven_headline():
         bench._load_tpu_record = saved
 
 
+def test_decode_leg_without_cache_layout_rejected():
+    # a decode number that cannot say which cache layout it measured
+    # (dense vs paged differ in reachable HBM by up to max_len/tokens)
+    # must never be promoted
+    leg = {"tokens_per_sec": 500.0, "transfer_note": "negligible",
+           "batch1": {"per_token_s": 0.002, "decode_tokens_per_sec": 500.0}}
+    ok, why = bench._leg_promotable("decode", leg)
+    assert not ok and "cache_layout" in why
+
+
+def test_decode_leg_with_cache_layout_promotes():
+    leg = {"tokens_per_sec": 500.0, "transfer_note": "negligible",
+           "dense_batch1": {"per_token_s": 0.002, "cache_layout": "dense"},
+           "paged_batch1": {"per_token_s": 0.002, "cache_layout": "paged"}}
+    ok, why = bench._leg_promotable("decode", leg)
+    assert ok, why
+
+
+def test_decode_leg_no_timed_subleg_rejected():
+    leg = {"tokens_per_sec": 500.0, "transfer_note": "negligible"}
+    ok, why = bench._leg_promotable("decode", leg)
+    assert not ok and "cache_layout" in why
+
+
 def test_resnet_mfu_formula_pinned():
     """The one shared MFU formula (2 FLOPs/MAC, fwd + ~2x bwd): the
     staged-input measurement of 2026-07-30 (batch 128, 0.0863 s on the

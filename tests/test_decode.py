@@ -146,6 +146,64 @@ def test_sample_logits_limits():
                                  top_p=0.1)[0]) == 1
 
 
+def test_sample_logits_filtering_invariants_under_jit():
+    """The filtering contracts hold INSIDE a compiled step (where the
+    engine runs them): top-k keeps exactly the k highest-logit
+    candidates, top-p never drops the argmax, temperature 0 is argmax."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(10)
+    logits = rng.randn(1, 32).astype(np.float32) * 3.0
+
+    # temperature 0 == argmax under jit, key-independent
+    greedy = jax.jit(lambda l, k: sample_logits(l, k, 0.0))
+    for s in range(3):
+        assert int(greedy(jnp.asarray(logits),
+                          jax.random.PRNGKey(s))[0]) == logits.argmax()
+
+    # top-k keeps EXACTLY k candidates: over many seeds every draw lands
+    # in the true top-k set, and (flat-ish logits, enough draws) every
+    # one of the k appears — nothing outside leaks in, nothing inside is
+    # filtered out
+    k = 3
+    topk = jax.jit(lambda l, key: sample_logits(l, key, 1.0, top_k=k))
+    allowed = set(np.argsort(logits[0])[-k:].tolist())
+    drawn = {int(topk(jnp.asarray(logits), jax.random.PRNGKey(s))[0])
+             for s in range(64)}
+    assert drawn <= allowed, (drawn, allowed)
+    assert drawn == allowed, "with 64 draws every top-k candidate appears"
+
+    # top-p never drops the argmax: even a top_p smaller than the
+    # argmax's own probability keeps it (the smallest covering set)
+    for p in (1e-6, 0.05, 0.3, 0.9):
+        topp = jax.jit(lambda l, key, _p=p: sample_logits(l, key, 1.0,
+                                                          top_p=_p))
+        probs = np.exp(logits[0] - logits[0].max())
+        probs /= probs.sum()
+        order = np.argsort(-probs)
+        cum = np.cumsum(probs[order])
+        nucleus = set(order[:int(np.searchsorted(cum, p) + 1)].tolist())
+        for s in range(16):
+            tok = int(topp(jnp.asarray(logits), jax.random.PRNGKey(s))[0])
+            assert tok in nucleus, (p, tok, nucleus)
+        assert int(logits.argmax()) in nucleus
+
+
+def test_bucket_error_names_available_buckets():
+    # the fix must be actionable from the exception alone: the message
+    # names the configured buckets, not just the largest one
+    m = _tiny_model()
+    sess = DecodeSession(m, max_len=64, buckets=[8, 16])
+    with pytest.raises(InvalidArgumentError,
+                       match=r"available buckets: \[8, 16\]"):
+        sess.generate(np.zeros((1, 20), np.int32), 4)
+    pool = GenerationPool(m, max_len=64, slots=1, buckets=[8, 16])
+    with pytest.raises(InvalidArgumentError,
+                       match=r"available buckets: \[8, 16\]"):
+        pool.submit(np.zeros(20, np.int32), 4)
+
+
 def test_eos_early_stop_pads():
     m = _tiny_model()
     rng = np.random.RandomState(4)

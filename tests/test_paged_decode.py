@@ -1,0 +1,307 @@
+"""Paged (block-table) KV cache — the vLLM scheme on static shapes.
+
+Pins the contracts the paged layout lives on:
+
+- paged and dense layouts are TOKEN-IDENTICAL under greedy decoding
+  (DecodeSession.generate and GenerationPool.run) across randomized
+  prompt lengths, interleaved submit/step orders, and slot churn;
+- the paged session still compiles exactly two functions per
+  (bucket, decode) pair — only table VALUES vary, never shapes;
+- the free-list allocator reserves a request's whole worst-case span at
+  admission, defers refills under block pressure instead of failing
+  mid-decode, and reuses blocks freed by ``_finish`` without
+  cross-request leakage;
+- reachable KV bytes scale with actual tokens (paged <= dense at every
+  occupancy below full max_len);
+- ``paged_decode_attention`` is the gather+mask composition of the
+  dense ``decode_attention`` (the math is shared, so layouts can only
+  differ by float-reduction noise).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.inference import GenerationPool, kv_reachable_bytes
+from paddle_tpu.jit import DecodeSession
+from paddle_tpu.models import TransformerLM
+
+
+def _tiny_model(vocab=128, hidden=64, heads=4, layers=2, max_position=1024):
+    pt.seed(0)
+    return TransformerLM(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_heads=heads, intermediate_size=2 * hidden,
+        max_position=max_position, causal=True, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+@pytest.fixture(scope="module")
+def dense_sess(model):
+    return DecodeSession(model, max_len=64, buckets=[16, 32])
+
+
+def test_paged_session_token_identical_randomized_lengths(model,
+                                                          dense_sess):
+    # property: for randomized prompt lengths (and a block size that does
+    # NOT divide most of them), greedy paged == greedy dense, token for
+    # token — the layout changes bytes touched, never math
+    paged = DecodeSession(model, max_len=64, buckets=[16, 32],
+                          cache_layout="paged", block_size=8)
+    rng = np.random.RandomState(0)
+    for length in rng.randint(1, 31, size=6):
+        ids = rng.randint(0, 128, (2, int(length))).astype("int32")
+        np.testing.assert_array_equal(
+            paged.generate(ids, 6), dense_sess.generate(ids, 6),
+            err_msg="prompt length %d" % length)
+
+
+def test_paged_session_exactly_two_compiles(model):
+    # the acceptance contract: paging must not cost compilations — the
+    # block table is DATA, so one prefill bucket + one decode step
+    sess = DecodeSession(model, max_len=64, buckets=[16],
+                         cache_layout="paged", block_size=8)
+    rng = np.random.RandomState(1)
+    for length in (5, 9, 16):
+        sess.generate(rng.randint(0, 128, (1, length)).astype("int32"), 4)
+    assert sess.compile_counts() == {"prefill": 1, "decode": 1}
+
+
+def test_paged_ragged_final_block(model, dense_sess):
+    # max_len 64 with block_size 24: ceil -> 3 blocks cover 72 >= 64
+    # positions; the over-hang is masked, never attended
+    paged = DecodeSession(model, max_len=64, buckets=[32],
+                          cache_layout="paged", block_size=24)
+    rng = np.random.RandomState(2)
+    ids = rng.randint(0, 128, (1, 20)).astype("int32")
+    np.testing.assert_array_equal(paged.generate(ids, 8),
+                                  dense_sess.generate(ids, 8))
+
+
+def test_pool_paged_matches_dense_interleaved_submit_step(model,
+                                                          dense_sess):
+    # interleaved submit/step: requests arrive while the pool is
+    # mid-decode, so refills splice into a HOT block pool
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 128, (n,)).astype("int32")
+               for n in (5, 11, 7, 3, 14)]
+    pool = GenerationPool(model, max_len=64, slots=2, buckets=[16, 32],
+                          cache_layout="paged", block_size=8)
+    rids = [pool.submit(p, 6) for p in prompts[:2]]
+    for _ in range(3):
+        pool.step()
+    rids += [pool.submit(p, 6) for p in prompts[2:]]
+    results = pool.run()
+    for rid, p in zip(rids, prompts):
+        np.testing.assert_array_equal(results[rid],
+                                      dense_sess.generate(p[None], 6)[0])
+    counts = pool.compile_counts()
+    assert counts["pool_decode"] == 1 and counts["slot_insert"] == 1
+
+
+def test_pool_block_reuse_no_cross_request_leakage(model, dense_sess):
+    # a pool with barely more blocks than one request: every later
+    # request decodes through blocks freed by an earlier _finish, so any
+    # missed table masking / stale write corrupts its tokens
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, 128, (n,)).astype("int32")
+               for n in (9, 13, 6, 11)]
+    pool = GenerationPool(model, max_len=64, slots=2, buckets=[16],
+                          cache_layout="paged", block_size=8,
+                          num_blocks=5)  # 4 allocatable = one 16+8 req +1
+    outs = pool.generate(prompts, 8)
+    for p, got in zip(prompts, outs):
+        np.testing.assert_array_equal(got,
+                                      dense_sess.generate(p[None], 8)[0])
+    stats = pool.cache_stats()
+    assert stats["mapped_blocks"] == 0 and stats["free_blocks"] == 4
+
+
+def test_pool_admission_defers_not_fails(model):
+    # two requests that cannot coexist in the block budget: the second
+    # waits in the queue (backpressure), neither fails, both finish
+    rng = np.random.RandomState(5)
+    a = rng.randint(0, 128, (10,)).astype("int32")
+    b = rng.randint(0, 128, (12,)).astype("int32")
+    pool = GenerationPool(model, max_len=64, slots=2, buckets=[16],
+                          cache_layout="paged", block_size=8,
+                          num_blocks=4)  # 3 allocatable; each req needs 3
+    ra, rb = pool.submit(a, 6), pool.submit(b, 6)
+    pool.step()  # admits only `a`
+    assert len(pool._active) == 1
+    results = pool.run()
+    assert set(results) == {ra, rb}
+    sess = DecodeSession(model, max_len=64, buckets=[16])
+    np.testing.assert_array_equal(results[ra], sess.generate(a[None], 6)[0])
+    np.testing.assert_array_equal(results[rb], sess.generate(b[None], 6)[0])
+
+
+def test_pool_submit_rejects_unservable_request(model):
+    # a request that could NEVER fit the pool must fail at submit (the
+    # queue would otherwise stall forever), and the error must be
+    # actionable: blocks needed, blocks available, the knobs to turn
+    pool = GenerationPool(model, max_len=64, slots=1, buckets=[16],
+                          cache_layout="paged", block_size=8,
+                          num_blocks=3)  # 2 allocatable blocks = 16 toks
+    with pytest.raises(InvalidArgumentError, match="num_blocks"):
+        pool.submit(np.zeros(10, np.int32), 20)
+    # within budget still serves
+    out = pool.generate([np.zeros(5, np.int32)], 3)
+    assert out[0].shape == (3,)
+
+
+def test_pool_rejects_num_blocks_with_dense_layout(model):
+    with pytest.raises(InvalidArgumentError, match="paged"):
+        GenerationPool(model, max_len=32, slots=1, buckets=[8],
+                       num_blocks=4)
+
+
+def test_cache_stats_reachable_bytes_track_allocator(model):
+    pool = GenerationPool(model, max_len=64, slots=2, buckets=[16],
+                          cache_layout="paged", block_size=8)
+    pool.submit(np.zeros(9, np.int32), 4)  # reserves ceil(13/8) = 2
+    pool.step()
+    stats = pool.cache_stats()
+    assert stats["cache_layout"] == "paged"
+    assert stats["mapped_blocks"] == 2  # ceil((9 + 4) / 8)
+    assert stats["reachable_bytes"] == kv_reachable_bytes(
+        [9 + 4], max_len=64, num_layers=2, num_heads=4, head_dim=16,
+        layout="paged", block_size=8)
+    assert stats["reachable_bytes"] < stats["dense_equiv_bytes"]
+    pool.run()
+    assert pool.cache_stats()["mapped_blocks"] == 0
+
+
+def test_kv_reachable_bytes_paged_leq_dense_below_full():
+    dims = dict(max_len=640, num_layers=4, num_heads=8, head_dim=64)
+    # includes block sizes that do NOT divide max_len: the ragged final
+    # block's over-hang is masked, so it must not be counted reachable
+    for bs in (16, 24, 32, 48, 64, 128, 600):
+        for tokens in (1, 17, 100, 320, 512, 639, 640):
+            dense = kv_reachable_bytes([tokens] * 4, layout="dense",
+                                       **dims)
+            paged = kv_reachable_bytes([tokens] * 4, layout="paged",
+                                       block_size=bs, **dims)
+            assert paged <= dense, (bs, tokens, paged, dense)
+    # and paged reaches parity only at full occupancy (bs | max_len)
+    assert kv_reachable_bytes([640], layout="paged", block_size=32,
+                              max_len=640, num_layers=4, num_heads=8,
+                              head_dim=64) == \
+        kv_reachable_bytes([640], layout="dense", max_len=640,
+                           num_layers=4, num_heads=8, head_dim=64)
+
+
+def test_paged_decode_attention_matches_dense_composition():
+    # op-level: gather-through-table + mask == dense decode_attention on
+    # the materialized cache; the masked over-hang past `lengths` and
+    # the scratch-pointing trailing table entries contribute nothing
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import decode_attention, paged_decode_attention
+
+    rng = np.random.RandomState(6)
+    b, h, bs, d, mb = 3, 2, 8, 16, 4
+    nb = 1 + b * mb
+    k_pool = rng.randn(nb, h, bs, d).astype(np.float32)
+    v_pool = rng.randn(nb, h, bs, d).astype(np.float32)
+    table = 1 + np.arange(b * mb, dtype=np.int32).reshape(b, mb)
+    lengths = np.array([5, 17, 32], np.int32)
+    q = rng.randn(b, h, 1, d).astype(np.float32)
+    got = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_pool), jnp.asarray(v_pool),
+        jnp.asarray(table), lengths=jnp.asarray(lengths)))
+    # dense reference: materialize each row's cache in logical order
+    s = mb * bs
+    k_dense = k_pool[table].transpose(0, 2, 1, 3, 4).reshape(b, h, s, d)
+    v_dense = v_pool[table].transpose(0, 2, 1, 3, 4).reshape(b, h, s, d)
+    neg = np.finfo(np.float32).min
+    bias = np.where(np.arange(s)[None, :] < lengths[:, None], 0.0,
+                    neg)[:, None, None, :].astype(np.float32)
+    want = np.asarray(decode_attention(
+        jnp.asarray(q), jnp.asarray(k_dense), jnp.asarray(v_dense),
+        bias=jnp.asarray(bias)))
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # garbage in masked positions must not leak: poison them and re-run
+    k_poison = k_pool.copy()
+    k_poison[0] = 1e9  # the scratch block
+    got2 = np.asarray(paged_decode_attention(
+        jnp.asarray(q), jnp.asarray(k_poison), jnp.asarray(v_pool),
+        jnp.asarray(table), lengths=jnp.asarray(lengths)))
+    np.testing.assert_allclose(got2, want, atol=1e-6)
+
+
+def test_paged_decode_attention_gate_conditions(monkeypatch):
+    import importlib
+
+    import jax
+    import jax.numpy as jnp
+
+    fa = importlib.import_module("paddle_tpu.ops.flash_attention")
+    ok_q, bs = (1, 8, 1, 64), 128
+    nb = fa.DECODE_FLASH_MIN_CACHE // bs
+    # CPU backend: the composition IS the kernel
+    assert not fa.paged_decode_attention_supported(ok_q, bs, nb,
+                                                  jnp.float32)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert fa.paged_decode_attention_supported(ok_q, bs, nb, jnp.bfloat16)
+    # below the measured-crossover pool size: composition wins
+    assert not fa.paged_decode_attention_supported(ok_q, bs, nb - 1,
+                                                   jnp.bfloat16)
+    # sublane-hostile block size
+    assert not fa.paged_decode_attention_supported(ok_q, 12, nb,
+                                                   jnp.bfloat16)
+    # long query chunks belong to the prefill kernel path
+    assert not fa.paged_decode_attention_supported((1, 8, 9, 64), bs, nb,
+                                                   jnp.bfloat16)
+
+
+def test_gen_decode_cache_paged_validation(model):
+    with pytest.raises(InvalidArgumentError, match="layout"):
+        model.gen_decode_cache(1, 32, layout="sparse")
+    with pytest.raises(InvalidArgumentError, match="block_size"):
+        model.gen_decode_cache(1, 32, layout="paged", block_size=0)
+    with pytest.raises(InvalidArgumentError, match="num_blocks"):
+        model.gen_decode_cache(1, 32, layout="paged", block_size=8,
+                               num_blocks=1)
+    cache = model.gen_decode_cache(2, 32, layout="paged", block_size=8)
+    # identity mapping, scratch block 0 reserved
+    assert cache[0].k.shape[0] == 1 + 2 * 4
+    assert np.asarray(cache[0].table).min() == 1
+    # explicit num_blocks -> allocator-managed: table starts unmapped
+    cache = model.gen_decode_cache(2, 32, layout="paged", block_size=8,
+                                   num_blocks=6)
+    assert np.asarray(cache[0].table).max() == 0
+
+
+@pytest.mark.slow
+def test_pool_paged_slot_churn_randomized_sweep(model, dense_sess):
+    # sweep-sized churn property: many random interleavings of
+    # submit/step with mixed lengths and budgets over a TIGHT pool —
+    # every request must still match its standalone dense generation
+    rng = np.random.RandomState(7)
+    pool = GenerationPool(model, max_len=64, slots=3, buckets=[16, 32],
+                          cache_layout="paged", block_size=8,
+                          num_blocks=10)
+    expect = {}
+    pending = 14
+    while pending or expect:
+        if pending and (rng.rand() < 0.5 or not expect):
+            n = int(rng.randint(1, 30))
+            p = rng.randint(0, 128, (n,)).astype("int32")
+            m = int(rng.randint(1, min(8, 64 - n) + 1))
+            rid = pool.submit(p, m)
+            expect[rid] = dense_sess.generate(p[None], m)[0]
+            pending -= 1
+        else:
+            pool.step()
+            done = set(pool._results) & set(expect)
+            for rid in done:
+                np.testing.assert_array_equal(pool._results[rid],
+                                              expect.pop(rid))
+    results = pool.run()
+    for rid, want in expect.items():
+        np.testing.assert_array_equal(results[rid], want)

@@ -12,8 +12,14 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
   counts are recorded so a bucket-policy regression is visible in the
   report).
 
+- dense-vs-paged per-token decode time with a BLOCK-SIZE axis
+  (16/32/64/128 by default): the paged block-table cache trades a
+  gather per step for HBM that scales with actual tokens; the sweep
+  prints both layouts' tokens/s and reachable-KV-bytes columns so the
+  crossover (if any) is measured, not asserted.
+
 Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
-     [--gen 64] [--cpu-smoke]
+     [--gen 64] [--block-sizes 16 32 64 128] [--cpu-smoke]
 Writes tools/decode_sweep.json; prints one line per leg.
 """
 from __future__ import annotations
@@ -35,8 +41,9 @@ REPORT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 REPEATS = 3  # median-of-N, same noise discipline as ceiling_probe.py
 
 
-def sweep(pt, cfg, batches, buckets, gen):
+def sweep(pt, cfg, batches, buckets, gen, block_sizes):
     from bench import measure_decode_marginal  # THE shared timing recipe
+    from paddle_tpu.inference.generation import kv_reachable_bytes
     from paddle_tpu.jit import DecodeSession
     from paddle_tpu.models import TransformerLM
 
@@ -50,24 +57,45 @@ def sweep(pt, cfg, batches, buckets, gen):
         # decode step always scans the full max_len cache, so a shared
         # max(buckets)-sized session would make every bucket leg measure
         # the SAME cache length and the cache-length axis would be
-        # fiction
-        sess = DecodeSession(model, max_len=bucket + gen,
-                             buckets=[bucket])
+        # fiction.  The paged sessions add the BLOCK-SIZE axis on top:
+        # same cache length, different gather/scatter granularity.
+        max_len = bucket + gen
+        dims = dict(max_len=max_len, num_layers=cfg["num_layers"],
+                    num_heads=cfg["num_heads"],
+                    head_dim=cfg["hidden_size"] // cfg["num_heads"])
+        sessions = [("dense", 0, DecodeSession(model, max_len=max_len,
+                                               buckets=[bucket]))]
+        for bs in block_sizes:
+            sessions.append(("paged", bs, DecodeSession(
+                model, max_len=max_len, buckets=[bucket],
+                cache_layout="paged", block_size=bs)))
         for batch in batches:
             ids = rng.randint(0, cfg["vocab_size"],
                               (batch, bucket)).astype("int32")
-            m = measure_decode_marginal(sess, ids, gen, repeats=REPEATS)
-            leg = dict(m, batch=batch, prefill=bucket, generated=gen,
-                       cache_len=bucket + gen,
-                       decode_tokens_per_sec=round(
-                           batch / m["per_token_s"], 1))
-            legs.append(leg)
-            print("bucket %-5d batch %-3d  prefill %.4fs  "
-                  "%.3f ms/tok  %.1f tok/s"
-                  % (bucket, batch, m["prefill_s"],
-                     m["per_token_s"] * 1e3,
-                     leg["decode_tokens_per_sec"]), flush=True)
-        compiles["bucket_%d" % bucket] = sess.compile_counts()
+            for layout, bs, sess in sessions:
+                m = measure_decode_marginal(sess, ids, gen,
+                                            repeats=REPEATS)
+                kv_bytes = kv_reachable_bytes(
+                    [max_len] * batch, layout=layout,
+                    block_size=(bs or 32), **dims)
+                leg = dict(m, batch=batch, prefill=bucket, generated=gen,
+                           cache_len=max_len, cache_layout=layout,
+                           block_size=bs or None,
+                           kv_reachable_bytes=kv_bytes,
+                           decode_tokens_per_sec=round(
+                               batch / m["per_token_s"], 1))
+                legs.append(leg)
+                print("bucket %-5d batch %-3d  %-5s bs %-4s  "
+                      "prefill %.4fs  %.3f ms/tok  %8.1f tok/s  "
+                      "%6.2f KV-MiB"
+                      % (bucket, batch, layout, bs or "-",
+                         m["prefill_s"], m["per_token_s"] * 1e3,
+                         leg["decode_tokens_per_sec"],
+                         kv_bytes / 2**20), flush=True)
+        compiles["bucket_%d" % bucket] = {
+            "%s_bs%d" % (layout, bs) if bs else layout:
+                sess.compile_counts()
+            for layout, bs, sess in sessions}
     return legs, compiles
 
 
@@ -78,6 +106,10 @@ def main():
                     default=[128, 256, 512])
     ap.add_argument("--gen", type=int, default=64,
                     help="tokens generated per timed leg")
+    ap.add_argument("--block-sizes", type=int, nargs="*",
+                    default=[16, 32, 64, 128],
+                    help="paged-layout KV block sizes to sweep (an "
+                         "empty list measures the dense layout only)")
     ap.add_argument("--cpu-smoke", action="store_true",
                     help="tiny model on CPU to exercise the harness")
     args = ap.parse_args()
@@ -107,13 +139,16 @@ def main():
             args.buckets = [32, 64]
         if args.batches == [1, 2, 4, 8]:
             args.batches = [1, 2]
+        if args.block_sizes == [16, 32, 64, 128]:
+            args.block_sizes = [8, 16]
         args.gen = min(args.gen, 8)
     else:
         cfg.update(num_layers=6)  # the one-chip GPT geometry (bench leg)
     # the marginal recipe differences against a 1-token generation
     args.gen = max(args.gen, 2)
 
-    legs, compiles = sweep(pt, cfg, args.batches, args.buckets, args.gen)
+    legs, compiles = sweep(pt, cfg, args.batches, args.buckets, args.gen,
+                           args.block_sizes)
     report = {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime()),
               "backend": jax.devices()[0].device_kind,
@@ -122,6 +157,7 @@ def main():
                         ("hidden_size", "num_layers", "num_heads",
                          "vocab_size")},
               "repeats": REPEATS,
+              "block_sizes": args.block_sizes,
               "compile_counts": compiles,
               "legs": legs}
     with open(REPORT, "w") as f:
