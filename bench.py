@@ -617,6 +617,78 @@ def bench_decode(pt, jax, on_tpu: bool):
     return out
 
 
+def bench_serving(pt, jax, on_tpu: bool):
+    """L7 serving-ENGINE leg: p50/p95 TTFT and sustained tokens/s
+    through ``serving.ServingEngine.pump()`` at 1 and 8 slots — the
+    end-to-end scheduler price (admission, lifecycle, streaming,
+    metrics hooks) ON TOP of the raw decode step bench_decode times.
+    Driven by the synchronous pump() mode, so the leg is
+    single-threaded and measures the same code path the deterministic
+    tests pin.  Sub-legs are stamped with ``cache_layout`` exactly like
+    the decode leg, and the _leg_promotable gate rejects serving legs
+    without the stamp.  TTFT percentiles come from the per-request
+    StreamStatus timings (exact), not the bucketed histogram."""
+    from paddle_tpu.models import TransformerLM, gpt_1p3b_config
+    from paddle_tpu.serving import ServingEngine
+
+    prefill, gen = (512, 64) if on_tpu else (32, 8)
+    cfg = gpt_1p3b_config()
+    if on_tpu:
+        cfg.update(num_layers=6)  # the one-chip GPT geometry
+    else:
+        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
+                   intermediate_size=512, vocab_size=1024,
+                   max_position=1024)
+    pt.seed(0)
+    model = TransformerLM(**cfg, dropout=0.0)
+    rng = np.random.RandomState(0)
+    max_len = prefill + gen
+    out = {
+        "prefill": prefill,
+        "generated": gen,
+        "input_staged": False,
+        "transfer_note": (
+            "prompt upload rides inside the prefill term exactly as in "
+            "the decode leg; the per-token host traffic is the sampled "
+            "token ids plus the host-side scheduler bookkeeping this "
+            "leg exists to price"),
+    }
+    best_tps = 0.0
+    for slots in (1, 8):
+        engine = ServingEngine(model, max_len=max_len, slots=slots,
+                               buckets=[prefill], max_queue=4 * slots)
+        # warm both executables OUTSIDE the timed region (a cold-compile
+        # TTFT measures XLA, not the scheduler)
+        engine.submit(rng.randint(0, cfg["vocab_size"],
+                                  (prefill,)).astype("int32"), 2)
+        while engine.pump(8):
+            pass
+        prompts = [rng.randint(0, cfg["vocab_size"],
+                               (prefill,)).astype("int32")
+                   for _ in range(2 * slots)]
+        t0 = time.perf_counter()
+        streams = [engine.submit(p, gen) for p in prompts]
+        while engine.pump(16):
+            pass
+        wall = time.perf_counter() - t0
+        statuses = [s.result(timeout_s=0) for s in streams]
+        ttfts = [st.ttft_s for st in statuses]
+        toks = sum(st.new_tokens for st in statuses)
+        tps = toks / wall
+        out["batch%d" % slots] = {
+            "slots": slots,
+            "requests": len(prompts),
+            "cache_layout": engine.cache_stats()["cache_layout"],
+            "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 5),
+            "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 5),
+            "tokens_per_sec": round(tps, 1),
+            "wall_s": round(wall, 4),
+        }
+        best_tps = max(best_tps, tps)
+    out["tokens_per_sec"] = round(best_tps, 1)
+    return out
+
+
 def _probe_accelerator(timeout_s: int = 180) -> bool:
     """Check from a THROWAWAY subprocess that the accelerator runtime
     answers; a wedged tunnel (the axon transport can hang for hours) must
@@ -744,18 +816,21 @@ def _leg_promotable(name: str, leg: dict):
         return False, ("mfu_convention %r != %d: pre-convention-fix MFU "
                        "understates 2x" % (leg.get("mfu_convention"),
                                            RESNET_MFU_CONVENTION))
-    if name == "decode":
-        # a decode number without its cache-layout stamp cannot say
-        # whether it measured the dense or the paged path (they differ in
-        # reachable HBM by up to max_len/actual-tokens): unpromotable
+    if name in ("decode", "serving"):
+        # a decode/serving number without its cache-layout stamp cannot
+        # say whether it measured the dense or the paged path (they
+        # differ in reachable HBM by up to max_len/actual-tokens):
+        # unpromotable.  Timed serving sub-legs are identified by their
+        # TTFT stamp, decode sub-legs by their marginal per-token time.
+        stamp = "per_token_s" if name == "decode" else "ttft_p50_s"
         timed = {k: v for k, v in leg.items()
-                 if isinstance(v, dict) and "per_token_s" in v}
+                 if isinstance(v, dict) and stamp in v}
         missing = sorted(k for k, v in timed.items()
                          if not v.get("cache_layout"))
         if not timed or missing:
-            return False, ("decode leg missing cache_layout on %s: "
+            return False, ("%s leg missing cache_layout on %s: "
                            "dense-vs-paged provenance unknown"
-                           % (missing or "every timed sub-leg"))
+                           % (name, missing or "every timed sub-leg"))
     return True, ""
 
 
@@ -910,7 +985,8 @@ def _measure_and_print():
                      ("longseq_flash_8k", bench_longseq_flash),
                      ("bert_k8_multistep", bench_bert_multistep),
                      ("mnist_k32_multistep", bench_mnist_multistep),
-                     ("decode", bench_decode)):
+                     ("decode", bench_decode),
+                     ("serving", bench_serving)):
         try:
             legs[name] = fn(pt, jax, on_tpu)
         except Exception as e:  # noqa: BLE001 - keep remaining legs alive

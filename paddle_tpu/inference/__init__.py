@@ -28,7 +28,7 @@ __all__ = ["Config", "Predictor", "PredictorTensor", "Tensor",
            "DataType", "PlaceType", "PrecisionType",
            "get_num_bytes_of_data_type",
            "GenerationPool", "create_generation_pool",
-           "kv_reachable_bytes"]
+           "kv_reachable_bytes", "DuplicateRequestError"]
 
 
 class DataType:
@@ -252,7 +252,8 @@ class PredictorPool:
 # The artifact Predictor above runs a FIXED exported program; generation
 # needs the cache-threaded forward of a live model, so the pool owns the
 # model (docs/DESIGN.md "prefill/decode split").
-from .generation import GenerationPool, kv_reachable_bytes  # noqa: E402,F401
+from .generation import (  # noqa: E402,F401
+    DuplicateRequestError, GenerationPool, kv_reachable_bytes)
 
 
 def create_generation_pool(model, max_len: int, **kwargs) -> GenerationPool:

@@ -40,10 +40,20 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.errors import InvalidArgumentError
-from ..jit.decode import DecodeSession
+from ..core.errors import (AlreadyExistsError, InvalidArgumentError,
+                           NotFoundError)
+from ..jit.decode import DecodeSession, classify_finish
 
-__all__ = ["GenerationPool", "kv_reachable_bytes"]
+__all__ = ["GenerationPool", "kv_reachable_bytes",
+           "DuplicateRequestError"]
+
+
+class DuplicateRequestError(AlreadyExistsError, InvalidArgumentError):
+    """``submit()`` reused a request_id that is still queued, active, or
+    awaiting collection.  Subclasses ``InvalidArgumentError`` so callers
+    that catch the broad class keep working, while retry loops can catch
+    the duplicate specifically (a duplicate means the caller's id
+    bookkeeping is wrong — retrying the same id cannot succeed)."""
 
 
 def kv_reachable_bytes(tokens, max_len: int, num_layers: int,
@@ -172,6 +182,17 @@ class GenerationPool:
         self._active_dev = None
         self._membership_dirty = True
         self._results: Dict[object, np.ndarray] = {}
+        self._finish_reasons: Dict[object, str] = {}
+        # serving-layer lifecycle hooks (paddle_tpu.serving sets these):
+        # on_admit(rid, slot, prompt_len) when a queued request takes a
+        # slot; on_token(rid, token) for EVERY emitted token including
+        # the prefill's first; on_finish(rid, tokens, reason) when a
+        # request completes (NOT on cancel/release — aborting is the
+        # caller's act, not a completion).  Hooks fire inside step(), so
+        # the timings they record come from the real code path.
+        self.on_admit = None
+        self.on_token = None
+        self.on_finish = None
         # ids currently queued/active/uncollected, maintained
         # incrementally so submit stays O(1) in a long-lived pool
         self._used_rids: set = set()
@@ -271,9 +292,10 @@ class GenerationPool:
         # collected ids (returned by run()) become reusable
         if request_id is not None:
             if request_id in self._used_rids:
-                raise InvalidArgumentError(
+                raise DuplicateRequestError(
                     "request_id %r is already queued, active, or "
-                    "awaiting collection" % (request_id,))
+                    "awaiting collection; a duplicate would shadow the "
+                    "earlier request's result" % (request_id,))
             rid = request_id
         else:
             while self._next_rid in self._used_rids:
@@ -295,7 +317,10 @@ class GenerationPool:
 
     def _finish(self, slot: int):
         state = self._active.pop(slot)
-        self._results[state.rid] = np.asarray(state.tokens, np.int32)
+        tokens = np.asarray(state.tokens, np.int32)
+        self._results[state.rid] = tokens
+        reason = classify_finish(tokens, self.eos_id)
+        self._finish_reasons[state.rid] = reason
         self._free.append(slot)
         if self.cache_layout == "paged":
             # returned blocks are immediately reusable: the slot's stale
@@ -303,6 +328,74 @@ class GenerationPool:
             # decode step until a refill overwrites it
             self._free_blocks.extend(self._slot_blocks.pop(slot, ()))
         self._membership_dirty = True
+        if self.on_finish is not None:
+            self.on_finish(state.rid, tokens, reason)
+
+    def release(self, slot: int):
+        """Free ``slot`` (and its paged blocks) WITHOUT recording a
+        result — the cancellation path.  Mid-generation release is as
+        safe as ``_finish``: the freed slot's stale table row is masked
+        to the scratch block inside every decode step until a refill
+        overwrites it.  Returns the request id the slot was serving."""
+        if slot not in self._active:
+            raise NotFoundError(
+                "slot %r is not active (active slots: %s)"
+                % (slot, sorted(self._active)))
+        state = self._active.pop(slot)
+        self._free.append(slot)
+        if self.cache_layout == "paged":
+            self._free_blocks.extend(self._slot_blocks.pop(slot, ()))
+        self._used_rids.discard(state.rid)
+        self._membership_dirty = True
+        return state.rid
+
+    def cancel(self, request_id):
+        """Abort one request wherever it lives: ``"queued"`` (removed
+        from the wait queue), ``"active"`` (its slot and paged blocks
+        freed mid-generation), or ``"finished"`` (the uncollected result
+        discarded).  The ``on_finish`` hook does NOT fire — cancellation
+        is the caller's decision, not a completion.  Unknown ids raise
+        :class:`NotFoundError`."""
+        for i, req in enumerate(self._queue):
+            if req.rid == request_id:
+                del self._queue[i]
+                self._used_rids.discard(request_id)
+                return "queued"
+        for slot, state in self._active.items():
+            if state.rid == request_id:
+                self.release(slot)
+                return "active"
+        if request_id in self._results:
+            del self._results[request_id]
+            self._finish_reasons.pop(request_id, None)
+            self._used_rids.discard(request_id)
+            return "finished"
+        raise NotFoundError(
+            "request_id %r is not queued, active, or awaiting "
+            "collection" % (request_id,))
+
+    def collect(self, request_id):
+        """Pop ONE finished request's ``(tokens, finish_reason)`` —
+        per-request collection for the serving layer, where ``run()``'s
+        drain-everything loop would block on other callers' requests."""
+        if request_id not in self._results:
+            raise NotFoundError(
+                "request_id %r has no finished result (still queued or "
+                "active, cancelled, or already collected)"
+                % (request_id,))
+        tokens = self._results.pop(request_id)
+        self._used_rids.discard(request_id)
+        return tokens, self._finish_reasons.pop(request_id, None)
+
+    @property
+    def queue_depth(self) -> int:
+        """Requests waiting for a slot (admission-control surface)."""
+        return len(self._queue)
+
+    @property
+    def active_count(self) -> int:
+        """Slots currently decoding."""
+        return len(self._active)
 
     def _refill(self):
         while self._queue and self._free:
@@ -344,6 +437,10 @@ class GenerationPool:
                                             req.max_new_tokens - 1)
             self._last_tok[slot] = first
             self._membership_dirty = True
+            if self.on_admit is not None:
+                self.on_admit(req.rid, slot, len(req.ids))
+            if self.on_token is not None:
+                self.on_token(req.rid, first)
             if self._active[slot].remaining == 0 or \
                     (self.eos_id is not None and first == self.eos_id):
                 self._finish(slot)
@@ -374,6 +471,8 @@ class GenerationPool:
             t = int(tok[slot])
             state.tokens.append(t)
             state.remaining -= 1
+            if self.on_token is not None:
+                self.on_token(state.rid, t)
             if state.remaining == 0 or \
                     (self.eos_id is not None and t == self.eos_id):
                 self._finish(slot)
@@ -391,6 +490,8 @@ class GenerationPool:
             pass
         out, self._results = self._results, {}
         self._used_rids -= set(out)  # collected ids become reusable
+        for rid in out:
+            self._finish_reasons.pop(rid, None)
         return out
 
     def generate(self, prompts, max_new_tokens: int) -> List[np.ndarray]:

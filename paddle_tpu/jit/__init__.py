@@ -44,6 +44,7 @@ from ..nn.layer.layers import Layer
 __all__ = [
     "to_static", "not_to_static", "StaticFunction", "InputSpec", "TrainStep",
     "MultiStepTrainStep", "DecodeSession", "sample_logits",
+    "FINISH_EOS", "FINISH_LENGTH", "classify_finish",
     "save", "load", "TranslatedLayer", "ProgramTranslator", "TracedLayer",
     "set_code_level", "set_verbosity", "enable_to_static",
 ]
@@ -869,4 +870,6 @@ class TracedLayer:
 
 # the decode engine imports _StateBinding back from this module, so it
 # loads after everything above is defined
-from .decode import DecodeSession, sample_logits  # noqa: E402,F401
+from .decode import (  # noqa: E402,F401
+    FINISH_EOS, FINISH_LENGTH, DecodeSession, classify_finish,
+    sample_logits)

@@ -41,7 +41,29 @@ from ..core.random import next_key
 from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
 
-__all__ = ["DecodeSession", "sample_logits", "default_buckets"]
+__all__ = ["DecodeSession", "sample_logits", "default_buckets",
+           "FINISH_EOS", "FINISH_LENGTH", "classify_finish"]
+
+# The decode layer's finish-reason vocabulary: a generation ends either
+# because the model emitted the EOS id or because the max_new_tokens
+# budget ran out.  The serving layer (paddle_tpu.serving) layers its
+# scheduler-side reasons (deadline expiry, caller cancellation) on top;
+# they can never originate here, because the compiled step knows nothing
+# about wall clocks or callers.
+FINISH_EOS = "eos"
+FINISH_LENGTH = "length"
+
+
+def classify_finish(tokens, eos_id) -> str:
+    """Finish reason for ONE finished row's generated tokens:
+    ``FINISH_EOS`` if the row terminated on ``eos_id``, else
+    ``FINISH_LENGTH``.  A row that spends its whole budget *and* lands
+    on EOS with its last token counts as EOS — the model stopped, the
+    budget coincidentally agreeing."""
+    toks = np.asarray(tokens)
+    if eos_id is not None and toks.size and int(toks[-1]) == int(eos_id):
+        return FINISH_EOS
+    return FINISH_LENGTH
 
 
 def sample_logits(logits, key, temperature: float = 0.0, top_k: int = 0,

@@ -1,0 +1,34 @@
+"""``paddle_tpu.serving`` — the request scheduler over the decode engine.
+
+The serving stack, bottom to top (docs/DESIGN.md §5a-§5c):
+
+- ``jit.DecodeSession`` — exactly-two-compiles prefill/decode split;
+- ``inference.GenerationPool`` — slot-based continuous batching, paged
+  KV with a free-list allocator;
+- **this package** — the entry point the ROADMAP north star needs:
+  request lifecycle + streaming (``ServingEngine.submit`` →
+  ``ResponseStream``), per-request deadlines, bounded-queue admission
+  control (typed, retryable ``QueueFullError``), mid-generation
+  cancellation that frees slots and paged blocks, graceful
+  drain/shutdown, hot weight swap, and a serving metrics registry
+  (TTFT, inter-token latency, queue depth, occupancy, tokens/s) with
+  prometheus text exposition.
+
+Reference parity: the framework-level analog of the reference's
+``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
+TPU-native over the compiled decode step instead of an executor —
+serving-oriented systems work (PAPERS.md, arXiv:2603.09555) treats the
+cached decode step as a component inside a request scheduler; this
+package is that scheduler.
+"""
+from .engine import QueueFullError, ServingEngine
+from .metrics import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
+                      MetricsRegistry)
+from .stream import RequestState, ResponseStream, StreamStatus
+
+__all__ = [
+    "ServingEngine", "QueueFullError",
+    "ResponseStream", "StreamStatus", "RequestState",
+    "MetricsRegistry", "Counter", "Gauge", "Histogram",
+    "DEFAULT_TIME_BUCKETS",
+]

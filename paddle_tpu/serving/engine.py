@@ -1,0 +1,454 @@
+"""The serving engine: request lifecycle over the continuous-batching pool.
+
+``inference.GenerationPool`` is the hardware-facing half of serving —
+slots, paged blocks, one batched decode dispatch per step.  This module
+is the half a server actually talks to: a scheduler that owns the
+request LIFECYCLE (``QUEUED → PREFILLING → DECODING → {DONE, CANCELLED,
+EXPIRED, FAILED}``), admission control, per-request deadlines, token
+streaming, and the serving metrics a dashboard needs — the
+framework-level analog of the reference's ``paddle/fluid/inference``
+serving layer rebuilt over the TPU-native decode engine (PAPERS.md:
+compiler-first O(1) autoregressive caching treats the cached step as a
+component INSIDE a request scheduler; this is that scheduler).
+
+Design points (docs/DESIGN.md §5c):
+
+- **One tick, two drive modes.** A scheduling tick = deadline sweep +
+  one batched ``pool.step()`` + gauge refresh.  ``pump(n)`` runs ticks
+  inline (single-threaded, deterministic — what every tier-1 test and
+  the bench leg use); ``start()`` runs the SAME ``_tick`` in an owned
+  background thread for real serving.  The modes share one code path,
+  so they cannot diverge.
+- **Fail-fast admission.** The wait queue is bounded (``max_queue``);
+  an over-depth ``submit`` raises the typed, retryable
+  :class:`QueueFullError` instead of buffering unboundedly —
+  backpressure surfaces at the caller, where load shedding belongs.
+- **Deadlines and cancellation free real resources.**  Expiry/cancel
+  route through ``GenerationPool.cancel`` → ``release(slot)``: the slot
+  and its paged KV blocks return to the allocator mid-generation
+  (``cache_stats()`` returns to baseline — pinned by tests).
+- **Metrics from the real path.** TTFT is observed by the pool's
+  ``on_token`` hook at the actual first-token moment inside ``step()``;
+  queue depth/occupancy are read per tick; the step loop reuses
+  ``profiler.StepTimer`` for sustained tokens/s.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..core.errors import (InvalidArgumentError, NotFoundError,
+                           PreconditionNotMetError, UnavailableError)
+from ..inference.generation import GenerationPool
+from ..profiler import StepTimer
+from .metrics import MetricsRegistry
+from .stream import RequestState, ResponseStream, StreamStatus
+
+__all__ = ["ServingEngine", "QueueFullError"]
+
+
+class QueueFullError(UnavailableError):
+    """Admission rejected: the wait queue is at ``max_queue`` depth.
+    Typed and RETRYABLE — the caller backs off and resubmits; the
+    engine never buffers beyond its declared bound."""
+
+
+class _Record:
+    """Engine-side per-request state (the pool keeps only slot state)."""
+
+    __slots__ = ("rid", "stream", "state", "prompt_len", "max_new",
+                 "deadline_abs", "submit_t", "first_t", "last_t",
+                 "tokens")
+
+    def __init__(self, rid, stream, prompt_len, max_new, deadline_abs,
+                 submit_t):
+        self.rid = rid
+        self.stream = stream
+        self.state = RequestState.QUEUED
+        self.prompt_len = prompt_len
+        self.max_new = max_new
+        self.deadline_abs = deadline_abs
+        self.submit_t = submit_t
+        self.first_t = None
+        self.last_t = None
+        self.tokens = []
+
+
+class ServingEngine:
+    """Async request scheduler with streaming, deadlines, and metrics
+    over :class:`inference.GenerationPool`.
+
+    ``model`` is a live cached-decode model (``models.TransformerLM``);
+    pool knobs (``slots``, ``buckets``, ``cache_layout``,
+    ``block_size``, ``num_blocks``, ``eos_id``, sampling config, ...)
+    pass through ``**pool_kwargs``.  ``clock`` injects a monotonic time
+    source so deadline tests are deterministic."""
+
+    def __init__(self, model, max_len: int, slots: int = 4,
+                 max_queue: int = 64, clock=None,
+                 metrics: Optional[MetricsRegistry] = None,
+                 **pool_kwargs):
+        if int(max_queue) < 1:
+            raise InvalidArgumentError(
+                "max_queue must be >= 1, got %r" % (max_queue,))
+        self._pool = GenerationPool(model, max_len, slots=slots,
+                                    **pool_kwargs)
+        self.max_queue = int(max_queue)
+        self._clock = clock if clock is not None else time.monotonic
+        self._live: Dict[object, _Record] = {}
+        # one reentrant lock serializes every pool mutation: submit and
+        # cancel may race the background step loop; in pump mode it is
+        # uncontended and costs nothing
+        self._lock = threading.RLock()
+        self._draining = False
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._timer = StepTimer()  # profiler's step-time/throughput helper
+        self._tokens_total = 0
+
+        self.metrics = metrics if metrics is not None else MetricsRegistry()
+        m = self.metrics
+        self._c_submitted = m.counter(
+            "serving_requests_submitted_total", "requests admitted")
+        self._c_done = m.counter(
+            "serving_requests_completed_total", "requests finished (eos/length)")
+        self._c_cancelled = m.counter(
+            "serving_requests_cancelled_total", "requests cancelled by callers")
+        self._c_expired = m.counter(
+            "serving_requests_expired_total", "requests past their deadline")
+        self._c_failed = m.counter(
+            "serving_requests_failed_total", "requests failed by step errors")
+        self._c_rejected = m.counter(
+            "serving_admission_rejected_total",
+            "submits refused with QueueFullError")
+        self._c_tokens = m.counter(
+            "serving_tokens_emitted_total", "tokens streamed to callers")
+        self._g_queue = m.gauge(
+            "serving_queue_depth", "requests waiting for a slot")
+        self._h_queue = m.histogram(
+            "serving_queue_depth_per_step", "queue depth sampled each tick",
+            buckets=(0, 1, 2, 4, 8, 16, 32, 64, 128, 256))
+        self._g_active = m.gauge(
+            "serving_active_slots", "slots currently decoding")
+        self._g_occupancy = m.gauge(
+            "serving_slot_occupancy", "active slots / total slots")
+        self._g_kv_bytes = m.gauge(
+            "serving_kv_reachable_bytes",
+            "KV bytes a decode step can read right now (cache_stats)")
+        self._g_kv_free = m.gauge(
+            "serving_kv_free_blocks",
+            "paged allocator free blocks") \
+            if self._pool.cache_layout == "paged" else None
+        self._g_tps = m.gauge(
+            "serving_tokens_per_sec",
+            "tokens emitted / cumulative step time (StepTimer)")
+        self._g_step = m.gauge(
+            "serving_step_time_s", "mean batched decode step wall time")
+        self._h_ttft = m.histogram(
+            "serving_ttft_seconds", "submit-to-first-token latency")
+        self._h_itl = m.histogram(
+            "serving_inter_token_seconds", "gap between consecutive tokens")
+
+        # the engine IS the pool's lifecycle observer
+        self._pool.on_admit = self._on_admit
+        self._pool.on_token = self._on_token
+        self._pool.on_finish = self._on_finish
+
+    # -- admission -------------------------------------------------------
+    def submit(self, input_ids, max_new_tokens: int, request_id=None,
+               deadline_s: Optional[float] = None) -> ResponseStream:
+        """Admit one request; returns its :class:`ResponseStream`.
+
+        Fails fast: :class:`QueueFullError` past ``max_queue`` waiting
+        requests (retryable), the pool's typed errors for invalid
+        prompts/budgets/duplicate ids, ``PreconditionNotMetError`` once
+        draining.  ``deadline_s`` is a wall-clock budget from NOW —
+        queued or decoding, the request is expired (slot and blocks
+        freed) at the first tick past it."""
+        if deadline_s is not None and deadline_s <= 0:
+            raise InvalidArgumentError(
+                "deadline_s must be > 0 (or None for no deadline), "
+                "got %r" % (deadline_s,))
+        with self._lock:
+            if self._draining:
+                raise PreconditionNotMetError(
+                    "engine is draining/shut down: admissions are "
+                    "stopped (drain()/shutdown() was called)")
+            depth = self._pool.queue_depth
+            if depth >= self.max_queue:
+                self._c_rejected.inc()
+                raise QueueFullError(
+                    "serving queue is full (%d waiting >= max_queue=%d); "
+                    "back off and retry, or raise max_queue/slots"
+                    % (depth, self.max_queue))
+            ids = np.asarray(getattr(input_ids, "value", input_ids))
+            now = self._clock()
+            rid = self._pool.submit(ids, max_new_tokens,
+                                    request_id=request_id)
+            stream = ResponseStream(self, rid, int(max_new_tokens))
+            self._live[rid] = _Record(
+                rid, stream, int(ids.shape[0]), int(max_new_tokens),
+                None if deadline_s is None else now + float(deadline_s),
+                now)
+            self._c_submitted.inc()
+            self._g_queue.set(self._pool.queue_depth)
+        self._wake.set()
+        return stream
+
+    # -- pool hooks (fire inside pool.step, under the engine lock) -------
+    def _on_admit(self, rid, slot, prompt_len):
+        rec = self._live.get(rid)
+        if rec is not None:
+            rec.state = RequestState.PREFILLING
+
+    def _on_token(self, rid, tok):
+        rec = self._live.get(rid)
+        if rec is None:  # pool used standalone alongside the engine
+            return
+        now = self._clock()
+        if rec.first_t is None:
+            rec.first_t = now
+            rec.state = RequestState.DECODING
+            self._h_ttft.observe(now - rec.submit_t)
+        else:
+            self._h_itl.observe(now - rec.last_t)
+        rec.last_t = now
+        rec.tokens.append(int(tok))
+        self._c_tokens.inc()
+        self._tokens_total += 1
+        rec.stream._put_token(int(tok))
+
+    def _on_finish(self, rid, tokens, reason):
+        rec = self._live.pop(rid, None)
+        if rec is None:
+            return
+        self._pool.collect(rid)  # frees the rid; tokens already streamed
+        self._c_done.inc()
+        self._finalize(rec, RequestState.DONE, reason, tokens)
+
+    # -- lifecycle transitions -------------------------------------------
+    def _finalize(self, rec: _Record, state: str, reason: str, tokens,
+                  error: Optional[str] = None) -> None:
+        now = self._clock()
+        toks = np.asarray(tokens if tokens is not None else rec.tokens,
+                          np.int32)
+        rec.state = state
+        rec.stream._finalize(StreamStatus(
+            request_id=rec.rid, state=state, finish_reason=reason,
+            tokens=toks, prompt_tokens=rec.prompt_len,
+            new_tokens=int(toks.size),
+            ttft_s=(None if rec.first_t is None
+                    else rec.first_t - rec.submit_t),
+            total_s=now - rec.submit_t, error=error))
+
+    def cancel(self, request_id) -> bool:
+        """Abort a live request: its slot and paged blocks are freed
+        mid-generation, its stream ends with state ``CANCELLED`` (the
+        tokens emitted so far ride in the status record).  False if the
+        id is not live (already terminal or unknown) — idempotent, so
+        callers can cancel on a races-with-completion path safely."""
+        with self._lock:
+            rec = self._live.pop(request_id, None)
+            if rec is None:
+                return False
+            self._pool.cancel(request_id)
+            self._c_cancelled.inc()
+            self._finalize(rec, RequestState.CANCELLED, "cancelled",
+                           rec.tokens)
+            return True
+
+    def _expire(self) -> None:
+        now = self._clock()
+        for rid, rec in list(self._live.items()):
+            if rec.deadline_abs is not None and now >= rec.deadline_abs:
+                self._live.pop(rid)
+                self._pool.cancel(rid)
+                self._c_expired.inc()
+                self._finalize(rec, RequestState.EXPIRED, "deadline",
+                               rec.tokens)
+
+    def _fail_live(self, exc: BaseException) -> None:
+        """A pool step blew up mid-flight: the batched step serves every
+        live request, so none of their caches can be trusted — fail them
+        all (their streams get a terminal FAILED record with the error)
+        rather than leaving callers blocked on a stream that will never
+        end."""
+        for rid, rec in list(self._live.items()):
+            self._live.pop(rid)
+            try:
+                self._pool.cancel(rid)
+            except NotFoundError:
+                pass
+            self._c_failed.inc()
+            self._finalize(rec, RequestState.FAILED, "error", rec.tokens,
+                           error=str(exc)[:500])
+
+    # -- the scheduling tick (ONE code path for both drive modes) --------
+    def _tick(self) -> bool:
+        self._expire()
+        if not self._live:
+            self._observe_gauges()
+            return False
+        self._h_queue.observe(self._pool.queue_depth)
+        try:
+            with self._timer:
+                self._pool.step()
+        except Exception as e:  # noqa: BLE001 - step is the blast radius
+            self._fail_live(e)
+            raise
+        self._observe_gauges()
+        return bool(self._live)
+
+    def _observe_gauges(self) -> None:
+        pool = self._pool
+        self._g_queue.set(pool.queue_depth)
+        self._g_active.set(pool.active_count)
+        self._g_occupancy.set(pool.active_count / pool.slots)
+        stats = pool.cache_stats()
+        self._g_kv_bytes.set(stats["reachable_bytes"])
+        if self._g_kv_free is not None:
+            self._g_kv_free.set(stats["free_blocks"])
+        if self._timer.total:
+            self._g_tps.set(self._tokens_total / self._timer.total)
+            self._g_step.set(self._timer.step_time)
+
+    # -- drive mode 1: synchronous pump (deterministic, test/bench) ------
+    def pump(self, steps: int = 1) -> bool:
+        """Run up to ``steps`` scheduling ticks INLINE on the calling
+        thread; True while live requests remain.  The deterministic
+        drive mode: no thread, no sleeps, every test single-threaded.
+        Refuses when the background loop owns the engine."""
+        if self._thread is not None:
+            raise PreconditionNotMetError(
+                "the engine owns a background step loop (start() was "
+                "called); pump() is the synchronous drive mode — don't "
+                "mix them")
+        if int(steps) < 1:
+            raise InvalidArgumentError(
+                "pump needs steps >= 1, got %r" % (steps,))
+        work = bool(self._live)
+        for _ in range(int(steps)):
+            with self._lock:
+                work = self._tick()
+            if not work:
+                break
+        return work
+
+    # -- drive mode 2: owned background step loop (real serving) ---------
+    def start(self) -> "ServingEngine":
+        """Spawn the owned step-loop thread; returns self.  The loop
+        runs the same ``_tick`` as ``pump()`` and parks on an event when
+        idle (a submit wakes it)."""
+        with self._lock:
+            if self._thread is not None:
+                return self
+            if self._draining:
+                # a restarted loop would park forever on an engine that
+                # refuses every submit; admissions cannot be re-opened
+                raise PreconditionNotMetError(
+                    "engine was drained/shut down; build a new "
+                    "ServingEngine instead of restarting this one")
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-engine-step-loop",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def is_running(self) -> bool:
+        """True when the background step loop owns the engine."""
+        return self._thread is not None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                with self._lock:
+                    work = self._tick()
+            except Exception:  # noqa: BLE001
+                work = False  # _tick already failed the live requests
+            if not work:
+                self._wake.wait(0.002)
+                self._wake.clear()
+
+    # -- graceful teardown ----------------------------------------------
+    def drain(self, timeout_s: Optional[float] = None) -> bool:
+        """Stop admissions, finish every in-flight request.  True when
+        drained; False on timeout (threaded mode).  In pump mode this
+        simply pumps until dry."""
+        with self._lock:
+            self._draining = True
+        if self._thread is None:
+            while self.pump(16):
+                pass
+            return True
+        # the poll deadline uses REAL time on purpose: an injected
+        # clock (deadline tests) governs request deadlines, but how
+        # long the caller is willing to block is a wall-clock matter
+        deadline = None if timeout_s is None \
+            else time.monotonic() + timeout_s
+        self._wake.set()
+        while True:
+            with self._lock:
+                if not self._live:
+                    return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.002)
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Graceful stop: admissions off, in-flight requests finished
+        (``drain=True``) or cancelled (``drain=False``), background
+        thread joined."""
+        with self._lock:
+            self._draining = True
+        if drain:
+            self.drain()
+        else:
+            with self._lock:
+                for rid in list(self._live):
+                    self.cancel(rid)
+        if self._thread is not None:
+            self._stop.set()
+            self._wake.set()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # -- passthroughs / introspection ------------------------------------
+    def refresh_weights(self) -> None:
+        """Hot weight swap between steps: drop the pool's cached
+        parameter values so the next decode step reads the model's
+        current weights (call after ``set_state_dict``)."""
+        with self._lock:
+            self._pool.refresh_weights()
+
+    def compile_counts(self) -> dict:
+        """The pool's compile accounting — the exactly-two-compiles
+        contract survives the serving layer (pinned by tests)."""
+        return self._pool.compile_counts()
+
+    def cache_stats(self) -> dict:
+        """Live KV accounting (``GenerationPool.cache_stats``)."""
+        return self._pool.cache_stats()
+
+    def request_state(self, request_id) -> Optional[str]:
+        """Lifecycle state of a LIVE request (terminal states live on
+        the stream's status record); None if unknown/terminal."""
+        with self._lock:
+            rec = self._live.get(request_id)
+            return rec.state if rec is not None else None
+
+    @property
+    def queue_depth(self) -> int:
+        return self._pool.queue_depth
+
+    @property
+    def live_requests(self) -> int:
+        return len(self._live)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining

@@ -119,6 +119,29 @@ def test_decode_leg_no_timed_subleg_rejected():
     assert not ok and "cache_layout" in why
 
 
+def test_serving_leg_without_cache_layout_rejected():
+    # a serving TTFT/tokens-per-sec number inherits the decode leg's
+    # provenance rule: no cache_layout stamp, no promotion
+    leg = {"tokens_per_sec": 100.0, "transfer_note": "negligible",
+           "batch1": {"ttft_p50_s": 0.01, "tokens_per_sec": 100.0}}
+    ok, why = bench._leg_promotable("serving", leg)
+    assert not ok and "cache_layout" in why
+
+
+def test_serving_leg_with_cache_layout_promotes():
+    leg = {"tokens_per_sec": 100.0, "transfer_note": "negligible",
+           "batch1": {"ttft_p50_s": 0.01, "ttft_p95_s": 0.02,
+                      "cache_layout": "dense"}}
+    ok, why = bench._leg_promotable("serving", leg)
+    assert ok, why
+
+
+def test_serving_leg_no_timed_subleg_rejected():
+    leg = {"tokens_per_sec": 100.0, "transfer_note": "negligible"}
+    ok, why = bench._leg_promotable("serving", leg)
+    assert not ok and "cache_layout" in why
+
+
 def test_resnet_mfu_formula_pinned():
     """The one shared MFU formula (2 FLOPs/MAC, fwd + ~2x bwd): the
     staged-input measurement of 2026-07-30 (batch 128, 0.0863 s on the

@@ -21,6 +21,7 @@ SCRIPTS = [
     # slow-marked territory
     ("06_deploy_inference.py", []),
     ("08_generate_serving.py", ["--tokens", "8"]),
+    ("09_serving_engine.py", ["--tokens", "8"]),
 ]
 
 

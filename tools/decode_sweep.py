@@ -20,7 +20,10 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
 
 Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
      [--gen 64] [--block-sizes 16 32 64 128] [--cpu-smoke]
-Writes tools/decode_sweep.json; prints one line per leg.
+     [--out decode_sweep.json]
+Writes the JSON report to --out (default: decode_sweep.json in the
+CWD — never into tools/, a measurement artifact is not source);
+prints one line per leg.
 """
 from __future__ import annotations
 
@@ -34,9 +37,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 import numpy as np
-
-REPORT = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                      "decode_sweep.json")
 
 REPEATS = 3  # median-of-N, same noise discipline as ceiling_probe.py
 
@@ -112,6 +112,11 @@ def main():
                          "empty list measures the dense layout only)")
     ap.add_argument("--cpu-smoke", action="store_true",
                     help="tiny model on CPU to exercise the harness")
+    ap.add_argument("--out",
+                    default=os.path.join(os.getcwd(),
+                                         "decode_sweep.json"),
+                    help="report path (default: decode_sweep.json in "
+                         "the CWD; never written into tools/)")
     args = ap.parse_args()
 
     from bench import _acquire_chip_lock, _peak_flops
@@ -160,9 +165,9 @@ def main():
               "block_sizes": args.block_sizes,
               "compile_counts": compiles,
               "legs": legs}
-    with open(REPORT, "w") as f:
+    with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
-    print("report:", REPORT)
+    print("report:", args.out)
 
 
 if __name__ == "__main__":
