@@ -513,20 +513,21 @@ DECODE_BLOCK_SIZE = 32  # default KV block for the paged-layout legs
 def bench_decode(pt, jax, on_tpu: bool):
     """L7 serving leg: KV-cached autoregressive decode (jit.DecodeSession,
     prefill 512 + 128 generated) at batch 1 and 8, for BOTH cache
-    layouts (dense preallocation vs paged block-table) — tokens/s/chip
-    of the steady-state decode step, the number a token-serving
-    deployment lives on.  Every timed sub-leg records its
-    ``cache_layout`` and the KV-cache bytes reachable per step at the
-    leg's occupancy (the _leg_promotable gate REJECTS decode legs
-    without the layout stamp, so a paged-vs-dense number can never be
-    presented without its provenance); ``kv_bytes_by_occupancy``
-    quantifies the paged HBM win across fill levels instead of
-    asserting it, and ``block_size_sweep`` records paged tokens/s
-    against the block-size axis.  Timing via measure_decode_marginal
-    (median-of-3 marginal decode time).  The prompt upload happens
-    inside the timed generate calls, so this leg does NOT claim
-    input_staged; its transfer bias is bounded in transfer_note instead
-    (the gate accepts either)."""
+    layouts (dense preallocation vs paged block-table) and BOTH cache
+    dtypes (fp32 vs quantized int8) — tokens/s/chip of the steady-state
+    decode step, the number a token-serving deployment lives on.  Every
+    timed sub-leg records its ``cache_layout`` AND ``cache_dtype`` plus
+    the KV-cache bytes reachable per step at the leg's occupancy (the
+    _leg_promotable gate REJECTS decode legs missing either stamp, so a
+    paged-vs-dense or int8-vs-fp32 number can never be presented
+    without its provenance); ``kv_bytes_by_occupancy`` quantifies the
+    paged HBM win AND the int8 byte reduction across fill levels
+    instead of asserting them, and ``block_size_sweep`` records paged
+    tokens/s against the block-size axis.  Timing via
+    measure_decode_marginal (median-of-3 marginal decode time).  The
+    prompt upload happens inside the timed generate calls, so this leg
+    does NOT claim input_staged; its transfer bias is bounded in
+    transfer_note instead (the gate accepts either)."""
     from paddle_tpu.inference.generation import kv_reachable_bytes
     from paddle_tpu.jit import DecodeSession
     from paddle_tpu.models import TransformerLM, gpt_1p3b_config
@@ -551,25 +552,32 @@ def bench_decode(pt, jax, on_tpu: bool):
     best_tps = 0.0
     compile_counts = {}
     for layout in ("dense", "paged"):
-        sess = DecodeSession(model, max_len=max_len, buckets=[prefill],
-                             cache_layout=layout,
-                             block_size=DECODE_BLOCK_SIZE)
-        for batch in (1, 8):
-            ids = rng.randint(0, cfg["vocab_size"],
-                              (batch, prefill)).astype("int32")
-            m = measure_decode_marginal(sess, ids, gen)
-            tps = batch / m["per_token_s"]
-            legs["%s_batch%d" % (layout, batch)] = dict(
-                m, cache_layout=layout,
-                decode_tokens_per_sec=round(tps, 1),
-                kv_reachable_bytes=kv_reachable_bytes(
-                    [max_len] * batch, layout=layout,
-                    block_size=DECODE_BLOCK_SIZE, **dims))
-            best_tps = max(best_tps, tps)
-        compile_counts[layout] = sess.compile_counts()
-    # the paged win quantified across fill levels: reachable KV bytes at
-    # batch-8 occupancy fractions of max_len (dense pins the full slab
-    # whatever the occupancy; paged maps only ceil(tokens/bs) blocks)
+        for cache_dtype in ("float32", "int8"):
+            sess = DecodeSession(model, max_len=max_len, buckets=[prefill],
+                                 cache_layout=layout,
+                                 block_size=DECODE_BLOCK_SIZE,
+                                 cache_dtype=cache_dtype)
+            tag = "fp32" if cache_dtype == "float32" else cache_dtype
+            for batch in (1, 8):
+                ids = rng.randint(0, cfg["vocab_size"],
+                                  (batch, prefill)).astype("int32")
+                m = measure_decode_marginal(sess, ids, gen)
+                tps = batch / m["per_token_s"]
+                legs["%s_%s_batch%d" % (layout, tag, batch)] = dict(
+                    m, cache_layout=layout, cache_dtype=cache_dtype,
+                    decode_tokens_per_sec=round(tps, 1),
+                    kv_reachable_bytes=kv_reachable_bytes(
+                        [max_len] * batch, layout=layout,
+                        block_size=DECODE_BLOCK_SIZE, dtype=cache_dtype,
+                        **dims))
+                best_tps = max(best_tps, tps)
+            compile_counts["%s_%s" % (layout, tag)] = sess.compile_counts()
+    # the paged win AND the int8 byte reduction quantified across fill
+    # levels: reachable KV bytes at batch-8 occupancy fractions of
+    # max_len (dense pins the full slab whatever the occupancy; paged
+    # maps only ceil(tokens/bs) blocks; the *_int8 twins count int8 K/V
+    # plus the riding fp32 per-head scales, so the ~2x-vs-bf16 /
+    # ~4x-vs-fp32 reduction is in the artifact, not just the prose)
     occupancy = []
     for frac in (0.125, 0.25, 0.5, 0.75, 1.0):
         tokens = max(1, int(max_len * frac))
@@ -579,7 +587,12 @@ def bench_decode(pt, jax, on_tpu: bool):
                                               layout="dense", **dims),
             "paged_bytes": kv_reachable_bytes(
                 [tokens] * 8, layout="paged",
-                block_size=DECODE_BLOCK_SIZE, **dims)})
+                block_size=DECODE_BLOCK_SIZE, **dims),
+            "dense_bytes_int8": kv_reachable_bytes(
+                [tokens] * 8, layout="dense", dtype="int8", **dims),
+            "paged_bytes_int8": kv_reachable_bytes(
+                [tokens] * 8, layout="paged",
+                block_size=DECODE_BLOCK_SIZE, dtype="int8", **dims)})
     # tokens/s against the block-size axis (batch 1, short generation:
     # the axis's effect is on the gather/scatter addressing, visible
     # without a long run) — the CPU record the ROADMAP item asks for,
@@ -593,13 +606,14 @@ def bench_decode(pt, jax, on_tpu: bool):
                           cache_layout="paged", block_size=bs)
         m = measure_decode_marginal(s, sweep_ids, sweep_gen)
         block_sweep.append(dict(
-            m, cache_layout="paged", block_size=bs,
+            m, cache_layout="paged", cache_dtype="float32", block_size=bs,
             decode_tokens_per_sec=round(1.0 / m["per_token_s"], 1)))
     out = {
         "tokens_per_sec": best_tps,
         "prefill": prefill,
         "generated": gen,
         "cache_layouts": ["dense", "paged"],
+        "cache_dtypes": ["float32", "int8"],
         "block_size": DECODE_BLOCK_SIZE,
         "kv_bytes_by_occupancy": occupancy,
         "block_size_sweep": block_sweep,
@@ -624,10 +638,11 @@ def bench_serving(pt, jax, on_tpu: bool):
     metrics hooks) ON TOP of the raw decode step bench_decode times.
     Driven by the synchronous pump() mode, so the leg is
     single-threaded and measures the same code path the deterministic
-    tests pin.  Sub-legs are stamped with ``cache_layout`` exactly like
-    the decode leg, and the _leg_promotable gate rejects serving legs
-    without the stamp.  TTFT percentiles come from the per-request
-    StreamStatus timings (exact), not the bucketed histogram."""
+    tests pin.  Sub-legs are stamped with ``cache_layout`` AND
+    ``cache_dtype`` exactly like the decode leg, and the
+    _leg_promotable gate rejects serving legs missing either stamp.
+    TTFT percentiles come from the per-request StreamStatus timings
+    (exact), not the bucketed histogram."""
     from paddle_tpu.models import TransformerLM, gpt_1p3b_config
     from paddle_tpu.serving import ServingEngine
 
@@ -675,10 +690,13 @@ def bench_serving(pt, jax, on_tpu: bool):
         ttfts = [st.ttft_s for st in statuses]
         toks = sum(st.new_tokens for st in statuses)
         tps = toks / wall
+        stats = engine.cache_stats()
         out["batch%d" % slots] = {
             "slots": slots,
             "requests": len(prompts),
-            "cache_layout": engine.cache_stats()["cache_layout"],
+            "cache_layout": stats["cache_layout"],
+            "cache_dtype": stats["cache_dtype"],
+            "kv_resident_bytes": stats["pool_bytes"],
             "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 5),
             "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 5),
             "tokens_per_sec": round(tps, 1),
@@ -817,19 +835,23 @@ def _leg_promotable(name: str, leg: dict):
                        "understates 2x" % (leg.get("mfu_convention"),
                                            RESNET_MFU_CONVENTION))
     if name in ("decode", "serving"):
-        # a decode/serving number without its cache-layout stamp cannot
-        # say whether it measured the dense or the paged path (they
-        # differ in reachable HBM by up to max_len/actual-tokens):
-        # unpromotable.  Timed serving sub-legs are identified by their
-        # TTFT stamp, decode sub-legs by their marginal per-token time.
+        # a decode/serving number without its cache-layout AND
+        # cache-dtype stamps cannot say whether it measured the dense or
+        # the paged path (they differ in reachable HBM by up to
+        # max_len/actual-tokens) or the fp32 or int8 cache (~4x fewer
+        # bytes streamed per step): unpromotable.  Timed serving
+        # sub-legs are identified by their TTFT stamp, decode sub-legs
+        # by their marginal per-token time.
         stamp = "per_token_s" if name == "decode" else "ttft_p50_s"
         timed = {k: v for k, v in leg.items()
                  if isinstance(v, dict) and stamp in v}
         missing = sorted(k for k, v in timed.items()
-                         if not v.get("cache_layout"))
+                         if not v.get("cache_layout")
+                         or not v.get("cache_dtype"))
         if not timed or missing:
-            return False, ("%s leg missing cache_layout on %s: "
-                           "dense-vs-paged provenance unknown"
+            return False, ("%s leg missing cache_layout/cache_dtype on "
+                           "%s: dense-vs-paged / fp32-vs-int8 "
+                           "provenance unknown"
                            % (name, missing or "every timed sub-leg"))
     return True, ""
 

@@ -74,11 +74,18 @@ def kv_reachable_bytes(tokens, max_len: int, num_layers: int,
     contract even for block sizes that do not divide max_len).  This is
     the quantity the ROADMAP item names — cache HBM scaling with actual
     tokens, not max_len × slots — and what bench.py's decode leg
-    records per layout."""
+    records per layout.
+
+    ``dtype="int8"`` (the quantized cache) counts the TRUE bytes: int8
+    K/V plus the per-head fp32 scales that ride alongside (4 bytes per
+    K and per V head-position) — the honest number is what makes the
+    "int8 halves cache bandwidth" claim auditable from the artifact."""
     toks = [int(t) for t in
             (tokens if hasattr(tokens, "__len__") else [tokens])]
-    per_token = 2 * num_layers * num_heads * head_dim * \
-        np.dtype(dtype).itemsize
+    # per-head scale overhead only exists for the quantized cache
+    scale_bytes = 4 if np.dtype(dtype) == np.dtype(np.int8) else 0
+    per_token = 2 * num_layers * num_heads * \
+        (head_dim * np.dtype(dtype).itemsize + scale_bytes)
     if layout == "dense":
         return len(toks) * int(max_len) * per_token
     if layout != "paged":
@@ -218,17 +225,31 @@ class GenerationPool:
         out = []
         for cp, cr in zip(pool_cache, row_cache):
             if hasattr(cp, "table"):
-                out.append(cp._replace(
+                upd = dict(
                     k=cp.k.at[blocks].set(cr.k[1:].astype(cp.k.dtype)),
                     v=cp.v.at[blocks].set(cr.v[1:].astype(cp.v.dtype)),
                     table=cp.table.at[slot].set(blocks),
                     index=cp.index.at[slot].set(
-                        jnp.asarray(length, jnp.int32))))
+                        jnp.asarray(length, jnp.int32)))
+                if cp.k_scale is not None:
+                    # int8 cache: the row's per-block scales splice with
+                    # their blocks (same ids), so a spliced block can
+                    # never be read under another request's scale
+                    upd.update(
+                        k_scale=cp.k_scale.at[blocks].set(cr.k_scale[1:]),
+                        v_scale=cp.v_scale.at[blocks].set(cr.v_scale[1:]))
+                out.append(cp._replace(**upd))
             else:
-                out.append(type(cp)(
-                    cp.k.at[slot].set(cr.k[0].astype(cp.k.dtype)),
-                    cp.v.at[slot].set(cr.v[0].astype(cp.v.dtype)),
-                    cp.index.at[slot].set(jnp.asarray(length, jnp.int32))))
+                upd = dict(
+                    k=cp.k.at[slot].set(cr.k[0].astype(cp.k.dtype)),
+                    v=cp.v.at[slot].set(cr.v[0].astype(cp.v.dtype)),
+                    index=cp.index.at[slot].set(
+                        jnp.asarray(length, jnp.int32)))
+                if cp.k_scale is not None:
+                    upd.update(
+                        k_scale=cp.k_scale.at[slot].set(cr.k_scale[0]),
+                        v_scale=cp.v_scale.at[slot].set(cr.v_scale[0]))
+                out.append(cp._replace(**upd))
         return out
 
     def _pool_decode(self, param_vals, buf_vals, cache, toks, active, key):
@@ -517,7 +538,12 @@ class GenerationPool:
                     dtype=first.k.dtype)
         dense_bytes = kv_reachable_bytes([self.max_len] * self.slots,
                                          layout="dense", **dims)
+        # every byte figure below is dtype-aware (int8 caches count the
+        # int8 K/V plus the riding fp32 scales — kv_reachable_bytes),
+        # and the dtype is stamped so a serving record can never present
+        # an int8 byte count as an fp32 one
         stats = {"cache_layout": self.cache_layout,
+                 "cache_dtype": str(np.dtype(first.k.dtype)),
                  "dense_equiv_bytes": dense_bytes}
         if self.cache_layout == "paged":
             bs = self._block_size

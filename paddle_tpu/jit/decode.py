@@ -182,7 +182,17 @@ class DecodeSession:
                 "sampling config: temperature must be >= 0 and top_p in "
                 "(0, 1]; got temperature=%r top_p=%r"
                 % (temperature, top_p))
-        self._cache_dtype = cache_dtype
+        from ..nn.layer.transformer import normalize_cache_dtype
+
+        # fail at construction with the supported set named, not as a
+        # shape/astype error deep in the first prefill trace.  "int8"
+        # selects the quantized cache: K/V stored int8 with per-head
+        # fp32 scales as extra donated carry leaves in the same pytree
+        # — the exactly-two-compiles contract is unchanged, the bytes
+        # the decode step streams from HBM per token drop ~4x (fp32)
+        # while greedy output stays token-identical over the pinned
+        # short-horizon corpus (tests/test_quant_cache.py).
+        self._cache_dtype = normalize_cache_dtype(cache_dtype)
         # "dense" preallocates [B, H, max_len, D] per row; "paged" stores
         # K/V in fixed-size blocks addressed through a block table
         # (identity-mapped here — the aligned batch needs no allocator;

@@ -141,7 +141,11 @@ class TransformerLM(Layer):
         ``MultiHeadAttention.gen_decode_cache``); thread it through
         ``forward(..., cache=...)`` for O(1)-per-token generation.
         ``layout="paged"`` selects the block-table cache
-        (``PagedDecodeCache``) whose HBM scales with allocated tokens.
+        (``PagedDecodeCache``) whose HBM scales with allocated tokens;
+        ``dtype="int8"`` stores K/V quantized with per-head fp32 scales
+        (quantize-on-write, dequant inside the attention — docs/DESIGN.md
+        §5d), cutting the bytes every decode step streams ~4x vs fp32.
+        Unsupported dtypes raise a typed error naming the supported set.
 
         Causal models only: the cached path masks attention causally over
         the prefix, which for a bidirectional (``causal=False``) encoder

@@ -33,6 +33,34 @@ def _convert_attn_mask(mask, dtype):
     return T.cast(mask, dtype)
 
 
+# Decode-cache storage dtypes: the float dtypes store K/V verbatim;
+# "int8" stores K/V quantized with per-head fp32 absmax scales
+# (ops.quantize_kv) riding alongside the buffers, dequantized inside the
+# attention composition — halving (vs bf16) or quartering (vs fp32) the
+# HBM bytes every decode step streams.
+SUPPORTED_CACHE_DTYPES = ("float32", "bfloat16", "float16", "int8")
+
+
+def normalize_cache_dtype(dtype) -> str:
+    """Canonical dtype name for a decode cache, or a typed error naming
+    the supported set — checked at cache allocation AND at
+    ``DecodeSession`` construction, because an unsupported dtype would
+    otherwise surface as a shape/astype failure deep inside the first
+    compiled step."""
+    import jax.numpy as jnp
+
+    try:
+        name = jnp.dtype(dtype).name
+    except TypeError:
+        name = str(dtype)
+    if name not in SUPPORTED_CACHE_DTYPES:
+        raise InvalidArgumentError(
+            "unsupported KV cache dtype %r; supported cache dtypes: %s "
+            "('int8' stores quantized K/V with per-head fp32 scales)"
+            % (dtype, list(SUPPORTED_CACHE_DTYPES)))
+    return name
+
+
 class MultiHeadAttention(Layer):
     """paddle.nn.MultiHeadAttention parity (transformer.py:109)."""
 
@@ -45,7 +73,15 @@ class MultiHeadAttention(Layer):
     # lax.dynamic_update_slice and the index advances, so every decode
     # step has IDENTICAL shapes: one XLA compilation, donate-able
     # buffers, O(1) per-token attention against the valid prefix.
-    DecodeCache = collections.namedtuple("DecodeCache", ["k", "v", "index"])
+    # ``k_scale``/``v_scale`` are None for float caches; for the int8
+    # cache they are fp32 per-head absmax scales (one per written
+    # position per head — dense [B, H, max_len]), quantized-on-write by
+    # the same dynamic_update_slice path that writes K/V.  None leaves
+    # vanish from the jit pytree, so the float cache's compiled steps
+    # are byte-identical to the pre-quantization ones.
+    DecodeCache = collections.namedtuple(
+        "DecodeCache", ["k", "v", "index", "k_scale", "v_scale"],
+        defaults=(None, None))
     # Paged decode cache (vLLM block-table scheme): K/V live in a GLOBAL
     # pool of fixed-size blocks [num_blocks, H, block_size, D] and each
     # row owns a [max_blocks] int32 row of ``table`` mapping its logical
@@ -54,8 +90,13 @@ class MultiHeadAttention(Layer):
     # static — only table VALUES vary — so the "exactly two compiles"
     # contract of the dense cache is preserved while cache HBM scales
     # with ALLOCATED tokens, not max_len × rows.
+    # Paged scales live in per-block pools ([num_blocks, H, block_size])
+    # gathered through the same table as K/V, so a block carries its own
+    # scales wherever the allocator maps it.
     PagedDecodeCache = collections.namedtuple(
-        "PagedDecodeCache", ["k", "v", "table", "index"])
+        "PagedDecodeCache", ["k", "v", "table", "index",
+                             "k_scale", "v_scale"],
+        defaults=(None, None))
 
     def __init__(
         self,
@@ -173,6 +214,11 @@ class MultiHeadAttention(Layer):
         ``per_slot`` — the GenerationPool's slot-batched layout where
         each row decodes at its own position).
 
+        ``dtype="int8"`` stores K/V quantized (per-head fp32 absmax
+        scales in ``k_scale``/``v_scale`` — dense [B, H, max_len], paged
+        [num_blocks, H, block_size]); unsupported dtypes raise a typed
+        error naming :data:`SUPPORTED_CACHE_DTYPES`.
+
         ``layout="dense"``: zeroed [B, H, max_len, D] K/V buffers.
 
         ``layout="paged"``: a global block pool
@@ -191,12 +237,17 @@ class MultiHeadAttention(Layer):
             raise InvalidArgumentError(
                 "cache layout must be 'dense' or 'paged', got %r"
                 % (layout,))
+        dtype = normalize_cache_dtype(dtype)
+        quant = dtype == "int8"
         index = (jnp.zeros((batch_size,), jnp.int32) if per_slot
                  else jnp.zeros((), jnp.int32))
         if layout == "dense":
             shape = (batch_size, self.num_heads, max_length, self.head_dim)
+            scales = ((jnp.zeros(shape[:-1], jnp.float32),) * 2 if quant
+                      else (None, None))
             return self.DecodeCache(jnp.zeros(shape, dtype),
-                                    jnp.zeros(shape, dtype), index)
+                                    jnp.zeros(shape, dtype), index,
+                                    *scales)
         block_size = int(block_size)
         if block_size < 1:
             raise InvalidArgumentError(
@@ -215,8 +266,11 @@ class MultiHeadAttention(Layer):
                     "reserved scratch block), got %d" % num_blocks)
             table = jnp.zeros((batch_size, max_blocks), jnp.int32)
         shape = (num_blocks, self.num_heads, block_size, self.head_dim)
+        scales = ((jnp.zeros(shape[:-1], jnp.float32),) * 2 if quant
+                  else (None, None))
         return self.PagedDecodeCache(jnp.zeros(shape, dtype),
-                                     jnp.zeros(shape, dtype), table, index)
+                                     jnp.zeros(shape, dtype), table, index,
+                                     *scales)
 
     def _decode_forward(self, q, k_new, v_new, attn_mask, cache):
         """Shape-static cached attention: write the new K/V chunk into the
@@ -227,13 +281,21 @@ class MultiHeadAttention(Layer):
         import jax.numpy as jnp
 
         from ...framework.tensor import Tensor as _T
-        from ...ops.flash_attention import decode_attention
+        from ...ops.flash_attention import decode_attention, quantize_kv
 
         def raw(x):
             return x.value if isinstance(x, _T) else jnp.asarray(x)
 
         q_, k_new, v_new = raw(q), raw(k_new), raw(v_new)
         k_buf, v_buf = raw(cache.k), raw(cache.v)
+        ks_buf, vs_buf = cache.k_scale, cache.v_scale
+        quant = ks_buf is not None
+        if quant:
+            # quantize-on-write: the chunk's per-head absmax scales are
+            # computed in-trace and written through the SAME slice /
+            # scatter addressing as the int8 values
+            k_new, k_s = quantize_kv(k_new)
+            v_new, v_s = quantize_kv(v_new)
         idx = jnp.asarray(cache.index, jnp.int32)
         b, _, length, _ = q_.shape
         max_len = k_buf.shape[2]
@@ -244,6 +306,11 @@ class MultiHeadAttention(Layer):
                 k_buf, k_new.astype(k_buf.dtype), (0, 0, idx, 0))
             v_buf = jax.lax.dynamic_update_slice(
                 v_buf, v_new.astype(v_buf.dtype), (0, 0, idx, 0))
+            if quant:
+                ks_buf = jax.lax.dynamic_update_slice(ks_buf, k_s,
+                                                      (0, 0, idx))
+                vs_buf = jax.lax.dynamic_update_slice(vs_buf, v_s,
+                                                      (0, 0, idx))
             q_pos = idx + jnp.arange(length)                    # [L]
             allow = jnp.arange(max_len)[None, :] <= q_pos[:, None]
             bias = jnp.where(allow, 0.0, neg)[None, None]       # [1,1,L,S]
@@ -261,6 +328,9 @@ class MultiHeadAttention(Layer):
                 k_new[:, :, 0, :].astype(k_buf.dtype))
             v_buf = v_buf.at[rows, :, idx, :].set(
                 v_new[:, :, 0, :].astype(v_buf.dtype))
+            if quant:
+                ks_buf = ks_buf.at[rows, :, idx].set(k_s[:, :, 0])
+                vs_buf = vs_buf.at[rows, :, idx].set(v_s[:, :, 0])
             allow = (jnp.arange(max_len)[None, None, :]
                      <= idx[:, None, None])                     # [B,1,S]
             bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,1,S]
@@ -274,9 +344,11 @@ class MultiHeadAttention(Layer):
                 "index (causal over the valid prefix); additive "
                 "attn_mask is not supported with a DecodeCache — pass "
                 "attn_mask=None, or use the uncached forward")
-        out = decode_attention(q_, k_buf, v_buf, bias=bias)
+        out = decode_attention(q_, k_buf, v_buf, bias=bias,
+                               k_scale=ks_buf, v_scale=vs_buf)
         return out, self.DecodeCache(k_buf, v_buf,
-                                     idx + (length if idx.ndim == 0 else 1))
+                                     idx + (length if idx.ndim == 0 else 1),
+                                     ks_buf, vs_buf)
 
     def _paged_decode_forward(self, q, k_new, v_new, attn_mask, cache):
         """Block-table cached attention: the new K/V chunk is scattered
@@ -290,7 +362,8 @@ class MultiHeadAttention(Layer):
         import jax.numpy as jnp
 
         from ...framework.tensor import Tensor as _T
-        from ...ops.flash_attention import paged_decode_attention
+        from ...ops.flash_attention import (paged_decode_attention,
+                                            quantize_kv)
 
         def raw(x):
             return x.value if isinstance(x, _T) else jnp.asarray(x)
@@ -303,6 +376,14 @@ class MultiHeadAttention(Layer):
                 "attn_mask=None, or use the uncached forward")
         q_, k_new, v_new = raw(q), raw(k_new), raw(v_new)
         k_pool, v_pool = raw(cache.k), raw(cache.v)
+        ks_pool, vs_pool = cache.k_scale, cache.v_scale
+        quant = ks_pool is not None
+        if quant:
+            # quantize-on-write; scales scatter into the per-block scale
+            # pools through the SAME (phys, off) addressing as K/V, so a
+            # block and its scales can never diverge
+            k_new, k_s = quantize_kv(k_new)
+            v_new, v_s = quantize_kv(v_new)
         table = jnp.asarray(cache.table, jnp.int32)
         idx = jnp.asarray(cache.index, jnp.int32)
         b, _, length, _ = q_.shape
@@ -319,6 +400,11 @@ class MultiHeadAttention(Layer):
                 k_new.transpose(0, 2, 1, 3).astype(k_pool.dtype))
             v_pool = v_pool.at[phys, :, off, :].set(
                 v_new.transpose(0, 2, 1, 3).astype(v_pool.dtype))
+            if quant:
+                ks_pool = ks_pool.at[phys, :, off].set(
+                    k_s.transpose(0, 2, 1))
+                vs_pool = vs_pool.at[phys, :, off].set(
+                    v_s.transpose(0, 2, 1))
             allow = jnp.arange(s)[None, :] <= pos[:, None]
             bias = jnp.where(allow, 0.0, neg)[None, None]       # [1,1,L,S]
         else:
@@ -336,12 +422,16 @@ class MultiHeadAttention(Layer):
                 k_new[:, :, 0, :].astype(k_pool.dtype))
             v_pool = v_pool.at[phys, :, off, :].set(
                 v_new[:, :, 0, :].astype(v_pool.dtype))
+            if quant:
+                ks_pool = ks_pool.at[phys, :, off].set(k_s[:, :, 0])
+                vs_pool = vs_pool.at[phys, :, off].set(v_s[:, :, 0])
             allow = (jnp.arange(s)[None, None, :]
                      <= idx[:, None, None])                     # [B,1,S]
             bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,1,S]
-        out = paged_decode_attention(q_, k_pool, v_pool, table, bias=bias)
+        out = paged_decode_attention(q_, k_pool, v_pool, table, bias=bias,
+                                     k_scale=ks_pool, v_scale=vs_pool)
         return out, cache._replace(
-            k=k_pool, v=v_pool,
+            k=k_pool, v=v_pool, k_scale=ks_pool, v_scale=vs_pool,
             index=idx + (length if idx.ndim == 0 else 1))
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):

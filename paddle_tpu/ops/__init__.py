@@ -10,12 +10,15 @@ long sequence length.
 from .flash_attention import (  # noqa: F401
     decode_attention,
     decode_attention_supported,
+    dequantize_kv,
     flash_attention,
     flash_attention_supported,
     paged_decode_attention,
     paged_decode_attention_supported,
+    quantize_kv,
 )
 
 __all__ = ["flash_attention", "flash_attention_supported",
            "decode_attention", "decode_attention_supported",
-           "paged_decode_attention", "paged_decode_attention_supported"]
+           "paged_decode_attention", "paged_decode_attention_supported",
+           "quantize_kv", "dequantize_kv"]

@@ -22,7 +22,8 @@ import numpy as np
 
 __all__ = ["flash_attention", "flash_attention_supported",
            "decode_attention", "decode_attention_supported",
-           "paged_decode_attention", "paged_decode_attention_supported"]
+           "paged_decode_attention", "paged_decode_attention_supported",
+           "quantize_kv", "dequantize_kv"]
 
 _SUPPORTED_DTYPES = (jnp.float32, jnp.bfloat16)
 
@@ -135,6 +136,43 @@ def flash_attention(q, k, v, bias=None, causal: bool = False,
 
 
 # ---------------------------------------------------------------------------
+# int8 KV-cache quantization: per-head absmax scales
+# ---------------------------------------------------------------------------
+
+# Floor for the absmax scale: an all-zero head row (a never-written cache
+# position) quantizes to zeros with a zero-ish scale instead of dividing
+# by zero; any real activation dwarfs this.
+KV_QUANT_EPS = 1e-8
+
+
+def quantize_kv(x):
+    """``[..., D]`` float K/V -> ``(int8 values [..., D], fp32 scales
+    [...])`` — symmetric per-head absmax quantization, the granularity of
+    the int8 KV cache: the quantization group is ONE head's ``[D]``
+    vector at one position, so the scale tensor is the K/V buffer minus
+    its head_dim axis (dense cache ``[B, H, S]``, paged pool
+    ``[num_blocks, H, block_size]``).  Runs INSIDE the compiled
+    prefill/decode step (quantize-on-write), the compiler-first
+    discipline: cache dtype is a property of the program, not a host-side
+    conversion pass."""
+    xf = jnp.asarray(x, jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), KV_QUANT_EPS) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`: ``int8 [..., D]`` times its
+    per-head ``[...]`` scales.  In the int8 decode paths this runs on
+    the GATHERED rows inside the attention composition, so the HBM-side
+    read of the cache is int8 and the fp up-cast happens in the fused
+    kernel's registers/VMEM — the bandwidth side is where the win lives
+    (EQuARX; decode is cache-bandwidth-bound)."""
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
+
+# ---------------------------------------------------------------------------
 # decode-time attention: one (or few) query positions against a
 # preallocated KV cache
 # ---------------------------------------------------------------------------
@@ -168,7 +206,8 @@ def decode_attention_supported(q_shape, kv_len: int, dtype) -> bool:
     return jnp.dtype(dtype) in _SUPPORTED_DTYPES
 
 
-def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None):
+def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None,
+                     k_scale=None, v_scale=None):
     """Decode-step attention: [B, H, Lq, D] queries against a FULL
     preallocated cache [B, H, S, D] (S = max_len), with ``bias`` masking
     the invalid tail (positions at or beyond the cache index) to -inf.
@@ -179,10 +218,20 @@ def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None):
     agree to float-reduction noise.  Masked (garbage) cache positions
     contribute exp(-inf) == 0 to the softmax, so preallocation never
     changes the result, only the reduction shape — which XLA keeps
-    shape-static across every decode step."""
+    shape-static across every decode step.
+
+    ``k_scale``/``v_scale`` ([B, H, S] fp32) mark an int8-quantized
+    cache: K/V arrive as int8 and are dequantized per head IN the
+    composition (the HBM read is int8; the up-cast fuses into the score
+    matmul).  The sm_scale default keys off the QUERY's head_dim, so the
+    int8 path scores identically to fp32 up to quantization error."""
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
+    if k_scale is not None:
+        k = dequantize_kv(k, k_scale, q.dtype)
+    if v_scale is not None:
+        v = dequantize_kv(v, v_scale, q.dtype)
     if decode_attention_supported(q.shape, k.shape[2], q.dtype):
         # reserved routing slot: a paged/splash single-query kernel lands
         # here once a measured crossover justifies it; until then even a
@@ -224,7 +273,8 @@ def paged_decode_attention_supported(q_shape, block_size: int,
 
 
 def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
-                           sm_scale: Optional[float] = None):
+                           sm_scale: Optional[float] = None,
+                           k_scale=None, v_scale=None):
     """Decode-step attention against a BLOCK-TABLE KV cache.
 
     ``q``: [B, H, Lq, D] queries (Lq = 1 for autoregressive decode).
@@ -238,6 +288,12 @@ def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
     broadcastable to [B, H, Lq, S] with S = max_blocks * block_size
     (callers that already know their causal-prefix mask pass it here and
     skip ``lengths``).
+
+    ``k_scale``/``v_scale`` ([num_blocks, H, block_size] fp32) mark an
+    int8-quantized pool: the per-head scales RIDE WITH their blocks
+    (gathered through the same table, so a remapped block carries its
+    own scales) and dequantization happens on the gathered rows — the
+    pool read stays int8.
 
     All shapes are static — only the TABLE VALUES vary per step — so one
     XLA compilation serves every allocation state, the same
@@ -256,6 +312,11 @@ def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
     tbl = jnp.asarray(table, jnp.int32)
     k = k_pool[tbl].transpose(0, 2, 1, 3, 4).reshape(b, h, s, d)
     v = v_pool[tbl].transpose(0, 2, 1, 3, 4).reshape(b, h, s, d)
+    ks = vs = None
+    if k_scale is not None:
+        ks = k_scale[tbl].transpose(0, 2, 1, 3).reshape(b, h, s)
+    if v_scale is not None:
+        vs = v_scale[tbl].transpose(0, 2, 1, 3).reshape(b, h, s)
     if lengths is not None:
         lengths = jnp.asarray(lengths, jnp.int32)
         if lengths.ndim == 0:
@@ -270,7 +331,8 @@ def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
         # reserved routing slot: a pallas paged/splash kernel that tiles
         # the gather lands here once a measured crossover justifies it
         pass
-    return decode_attention(q, k, v, bias=bias, sm_scale=sm_scale)
+    return decode_attention(q, k, v, bias=bias, sm_scale=sm_scale,
+                            k_scale=ks, v_scale=vs)
 
 
 # id(mask) → (weakref(mask), verdict); masks are immutable jax arrays built

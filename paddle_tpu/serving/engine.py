@@ -139,6 +139,10 @@ class ServingEngine:
         self._g_kv_bytes = m.gauge(
             "serving_kv_reachable_bytes",
             "KV bytes a decode step can read right now (cache_stats)")
+        self._g_kv_resident = m.gauge(
+            "serving_kv_resident_bytes",
+            "KV cache bytes resident on device (whole pool allocation, "
+            "dtype-aware: int8 caches count int8 K/V + fp32 scales)")
         self._g_kv_free = m.gauge(
             "serving_kv_free_blocks",
             "paged allocator free blocks") \
@@ -169,7 +173,10 @@ class ServingEngine:
         draining.  ``deadline_s`` is a wall-clock budget from NOW —
         queued or decoding, the request is expired (slot and blocks
         freed) at the first tick past it."""
-        if deadline_s is not None and deadline_s <= 0:
+        if deadline_s is not None and not (float(deadline_s) > 0):
+            # `not (x > 0)` instead of `x <= 0`: NaN fails both
+            # comparisons, and a NaN deadline would otherwise admit a
+            # request that can never expire
             raise InvalidArgumentError(
                 "deadline_s must be > 0 (or None for no deadline), "
                 "got %r" % (deadline_s,))
@@ -310,6 +317,7 @@ class ServingEngine:
         self._g_occupancy.set(pool.active_count / pool.slots)
         stats = pool.cache_stats()
         self._g_kv_bytes.set(stats["reachable_bytes"])
+        self._g_kv_resident.set(stats["pool_bytes"])
         if self._g_kv_free is not None:
             self._g_kv_free.set(stats["free_blocks"])
         if self._timer.total:

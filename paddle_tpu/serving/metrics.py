@@ -4,8 +4,13 @@ A deliberately small registry — no labels, no metric vectors, no
 background collection — because the engine records everything from the
 REAL code path: admission increments the counters inside ``submit()``,
 TTFT is observed by the pool's ``on_token`` hook the moment the prefill
-emits a request's first token, and occupancy gauges read
-``cache_stats()`` (the allocator's own accounting) after every step.
+emits a request's first token, and KV-cache gauges read
+``cache_stats()`` (the allocator's own accounting) after every step —
+``serving_kv_reachable_bytes`` (what a step can READ right now) and
+``serving_kv_resident_bytes`` (the whole pool allocation), both
+dtype-aware: an int8 quantized cache reports int8 K/V bytes plus the
+riding fp32 per-head scales, so the ~4x byte reduction vs fp32 shows up
+on the dashboard, not just in prose.
 ``snapshot()`` returns plain python for tests/JSON; the text exposition
 (``render_prometheus``) follows the Prometheus conventions (counters
 end in ``_total``, histograms emit cumulative ``_bucket{le=...}`` plus

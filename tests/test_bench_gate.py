@@ -105,10 +105,24 @@ def test_decode_leg_without_cache_layout_rejected():
     assert not ok and "cache_layout" in why
 
 
-def test_decode_leg_with_cache_layout_promotes():
+def test_decode_leg_without_cache_dtype_rejected():
+    # the int8 analog of the layout rule: a decode number that cannot
+    # say whether it streamed the fp32 or the quantized int8 cache
+    # (~4x fewer HBM bytes per step) must never be promoted
     leg = {"tokens_per_sec": 500.0, "transfer_note": "negligible",
-           "dense_batch1": {"per_token_s": 0.002, "cache_layout": "dense"},
-           "paged_batch1": {"per_token_s": 0.002, "cache_layout": "paged"}}
+           "dense_batch1": {"per_token_s": 0.002, "cache_layout": "dense"}}
+    ok, why = bench._leg_promotable("decode", leg)
+    assert not ok and "cache_dtype" in why
+
+
+def test_decode_leg_with_layout_and_dtype_promotes():
+    leg = {"tokens_per_sec": 500.0, "transfer_note": "negligible",
+           "dense_fp32_batch1": {"per_token_s": 0.002,
+                                 "cache_layout": "dense",
+                                 "cache_dtype": "float32"},
+           "paged_int8_batch1": {"per_token_s": 0.002,
+                                 "cache_layout": "paged",
+                                 "cache_dtype": "int8"}}
     ok, why = bench._leg_promotable("decode", leg)
     assert ok, why
 
@@ -128,10 +142,18 @@ def test_serving_leg_without_cache_layout_rejected():
     assert not ok and "cache_layout" in why
 
 
-def test_serving_leg_with_cache_layout_promotes():
+def test_serving_leg_without_cache_dtype_rejected():
+    leg = {"tokens_per_sec": 100.0, "transfer_note": "negligible",
+           "batch1": {"ttft_p50_s": 0.01, "cache_layout": "dense"}}
+    ok, why = bench._leg_promotable("serving", leg)
+    assert not ok and "cache_dtype" in why
+
+
+def test_serving_leg_with_layout_and_dtype_promotes():
     leg = {"tokens_per_sec": 100.0, "transfer_note": "negligible",
            "batch1": {"ttft_p50_s": 0.01, "ttft_p95_s": 0.02,
-                      "cache_layout": "dense"}}
+                      "cache_layout": "dense",
+                      "cache_dtype": "float32"}}
     ok, why = bench._leg_promotable("serving", leg)
     assert ok, why
 

@@ -315,6 +315,37 @@ def test_metrics_snapshot_and_prometheus_render(model):
     assert reg.snapshot()["serving_requests_completed_total"] == 3
 
 
+def test_kv_resident_bytes_gauge_dtype_aware(model):
+    # the resident-bytes gauge reports the WHOLE pool allocation and is
+    # dtype-aware: an int8 engine's resident bytes must show the
+    # quantization win (<= 0.55x fp32: int8 K/V + riding fp32 scales)
+    engines = {}
+    for dtype in ("float32", "int8"):
+        eng = ServingEngine(model, max_len=64, slots=2, buckets=[16],
+                            cache_dtype=dtype)
+        eng.submit(np.zeros(5, np.int32), 3)
+        while eng.pump(8):
+            pass
+        snap = eng.metrics.snapshot()
+        assert snap["serving_kv_resident_bytes"] == \
+            eng.cache_stats()["pool_bytes"]
+        engines[dtype] = snap["serving_kv_resident_bytes"]
+        assert "serving_kv_resident_bytes" in eng.metrics \
+            .render_prometheus()
+    assert 0 < engines["int8"] <= 0.55 * engines["float32"]
+    # paged int8: resident = the block-pool allocation, not slots*max_len
+    paged = ServingEngine(model, max_len=64, slots=2, buckets=[16],
+                          cache_layout="paged", block_size=8,
+                          num_blocks=5, cache_dtype="int8")
+    paged.submit(np.zeros(5, np.int32), 3)
+    while paged.pump(8):
+        pass
+    snap = paged.metrics.snapshot()
+    assert snap["serving_kv_resident_bytes"] == \
+        paged.cache_stats()["pool_bytes"]
+    assert snap["serving_kv_resident_bytes"] < engines["int8"]
+
+
 def test_metrics_registry_typing_and_quantile():
     from paddle_tpu.serving import Histogram
     reg = MetricsRegistry()

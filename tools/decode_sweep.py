@@ -18,8 +18,15 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
   prints both layouts' tokens/s and reachable-KV-bytes columns so the
   crossover (if any) is measured, not asserted.
 
+- fp32-vs-int8 per-token decode time with a CACHE-DTYPE axis
+  (``--cache-dtypes``, both by default): the quantized cache streams
+  ~4x fewer bytes per step (int8 K/V + riding fp32 per-head scales);
+  tok/s and bytes columns for dense AND paged, so the bandwidth win is
+  measured where it is claimed to live.
+
 Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
-     [--gen 64] [--block-sizes 16 32 64 128] [--cpu-smoke]
+     [--gen 64] [--block-sizes 16 32 64 128]
+     [--cache-dtypes float32 int8] [--cpu-smoke]
      [--out decode_sweep.json]
 Writes the JSON report to --out (default: decode_sweep.json in the
 CWD — never into tools/, a measurement artifact is not source);
@@ -41,7 +48,7 @@ import numpy as np
 REPEATS = 3  # median-of-N, same noise discipline as ceiling_probe.py
 
 
-def sweep(pt, cfg, batches, buckets, gen, block_sizes):
+def sweep(pt, cfg, batches, buckets, gen, block_sizes, cache_dtypes):
     from bench import measure_decode_marginal  # THE shared timing recipe
     from paddle_tpu.inference.generation import kv_reachable_bytes
     from paddle_tpu.jit import DecodeSession
@@ -57,45 +64,52 @@ def sweep(pt, cfg, batches, buckets, gen, block_sizes):
         # decode step always scans the full max_len cache, so a shared
         # max(buckets)-sized session would make every bucket leg measure
         # the SAME cache length and the cache-length axis would be
-        # fiction.  The paged sessions add the BLOCK-SIZE axis on top:
-        # same cache length, different gather/scatter granularity.
+        # fiction.  The paged sessions add the BLOCK-SIZE axis on top
+        # (same cache length, different gather/scatter granularity) and
+        # the CACHE-DTYPE axis multiplies both: fp32 vs quantized int8,
+        # same math up to quantization error, ~4x fewer bytes per step.
         max_len = bucket + gen
         dims = dict(max_len=max_len, num_layers=cfg["num_layers"],
                     num_heads=cfg["num_heads"],
                     head_dim=cfg["hidden_size"] // cfg["num_heads"])
-        sessions = [("dense", 0, DecodeSession(model, max_len=max_len,
-                                               buckets=[bucket]))]
-        for bs in block_sizes:
-            sessions.append(("paged", bs, DecodeSession(
+        sessions = []
+        for dtype in cache_dtypes:
+            sessions.append(("dense", 0, dtype, DecodeSession(
                 model, max_len=max_len, buckets=[bucket],
-                cache_layout="paged", block_size=bs)))
+                cache_dtype=dtype)))
+            for bs in block_sizes:
+                sessions.append(("paged", bs, dtype, DecodeSession(
+                    model, max_len=max_len, buckets=[bucket],
+                    cache_layout="paged", block_size=bs,
+                    cache_dtype=dtype)))
         for batch in batches:
             ids = rng.randint(0, cfg["vocab_size"],
                               (batch, bucket)).astype("int32")
-            for layout, bs, sess in sessions:
+            for layout, bs, dtype, sess in sessions:
                 m = measure_decode_marginal(sess, ids, gen,
                                             repeats=REPEATS)
                 kv_bytes = kv_reachable_bytes(
                     [max_len] * batch, layout=layout,
-                    block_size=(bs or 32), **dims)
+                    block_size=(bs or 32), dtype=dtype, **dims)
                 leg = dict(m, batch=batch, prefill=bucket, generated=gen,
                            cache_len=max_len, cache_layout=layout,
+                           cache_dtype=dtype,
                            block_size=bs or None,
                            kv_reachable_bytes=kv_bytes,
                            decode_tokens_per_sec=round(
                                batch / m["per_token_s"], 1))
                 legs.append(leg)
-                print("bucket %-5d batch %-3d  %-5s bs %-4s  "
+                print("bucket %-5d batch %-3d  %-5s bs %-4s %-8s  "
                       "prefill %.4fs  %.3f ms/tok  %8.1f tok/s  "
                       "%6.2f KV-MiB"
-                      % (bucket, batch, layout, bs or "-",
+                      % (bucket, batch, layout, bs or "-", dtype,
                          m["prefill_s"], m["per_token_s"] * 1e3,
                          leg["decode_tokens_per_sec"],
                          kv_bytes / 2**20), flush=True)
         compiles["bucket_%d" % bucket] = {
-            "%s_bs%d" % (layout, bs) if bs else layout:
-                sess.compile_counts()
-            for layout, bs, sess in sessions}
+            ("%s_bs%d_%s" % (layout, bs, dtype) if bs
+             else "%s_%s" % (layout, dtype)): sess.compile_counts()
+            for layout, bs, dtype, sess in sessions}
     return legs, compiles
 
 
@@ -110,6 +124,10 @@ def main():
                     default=[16, 32, 64, 128],
                     help="paged-layout KV block sizes to sweep (an "
                          "empty list measures the dense layout only)")
+    ap.add_argument("--cache-dtypes", nargs="+",
+                    default=["float32", "int8"],
+                    help="KV cache storage dtypes to sweep (int8 = "
+                         "quantized cache with per-head fp32 scales)")
     ap.add_argument("--cpu-smoke", action="store_true",
                     help="tiny model on CPU to exercise the harness")
     ap.add_argument("--out",
@@ -153,7 +171,7 @@ def main():
     args.gen = max(args.gen, 2)
 
     legs, compiles = sweep(pt, cfg, args.batches, args.buckets, args.gen,
-                           args.block_sizes)
+                           args.block_sizes, args.cache_dtypes)
     report = {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime()),
               "backend": jax.devices()[0].device_kind,
@@ -163,6 +181,7 @@ def main():
                          "vocab_size")},
               "repeats": REPEATS,
               "block_sizes": args.block_sizes,
+              "cache_dtypes": args.cache_dtypes,
               "compile_counts": compiles,
               "legs": legs}
     with open(args.out, "w") as f:
