@@ -148,13 +148,25 @@ def _lm_leg_runner(pt, jax, on_tpu, cfg, batches, seq, iters,
     return _sweep_best(batches, leg)
 
 
+def _cpu_smoke_shrink(cfg, **extra):
+    """Shrink a real model config to THE shared CPU-smoke geometry.
+
+    Every CPU-fallback leg must run this one geometry: the legs are
+    compared against each other (plain vs speculative decode, decode vs
+    serving), and a per-leg copy of these numbers that drifted would
+    silently compare different models.  ``extra`` carries the per-leg
+    additions (``max_position`` for the decode-family legs)."""
+    cfg.update(num_layers=2, hidden_size=128, num_heads=2,
+               intermediate_size=512, vocab_size=1024, **extra)
+    return cfg
+
+
 def bench_bert(pt, jax, on_tpu: bool):
     from paddle_tpu.models import bert_base_config
 
     cfg = bert_base_config()
     if not on_tpu:  # CPU smoke: shrink so the harness itself stays testable
-        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
-                   intermediate_size=512, vocab_size=1024)
+        _cpu_smoke_shrink(cfg)
     # batch 40 was the measured v5e knee (0.4365 MFU); sweep its
     # neighborhood in case layout/memory behavior moved
     batches, seq = ([40, 48, 32], 512) if on_tpu else ([2], 128)
@@ -181,8 +193,7 @@ def bench_bert_multistep(pt, jax, on_tpu: bool):
     if on_tpu:
         k, batch, seq, iters = 8, 40, 512, 3
     else:
-        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
-                   intermediate_size=512, vocab_size=1024)
+        _cpu_smoke_shrink(cfg)
         k, batch, seq, iters = 2, 2, 128, 2
 
     pt.seed(0)
@@ -432,8 +443,7 @@ def bench_gpt_block(pt, jax, on_tpu: bool):
         cfg.update(num_layers=6)
         batches, seq = [8, 16, 4], 1024
     else:
-        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
-                   intermediate_size=512, vocab_size=1024)
+        _cpu_smoke_shrink(cfg)
         batches, seq = [2], 128
     return _lm_leg_runner(pt, jax, on_tpu, cfg, batches, seq,
                           6 if on_tpu else 2, shift_labels=True)
@@ -537,9 +547,7 @@ def bench_decode(pt, jax, on_tpu: bool):
     if on_tpu:
         cfg.update(num_layers=6)  # the one-chip GPT geometry (gpt leg)
     else:
-        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
-                   intermediate_size=512, vocab_size=1024,
-                   max_position=1024)
+        _cpu_smoke_shrink(cfg, max_position=1024)
 
     pt.seed(0)
     model = TransformerLM(**cfg, dropout=0.0)
@@ -631,6 +639,16 @@ def bench_decode(pt, jax, on_tpu: bool):
     return out
 
 
+def _histogram_quantile(hist, q: float):
+    """A serving Histogram's quantile as a JSON-safe number: the bucket
+    upper-bound estimate, None when the histogram is empty or the
+    quantile overflowed the largest bucket (inf is not valid JSON)."""
+    v = hist.quantile(q)
+    if v is None or v != v or v == float("inf"):
+        return None
+    return round(float(v), 6)
+
+
 def bench_serving(pt, jax, on_tpu: bool):
     """L7 serving-ENGINE leg: p50/p95 TTFT and sustained tokens/s
     through ``serving.ServingEngine.pump()`` at 1 and 8 slots — the
@@ -642,7 +660,11 @@ def bench_serving(pt, jax, on_tpu: bool):
     ``cache_dtype`` exactly like the decode leg, and the
     _leg_promotable gate rejects serving legs missing either stamp.
     TTFT percentiles come from the per-request StreamStatus timings
-    (exact), not the bucketed histogram."""
+    (exact), not the bucketed histogram; inter-token latency p50/p95
+    come from the engine's ``serving_inter_token_seconds`` histogram
+    (bucket upper-bound estimates — the per-gap timestamps are not
+    retained per request, and the bucketed quantile is the same number
+    a Prometheus dashboard would show)."""
     from paddle_tpu.models import TransformerLM, gpt_1p3b_config
     from paddle_tpu.serving import ServingEngine
 
@@ -651,9 +673,7 @@ def bench_serving(pt, jax, on_tpu: bool):
     if on_tpu:
         cfg.update(num_layers=6)  # the one-chip GPT geometry
     else:
-        cfg.update(num_layers=2, hidden_size=128, num_heads=2,
-                   intermediate_size=512, vocab_size=1024,
-                   max_position=1024)
+        _cpu_smoke_shrink(cfg, max_position=1024)
     pt.seed(0)
     model = TransformerLM(**cfg, dropout=0.0)
     rng = np.random.RandomState(0)
@@ -678,6 +698,12 @@ def bench_serving(pt, jax, on_tpu: bool):
                                   (prefill,)).astype("int32"), 2)
         while engine.pump(8):
             pass
+        # the warmup request's token1->token2 gap CONTAINS the decode
+        # compile and was observed into the engine-lifetime inter-token
+        # histogram; reset it so itl_p50/p95 honor the warm-outside-the-
+        # timed-region rule (TTFT needs no reset: it reads per-request
+        # StreamStatus timings of the timed requests only)
+        engine.metrics.histogram("serving_inter_token_seconds").reset()
         prompts = [rng.randint(0, cfg["vocab_size"],
                                (prefill,)).astype("int32")
                    for _ in range(2 * slots)]
@@ -691,6 +717,7 @@ def bench_serving(pt, jax, on_tpu: bool):
         toks = sum(st.new_tokens for st in statuses)
         tps = toks / wall
         stats = engine.cache_stats()
+        itl = engine.metrics.histogram("serving_inter_token_seconds")
         out["batch%d" % slots] = {
             "slots": slots,
             "requests": len(prompts),
@@ -699,11 +726,126 @@ def bench_serving(pt, jax, on_tpu: bool):
             "kv_resident_bytes": stats["pool_bytes"],
             "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 5),
             "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 5),
+            "itl_p50_s": _histogram_quantile(itl, 0.5),
+            "itl_p95_s": _histogram_quantile(itl, 0.95),
             "tokens_per_sec": round(tps, 1),
             "wall_s": round(wall, 4),
         }
         best_tps = max(best_tps, tps)
     out["tokens_per_sec"] = round(best_tps, 1)
+    return out
+
+
+def bench_speculative(pt, jax, on_tpu: bool):
+    """L7 speculative-decoding leg: the draft/verify pool
+    (``inference.SpeculativePool``) against the PLAIN decode pool at
+    matched batch — tokens/s, the acceptance-rate stamp, and the
+    draft/verify wall-time split, so the speculative claim is measured,
+    never asserted.  Two draft sub-legs bracket the mechanism:
+
+    - ``selfdraft`` (draft IS the target): acceptance ~1.0 by
+      construction — the machinery's CEILING, what the round overhead
+      costs when every guess lands;
+    - ``smalldraft`` (same geometry shrunk, independently initialized):
+      the structural configuration a deployment runs; with random
+      weights its acceptance is ~chance, making the stamped rate the
+      honest explanation of whichever tokens/s it gets (draft QUALITY,
+      not machinery, is the whole game — greedy output is
+      token-identical to the plain pool in every case, pinned by
+      tests/test_speculative.py).
+
+    Every sub-leg carries cache_layout/cache_dtype like the decode leg
+    plus ``acceptance_rate``; _leg_promotable rejects speculative legs
+    missing the acceptance stamp."""
+    from paddle_tpu.inference import GenerationPool, SpeculativePool
+    from paddle_tpu.models import TransformerLM, gpt_1p3b_config
+
+    prefill, gen, spec_k = (512, 64, 4) if on_tpu else (32, 16, 4)
+    slots = 8 if on_tpu else 4
+    cfg = gpt_1p3b_config()
+    if on_tpu:
+        cfg.update(num_layers=6)  # the one-chip GPT geometry
+        draft_cfg = dict(cfg, num_layers=2)
+    else:
+        _cpu_smoke_shrink(cfg, max_position=1024)
+        draft_cfg = dict(cfg, num_layers=1, hidden_size=64,
+                         intermediate_size=256)
+    pt.seed(0)
+    target = TransformerLM(**cfg, dropout=0.0)
+    pt.seed(1)
+    draft_small = TransformerLM(**draft_cfg, dropout=0.0)
+    max_len = prefill + gen
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg["vocab_size"],
+                           (prefill,)).astype("int32")
+               for _ in range(slots)]
+
+    def timed_run(pool):
+        pool.generate([prompts[0]], 2)  # compile + warm every program
+        if hasattr(pool, "reset_acceptance_stats"):
+            # the stamped rate must cover exactly the timed region
+            pool.reset_acceptance_stats()
+        t0 = time.perf_counter()
+        outs = pool.generate(prompts, gen)
+        wall = time.perf_counter() - t0
+        return sum(len(o) for o in outs) / wall, wall
+
+    out = {
+        "prefill": prefill,
+        "generated": gen,
+        "spec_k": spec_k,
+        "slots": slots,
+        "input_staged": False,
+        "transfer_note": (
+            "prompt upload rides inside the prefill term exactly as in "
+            "the decode leg; per-round host traffic is the emitted "
+            "token block plus per-slot acceptance counts — the "
+            "scheduler cost this leg compares against plain decoding"),
+    }
+    plain = GenerationPool(target, max_len, slots=slots,
+                           buckets=[prefill])
+    plain_tps, plain_wall = timed_run(plain)
+    out["plain_batch%d" % slots] = {
+        "cache_layout": "dense", "cache_dtype": "float32",
+        "tokens_per_sec": round(plain_tps, 1),
+        "wall_s": round(plain_wall, 4),
+    }
+    # only plain_tps is needed past this point: drop the plain pool's
+    # slots x max_len KV cache before building the speculative pools
+    # (which each add a draft cache on top of the target's), so the
+    # timed sub-legs never carry a dead pool's HBM
+    del plain
+    best_spec = 0.0
+    for tag, draft in (("selfdraft", target),
+                       ("smalldraft", draft_small)):
+        pool = SpeculativePool(target, draft, max_len, spec_k=spec_k,
+                               slots=slots, buckets=[prefill],
+                               time_split=True)
+        tps, wall = timed_run(pool)
+        st = pool.acceptance_stats()  # timed region only (post-reset)
+        sub = {
+            "cache_layout": "dense", "cache_dtype": "float32",
+            "tokens_per_sec": round(tps, 1),
+            "wall_s": round(wall, 4),
+            "speedup_vs_plain": round(tps / plain_tps, 4),
+            "acceptance_rate": round(st["acceptance_rate"], 4),
+            "rounds": st["rounds"],
+            "draft_layers": (draft_cfg["num_layers"]
+                             if tag == "smalldraft"
+                             else cfg["num_layers"]),
+            # the draft/target step-time split: where the round's wall
+            # time actually goes (drafting vs the one verify chunk)
+            "draft_time_s": round(st["draft_time_s"], 4),
+            "verify_time_s": round(st["verify_time_s"], 4),
+        }
+        out["%s_batch%d" % (tag, slots)] = sub
+        best_spec = max(best_spec, tps)
+        del pool  # the next sub-leg builds its own target+draft caches
+    # the headline is the best SPECULATIVE sub-leg, never the plain
+    # baseline: a leg named "speculative" whose headline could fall
+    # back to plain_tps would hide a speculative regression from every
+    # cross-run comparison (the plain number lives in its own sub-leg)
+    out["tokens_per_sec"] = round(best_spec, 1)
     return out
 
 
@@ -834,15 +976,18 @@ def _leg_promotable(name: str, leg: dict):
         return False, ("mfu_convention %r != %d: pre-convention-fix MFU "
                        "understates 2x" % (leg.get("mfu_convention"),
                                            RESNET_MFU_CONVENTION))
-    if name in ("decode", "serving"):
-        # a decode/serving number without its cache-layout AND
-        # cache-dtype stamps cannot say whether it measured the dense or
-        # the paged path (they differ in reachable HBM by up to
-        # max_len/actual-tokens) or the fp32 or int8 cache (~4x fewer
-        # bytes streamed per step): unpromotable.  Timed serving
-        # sub-legs are identified by their TTFT stamp, decode sub-legs
-        # by their marginal per-token time.
-        stamp = "per_token_s" if name == "decode" else "ttft_p50_s"
+    cache_stamp_keys = {"decode": "per_token_s",
+                        "serving": "ttft_p50_s",
+                        "speculative": "tokens_per_sec"}
+    if name in cache_stamp_keys:
+        # a decode/serving/speculative number without its cache-layout
+        # AND cache-dtype stamps cannot say whether it measured the
+        # dense or the paged path (they differ in reachable HBM by up
+        # to max_len/actual-tokens) or the fp32 or int8 cache (~4x
+        # fewer bytes streamed per step): unpromotable.  Timed sub-legs
+        # are identified by their timing stamp: marginal per-token time
+        # for decode, TTFT for serving, tokens/s for speculative.
+        stamp = cache_stamp_keys[name]
         timed = {k: v for k, v in leg.items()
                  if isinstance(v, dict) and stamp in v}
         missing = sorted(k for k, v in timed.items()
@@ -853,6 +998,19 @@ def _leg_promotable(name: str, leg: dict):
                            "%s: dense-vs-paged / fp32-vs-int8 "
                            "provenance unknown"
                            % (name, missing or "every timed sub-leg"))
+        if name == "speculative":
+            # a speculative tokens/s additionally needs its
+            # acceptance_rate stamp: without it the number cannot say
+            # whether it measured a draft that mostly landed or mostly
+            # wasted work — the rate IS the number's provenance (the
+            # plain_* baseline sub-leg is exempt: it drafts nothing)
+            no_rate = sorted(k for k, v in timed.items()
+                             if not k.startswith("plain")
+                             and "acceptance_rate" not in v)
+            if no_rate:
+                return False, ("speculative leg missing acceptance_rate "
+                               "on %s: cannot tell a measured draft win "
+                               "from wasted drafting" % (no_rate,))
     return True, ""
 
 
@@ -1008,7 +1166,8 @@ def _measure_and_print():
                      ("bert_k8_multistep", bench_bert_multistep),
                      ("mnist_k32_multistep", bench_mnist_multistep),
                      ("decode", bench_decode),
-                     ("serving", bench_serving)):
+                     ("serving", bench_serving),
+                     ("speculative", bench_speculative)):
         try:
             legs[name] = fn(pt, jax, on_tpu)
         except Exception as e:  # noqa: BLE001 - keep remaining legs alive

@@ -28,7 +28,8 @@ __all__ = ["Config", "Predictor", "PredictorTensor", "Tensor",
            "DataType", "PlaceType", "PrecisionType",
            "get_num_bytes_of_data_type",
            "GenerationPool", "create_generation_pool",
-           "kv_reachable_bytes", "DuplicateRequestError"]
+           "kv_reachable_bytes", "DuplicateRequestError",
+           "SpeculativePool"]
 
 
 class DataType:
@@ -254,6 +255,7 @@ class PredictorPool:
 # model (docs/DESIGN.md "prefill/decode split").
 from .generation import (  # noqa: E402,F401
     DuplicateRequestError, GenerationPool, kv_reachable_bytes)
+from .speculative import SpeculativePool  # noqa: E402,F401
 
 
 def create_generation_pool(model, max_len: int, **kwargs) -> GenerationPool:

@@ -138,6 +138,8 @@ class GenerationPool:
             donate=donate, cache_layout=cache_layout,
             block_size=block_size)
         self._model = model
+        from ..jit.speculative import model_vocab_size
+        self._vocab = model_vocab_size(model)
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.eos_id = eos_id
@@ -285,6 +287,19 @@ class GenerationPool:
         if len(ids) < 1:
             raise InvalidArgumentError(
                 "prompt must contain at least one token")
+        if self._vocab is not None and ids.size and (
+                int(ids.min()) < 0 or int(ids.max()) >= self._vocab):
+            # out-of-vocab ids would be silently CLAMPED by the
+            # embedding gather — garbage output conditioned on the
+            # wrong row; checked here (the pool owns the model) so
+            # direct pool users, the engine, and the HTTP boundary all
+            # fail fast with the same typed error
+            raise InvalidArgumentError(
+                "prompt token ids must be in [0, vocab_size=%d): "
+                "got range [%d, %d] — out-of-vocab ids would be "
+                "clamped to the wrong embedding row, not rejected "
+                "by the model" % (self._vocab, int(ids.min()),
+                                  int(ids.max())))
         if len(ids) + max_new_tokens > self.max_len:
             raise InvalidArgumentError(
                 "prompt %d + max_new_tokens %d exceeds cache max_len %d"
@@ -466,12 +481,11 @@ class GenerationPool:
                     (self.eos_id is not None and first == self.eos_id):
                 self._finish(slot)
 
-    def step(self) -> bool:
-        """Refill free slots, run ONE batched decode step; False when the
-        pool is drained (no queued or active requests)."""
-        self._refill()
-        if not self._active:
-            return bool(self._queue)
+    def _sync_step_inputs(self):
+        """The shared pre-step protocol (also the speculative pool's):
+        rebuild the device-resident token/active vectors when slot
+        membership changed, and lazily cache the weight value lists.
+        Returns ``(params, bufs)``."""
         if self._membership_dirty:
             active = np.zeros(self.slots, bool)
             active[list(self._active)] = True
@@ -480,7 +494,15 @@ class GenerationPool:
             self._membership_dirty = False
         if self._state_cache is None:
             self._state_cache = self._session._state_vals()
-        params, bufs = self._state_cache
+        return self._state_cache
+
+    def step(self) -> bool:
+        """Refill free slots, run ONE batched decode step; False when the
+        pool is drained (no queued or active requests)."""
+        self._refill()
+        if not self._active:
+            return bool(self._queue)
+        params, bufs = self._sync_step_inputs()
         self._cache, tok_dev, self._key = self._decode_jit(
             params, bufs, self._cache, self._tok_dev, self._active_dev,
             self._key)

@@ -1,0 +1,324 @@
+"""Slot-batched speculative decoding: the draft/verify pool variant.
+
+``SpeculativePool`` is ``GenerationPool`` with the decode step swapped
+for a speculative ROUND (jit/speculative.py has the single-request
+anatomy): a small draft model runs ``spec_k`` batched greedy decode
+steps over its own slot cache, then the target judges every slot's
+``[pending, d_1..d_K]`` chunk in ONE per-slot chunk forward — the
+multi-token append of ``_decode_forward``/``_paged_decode_forward``
+with a ``[slots]`` index vector, so EVERY slot accepts a different
+prefix length in the same fixed-shape dispatch.  Rejection rewinds by
+moving each row's index pointer; the rejected drafts' K/V become stale
+rows the next chunk overwrites (paged writes past a slot's reservation
+land in the scratch block through the padded table, exactly the
+slot-churn masking of docs/DESIGN.md §5b — scales included, §5d).
+
+Per ``step()``, each active slot emits between 1 and ``spec_k + 1``
+tokens (all of them EXACTLY what target-only greedy decode would have
+emitted); EOS inside an accepted chunk truncates the commit AT the EOS
+(``jit.truncate_at_eos``) — the accepted tail behind it is never
+emitted, matching the one-token-at-a-time loop's stopping point.
+
+Fixed compile budget on top of the base pool's: one draft prefill per
+bucket + ONE draft decode step (the round's K dispatches and the
+catch-up all reuse it) + one draft fixup + one draft slot-insert for
+the draft side; one target prefill per bucket + ONE verify step for the
+target — no compile ever depends on an acceptance length.
+
+The scheduler above (``serving.ServingEngine``) drives this pool
+through the unchanged ``submit``/``step``/``cancel``/``release``
+surface — lifecycle, deadlines and cancellation apply to speculative
+slots verbatim; the engine only gains an ``acceptance_rate`` gauge.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+from ..jit.decode import DecodeSession, truncate_at_eos
+from ..jit.speculative import (acceptance_summary, check_draft_compatible,
+                               greedy_accept)
+from .generation import GenerationPool
+
+__all__ = ["SpeculativePool"]
+
+
+class SpeculativePool(GenerationPool):
+    """Continuous batching whose step is a draft/verify round.
+
+    ``model`` is the target; ``draft_model`` a (typically much smaller)
+    causal model sharing the target's token id space (a typed error at
+    construction names both vocab sizes otherwise).  Greedy only — the
+    acceptance rule that preserves a SAMPLED target distribution is
+    rejection sampling, which is future work; greedy acceptance is
+    exact by construction, so the pool's output is token-identical to a
+    plain ``GenerationPool`` over the same target.
+
+    The target cache takes the usual ``cache_layout``/``cache_dtype``
+    knobs; the draft keeps a dense fp32 slot cache (it is small by
+    design — the paged/int8 machinery earns its complexity on the
+    target's HBM bill, not the draft's).
+
+    ``time_split=True`` accumulates a wall-clock draft/verify split
+    (blocking on each phase — measurement mode for bench.py, not for
+    serving, where blocking would serialize the dispatch pipeline).
+    """
+
+    def __init__(self, model, draft_model, max_len: int, spec_k: int = 4,
+                 slots: int = 4, buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None, cache_dtype="float32",
+                 donate: Optional[bool] = None, seed: int = 0,
+                 cache_layout: str = "dense", block_size: int = 32,
+                 num_blocks: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, time_split: bool = False):
+        if float(temperature) != 0.0:
+            raise InvalidArgumentError(
+                "speculative decoding is greedy-only (temperature=0): "
+                "got temperature=%r; use GenerationPool for sampled "
+                "generation" % (temperature,))
+        if int(spec_k) < 1:
+            raise InvalidArgumentError(
+                "spec_k must be >= 1 draft tokens per round, got %r"
+                % (spec_k,))
+        check_draft_compatible(draft_model, model)
+        # top_k/top_p are accepted (and forwarded) so the pool stays a
+        # DROP-IN for GenerationPool under ServingEngine's **pool_kwargs
+        # — at temperature=0 the base pool ignores them exactly as the
+        # plain pool does, rather than dying on an untyped TypeError
+        super().__init__(model, max_len, slots=slots, buckets=buckets,
+                         eos_id=eos_id, cache_dtype=cache_dtype,
+                         donate=donate, seed=seed, top_k=top_k,
+                         top_p=top_p,
+                         cache_layout=cache_layout, block_size=block_size,
+                         num_blocks=num_blocks)
+        self.spec_k = int(spec_k)
+        # the draft session owns the draft binding and its bucketed
+        # batch-1 prefill (compiled once per bucket); its decode step is
+        # unused — the pool's slot-batched draft step below replaces it
+        self._draft_session = DecodeSession(
+            draft_model, max_len, buckets=buckets, temperature=0.0,
+            donate=donate)
+        self._draft_cache = draft_model.gen_decode_cache(
+            self.slots, self.max_len, "float32", per_slot=True)
+        if donate is None:
+            donate = jax.default_backend() != "cpu"
+        dn = (2,) if donate else ()
+        self._draft_decode_jit = jax.jit(self._draft_decode,
+                                         donate_argnums=dn)
+        self._draft_fixup_jit = jax.jit(self._draft_fixup,
+                                        donate_argnums=dn)
+        self._draft_insert_jit = jax.jit(
+            self._draft_insert, donate_argnums=(0,) if donate else ())
+        self._verify_jit = jax.jit(self._pool_verify, donate_argnums=dn)
+        self._draft_state_cache = None
+        self._drafted = 0
+        self._accepted = 0
+        self._rounds = 0
+        self._time_split = bool(time_split)
+        self._draft_time_s = 0.0
+        self._verify_time_s = 0.0
+
+    # -- traced bodies ---------------------------------------------------
+    def _draft_decode(self, param_vals, buf_vals, cache, toks, active):
+        """One batched greedy draft step; inactive slots frozen (their
+        index does not advance) like the base pool's decode step."""
+        sess = self._draft_session
+        logits, new_cache = sess._run_model(param_vals, buf_vals,
+                                            toks[:, None], cache)
+        tok = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        new_cache = [c._replace(index=jnp.where(active, c.index,
+                                                old.index))
+                     for c, old in zip(new_cache, cache)]
+        return new_cache, jnp.where(active, tok, 0)
+
+    def _draft_fixup(self, param_vals, buf_vals, cache, toks, accepted,
+                     active):
+        """Post-verify draft maintenance, one dispatch: the catch-up
+        write (fully-accepted rows never wrote d_K's K/V — ``toks`` is
+        the d_K vector) plus the rejection REWIND (every active row's
+        index moves to its accepted prefix: active rows advanced exactly
+        ``spec_k`` during drafting, so the rewound index is
+        ``idx - spec_k + accepted + 1`` — for catch-up rows that equals
+        the position just written).  Rows with a partial acceptance also
+        write ``toks`` at their stale position; harmless, because the
+        next round's chunk overwrites every stale row before the index
+        could ever reach it."""
+        sess = self._draft_session
+        idx_pre = cache[0].index
+        _logits, new_cache = sess._run_model(param_vals, buf_vals,
+                                             toks[:, None], cache)
+        new_idx = jnp.where(active,
+                            idx_pre - self.spec_k + accepted + 1,
+                            idx_pre)
+        return [c._replace(index=new_idx) for c in new_cache]
+
+    def _draft_insert(self, pool_cache, row_cache, slot, length):
+        """Splice a batch-1 draft prefill into ``slot`` (dense fp32 —
+        the draft-side half of the base pool's ``_insert``)."""
+        out = []
+        for cp, cr in zip(pool_cache, row_cache):
+            out.append(cp._replace(
+                k=cp.k.at[slot].set(cr.k[0].astype(cp.k.dtype)),
+                v=cp.v.at[slot].set(cr.v[0].astype(cp.v.dtype)),
+                index=cp.index.at[slot].set(
+                    jnp.asarray(length, jnp.int32))))
+        return out
+
+    def _pool_verify(self, param_vals, buf_vals, cache, chunk, active):
+        """One per-slot chunk forward of the target over every slot's
+        ``[pending, d_1..d_K]``; acceptance, emission and the index
+        rewind all happen IN-TRACE, so the acceptance length is data
+        and the step compiles exactly once.  Inactive slots are frozen:
+        paged table rows masked to scratch before the write (slot-churn
+        discipline), emitted tokens zeroed, index unchanged."""
+        sess = self._session
+        idx0 = cache[0].index                                # [slots]
+        if self.cache_layout == "paged":
+            cache = [c._replace(table=jnp.where(active[:, None],
+                                                c.table, 0))
+                     for c in cache]
+        logits, new_cache = sess._run_model(param_vals, buf_vals, chunk,
+                                            cache)
+        m, emitted = greedy_accept(logits, chunk, active)    # [S], [S,K+1]
+        new_idx = jnp.where(active, idx0 + m + 1, idx0)
+        new_cache = [c._replace(index=new_idx) for c in new_cache]
+        # pending = each row's LAST emitted token, the next round's
+        # draft input — computed here so the steady state feeds straight
+        # back on-device
+        pending = jnp.take_along_axis(emitted, m[:, None], axis=1)[:, 0]
+        return new_cache, emitted, m, pending
+
+    # -- host API --------------------------------------------------------
+    def _refill(self):
+        """Base refill (target prefill + splice + first token) plus the
+        draft-side twin: every NEWLY admitted slot gets a draft prefill
+        of the same prompt spliced into the draft slot cache (the
+        draft's own sampled first token is discarded — the target's is
+        the ground truth the draft continues from)."""
+        before = {slot: st.rid for slot, st in self._active.items()}
+        pending_ids = {req.rid: req.ids for req in self._queue}
+        super()._refill()
+        for slot, st in self._active.items():
+            if before.get(slot) == st.rid:
+                continue
+            ids = pending_ids[st.rid]
+            row_cache, _tok, self._key = self._draft_session.prefill(
+                ids[None], self._key)
+            self._draft_cache = self._draft_insert_jit(
+                self._draft_cache, row_cache,
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(len(ids), jnp.int32))
+
+    def step(self) -> bool:
+        """Refill free slots, run ONE speculative round (K draft steps,
+        one verify, one draft fixup); every active slot commits 1 to
+        ``spec_k + 1`` tokens.  False when the pool is drained."""
+        self._refill()
+        if not self._active:
+            return bool(self._queue)
+        params, bufs = self._sync_step_inputs()
+        if self._draft_state_cache is None:
+            self._draft_state_cache = self._draft_session._state_vals()
+        dparams, dbufs = self._draft_state_cache
+        k = self.spec_k
+        t0 = time.perf_counter() if self._time_split else 0.0
+        d_toks = []
+        tok = self._tok_dev
+        for _ in range(k):
+            self._draft_cache, tok = self._draft_decode_jit(
+                dparams, dbufs, self._draft_cache, tok,
+                self._active_dev)
+            d_toks.append(tok)
+        chunk = jnp.concatenate(
+            [self._tok_dev[:, None]] + [x[:, None] for x in d_toks],
+            axis=1)
+        if self._time_split:
+            jax.block_until_ready(chunk)
+            t1 = time.perf_counter()
+            self._draft_time_s += t1 - t0
+        self._cache, emitted_dev, m_dev, pending_dev = self._verify_jit(
+            params, bufs, self._cache, chunk, self._active_dev)
+        if self._time_split:
+            jax.block_until_ready(m_dev)
+            self._verify_time_s += time.perf_counter() - t1
+        # catch-up + rewind for the draft cache (one dispatch; d_K is
+        # the catch-up token, rows that rewind ignore its write)
+        self._draft_cache = self._draft_fixup_jit(
+            dparams, dbufs, self._draft_cache, d_toks[-1], m_dev,
+            self._active_dev)
+        emitted = np.asarray(emitted_dev)
+        m_host = np.asarray(m_dev)
+        n_active = len(self._active)
+        self._rounds += 1
+        self._drafted += k * n_active
+        self._accepted += int(m_host[list(self._active)].sum())
+        for slot in list(self._active):
+            state = self._active[slot]
+            take = emitted[slot, :int(m_host[slot]) + 1] \
+                .astype(np.int32)[:state.remaining]
+            take = truncate_at_eos(take, self.eos_id)
+            state.tokens.extend(int(x) for x in take)
+            state.remaining -= len(take)
+            if self.on_token is not None:
+                for x in take:
+                    self.on_token(state.rid, int(x))
+            self._last_tok[slot] = int(take[-1])
+            if state.remaining == 0 or \
+                    (self.eos_id is not None and
+                     int(take[-1]) == self.eos_id):
+                self._finish(slot)
+        if not self._membership_dirty:
+            # steady state: every slot committed its full round, so the
+            # device-resident pending vector is already next round's
+            # draft input
+            self._tok_dev = pending_dev
+        return bool(self._active or self._queue)
+
+    def refresh_weights(self):
+        """Drop BOTH models' cached weight value lists (hot swap)."""
+        super().refresh_weights()
+        self._draft_state_cache = None
+
+    def acceptance_stats(self) -> dict:
+        """{'spec_k', 'rounds', 'drafted', 'accepted',
+        'acceptance_rate'} (+ the wall-clock ``draft_time_s`` /
+        ``verify_time_s`` split when ``time_split=True``) — the
+        measured quantities the serving gauge and the bench leg stamp."""
+        stats = acceptance_summary(self.spec_k, self._rounds,
+                                   self._drafted, self._accepted)
+        if self._time_split:
+            stats["draft_time_s"] = self._draft_time_s
+            stats["verify_time_s"] = self._verify_time_s
+        return stats
+
+    def reset_acceptance_stats(self) -> None:
+        """Zero the acceptance/time accounting — bench legs call this
+        between warmup and the timed region so the stamped rate covers
+        exactly what was measured."""
+        self._drafted = self._accepted = self._rounds = 0
+        self._draft_time_s = self._verify_time_s = 0.0
+
+    def compile_counts(self) -> dict:
+        """Base pool accounting plus the speculative executables: the
+        contract is that NONE of these grow with rounds or acceptance
+        lengths (pinned by tests)."""
+        counts = super().compile_counts()
+        # the target's 1-token steps are unused here: the verify chunk
+        # IS the target's decode step
+        counts.pop("decode", None)
+        counts.pop("pool_decode", None)
+        counts["verify"] = int(self._verify_jit._cache_size())
+        counts["draft_prefill"] = int(
+            self._draft_session._prefill_jit._cache_size())
+        counts["draft_decode"] = int(
+            self._draft_decode_jit._cache_size())
+        counts["draft_fixup"] = int(self._draft_fixup_jit._cache_size())
+        counts["draft_insert"] = int(
+            self._draft_insert_jit._cache_size())
+        return counts

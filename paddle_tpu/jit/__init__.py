@@ -44,7 +44,8 @@ from ..nn.layer.layers import Layer
 __all__ = [
     "to_static", "not_to_static", "StaticFunction", "InputSpec", "TrainStep",
     "MultiStepTrainStep", "DecodeSession", "sample_logits",
-    "FINISH_EOS", "FINISH_LENGTH", "classify_finish",
+    "FINISH_EOS", "FINISH_LENGTH", "classify_finish", "truncate_at_eos",
+    "SpeculativeDecodeSession", "check_draft_compatible",
     "save", "load", "TranslatedLayer", "ProgramTranslator", "TracedLayer",
     "set_code_level", "set_verbosity", "enable_to_static",
 ]
@@ -872,4 +873,6 @@ class TracedLayer:
 # loads after everything above is defined
 from .decode import (  # noqa: E402,F401
     FINISH_EOS, FINISH_LENGTH, DecodeSession, classify_finish,
-    sample_logits)
+    sample_logits, truncate_at_eos)
+from .speculative import (  # noqa: E402,F401
+    SpeculativeDecodeSession, check_draft_compatible)

@@ -42,7 +42,8 @@ from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 __all__ = ["DecodeSession", "sample_logits", "default_buckets",
-           "FINISH_EOS", "FINISH_LENGTH", "classify_finish"]
+           "FINISH_EOS", "FINISH_LENGTH", "classify_finish",
+           "truncate_at_eos"]
 
 # The decode layer's finish-reason vocabulary: a generation ends either
 # because the model emitted the EOS id or because the max_new_tokens
@@ -64,6 +65,27 @@ def classify_finish(tokens, eos_id) -> str:
     if eos_id is not None and toks.size and int(toks[-1]) == int(eos_id):
         return FINISH_EOS
     return FINISH_LENGTH
+
+
+def truncate_at_eos(tokens, eos_id):
+    """Truncate a 1-D emitted-token array at the FIRST ``eos_id``
+    (inclusive); with no EOS present (or ``eos_id=None``) the tokens
+    pass through unchanged.
+
+    This is the speculative COMMIT rule: a verify step may accept a
+    whole chunk of draft tokens at once, and an EOS anywhere inside the
+    accepted prefix ends the request THERE — the accepted tail after
+    the EOS (and the bonus token) must never be emitted, exactly as the
+    one-token-at-a-time decode loop would have stopped.  The truncated
+    array always ends on the EOS, so ``classify_finish`` sees
+    ``FINISH_EOS`` for it."""
+    toks = np.asarray(tokens)
+    if eos_id is None or toks.size == 0:
+        return toks
+    hits = np.nonzero(toks == int(eos_id))[0]
+    if hits.size:
+        return toks[:int(hits[0]) + 1]
+    return toks
 
 
 def sample_logits(logits, key, temperature: float = 0.0, top_k: int = 0,

@@ -315,25 +315,28 @@ class MultiHeadAttention(Layer):
             allow = jnp.arange(max_len)[None, :] <= q_pos[:, None]
             bias = jnp.where(allow, 0.0, neg)[None, None]       # [1,1,L,S]
         else:
-            # slot-batched decode: each row writes ONE token at its own
-            # position (scatter); chunked prefill stays per-request
-            if length != 1:
-                raise InvalidArgumentError(
-                    "per-slot DecodeCache decodes one token per step "
-                    "(query length 1), got query length %d; prefill each "
-                    "request with a scalar-index cache and insert it "
-                    "into the slot" % length)
-            rows = jnp.arange(b)
-            k_buf = k_buf.at[rows, :, idx, :].set(
-                k_new[:, :, 0, :].astype(k_buf.dtype))
-            v_buf = v_buf.at[rows, :, idx, :].set(
-                v_new[:, :, 0, :].astype(v_buf.dtype))
+            # slot-batched decode/verify: each row writes its L-token
+            # chunk at its OWN position — a scatter over [B, L]
+            # (row, pos) pairs.  L is 1 for the steady-state pool step
+            # and spec_k+1 for the speculative verify chunk; positions
+            # past max_len (a speculative tail overshooting the cache)
+            # are DROPPED by the scatter, never clamped onto valid rows.
+            rows = jnp.arange(b)[:, None]                       # [B,1]
+            pos = idx[:, None] + jnp.arange(length)[None, :]    # [B,L]
+            k_buf = k_buf.at[rows, :, pos, :].set(
+                k_new.transpose(0, 2, 1, 3).astype(k_buf.dtype),
+                mode="drop")
+            v_buf = v_buf.at[rows, :, pos, :].set(
+                v_new.transpose(0, 2, 1, 3).astype(v_buf.dtype),
+                mode="drop")
             if quant:
-                ks_buf = ks_buf.at[rows, :, idx].set(k_s[:, :, 0])
-                vs_buf = vs_buf.at[rows, :, idx].set(v_s[:, :, 0])
+                ks_buf = ks_buf.at[rows, :, pos].set(
+                    k_s.transpose(0, 2, 1), mode="drop")
+                vs_buf = vs_buf.at[rows, :, pos].set(
+                    v_s.transpose(0, 2, 1), mode="drop")
             allow = (jnp.arange(max_len)[None, None, :]
-                     <= idx[:, None, None])                     # [B,1,S]
-            bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,1,S]
+                     <= pos[:, :, None])                        # [B,L,S]
+            bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,L,S]
         if attn_mask is not None:
             # a caller's mask is keyed to the CHUNK length while the
             # score axis here is the cache length max_len — combining
@@ -346,8 +349,7 @@ class MultiHeadAttention(Layer):
                 "attn_mask=None, or use the uncached forward")
         out = decode_attention(q_, k_buf, v_buf, bias=bias,
                                k_scale=ks_buf, v_scale=vs_buf)
-        return out, self.DecodeCache(k_buf, v_buf,
-                                     idx + (length if idx.ndim == 0 else 1),
+        return out, self.DecodeCache(k_buf, v_buf, idx + length,
                                      ks_buf, vs_buf)
 
     def _paged_decode_forward(self, q, k_new, v_new, attn_mask, cache):
@@ -408,31 +410,34 @@ class MultiHeadAttention(Layer):
             allow = jnp.arange(s)[None, :] <= pos[:, None]
             bias = jnp.where(allow, 0.0, neg)[None, None]       # [1,1,L,S]
         else:
-            # slot-batched decode: ONE token per row at its own position
-            if length != 1:
-                raise InvalidArgumentError(
-                    "per-slot DecodeCache decodes one token per step "
-                    "(query length 1), got query length %d; prefill each "
-                    "request with a scalar-index cache and insert it "
-                    "into the slot" % length)
-            rows = jnp.arange(b)
-            phys = table[rows, idx // bs]                       # [B]
-            off = idx % bs
+            # slot-batched decode/verify: each row writes its L-token
+            # chunk at its OWN position, addressed through ITS table row
+            # (L=1 steady-state pool step, L=spec_k+1 speculative
+            # verify).  Positions past the table span are routed to the
+            # scratch block — the same masking discipline as slot churn
+            # — so a speculative tail can never clamp onto a real block.
+            rows = jnp.arange(b)[:, None]                       # [B,1]
+            pos = idx[:, None] + jnp.arange(length)[None, :]    # [B,L]
+            logical = jnp.minimum(pos // bs, table.shape[1] - 1)
+            phys = jnp.where(pos < s, table[rows, logical], 0)  # [B,L]
+            off = pos % bs
             k_pool = k_pool.at[phys, :, off, :].set(
-                k_new[:, :, 0, :].astype(k_pool.dtype))
+                k_new.transpose(0, 2, 1, 3).astype(k_pool.dtype))
             v_pool = v_pool.at[phys, :, off, :].set(
-                v_new[:, :, 0, :].astype(v_pool.dtype))
+                v_new.transpose(0, 2, 1, 3).astype(v_pool.dtype))
             if quant:
-                ks_pool = ks_pool.at[phys, :, off].set(k_s[:, :, 0])
-                vs_pool = vs_pool.at[phys, :, off].set(v_s[:, :, 0])
+                ks_pool = ks_pool.at[phys, :, off].set(
+                    k_s.transpose(0, 2, 1))
+                vs_pool = vs_pool.at[phys, :, off].set(
+                    v_s.transpose(0, 2, 1))
             allow = (jnp.arange(s)[None, None, :]
-                     <= idx[:, None, None])                     # [B,1,S]
-            bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,1,S]
+                     <= pos[:, :, None])                        # [B,L,S]
+            bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,L,S]
         out = paged_decode_attention(q_, k_pool, v_pool, table, bias=bias,
                                      k_scale=ks_pool, v_scale=vs_pool)
         return out, cache._replace(
             k=k_pool, v=v_pool, k_scale=ks_pool, v_scale=vs_pool,
-            index=idx + (length if idx.ndim == 0 else 1))
+            index=idx + length)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         from ... import tensor as T

@@ -212,8 +212,13 @@ def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None,
     preallocated cache [B, H, S, D] (S = max_len), with ``bias`` masking
     the invalid tail (positions at or beyond the cache index) to -inf.
 
-    Lq is the current chunk (1 for autoregressive decode); the math is
-    deliberately identical to the XLA fallback in
+    Lq is the current chunk: 1 for autoregressive decode, spec_k+1 for
+    a speculative VERIFY step (jit/speculative.py) — the verify chunk
+    reuses this composition unchanged, which is why speculative logits
+    equal plain decode logits up to reduction order, and why the
+    single-query kernel gate below admits short chunks (Lq <= 8), not
+    just Lq == 1.  The math is deliberately identical to the XLA
+    fallback in
     ``F.scaled_dot_product_attention`` so cached and uncached logits
     agree to float-reduction noise.  Masked (garbage) cache positions
     contribute exp(-inf) == 0 to the softmax, so preallocation never
@@ -277,7 +282,9 @@ def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
                            k_scale=None, v_scale=None):
     """Decode-step attention against a BLOCK-TABLE KV cache.
 
-    ``q``: [B, H, Lq, D] queries (Lq = 1 for autoregressive decode).
+    ``q``: [B, H, Lq, D] queries (Lq = 1 for autoregressive decode,
+    spec_k+1 for a speculative verify chunk — same reuse discipline as
+    ``decode_attention``).
     ``k_pool``/``v_pool``: [num_blocks, H, block_size, D] global block
     pools shared by every row.  ``table``: [B, max_blocks] int32 — row
     b's logical block j lives in physical pool row ``table[b, j]``

@@ -22,6 +22,7 @@ cached decode step as a component inside a request scheduler; this
 package is that scheduler.
 """
 from .engine import QueueFullError, ServingEngine
+from .http import ServingHTTPFrontend, parse_generate_request
 from .metrics import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry)
 from .stream import RequestState, ResponseStream, StreamStatus
@@ -31,4 +32,5 @@ __all__ = [
     "ResponseStream", "StreamStatus", "RequestState",
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "DEFAULT_TIME_BUCKETS",
+    "ServingHTTPFrontend", "parse_generate_request",
 ]

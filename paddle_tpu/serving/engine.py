@@ -85,17 +85,42 @@ class ServingEngine:
     pool knobs (``slots``, ``buckets``, ``cache_layout``,
     ``block_size``, ``num_blocks``, ``eos_id``, sampling config, ...)
     pass through ``**pool_kwargs``.  ``clock`` injects a monotonic time
-    source so deadline tests are deterministic."""
+    source so deadline tests are deterministic.
+
+    ``draft_model`` switches the engine onto the speculative pool
+    variant (``inference.SpeculativePool``): the scheduler is
+    UNCHANGED — lifecycle, deadlines, cancellation and streaming apply
+    to speculative slots verbatim (a tick just commits 1..``spec_k``+1
+    tokens per slot instead of one) — and the engine gains only the
+    ``serving_acceptance_rate`` gauge."""
 
     def __init__(self, model, max_len: int, slots: int = 4,
                  max_queue: int = 64, clock=None,
                  metrics: Optional[MetricsRegistry] = None,
+                 draft_model=None, spec_k: Optional[int] = None,
                  **pool_kwargs):
         if int(max_queue) < 1:
             raise InvalidArgumentError(
                 "max_queue must be >= 1, got %r" % (max_queue,))
-        self._pool = GenerationPool(model, max_len, slots=slots,
-                                    **pool_kwargs)
+        if draft_model is not None:
+            from ..inference.speculative import SpeculativePool
+
+            self._pool = SpeculativePool(model, draft_model, max_len,
+                                         spec_k=4 if spec_k is None
+                                         else spec_k, slots=slots,
+                                         **pool_kwargs)
+        elif spec_k is not None:
+            # spec_k without a draft would silently run un-speculated;
+            # the operator would only notice the missing acceptance
+            # gauge on /metrics
+            raise InvalidArgumentError(
+                "spec_k=%r was given without draft_model: speculative "
+                "decoding needs the draft — pass draft_model= (spec_k "
+                "then defaults to 4), or drop spec_k for a plain "
+                "engine" % (spec_k,))
+        else:
+            self._pool = GenerationPool(model, max_len, slots=slots,
+                                        **pool_kwargs)
         self.max_queue = int(max_queue)
         self._clock = clock if clock is not None else time.monotonic
         self._live: Dict[object, _Record] = {}
@@ -147,6 +172,10 @@ class ServingEngine:
             "serving_kv_free_blocks",
             "paged allocator free blocks") \
             if self._pool.cache_layout == "paged" else None
+        self._g_accept = m.gauge(
+            "serving_acceptance_rate",
+            "accepted draft tokens / drafted (speculative pool)") \
+            if hasattr(self._pool, "acceptance_stats") else None
         self._g_tps = m.gauge(
             "serving_tokens_per_sec",
             "tokens emitted / cumulative step time (StepTimer)")
@@ -320,6 +349,9 @@ class ServingEngine:
         self._g_kv_resident.set(stats["pool_bytes"])
         if self._g_kv_free is not None:
             self._g_kv_free.set(stats["free_blocks"])
+        if self._g_accept is not None:
+            self._g_accept.set(
+                pool.acceptance_stats()["acceptance_rate"])
         if self._timer.total:
             self._g_tps.set(self._tokens_total / self._timer.total)
             self._g_step.set(self._timer.step_time)
@@ -441,6 +473,13 @@ class ServingEngine:
     def cache_stats(self) -> dict:
         """Live KV accounting (``GenerationPool.cache_stats``)."""
         return self._pool.cache_stats()
+
+    def acceptance_stats(self) -> Optional[dict]:
+        """Speculative acceptance accounting
+        (``SpeculativePool.acceptance_stats``); None on a plain pool."""
+        if hasattr(self._pool, "acceptance_stats"):
+            return self._pool.acceptance_stats()
+        return None
 
     def request_state(self, request_id) -> Optional[str]:
         """Lifecycle state of a LIVE request (terminal states live on

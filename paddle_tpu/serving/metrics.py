@@ -117,6 +117,15 @@ class Histogram(_Metric):
         self.sum += v
         self._counts[bisect.bisect_left(self.buckets, v)] += 1
 
+    def reset(self) -> None:
+        """Zero all counts, keeping the bucket layout.  For callers that
+        warm a code path (compile, cache fill) before the measurement
+        window and must not let the warmup observations pollute
+        engine-lifetime quantiles."""
+        self._counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+
     def quantile(self, q: float) -> Optional[float]:
         if not 0.0 <= q <= 1.0:
             raise InvalidArgumentError(

@@ -12,6 +12,8 @@ comparable when measured under the same conditions as develop's).
 """
 import json
 
+import pytest
+
 import bench
 
 
@@ -162,6 +164,70 @@ def test_serving_leg_no_timed_subleg_rejected():
     leg = {"tokens_per_sec": 100.0, "transfer_note": "negligible"}
     ok, why = bench._leg_promotable("serving", leg)
     assert not ok and "cache_layout" in why
+
+
+def test_speculative_leg_missing_acceptance_rejected():
+    # a speculative tokens/s number without its acceptance-rate stamp
+    # cannot say whether it measured a draft that mostly landed or
+    # mostly wasted work — unpromotable
+    leg = {"tokens_per_sec": 800.0, "transfer_note": "negligible",
+           "selfdraft_batch8": {"tokens_per_sec": 800.0,
+                                "cache_layout": "dense",
+                                "cache_dtype": "float32"}}
+    ok, why = bench._leg_promotable("speculative", leg)
+    assert not ok and "acceptance_rate" in why
+
+
+def test_speculative_leg_missing_layout_rejected():
+    leg = {"tokens_per_sec": 800.0, "transfer_note": "negligible",
+           "selfdraft_batch8": {"tokens_per_sec": 800.0,
+                                "acceptance_rate": 1.0}}
+    ok, why = bench._leg_promotable("speculative", leg)
+    assert not ok and "cache_layout" in why
+
+
+def test_speculative_leg_with_stamps_promotes():
+    # the plain_* baseline sub-leg drafts nothing and is exempt from
+    # the acceptance stamp; speculative sub-legs carry it
+    leg = {"tokens_per_sec": 900.0, "transfer_note": "negligible",
+           "plain_batch8": {"tokens_per_sec": 700.0,
+                            "cache_layout": "dense",
+                            "cache_dtype": "float32"},
+           "selfdraft_batch8": {"tokens_per_sec": 900.0,
+                                "cache_layout": "dense",
+                                "cache_dtype": "float32",
+                                "acceptance_rate": 0.97}}
+    ok, why = bench._leg_promotable("speculative", leg)
+    assert ok, why
+
+
+def test_speculative_leg_no_timed_subleg_rejected():
+    leg = {"tokens_per_sec": 900.0, "transfer_note": "negligible"}
+    ok, why = bench._leg_promotable("speculative", leg)
+    assert not ok
+
+
+@pytest.mark.slow
+def test_live_speculative_leg_passes_its_own_gate():
+    """The leg bench.py actually emits must satisfy the gate it ships
+    with (a CPU-smoke run of the real leg, not a hand-built dict) —
+    slow-marked: it builds three pools over two fresh models (~6s,
+    over the conftest's 5s tier-1 line); the gate LOGIC stays covered
+    by the fast hand-built-dict cases above."""
+    import jax
+
+    import paddle_tpu as pt
+
+    leg = bench.bench_speculative(pt, jax, False)
+    ok, why = bench._leg_promotable("speculative", leg)
+    assert ok, why
+    for key in ("selfdraft_batch4", "smalldraft_batch4"):
+        sub = leg[key]
+        assert 0.0 <= sub["acceptance_rate"] <= 1.0
+        assert sub["tokens_per_sec"] > 0
+        assert sub["draft_time_s"] >= 0 and sub["verify_time_s"] >= 0
+    # the self-draft guesses ARE the target's continuations
+    assert leg["selfdraft_batch4"]["acceptance_rate"] > 0.9
 
 
 def test_resnet_mfu_formula_pinned():

@@ -283,11 +283,33 @@ def test_decode_cache_rejects_additive_mask():
         m(pt.to_tensor(ids), mask, cache=cache)
 
 
-def test_per_slot_cache_rejects_chunk_decode():
+def test_per_slot_cache_chunk_write_matches_sequential():
+    # the speculative verify path: a per-slot cache accepts an L-token
+    # chunk whose logits (and cache writes) must equal feeding the same
+    # tokens one step at a time — the multi-token append is a cost
+    # change, never a math change
     m = _tiny_model()
-    cache = m.gen_decode_cache(2, 16, per_slot=True)
-    with pytest.raises(InvalidArgumentError, match="one token"):
-        m(pt.to_tensor(np.zeros((2, 3), np.int32)), cache=cache)
+    m.eval()
+    rng = np.random.RandomState(11)
+    ids = rng.randint(0, 128, (2, 4)).astype("int32")
+    chunk_cache = m.gen_decode_cache(2, 16, per_slot=True)
+    chunk_logits, chunk_cache = m(pt.to_tensor(ids), cache=chunk_cache)
+    step_cache = m.gen_decode_cache(2, 16, per_slot=True)
+    parts = []
+    for t in range(4):
+        lg, step_cache = m(pt.to_tensor(ids[:, t:t + 1]),
+                           cache=step_cache)
+        parts.append(np.asarray(lg.value))
+    np.testing.assert_allclose(np.asarray(chunk_logits.value),
+                               np.concatenate(parts, axis=1),
+                               atol=2e-4, rtol=2e-3)
+    for c_chunk, c_step in zip(chunk_cache, step_cache):
+        np.testing.assert_array_equal(np.asarray(c_chunk.index),
+                                      np.asarray(c_step.index))
+        np.testing.assert_allclose(np.asarray(c_chunk.k),
+                                   np.asarray(c_step.k), atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c_chunk.v),
+                                   np.asarray(c_step.v), atol=1e-5)
 
 
 def test_non_causal_model_rejected():

@@ -22,6 +22,7 @@ SCRIPTS = [
     ("06_deploy_inference.py", []),
     ("08_generate_serving.py", ["--tokens", "8"]),
     ("09_serving_engine.py", ["--tokens", "8"]),
+    ("10_http_serving.py", ["--tokens", "8"]),
 ]
 
 

@@ -1,0 +1,256 @@
+"""The stdlib HTTP front end over the serving engine.
+
+The tier-1 tests stay SINGLE-THREADED: the request handler is driven
+against an in-memory fake socket, so the handler thread IS the test
+thread and the engine runs in deterministic pump mode (stream iteration
+pumps it inline) — full request→stream→response coverage with no
+concurrency in the time budget.  One slow-marked test runs the real
+``ThreadingHTTPServer`` + ``urllib`` round trip.
+"""
+import io
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (ServingEngine, ServingHTTPFrontend,
+                                parse_generate_request)
+from paddle_tpu.serving.http import _make_handler
+
+
+def _tiny_model():
+    pt.seed(0)
+    return TransformerLM(vocab_size=128, hidden_size=32, num_layers=1,
+                         num_heads=2, intermediate_size=64,
+                         max_position=256, causal=True, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+# -- request parsing (pure, no engine) -----------------------------------
+
+def test_parse_generate_request_valid():
+    ids, max_new, rid, deadline = parse_generate_request(
+        json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4,
+                    "request_id": "job-1", "deadline_s": 2.5}).encode())
+    np.testing.assert_array_equal(ids, [1, 2, 3])
+    assert ids.dtype == np.int32
+    assert max_new == 4 and rid == "job-1" and deadline == 2.5
+    ids, max_new, rid, deadline = parse_generate_request(
+        b'{"prompt": [7], "max_new_tokens": 1}')
+    assert rid is None and deadline is None
+
+
+def test_parse_generate_request_malformed():
+    for body, why in ((b"not json", "JSON"),
+                      (b'[1, 2]', "object"),
+                      (b'{"max_new_tokens": 3}', "prompt"),
+                      (b'{"prompt": [], "max_new_tokens": 3}', "prompt"),
+                      (b'{"prompt": "abc", "max_new_tokens": 3}',
+                       "prompt"),
+                      (b'{"prompt": [1, true], "max_new_tokens": 3}',
+                       "prompt"),
+                      (b'{"prompt": [1]}', "max_new_tokens"),
+                      (b'{"prompt": [1], "max_new_tokens": 0}',
+                       "max_new_tokens"),
+                      (b'{"prompt": [1], "max_new_tokens": 2.5}',
+                       "max_new_tokens"),
+                      (b'{"prompt": [1], "max_new_tokens": 2, '
+                       b'"deadline_s": "soon"}', "deadline_s"),
+                      (b'{"prompt": [1], "max_new_tokens": 2, '
+                       b'"deadline_s": true}', "deadline_s"),
+                      (b'{"prompt": [34359738368], '
+                       b'"max_new_tokens": 2}', "int32"),
+                      (b'{"prompt": [1], "max_new_tokens": 2, '
+                       b'"request_id": {"a": 1}}', "request_id"),
+                      (b'{"prompt": [1], "max_new_tokens": 2, '
+                       b'"request_id": [1]}', "request_id")):
+        with pytest.raises(InvalidArgumentError, match=why):
+            parse_generate_request(body)
+
+
+# -- the handler against an in-memory socket (single-threaded) -----------
+
+class _FakeSocket:
+    """Just enough socket for BaseHTTPRequestHandler: the request bytes
+    come from a BytesIO, the response accumulates in ``out``."""
+
+    def __init__(self, data: bytes):
+        self._in = io.BytesIO(data)
+        self.out = io.BytesIO()
+
+    def makefile(self, mode, *args, **kwargs):
+        return self._in
+
+    def settimeout(self, value):  # handler sets its socket timeout
+        pass
+
+    def sendall(self, data):
+        self.out.write(data)
+
+    def close(self):
+        pass
+
+
+def _http(engine, method, path, body=b""):
+    """Run ONE request through the front end's handler class in-process;
+    returns (status_code, header dict, body bytes)."""
+    req = ("%s %s HTTP/1.1\r\nHost: t\r\nContent-Length: %d\r\n\r\n"
+           % (method, path, len(body))).encode() + body
+    sock = _FakeSocket(req)
+    _make_handler(engine)(sock, ("127.0.0.1", 0), None)
+    raw = sock.out.getvalue()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    lines = head.decode("latin-1").splitlines()
+    code = int(lines[0].split()[1])
+    headers = dict(l.split(": ", 1) for l in lines[1:] if ": " in l)
+    return code, headers, payload
+
+
+def test_post_generate_streams_tokens_and_status(model):
+    eng = ServingEngine(model, max_len=64, slots=2, buckets=[16])
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, 128, (6,)).tolist()
+    code, headers, payload = _http(
+        eng, "POST", "/generate",
+        json.dumps({"prompt": prompt, "max_new_tokens": 5}).encode())
+    assert code == 200
+    assert headers["Content-Type"] == "application/x-ndjson"
+    lines = [json.loads(l) for l in payload.splitlines()]
+    toks = [l["token"] for l in lines if "token" in l]
+    final = lines[-1]
+    assert final["done"] and final["state"] == "DONE"
+    assert final["finish_reason"] == "length"
+    assert final["tokens"] == toks and len(toks) == 5
+    assert final["prompt_tokens"] == 6 and final["new_tokens"] == 5
+    # token-identical to the engine-free baseline
+    from paddle_tpu.jit import DecodeSession
+    want = DecodeSession(model, max_len=64, buckets=[16]).generate(
+        np.asarray(prompt, np.int32)[None], 5)[0]
+    np.testing.assert_array_equal(np.asarray(toks, np.int32), want)
+
+
+def test_error_mapping(model):
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[16],
+                        max_queue=4)
+    # malformed body -> 400 with the actionable message
+    code, _, payload = _http(eng, "POST", "/generate",
+                             b'{"prompt": "nope"}')
+    assert code == 400 and b"prompt" in payload
+    # out-of-vocab prompt ids -> 400 naming the valid range (the
+    # embedding gather would otherwise CLAMP them into garbage output)
+    code, _, payload = _http(
+        eng, "POST", "/generate",
+        json.dumps({"prompt": [999999], "max_new_tokens": 2}).encode())
+    assert code == 400 and b"vocab" in payload
+    # duplicate of a LIVE request id -> 409 naming the id (a finished
+    # id becomes reusable, so the first "dup" is parked via the engine
+    # API instead of a drained HTTP stream)
+    eng.submit(np.zeros(4, np.int32), 4, request_id="dup")
+    code, _, payload = _http(
+        eng, "POST", "/generate",
+        json.dumps({"prompt": [1, 2], "max_new_tokens": 2,
+                    "request_id": "dup"}).encode())
+    assert code == 409 and b"dup" in payload
+    while eng.pump(16):
+        pass
+    # queue full -> retryable 503 with Retry-After
+    stuffed = ServingEngine(model, max_len=64, slots=1, buckets=[16],
+                            max_queue=1)
+    stuffed.submit(np.zeros(4, np.int32), 20)
+    stuffed.pump(1)  # admit it to the one slot (still decoding)
+    stuffed.submit(np.zeros(4, np.int32), 4)  # fills the queue
+    code, headers, payload = _http(
+        stuffed, "POST", "/generate",
+        json.dumps({"prompt": [1], "max_new_tokens": 2}).encode())
+    assert code == 503 and headers.get("Retry-After") == "1"
+    assert json.loads(payload)["retryable"] is True
+    # draining -> 503 without the retry hint
+    while stuffed.pump(16):
+        pass
+    stuffed.drain()
+    code, headers, payload = _http(
+        stuffed, "POST", "/generate",
+        json.dumps({"prompt": [1], "max_new_tokens": 2}).encode())
+    assert code == 503 and "Retry-After" not in headers
+    assert json.loads(payload)["retryable"] is False
+    # unknown paths -> 404 naming the two served endpoints
+    assert _http(eng, "GET", "/nope")[0] == 404
+    code, _, payload = _http(eng, "POST", "/nope", b"{}")
+    assert code == 404 and b"/generate" in payload
+    # a hand-crafted non-numeric Content-Length -> 400, never a dropped
+    # connection with no response body
+    for bad_len in (b"abc", b"-5"):
+        sock = _FakeSocket(b"POST /generate HTTP/1.1\r\nHost: t\r\n"
+                           b"Content-Length: " + bad_len + b"\r\n\r\n")
+        _make_handler(eng)(sock, ("127.0.0.1", 0), None)
+        raw = sock.out.getvalue()
+        assert b" 400 " in raw.splitlines()[0]
+        assert b"Content-Length" in raw
+    # an oversized Content-Length -> 413 BEFORE any body bytes are
+    # buffered (the cap is what stops one request OOMing the server)
+    sock = _FakeSocket(b"POST /generate HTTP/1.1\r\nHost: t\r\n"
+                       b"Content-Length: 8000000000\r\n\r\n")
+    _make_handler(eng)(sock, ("127.0.0.1", 0), None)
+    raw = sock.out.getvalue()
+    assert b" 413 " in raw.splitlines()[0]
+    assert b"limit" in raw
+
+
+def test_get_metrics_renders_prometheus(model):
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[16])
+    s = eng.submit(np.zeros(4, np.int32), 3)
+    while eng.pump(8):
+        pass
+    assert s.result(timeout_s=0).state == "DONE"
+    code, headers, payload = _http(eng, "GET", "/metrics")
+    assert code == 200
+    assert headers["Content-Type"].startswith("text/plain")
+    text = payload.decode()
+    assert "# TYPE serving_ttft_seconds histogram" in text
+    assert "serving_requests_completed_total 1" in text
+    assert text == eng.metrics.render_prometheus()
+
+
+# -- the real server (threaded: slow-marked per the tier-1 budget) -------
+
+@pytest.mark.slow
+def test_real_server_round_trip(model):
+    import urllib.error
+    import urllib.request
+
+    eng = ServingEngine(model, max_len=64, slots=2, buckets=[16]).start()
+    front = ServingHTTPFrontend(eng).start()
+    try:
+        base = "http://%s:%d" % front.address
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": [3, 1, 4],
+                             "max_new_tokens": 4}).encode(),
+            headers={"Content-Type": "application/json"})
+        lines = []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
+            for line in resp:
+                lines.append(json.loads(line))
+        assert lines[-1]["done"] and lines[-1]["new_tokens"] == 4
+        assert [l["token"] for l in lines[:-1]] == lines[-1]["tokens"]
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=30) as resp:
+            assert "serving_tokens_emitted_total" in resp.read().decode()
+        try:
+            urllib.request.urlopen(
+                urllib.request.Request(base + "/generate", data=b"bad"),
+                timeout=30)
+            raise AssertionError("malformed body must 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        front.shutdown()
+        eng.shutdown()

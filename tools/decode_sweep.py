@@ -24,9 +24,16 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
   tok/s and bytes columns for dense AND paged, so the bandwidth win is
   measured where it is claimed to live.
 
+- plain-vs-SPECULATIVE tokens/s with a ``--speculate K`` axis: the
+  draft/verify pool (``inference.SpeculativePool``, K draft tokens per
+  round against a 1-layer draft twin) timed against the plain pool at
+  the same batch; every speculative leg writes its tok/s AND its
+  measured acceptance-rate column to the report, so a speculative
+  number can never be read without knowing how many drafts landed.
+
 Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
      [--gen 64] [--block-sizes 16 32 64 128]
-     [--cache-dtypes float32 int8] [--cpu-smoke]
+     [--cache-dtypes float32 int8] [--speculate K] [--cpu-smoke]
      [--out decode_sweep.json]
 Writes the JSON report to --out (default: decode_sweep.json in the
 CWD — never into tools/, a measurement artifact is not source);
@@ -113,6 +120,64 @@ def sweep(pt, cfg, batches, buckets, gen, block_sizes, cache_dtypes):
     return legs, compiles
 
 
+def speculative_sweep(pt, cfg, batches, buckets, gen, spec_k):
+    """Plain-pool vs speculative-pool tokens/s per (bucket, batch),
+    with the measured acceptance rate stamped on every speculative
+    row.  The draft is the target geometry at num_layers=1 — the
+    structural configuration a deployment would run; with random
+    weights its acceptance is ~chance, which the column records
+    honestly (the tok/s number means nothing without it)."""
+    from paddle_tpu.inference import GenerationPool, SpeculativePool
+    from paddle_tpu.models import TransformerLM
+
+    pt.seed(0)
+    target = TransformerLM(**cfg, dropout=0.0)
+    pt.seed(1)
+    draft = TransformerLM(**dict(cfg, num_layers=1), dropout=0.0)
+    rng = np.random.RandomState(0)
+    legs = []
+    for bucket in buckets:
+        max_len = bucket + gen
+        for batch in batches:
+            prompts = [rng.randint(0, cfg["vocab_size"],
+                                   (bucket,)).astype("int32")
+                       for _ in range(batch)]
+
+            def timed(pool):
+                pool.generate([prompts[0]], 2)  # compile + warm
+                if hasattr(pool, "reset_acceptance_stats"):
+                    pool.reset_acceptance_stats()
+                walls = []
+                for _ in range(REPEATS):
+                    t0 = time.perf_counter()
+                    outs = pool.generate(prompts, gen)
+                    walls.append(time.perf_counter() - t0)
+                toks = sum(len(o) for o in outs)
+                return toks / float(np.median(walls))
+
+            plain_tps = timed(GenerationPool(target, max_len,
+                                             slots=batch,
+                                             buckets=[bucket]))
+            spec = SpeculativePool(target, draft, max_len,
+                                   spec_k=spec_k, slots=batch,
+                                   buckets=[bucket])
+            spec_tps = timed(spec)
+            rate = spec.acceptance_stats()["acceptance_rate"]
+            legs.append(dict(batch=batch, prefill=bucket, generated=gen,
+                             spec_k=spec_k, cache_layout="dense",
+                             cache_dtype="float32",
+                             plain_tokens_per_sec=round(plain_tps, 1),
+                             decode_tokens_per_sec=round(spec_tps, 1),
+                             speedup_vs_plain=round(
+                                 spec_tps / plain_tps, 4),
+                             acceptance_rate=round(rate, 4)))
+            print("bucket %-5d batch %-3d  speculative K=%d  "
+                  "%8.1f tok/s (plain %8.1f)  accept %.3f"
+                  % (bucket, batch, spec_k, spec_tps, plain_tps, rate),
+                  flush=True)
+    return legs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
@@ -128,6 +193,11 @@ def main():
                     default=["float32", "int8"],
                     help="KV cache storage dtypes to sweep (int8 = "
                          "quantized cache with per-head fp32 scales)")
+    ap.add_argument("--speculate", type=int, default=0, metavar="K",
+                    help="also sweep the speculative draft/verify pool "
+                         "at K draft tokens per round (0 = off); every "
+                         "speculative row records tok/s AND its "
+                         "measured acceptance rate")
     ap.add_argument("--cpu-smoke", action="store_true",
                     help="tiny model on CPU to exercise the harness")
     ap.add_argument("--out",
@@ -172,6 +242,11 @@ def main():
 
     legs, compiles = sweep(pt, cfg, args.batches, args.buckets, args.gen,
                            args.block_sizes, args.cache_dtypes)
+    spec_legs = None
+    if args.speculate > 0:
+        spec_legs = speculative_sweep(pt, cfg, args.batches,
+                                      args.buckets, args.gen,
+                                      args.speculate)
     report = {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime()),
               "backend": jax.devices()[0].device_kind,
@@ -182,8 +257,10 @@ def main():
               "repeats": REPEATS,
               "block_sizes": args.block_sizes,
               "cache_dtypes": args.cache_dtypes,
+              "spec_k": args.speculate or None,
               "compile_counts": compiles,
-              "legs": legs}
+              "legs": legs,
+              "speculative_legs": spec_legs}
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print("report:", args.out)
