@@ -36,9 +36,16 @@ class Generator:
 
     @property
     def _key(self) -> jax.Array:
-        if self._key_cache is None:
-            self._key_cache = jax.random.key(self._seed)
-        return self._key_cache
+        # the lazy build is shared mutable state: unguarded, two
+        # threads could interleave with a concurrent manual_seed and
+        # publish a key for the OLD seed after the reseed "completed"
+        # (tools/analysis lock-discipline).  No caller holds the lock
+        # while reading the property (next_key's critical section ends
+        # before the fold_in), so taking it here cannot deadlock.
+        with self._lock:
+            if self._key_cache is None:
+                self._key_cache = jax.random.key(self._seed)
+            return self._key_cache
 
     def manual_seed(self, seed: int) -> "Generator":
         with self._lock:

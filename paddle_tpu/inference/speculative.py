@@ -252,8 +252,11 @@ class SpeculativePool(GenerationPool):
         self._draft_cache = self._draft_fixup_jit(
             dparams, dbufs, self._draft_cache, d_toks[-1], m_dev,
             self._active_dev)
-        emitted = np.asarray(emitted_dev)
-        m_host = np.asarray(m_dev)
+        # ONE batched download for the round (tools/analysis
+        # host-sync-in-hot-path): device_get starts both transfers
+        # before blocking, where two np.asarray calls would pay two
+        # sequential host round trips per round over a thin transport
+        emitted, m_host = jax.device_get((emitted_dev, m_dev))
         n_active = len(self._active)
         self._rounds += 1
         self._drafted += k * n_active

@@ -451,11 +451,21 @@ class ServingEngine:
             with self._lock:
                 for rid in list(self._live):
                     self.cancel(rid)
-        if self._thread is not None:
+        t = self._thread  # snapshot: a concurrent shutdown may null it
+        if t is not None:
             self._stop.set()
             self._wake.set()
-            self._thread.join(timeout=10.0)
-            self._thread = None
+            t.join(timeout=10.0)
+            # the handle write goes back under the lock: a concurrent
+            # start()/pump() reads _thread to decide the drive mode.
+            # Only after a SUCCESSFUL join — a wedged tick outlives the
+            # join timeout still holding the lock, and acquiring it
+            # here would turn the bounded 10 s shutdown into an
+            # unbounded hang (tools/analysis lock-discipline)
+            if not t.is_alive():
+                with self._lock:
+                    if self._thread is t:
+                        self._thread = None
 
     # -- passthroughs / introspection ------------------------------------
     def refresh_weights(self) -> None:

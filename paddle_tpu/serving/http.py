@@ -254,7 +254,21 @@ class ServingHTTPFrontend:
         # connection threads die with the process; the engine drains
         # independently of them
         self._server.daemon_threads = True
+        # serializes start()/shutdown(): the serve thread handle is
+        # shared state, and a start racing a shutdown could leak a
+        # second serve thread on the closed socket (tools/analysis
+        # lock-discipline)
+        self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
+        # True once a serve loop was (or is about to be) entered —
+        # BaseServer.shutdown() waits on an event only serve_forever
+        # sets, so calling it with no loop ever run blocks forever
+        self._served = False
+        # True once shutdown() closed the listening socket: a later
+        # start() would spawn a serve thread on a dead fd that dies
+        # with an unraised selector error while clients see
+        # connection-refused — fail loudly instead
+        self._closed = False
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -262,19 +276,53 @@ class ServingHTTPFrontend:
         return host, port
 
     def start(self) -> "ServingHTTPFrontend":
-        if self._thread is None:
-            self._thread = threading.Thread(
-                target=self._server.serve_forever,
-                name="serving-http-frontend", daemon=True)
-            self._thread.start()
+        with self._lock:
+            self._check_open()
+            if self._served and self._thread is None:
+                # a blocking serve_forever() loop owns the server; a
+                # second loop on one socket would race BaseServer's
+                # one-shot shutdown event and leave a loop spinning on
+                # a closed fd at shutdown
+                raise PreconditionNotMetError(
+                    "frontend is already serving on the calling "
+                    "thread (serve_forever); one serve loop per "
+                    "frontend")
+            if self._thread is None:
+                self._served = True
+                self._thread = threading.Thread(
+                    target=self._server.serve_forever,
+                    name="serving-http-frontend", daemon=True)
+                self._thread.start()
         return self
 
     def serve_forever(self) -> None:
+        with self._lock:
+            self._check_open()
+            if self._served:
+                raise PreconditionNotMetError(
+                    "frontend is already serving (start() or a prior "
+                    "serve_forever); one serve loop per frontend")
+            self._served = True
         self._server.serve_forever()
 
+    def _check_open(self) -> None:
+        if self._closed:
+            raise PreconditionNotMetError(
+                "ServingHTTPFrontend was shut down (listening socket "
+                "closed); build a new frontend — the engine's "
+                "lifecycle is separate and unaffected")
+
     def shutdown(self) -> None:
-        self._server.shutdown()
-        self._server.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+        # the lock serializes against start(); the serve thread never
+        # takes it, so joining under the lock cannot deadlock.  Skipping
+        # BaseServer.shutdown() when no loop ever ran matters doubly
+        # here: the hang would now pin the lock too.
+        with self._lock:
+            if not self._closed:
+                if self._served:
+                    self._server.shutdown()
+                self._server.server_close()
+                self._closed = True
+            if self._thread is not None:
+                self._thread.join(timeout=10.0)
+                self._thread = None

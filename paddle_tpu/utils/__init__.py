@@ -94,7 +94,10 @@ def run_check() -> None:
     n = min(len(devs), 8)
     mesh = Mesh(np.array(devs[:n]), ("dp",))
     x = jax.device_put(jnp.ones((n, 2)), NamedSharding(mesh, P("dp")))
-    total = jax.jit(lambda v: v.sum())(x)
+    # eager sum, not jax.jit(lambda ...): an inline jitted lambda would
+    # compile fresh on every run_check call (tools/analysis
+    # retrace-hazard), and the check only needs the sharded reduction
+    total = x.sum()
     assert float(total) == 2 * n
     print("PaddlePaddle-TPU works well on 1 %s device." % backend)
     if n > 1:

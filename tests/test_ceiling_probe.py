@@ -10,11 +10,16 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PROBE = os.path.join(REPO, "tools", "ceiling_probe.py")
 REPORT = os.path.join(REPO, "tools", "ceiling_report.json")
 
 
+@pytest.mark.slow  # subprocess probe (fresh interpreter + warmup
+# matmul chains, up to 280s): tier-1 budget protection
+# (tools/analysis slow-marker)
 def test_cpu_smoke_report_contract(tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # a banked ON-CHIP report must survive this test: stash and restore

@@ -26,6 +26,9 @@ SCRIPTS = [
 ]
 
 
+@pytest.mark.slow  # one fresh interpreter + compile per script: the
+# suite costs minutes, which the tier-1 'not slow' budget cannot carry
+# (tools/analysis slow-marker)
 @pytest.mark.parametrize("script,args", SCRIPTS,
                          ids=[s for s, _ in SCRIPTS])
 def test_example_runs(script, args):

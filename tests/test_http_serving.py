@@ -254,3 +254,28 @@ def test_real_server_round_trip(model):
     finally:
         front.shutdown()
         eng.shutdown()
+
+
+def test_frontend_lifecycle_guards(model):
+    from paddle_tpu.core.errors import PreconditionNotMetError
+
+    eng = ServingEngine(model, max_len=64, slots=2, buckets=[16])
+    # shutdown before any serve loop: must return (BaseServer.shutdown
+    # would wait forever on an event only serve_forever sets), and be
+    # idempotent
+    f1 = ServingHTTPFrontend(eng)
+    f1.shutdown()
+    f1.shutdown()
+    with pytest.raises(PreconditionNotMetError):
+        f1.start()           # socket is closed: refuse, don't leak a
+    with pytest.raises(PreconditionNotMetError):
+        f1.serve_forever()   # dead serve thread on a dead fd
+    # one serve loop per frontend: a started frontend refuses a second
+    # blocking loop on the same socket
+    f2 = ServingHTTPFrontend(eng).start()
+    try:
+        assert f2.start() is f2          # idempotent
+        with pytest.raises(PreconditionNotMetError):
+            f2.serve_forever()
+    finally:
+        f2.shutdown()

@@ -10,6 +10,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 REFERENCE = "/root/reference"
 
 
+@pytest.mark.slow  # subprocess audit over the whole reference tree
+# (tools/analysis slow-marker); skipped anyway when /root/reference is
+# not mounted
 @pytest.mark.skipif(not os.path.isdir(REFERENCE),
                     reason="reference tree not mounted")
 def test_namespace_parity():

@@ -1,0 +1,37 @@
+"""Tracer-safety, compile-budget, and lock-discipline linter.
+
+The serving stack's correctness rests on invariants no test can
+exhaustively pin: exactly two compiles per session, no host sync inside
+the decode tick, every shared ``ServingEngine`` field mutated only under
+``self._lock``.  This package enforces them at review time with a pure
+stdlib-``ast`` pass (NO jax/numpy import — it runs in milliseconds
+inside tier-1), the framework-level analog of the reference's C++-side
+``PADDLE_ENFORCE`` static discipline.
+
+Pieces:
+
+- :mod:`.engine` — repo walker, AST index, the lightweight
+  call-reachability graph (jit-attr bindings, ``self.X = Class()``
+  type inference, annotated dynamic-dispatch edges), the rule registry
+  and the baseline machinery.
+- :mod:`.rules` — the seven rules (docs/DESIGN.md §6 has the
+  catalogue): host-sync-in-hot-path, traced-branch, retrace-hazard,
+  donation-reuse, lock-discipline, slow-marker, unblocked-timing.
+- :mod:`.config` — hot-path roots, jit roots, and the explicit
+  dynamic-dispatch edges static analysis cannot see.
+- ``baseline.json`` — grandfathered findings, each with a per-entry
+  justification string.  ``python -m tools.analysis`` exits nonzero on
+  any finding NOT covered by the baseline.
+
+CLI::
+
+    python -m tools.analysis                  # human report, exit 0/1
+    python -m tools.analysis --json           # machine report (PR diffs)
+    python -m tools.analysis --update-baseline  # re-grandfather
+"""
+from .engine import (Baseline, Finding, RepoIndex, load_baseline,
+                     run_analysis)
+from .rules import ALL_RULES
+
+__all__ = ["Finding", "RepoIndex", "Baseline", "load_baseline",
+           "run_analysis", "ALL_RULES"]

@@ -1,0 +1,118 @@
+"""CLI: ``python -m tools.analysis [--json] [--update-baseline]``.
+
+Exit codes: 0 clean (every finding baselined), 1 non-baselined findings
+(each printed as ``rule file:line``), 2 internal/usage error.  ``--json``
+emits the full machine-readable report (PR-over-PR finding-count diffs
+for CHANGES.md); ``--update-baseline`` rewrites ``baseline.json`` from
+the current findings, PRESERVING existing justification strings whose
+keys still match (new entries get a TODO placeholder the reviewer must
+replace).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .engine import (Baseline, default_baseline_path, load_baseline,
+                     repo_root, run_analysis)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="tracer-safety / compile-budget / lock-discipline "
+                    "linter (stdlib ast only; no jax import)")
+    ap.add_argument("--root", default=None,
+                    help="tree to analyze (default: the repo root)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: tools/analysis/"
+                         "baseline.json)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the machine-readable report")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings, "
+                         "keeping matching justifications")
+    ap.add_argument("--rule", action="append", default=None,
+                    help="run only this rule id (repeatable)")
+    args = ap.parse_args(argv)
+
+    from .rules import ALL_RULES
+
+    rules = ALL_RULES
+    if args.rule:
+        known = {r.id for r in ALL_RULES}
+        bad = [r for r in args.rule if r not in known]
+        if bad:
+            print("unknown rule id(s) %s; known: %s"
+                  % (bad, sorted(known)), file=sys.stderr)
+            return 2
+        rules = [r for r in ALL_RULES if r.id in args.rule]
+
+    root = repo_root() if args.root is None else args.root
+    baseline_path = default_baseline_path() if args.baseline is None \
+        else args.baseline
+
+    if args.update_baseline:
+        report = run_analysis(root, rules=rules, baseline=Baseline([]))
+        old = load_baseline(baseline_path)
+        new = Baseline.from_findings(report["all_findings"], old=old)
+        if args.rule:
+            # a filtered run regenerates ONLY the filtered rules'
+            # entries; every other rule's entries (and their hand-
+            # written justifications) ride through untouched
+            keep = [e for e in old.entries
+                    if e["rule"] not in set(args.rule)]
+            new.entries = sorted(
+                keep + new.entries,
+                key=lambda e: (e["rule"], e["file"], e["detail"]))
+        new.dump(baseline_path)
+        todo = sum(1 for e in new.entries
+                   if e["justification"].startswith("TODO"))
+        print("baseline updated: %d entries (%d findings) -> %s"
+              % (len(new.entries), report["total_findings"],
+                 baseline_path))
+        if todo:
+            print("%d entries carry a TODO justification — fill them "
+                  "in before committing" % todo)
+        return 0
+
+    baseline = load_baseline(baseline_path)
+    if args.rule:
+        # a filtered run must judge only the selected rules' baseline
+        # slice — the unselected rules' entries are not "stale", they
+        # just were not exercised
+        sel = set(args.rule)
+        baseline = Baseline([e for e in baseline.entries
+                             if e["rule"] in sel])
+    report = run_analysis(root, rules=rules, baseline=baseline)
+    findings = report["findings"]
+    if args.json:
+        payload = {k: v for k, v in report.items()
+                   if k not in ("findings", "all_findings")}
+        payload["findings"] = [f.to_dict() for f in findings]
+        payload["exit_code"] = 1 if findings else 0
+        json.dump(payload, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+        return 1 if findings else 0
+
+    for err in report["parse_errors"]:
+        print("parse error: %s" % err, file=sys.stderr)
+    for f in findings:
+        print("%-22s %-10s %s  [%s]  %s"
+              % (f.rule, f.severity, f.location(), f.scope, f.message))
+    stale = report["stale_baseline_entries"]
+    if stale:
+        print("note: %d stale baseline entr%s (unused suppression "
+              "budget) — run --update-baseline to prune:"
+              % (len(stale), "y" if len(stale) == 1 else "ies"))
+        for e in stale[:10]:
+            print("  %s %s %s" % (e["rule"], e["file"], e["detail"]))
+    print("scanned %d files: %d findings, %d baselined, %d new"
+          % (report["files_scanned"], report["total_findings"],
+             report["suppressed_by_baseline"], len(findings)))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

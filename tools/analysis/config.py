@@ -1,0 +1,87 @@
+"""Analysis configuration: walk roots, hot-path roots, dynamic edges.
+
+Everything here is DATA the engine/rules consume, so the policy (what
+counts as the decode hot path, which dynamic dispatch points exist) is
+reviewable in one place instead of buried in rule code.
+"""
+
+# Trees the engine walks, relative to the repo root.  bench.py is a
+# single file; missing entries are skipped (fixture trees in tests pass
+# a bare tmp directory, which falls back to "every .py under root").
+WALK_ROOTS = ("paddle_tpu", "tools", "tests", "bench.py")
+
+# Directories never walked (caches, VCS).
+SKIP_DIRS = {".git", "__pycache__", ".jax_cache", ".pytest_cache"}
+
+# -- hot-path roots (rule: host-sync-in-hot-path) ------------------------
+# Functions whose transitive callees form the decode hot path: the
+# compiled step fns of DecodeSession, the pool/engine tick, and the
+# host-driven decode loops.  Matched against qualname suffixes
+# ("Class.method" or bare function name).
+HOT_ROOTS = (
+    "DecodeSession._prefill",
+    "DecodeSession._decode",
+    "GenerationPool.step",
+    "SpeculativePool.step",
+    "ServingEngine._tick",
+    # host-driven seq2seq decode loop (nn/decode.py): eager by design,
+    # but its per-step body is hot all the same
+    "dynamic_decode",
+)
+
+# -- dynamic-dispatch edges the AST cannot resolve -----------------------
+# caller qualname suffix -> callee qualname suffixes.  These annotate
+# the three dynamic seams of the decode path: the session's model
+# indirection (self._model(...)), container iteration over LayerList,
+# and the pool's serving-layer lifecycle hooks.  Keeping them explicit
+# is the deal static analysis makes with dynamic dispatch — a new seam
+# needs a new line here, which review can see.
+EXTRA_EDGES = {
+    "DecodeSession._run_model": ("TransformerLM.forward",),
+    "TransformerEncoder.forward": ("TransformerEncoderLayer.forward",),
+    "TransformerDecoder.forward": ("TransformerDecoderLayer.forward",),
+    "GenerationPool.step": ("ServingEngine._on_token",
+                            "ServingEngine._on_finish"),
+    "GenerationPool._refill": ("ServingEngine._on_admit",
+                               "ServingEngine._on_token",
+                               "ServingEngine._on_finish"),
+    "SpeculativePool.step": ("ServingEngine._on_token",
+                             "ServingEngine._on_finish"),
+    "ServingEngine._finalize": ("ResponseStream._finalize",),
+    "dynamic_decode": ("BeamSearchDecoder.initialize",
+                       "BeamSearchDecoder.step",
+                       "BeamSearchDecoder.finalize"),
+}
+
+# -- host-sync markers (rule: host-sync-in-hot-path) ---------------------
+# numpy-module functions that materialize their argument on host.
+NP_SYNC_FUNCS = {"asarray", "array", "stack", "concatenate"}
+# jax-module functions that block / transfer.
+JAX_SYNC_FUNCS = {"device_get", "block_until_ready"}
+# builtins that force a traced value to host when applied to device math
+# (only flagged when the argument contains a jax/jnp call — shape ints
+# and python config scalars stay quiet).
+BUILTIN_SYNC_FUNCS = {"float", "int", "bool"}
+# attribute calls that always materialize.
+ATTR_SYNC_CALLS = {"item", "tolist"}
+
+# -- lock discipline (rule: lock-discipline) -----------------------------
+# Mutating method names that count as a write to ``self.X`` when called
+# as ``self.X.<name>(...)``.  Deliberately excludes ``set`` (Gauge.set /
+# Event.set are thread-safe by design) and queue put/get.
+MUTATOR_METHODS = {
+    "pop", "popleft", "append", "appendleft", "extend", "add", "remove",
+    "discard", "clear", "insert", "update", "setdefault",
+}
+
+# -- timing (rule: unblocked-timing) -------------------------------------
+# Calls considered benign inside a timed span (pure host work).
+BENIGN_SPAN_CALLS = {
+    "append", "extend", "len", "print", "range", "zip", "min", "max",
+    "sorted", "sum", "join", "split", "format", "get", "items", "keys",
+    "values", "perf_counter", "time", "monotonic", "round", "abs",
+    "list", "tuple", "dict", "set", "str", "repr", "enumerate",
+}
+# In-span calls that make a timing span honest (explicit sync).
+SPAN_SYNC_CALLS = {"block_until_ready", "device_get", "asarray", "array",
+                   "item", "float", "int", "tolist"}

@@ -1,0 +1,778 @@
+"""The seven rules (docs/DESIGN.md §6 has the operator-facing catalogue).
+
+Every rule is a pure function of the :class:`~.engine.RepoIndex`; rules
+never import jax/numpy and never execute repo code.  A rule errs toward
+flagging — the checked-in baseline (with per-entry justification
+strings) is where intentional host boundaries are recorded, so "this
+sync is the design" is a reviewable artifact instead of tribal
+knowledge.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import config
+from .engine import (_BUILTIN_NAMES, Finding, FuncInfo, RepoIndex,
+                     _contains_jax_math, _detail_of, _dotted,
+                     _donated_positions, _is_jit_call)
+
+__all__ = ["ALL_RULES", "Rule"]
+
+
+class Rule:
+    id = "abstract"
+    severity = "error"
+    description = ""
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, file: str, node: ast.AST, scope: str, message: str,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(self.id, severity or self.severity, file,
+                       getattr(node, "lineno", 0), scope, message,
+                       _detail_of(node))
+
+
+def _parents(root: ast.AST) -> Dict[ast.AST, ast.AST]:
+    out: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(root):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _enclosing_stmt(node: ast.AST,
+                    parents: Dict[ast.AST, ast.AST]) -> ast.AST:
+    while node in parents and not isinstance(node, ast.stmt):
+        node = parents[node]
+    return node
+
+
+# -- 1. host-sync-in-hot-path --------------------------------------------
+class HostSyncInHotPath(Rule):
+    """``.item()``/``float()``/``np.asarray``/``np.array``/
+    ``jax.device_get`` (and friends) inside functions reachable from
+    the decode hot path's roots.  Every hit is either a designed host
+    boundary (baseline it, with the justification saying WHY the sync
+    is the contract) or a regression that will serialize the decode
+    tick on host round-trips."""
+
+    id = "host-sync-in-hot-path"
+    severity = "error"
+    description = ("host synchronization inside the decode hot path "
+                   "(reachable from %s)" % (", ".join(config.HOT_ROOTS),))
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        hot = index.reachable(config.HOT_ROOTS)
+        out: List[Finding] = []
+        for fi in index.functions.values():
+            if fi.qualname not in hot:
+                continue
+            info = index.files[fi.file]
+            params = {p for p in fi.params if p != "self"}
+            for node in fi.calls:
+                msg = self._classify(node, info, params)
+                if msg is not None:
+                    out.append(self.finding(
+                        fi.file, node, fi.qualname,
+                        "%s in hot-path function %s" % (msg,
+                                                        fi.qualname)))
+        return out
+
+    def _classify(self, call: ast.Call, info,
+                  params: Set[str]) -> Optional[str]:
+        func = call.func
+        dotted = _dotted(func) or ""
+        parts = dotted.split(".")
+        if len(parts) == 2 and parts[0] in info.np_aliases \
+                and parts[1] in config.NP_SYNC_FUNCS:
+            return "numpy materialization %s()" % dotted
+        if len(parts) >= 2 and parts[0] in info.jax_aliases \
+                and parts[-1] in config.JAX_SYNC_FUNCS:
+            return "explicit device sync %s()" % dotted
+        if isinstance(func, ast.Attribute) \
+                and func.attr in config.ATTR_SYNC_CALLS \
+                and not call.args:
+            return "host materialization .%s()" % func.attr
+        if isinstance(func, ast.Name) \
+                and func.id in config.BUILTIN_SYNC_FUNCS and call.args:
+            # a cast of jnp math, or of a hot-function PARAMETER (the
+            # value flowing through the hot path is presumptively
+            # device-resident); locals derived from an already-
+            # downloaded np array stay quiet
+            if any(_contains_jax_math(a, info) for a in call.args) \
+                    or any(isinstance(n, ast.Name) and n.id in params
+                           for a in call.args for n in ast.walk(a)):
+                return "builtin %s() forcing a traced value to host" \
+                    % func.id
+        return None
+
+
+# -- 2. traced-branch ----------------------------------------------------
+class TracedBranch(Rule):
+    """Python ``if``/``while``/``assert`` on a function parameter inside
+    jit-traced code.  A traced-array test raises at trace time at best,
+    silently freezes one branch into the compile at worst; python-static
+    config branches (sampling config) are the legitimate case — baseline
+    them so NEW data-dependent branches can't ride in quietly."""
+
+    id = "traced-branch"
+    severity = "error"
+    description = ("python control flow on a parameter of a jit-traced "
+                   "function")
+
+    _STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        traced = index.jit_traced()
+        out: List[Finding] = []
+        for fi in index.functions.values():
+            if fi.qualname not in traced:
+                continue
+            params = {p for p in fi.params if p != "self"} \
+                - index.jit_static_params(fi.qualname)
+            if not params:
+                continue
+            parents = _parents(fi.node)
+            for node in ast.walk(fi.node):
+                if isinstance(node, (ast.If, ast.While)):
+                    test = node.test
+                elif isinstance(node, ast.Assert):
+                    test = node.test
+                else:
+                    continue
+                if self._references_param_dynamically(test, params,
+                                                      parents):
+                    kind = type(node).__name__.lower()
+                    out.append(self.finding(
+                        fi.file, node, fi.qualname,
+                        "python %s on parameter of jit-traced %s — "
+                        "traced values cannot branch; python-static "
+                        "config must be baselined as such"
+                        % (kind, fi.qualname)))
+        return out
+
+    def _references_param_dynamically(self, test, params, parents) -> bool:
+        hit = False
+        for sub in ast.walk(test):
+            if not (isinstance(sub, ast.Name) and sub.id in params):
+                continue
+            if self._is_static_use(sub, parents, test):
+                continue
+            hit = True
+        return hit
+
+    def _is_static_use(self, name: ast.Name, parents, stop) -> bool:
+        """True when the param reference only feeds trace-static
+        machinery: ``x is None``, ``isinstance(x, ...)``,
+        ``x.shape``/``x.ndim``/``x.dtype``/``x.size``, ``len(x)``."""
+        node = name
+        while node is not stop and node in parents:
+            parent = parents[node]
+            if isinstance(parent, ast.Attribute) \
+                    and parent.attr in self._STATIC_ATTRS:
+                return True
+            if isinstance(parent, ast.Call):
+                callee = _dotted(parent.func) or ""
+                if callee in ("isinstance", "len", "hasattr", "getattr",
+                              "type"):
+                    return True
+            if isinstance(parent, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in parent.ops):
+                return True
+            node = parent
+        return False
+
+
+# -- 3. retrace-hazard ---------------------------------------------------
+class RetraceHazard(Rule):
+    """Compile-budget leaks: ``jax.jit`` evaluated inside a loop (one
+    fresh compile cache per iteration), an inline
+    ``jax.jit(...)(...)``-and-discard in library code (a fresh callable
+    — and compile — per invocation of the enclosing function), and
+    f-string dict keys inside traced code (pytree structure that varies
+    with runtime strings retraces per key set)."""
+
+    id = "retrace-hazard"
+    severity = "warning"
+    description = "compile-cache/pytree-structure retrace hazards"
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        traced = index.jit_traced()
+        for fi in index.functions.values():
+            info = index.files[fi.file]
+            jit_calls = {c for c in fi.calls if _is_jit_call(c, info)}
+            is_traced = fi.qualname in traced
+            if not jit_calls and not is_traced:
+                continue
+            parents = _parents(fi.node)
+            in_tests = fi.file.startswith("tests")
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call) and node in jit_calls:
+                    if self._in_loop(node, parents, fi.node):
+                        out.append(self.finding(
+                            fi.file, node, fi.qualname,
+                            "jax.jit evaluated inside a loop in %s: "
+                            "every iteration builds a fresh callable "
+                            "and compile cache — hoist the jit out of "
+                            "the loop" % fi.qualname))
+                    elif not in_tests and node in parents \
+                            and isinstance(parents[node], ast.Call) \
+                            and parents[node].func is node:
+                        out.append(self.finding(
+                            fi.file, parents[node], fi.qualname,
+                            "inline jax.jit(...)(...) in %s: the "
+                            "callable (and its compile) is rebuilt on "
+                            "every call of the enclosing function — "
+                            "bind the jitted callable once, or drop "
+                            "the jit" % fi.qualname))
+                if isinstance(node, ast.Dict) \
+                        and is_traced and any(
+                            isinstance(k, ast.JoinedStr)
+                            for k in node.keys if k is not None):
+                    out.append(self.finding(
+                        fi.file, node, fi.qualname,
+                        "f-string dict key inside jit-traced %s: pytree "
+                        "structure depending on runtime strings "
+                        "retraces per distinct key set" % fi.qualname))
+        return out
+
+    @staticmethod
+    def _in_loop(node, parents, stop) -> bool:
+        cur = node
+        while cur is not stop and cur in parents:
+            parent = parents[cur]
+            # ast.While has no .iter — getattr keeps the comparison
+            # meaningful for For (a jit in the iterable runs once)
+            if isinstance(parent, (ast.For, ast.While)) \
+                    and cur is not getattr(parent, "iter", None):
+                return True
+            if isinstance(parent, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                return False
+            cur = parent
+        return False
+
+
+# -- 4. donation-reuse ---------------------------------------------------
+class DonationReuse(Rule):
+    """A buffer passed to a ``donate_argnums`` slot is dead the moment
+    the call dispatches.  Reading it afterwards is the hard error
+    (works on CPU where donation is skipped, corrupts on TPU); leaving
+    the donated alias bound without rebinding is the soft variant the
+    repo's ``x, ... = f(x, ...)`` idiom avoids — both are flagged, the
+    soft one at warning severity."""
+
+    id = "donation-reuse"
+    severity = "error"
+    description = "buffer used after being donated to a jitted call"
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        deco_by_file = {rel: self._decorated_donated(info)
+                        for rel, info in index.files.items()}
+        for fi in index.functions.values():
+            donated = self._donated_callables(
+                fi, index, deco_by_file[fi.file])
+            if not donated:
+                continue
+            parents = _parents(fi.node)
+            body_stmts = [n for n in ast.walk(fi.node)
+                          if isinstance(n, ast.stmt)]
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                positions = self._call_positions(node, fi, donated)
+                if not positions:
+                    continue
+                stmt = _enclosing_stmt(node, parents)
+                targets = self._target_texts(stmt)
+                for pos in positions:
+                    if pos >= len(node.args) or any(
+                            isinstance(a, ast.Starred)
+                            for a in node.args[:pos + 1]):
+                        continue
+                    arg = node.args[pos]
+                    if not isinstance(arg, (ast.Name, ast.Attribute,
+                                            ast.Subscript)):
+                        continue
+                    text = _detail_of(arg)
+                    if text in targets:
+                        continue
+                    later = self._later_use(text, stmt, body_stmts, node)
+                    if later == "read":
+                        out.append(self.finding(
+                            fi.file, node, fi.qualname,
+                            "donated buffer %r (argnum %d) is READ "
+                            "after donation in %s — on an accelerator "
+                            "the buffer is dead once the call "
+                            "dispatches" % (text, pos, fi.qualname)))
+                    else:
+                        out.append(self.finding(
+                            fi.file, node, fi.qualname,
+                            "donated buffer %r (argnum %d) stays bound "
+                            "after the call in %s — rebind the "
+                            "successor over it (x, ... = f(x, ...)) or "
+                            "del the alias" % (text, pos, fi.qualname),
+                            severity="warning"))
+        return out
+
+    @staticmethod
+    def _decorated_donated(info) -> Dict[str, Tuple[int, ...]]:
+        """@partial(jax.jit, donate_argnums=..)-decorated functions of
+        one file: {bare name: positions} (computed once per file,
+        through the same matcher jit_traced uses — the two rules must
+        agree on what counts as jitted)."""
+        out: Dict[str, Tuple[int, ...]] = {}
+        for other in info.functions:
+            deco = RepoIndex._jit_decorator(other)
+            if isinstance(deco, ast.Call):
+                pos = _donated_positions(deco, other.node)
+                if pos:
+                    out[other.name] = pos
+        return out
+
+    def _donated_callables(self, fi: FuncInfo, index: RepoIndex,
+                           decorated: Dict[str, Tuple[int, ...]]
+                           ) -> Dict[str, Tuple[int, ...]]:
+        """{call-head text: donated positions} visible inside ``fi``:
+        class jit bindings (``self._x_jit``), local ``x = jax.jit(...,
+        donate_argnums=...)``, and @partial(jax.jit, donate_argnums=..)
+        decorated same-module functions."""
+        info = index.files[fi.file]
+        out: Dict[str, Tuple[int, ...]] = dict(decorated)
+        cls = fi.parent_class
+        if cls is not None:
+            for attr, (_, pos) in cls.jit_bindings.items():
+                if pos:
+                    out["self." + attr] = pos
+        if any(_is_jit_call(c, info) for c in fi.calls):
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, ast.Call) \
+                        and _is_jit_call(node.value, info):
+                    pos = _donated_positions(node.value, fi.node)
+                    if pos:
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Name):
+                                out[tgt.id] = pos
+        return out
+
+    @staticmethod
+    def _call_positions(call: ast.Call, fi: FuncInfo,
+                        donated: Dict[str, Tuple[int, ...]]
+                        ) -> Tuple[int, ...]:
+        head = _dotted(call.func) or ""
+        return donated.get(head, ())
+
+    @staticmethod
+    def _target_texts(stmt: ast.AST) -> Set[str]:
+        out: Set[str] = set()
+        if isinstance(stmt, ast.Assign):
+            for tgt in stmt.targets:
+                if isinstance(tgt, ast.Tuple):
+                    for elt in tgt.elts:
+                        out.add(_detail_of(elt))
+                else:
+                    out.add(_detail_of(tgt))
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            out.add(_detail_of(stmt.target))
+        return out
+
+    @staticmethod
+    def _later_use(text: str, stmt: ast.AST, body_stmts, call: ast.Call
+                   ) -> str:
+        """'read' | 'none': does ``text`` appear as a Load after the
+        donating statement (before being re-stored)?  Statement order
+        approximated by line number — good enough for the linear
+        host-API methods this rule patrols."""
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        reads, stores = [], []
+        for other in body_stmts:
+            if other.lineno <= end:
+                continue
+            for sub in ast.walk(other):
+                if isinstance(sub, (ast.Name, ast.Attribute,
+                                    ast.Subscript)) \
+                        and _detail_of(sub) == text:
+                    if isinstance(sub.ctx, ast.Store):
+                        stores.append(sub.lineno)
+                    else:
+                        reads.append(sub.lineno)
+        if reads and (not stores or min(reads) <= min(stores)):
+            return "read"
+        return "none"
+
+
+# -- 5. lock-discipline --------------------------------------------------
+class LockDiscipline(Rule):
+    """Classes owning a ``threading.Lock``/``RLock`` (or an owned
+    worker ``Thread``) must mutate shared ``self`` state under the
+    lock.  Writes in methods documented as running under a caller-held
+    lock are the legitimate case — baseline them, so the discipline is
+    recorded per site and any NEW unguarded write fails review."""
+
+    id = "lock-discipline"
+    severity = "error"
+    description = ("shared attribute mutated outside the owning lock "
+                   "in a lock/thread-owning class")
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for ci in index.classes.values():
+            if not ci.lock_attrs and not ci.thread_attrs:
+                continue
+            for mname, mi in ci.methods.items():
+                if mname == "__init__":
+                    continue
+                out.extend(self._check_method(ci, mi))
+        return out
+
+    def _check_method(self, ci, mi: FuncInfo) -> List[Finding]:
+        out: List[Finding] = []
+        locked_ranges = self._lock_ranges(ci, mi.node)
+        for node in ast.walk(mi.node):
+            hit = self._write_target(node, ci)
+            if hit is None:
+                continue
+            if any(lo <= node.lineno <= hi for lo, hi in locked_ranges):
+                continue
+            if ci.lock_attrs:
+                how = "outside `with self.%s`" % sorted(ci.lock_attrs)[0]
+            else:
+                how = ("with no lock in the class (it owns a worker "
+                       "thread)")
+            out.append(self.finding(
+                mi.file, node, "%s.%s" % (ci.name, mi.name),
+                "shared attribute self.%s mutated %s in %s.%s — guard "
+                "it, or baseline with the justification naming who "
+                "holds the lock" % (hit, how, ci.name, mi.name)))
+        return out
+
+    @staticmethod
+    def _lock_ranges(ci, func_node) -> List[Tuple[int, int]]:
+        out = []
+        for node in ast.walk(func_node):
+            if not isinstance(node, ast.With):
+                continue
+            for item in node.items:
+                dotted = _dotted(item.context_expr) or ""
+                if dotted.startswith("self.") \
+                        and dotted.split(".")[1] in ci.lock_attrs:
+                    out.append((node.lineno,
+                                getattr(node, "end_lineno", node.lineno)))
+        return out
+
+    @staticmethod
+    def _write_target(node: ast.AST, ci) -> Optional[str]:
+        """Name of the mutated ``self.X``, else None."""
+
+        def self_attr(n) -> Optional[str]:
+            if isinstance(n, ast.Subscript):
+                n = n.value
+            if isinstance(n, ast.Attribute) \
+                    and isinstance(n.value, ast.Name) \
+                    and n.value.id == "self" \
+                    and not n.attr.startswith("__"):
+                return n.attr
+            return None
+
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                tgts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                for t in tgts:
+                    got = self_attr(t)
+                    if got is not None and got not in ci.lock_attrs:
+                        return got
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            got = self_attr(node.target)
+            if got is not None and got not in ci.lock_attrs:
+                return got
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                got = self_attr(t)
+                if got is not None:
+                    return got
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in config.MUTATOR_METHODS:
+            got = self_attr(node.func.value)
+            if got is not None:
+                return got
+        return None
+
+
+# -- 6. slow-marker ------------------------------------------------------
+class SlowMarker(Rule):
+    """Subprocess-spawning or axis-sweeping test functions without
+    ``@pytest.mark.slow`` eat the tier-1 wall-clock budget (ROADMAP:
+    1260 s).  Spawners get the marker; small fixed grids that are
+    genuinely cheap get a baseline entry saying so."""
+
+    id = "slow-marker"
+    severity = "warning"
+    description = ("subprocess/sweep test without @pytest.mark.slow "
+                   "(tier-1 budget protection)")
+
+    _SPAWN_TAILS = {"run", "Popen", "check_call", "check_output", "call"}
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        out: List[Finding] = []
+        for rel, info in index.files.items():
+            base = rel.replace("\\", "/")
+            if not base.startswith("tests/") \
+                    or not base.split("/")[-1].startswith("test_"):
+                continue
+            if self._module_slow(info.tree):
+                continue
+            spawn_helpers = self._spawning_helpers(info)
+            for fi in info.functions:
+                if not fi.name.startswith("test"):
+                    continue
+                if fi.class_name is not None \
+                        and not fi.class_name.startswith("Test"):
+                    continue
+                if self._is_slow(fi):
+                    continue
+                spawn = self._spawns(fi.node, spawn_helpers)
+                sweep = sum(1 for d in fi.decorators
+                            if "parametrize" in d)
+                if spawn:
+                    out.append(self.finding(
+                        fi.file, fi.node, fi.qualname,
+                        "test %s spawns a subprocess without "
+                        "@pytest.mark.slow — mark it (tier-1 runs "
+                        "-m 'not slow')" % fi.qualname))
+                elif sweep >= 3:
+                    out.append(self.finding(
+                        fi.file, fi.node, fi.qualname,
+                        "test %s sweeps %d parametrize axes without "
+                        "@pytest.mark.slow — mark it, or baseline with "
+                        "the measured cost" % (fi.qualname, sweep)))
+        return out
+
+    @staticmethod
+    def _module_slow(tree) -> bool:
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and t.id == "pytestmark"
+                    for t in node.targets):
+                if "slow" in _detail_of(node.value):
+                    return True
+        return False
+
+    def _is_slow(self, fi: FuncInfo) -> bool:
+        if any("slow" in d for d in fi.decorators):
+            return True
+        cls = fi.parent_class
+        if cls is not None and any("slow" in d for d in cls.decorators):
+            return True
+        return False
+
+    def _spawning_helpers(self, info) -> Set[str]:
+        out: Set[str] = set()
+        for name, fi in info.module_funcs.items():
+            if self._spawns(fi.node, set()):
+                out.add(name)
+        return out
+
+    def _spawns(self, node, helpers: Set[str]) -> bool:
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            dotted = _dotted(sub.func) or ""
+            parts = dotted.split(".")
+            if parts[0] == "subprocess" \
+                    and parts[-1] in self._SPAWN_TAILS:
+                return True
+            if dotted == "os.system":
+                return True
+            if len(parts) == 1 and parts[0] in helpers:
+                return True
+        return False
+
+
+# -- 7. unblocked-timing -------------------------------------------------
+class UnblockedTiming(Rule):
+    """A ``perf_counter``/``time.time`` span that dispatches device
+    work but never syncs measures DISPATCH, not execution — a bench leg
+    lying to the artifact.  The span is clean when it contains an
+    explicit sync (``block_until_ready``/``device_get``/``np.asarray``/
+    ``float``/``.item``) or calls something the call graph proves
+    syncs internally."""
+
+    id = "unblocked-timing"
+    severity = "warning"
+    description = ("timed span around device work with no "
+                   "block_until_ready/host fetch")
+
+    _CLOCKS = {"perf_counter", "time", "monotonic"}
+
+    def run(self, index: RepoIndex) -> List[Finding]:
+        may_sync = index.may_sync()
+        may_jax = index.may_touch_jax()
+        clock_attrs: Dict[int, Set[str]] = {}  # per-run, keyed id(ci)
+        out: List[Finding] = []
+        for fi in index.functions.values():
+            out.extend(self._check_function(fi, index, may_sync,
+                                            may_jax, clock_attrs))
+        return out
+
+    def _is_clock(self, call: ast.Call) -> bool:
+        dotted = _dotted(call.func) or ""
+        return dotted.split(".")[-1] in self._CLOCKS and (
+            dotted.startswith("time.") or dotted in self._CLOCKS)
+
+    def _check_function(self, fi: FuncInfo, index: RepoIndex,
+                        may_sync: Set[str], may_jax: Set[str],
+                        clock_attrs: Dict[int, Set[str]]
+                        ) -> List[Finding]:
+        # cheap pre-filter: no clock call, no spans
+        if not any(self._is_clock(c) for c in fi.calls):
+            return []
+        # spans: latest `t0 = clock()` (name OR self-attribute target)
+        # before each `clock() - t0` / `t1 - t0` where t1 is itself
+        # clock-assigned (the two common bench idioms)
+        assigns: List[Tuple[str, int]] = []
+        subs: List[Tuple[str, int, ast.AST]] = []  # (anchor, end, node)
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and self._is_clock(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, (ast.Name, ast.Attribute)):
+                        assigns.append((_detail_of(tgt), node.lineno))
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and isinstance(node.right, (ast.Name,
+                                                ast.Attribute))):
+                continue
+            anchor = _detail_of(node.right)
+            if isinstance(node.left, ast.Call) \
+                    and self._is_clock(node.left):
+                subs.append((anchor, node.lineno, node))
+            elif isinstance(node.left, (ast.Name, ast.Attribute)):
+                # t1 = clock(); ...; t1 - t0: the span CLOSES at t1's
+                # assignment, not at the subtraction
+                lt = _detail_of(node.left)
+                end = max((ln for n, ln in assigns
+                           if n == lt and ln <= node.lineno),
+                          default=None)
+                if end is not None:
+                    subs.append((anchor, end, node))
+        if not subs:
+            return []
+        local = fi.local_types
+        out: List[Finding] = []
+        for anchor, end, sub_node in subs:
+            start = max((ln for n, ln in assigns
+                         if n == anchor and ln <= end), default=None)
+            if start is None:
+                # self._t0 anchored in ANOTHER method (context-manager
+                # timers): the whole current function is the span —
+                # usually trivially clean, but a stop() that dispatches
+                # unsynced work is exactly the lie we patrol
+                if not (anchor.startswith("self.")
+                        and fi.parent_class is not None
+                        and self._class_clock_attr(fi.parent_class,
+                                                   anchor,
+                                                   clock_attrs)):
+                    continue
+                start = fi.node.lineno
+            verdict = self._span_verdict(fi, index, local, may_sync,
+                                         may_jax, start, end)
+            if verdict is not None:
+                out.append(self.finding(
+                    fi.file, sub_node, fi.qualname,
+                    "timed span %s:%d-%d in %s dispatches %s but never "
+                    "syncs — add jax.block_until_ready (or fetch the "
+                    "result) inside the span, or baseline with where "
+                    "the sync actually happens"
+                    % (fi.file, start, end, fi.qualname, verdict)))
+        return out
+
+    def _class_clock_attr(self, ci, anchor: str,
+                          clock_attrs: Dict[int, Set[str]]) -> bool:
+        """Is ``anchor`` (a ``self.X`` text) assigned a clock reading in
+        any method of ``ci``?  Cached per class for one run."""
+        cache = clock_attrs.get(id(ci))
+        if cache is None:
+            cache = set()
+            for mi in ci.methods.values():
+                for node in ast.walk(mi.node):
+                    if isinstance(node, ast.Assign) \
+                            and isinstance(node.value, ast.Call) \
+                            and self._is_clock(node.value):
+                        for tgt in node.targets:
+                            if isinstance(tgt, ast.Attribute):
+                                cache.add(_detail_of(tgt))
+            clock_attrs[id(ci)] = cache
+        return anchor in cache
+
+    def _span_verdict(self, fi, index, local, may_sync, may_jax, start,
+                      end) -> Optional[str]:
+        """None when clean; else a description of the unsynced work."""
+        info = index.files[fi.file]
+        # names bound IN-SPAN from a non-benign call: `loss, .. =
+        # step(..); float(loss)` is a genuine sync, `int(steps)` of a
+        # config scalar is not
+        bound_from_call: Set[str] = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and start < node.lineno <= end:
+                t = (_dotted(node.value.func) or "").split(".")[-1]
+                if t in config.BENIGN_SPAN_CALLS or t in self._CLOCKS:
+                    continue
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(tgt, ast.Tuple) \
+                        else [tgt]
+                    for e in elts:
+                        if isinstance(e, ast.Name):
+                            bound_from_call.add(e.id)
+        dispatchy: List[str] = []
+        for node in fi.calls:
+            line = node.lineno
+            if not (start < line <= end):
+                continue
+            dotted = _dotted(node.func) or ""
+            tail = dotted.split(".")[-1]
+            if tail in config.SPAN_SYNC_CALLS:
+                if tail in config.BUILTIN_SYNC_FUNCS:
+                    # int()/float()/bool() sync only when forcing a
+                    # traced value to host — a python-scalar cast must
+                    # not launder the span
+                    if any(_contains_jax_math(a, info)
+                           or (isinstance(a, ast.Name)
+                               and a.id in bound_from_call)
+                           for a in node.args):
+                        return None
+                    continue
+                return None
+            if tail in config.BENIGN_SPAN_CALLS or tail in self._CLOCKS:
+                continue
+            callees = index.resolve_call(fi, node, local)
+            if not callees:
+                callees = index.resolve_call(fi, node, local, loose=True)
+            if callees:
+                if any(c.qualname in may_sync for c in callees):
+                    return None
+                if any(c.qualname in may_jax for c in callees):
+                    dispatchy.append(dotted or tail)
+                continue
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _BUILTIN_NAMES:
+                continue  # bool()/isinstance()/... never dispatch
+            dispatchy.append(dotted or tail or "<call>")
+        if dispatchy:
+            return "/".join(sorted(set(dispatchy))[:3])
+        return None
+
+
+ALL_RULES = (HostSyncInHotPath(), TracedBranch(), RetraceHazard(),
+             DonationReuse(), LockDiscipline(), SlowMarker(),
+             UnblockedTiming())
