@@ -736,6 +736,135 @@ def bench_serving(pt, jax, on_tpu: bool):
     return out
 
 
+def bench_serving_faults(pt, jax, on_tpu: bool):
+    """L7 robustness leg: the PRICE of request-level recovery.
+
+    Runs the same traffic twice through ``serving.ServingEngine`` — once
+    clean, once with a scripted transient fault injected into the
+    batched pool step (``serving.faults``) — and stamps what the
+    recovery machinery costs and what it preserves:
+
+    - ``recovery_wall_s``: wall time of the faulted tick (pool rebuild +
+      resubmit of every victim) PLUS the pumping until every survivor
+      has decoded a post-recovery token — the honest time-to-first-
+      recovered-token, synced by the pool's own per-tick host download;
+    - ``tokens_lost``: mismatched-or-missing tokens of surviving greedy
+      requests vs the fault-free run.  MUST be 0 — greedy recovery is
+      token-identical by the O(1)-cache contract, and the
+      ``_leg_promotable`` gate structurally refuses to promote a
+      serving_faults leg that lost tokens;
+    - the recovery counters, so the stamped number says how many
+      requests the wall time covered.
+
+    Sub-legs carry cache_layout/cache_dtype stamps like every serving
+    leg (the gate rejects them otherwise)."""
+    from paddle_tpu.models import TransformerLM, gpt_1p3b_config
+    from paddle_tpu.serving import ServingEngine, faults
+
+    prefill, gen = (512, 32) if on_tpu else (16, 8)
+    slots = 4
+    cfg = gpt_1p3b_config()
+    if on_tpu:
+        cfg.update(num_layers=6)
+    else:
+        _cpu_smoke_shrink(cfg, max_position=1024)
+    pt.seed(0)
+    model = TransformerLM(**cfg, dropout=0.0)
+    rng = np.random.RandomState(0)
+    max_len = prefill + gen
+    prompts = [rng.randint(0, cfg["vocab_size"],
+                           (prefill,)).astype("int32")
+               for _ in range(2 * slots)]
+
+    def fresh_engine():
+        # TWO prefill buckets: `prefill` serves admission, `max_len`
+        # serves RECOVERY — a resubmitted victim re-prefills
+        # prompt+committed, which outgrows the admission bucket (the
+        # bucket-coverage requirement of docs/DESIGN.md §5f)
+        return ServingEngine(model, max_len=max_len, slots=slots,
+                             buckets=[prefill, max_len],
+                             max_queue=4 * slots,
+                             cache_layout="paged", block_size=32)
+
+    # fault-free reference (also warms every executable, so the faulted
+    # run's recovery wall time measures RECOVERY, not XLA)
+    engine = fresh_engine()
+    streams = [engine.submit(p, gen, request_id="req-%d" % i)
+               for i, p in enumerate(prompts)]
+    while engine.pump(16):
+        pass
+    want = {s.request_id: s.result(timeout_s=0).tokens for s in streams}
+
+    engine = fresh_engine()
+    # warm the recovery bucket OUTSIDE the timed region (a cold-compile
+    # recovery would measure XLA, not the rebuild+re-prefill): one
+    # request long enough to prefill through the max_len bucket
+    warm = engine.submit(rng.randint(0, cfg["vocab_size"],
+                                     (max_len - 2,)).astype("int32"), 2)
+    while engine.pump(8):
+        pass
+    assert warm.result(timeout_s=0).state == "DONE"
+    fault_after = 3  # let the pool reach steady state first
+    plane = faults.FaultPlane([faults.FaultSpec(
+        "pool.step", error=faults.TransientInjectedFault,
+        after=fault_after, times=1)])
+    with faults.injected(plane):
+        streams = [engine.submit(p, gen, request_id="req-%d" % i)
+                   for i, p in enumerate(prompts)]
+        engine.pump(fault_after)   # clean steady-state ticks
+        tokens_before = int(engine.metrics.snapshot()[
+            "serving_tokens_emitted_total"])
+        live_before = engine.live_requests
+        t0 = time.perf_counter()
+        engine.pump(1)             # the tick that faults AND recovers
+        # ...then pump until every survivor has emitted a post-recovery
+        # token: each recovered request re-prefills (emitting one), so
+        # token progress >= survivors means recovery is fully paid for
+        while engine.live_requests and int(engine.metrics.snapshot()[
+                "serving_tokens_emitted_total"]) - tokens_before \
+                < live_before:
+            if not engine.pump(1):
+                break
+        recovery_wall = time.perf_counter() - t0
+        while engine.pump(16):
+            pass
+    statuses = [s.result(timeout_s=0) for s in streams]
+    snap = engine.metrics.snapshot()
+    stats = engine.cache_stats()
+    tokens_lost = 0
+    for st in statuses:
+        if st.state != "DONE":
+            continue  # non-survivors are counted via the failed counter
+        ref = want[st.request_id]
+        got = np.asarray(st.tokens)
+        tokens_lost += max(0, len(ref) - len(got)) + int(
+            (got[:len(ref)] != ref[:len(got)]).sum())
+    out = {
+        "prefill": prefill,
+        "generated": gen,
+        "slots": slots,
+        "input_staged": False,
+        "transfer_note": (
+            "recovery wall time is host-side rebuild + re-prefill; the "
+            "re-prefill's prompt re-upload IS the recovery cost being "
+            "measured, synced by the pool's per-tick token download"),
+        "faulted": {
+            "cache_layout": stats["cache_layout"],
+            "cache_dtype": stats["cache_dtype"],
+            "requests": len(prompts),
+            "recovery_wall_s": round(recovery_wall, 4),
+            "tokens_lost": tokens_lost,
+            "requests_recovered": int(
+                snap["serving_requests_recovered_total"]),
+            "requests_failed": int(snap["serving_requests_failed_total"]),
+            "recoveries": int(snap["serving_recoveries_total"]),
+            "survivors": sum(1 for st in statuses if st.state == "DONE"),
+            "blocks_reclaimed": stats["mapped_blocks"] == 0,
+        },
+    }
+    return out
+
+
 def bench_speculative(pt, jax, on_tpu: bool):
     """L7 speculative-decoding leg: the draft/verify pool
     (``inference.SpeculativePool``) against the PLAIN decode pool at
@@ -978,6 +1107,7 @@ def _leg_promotable(name: str, leg: dict):
                                            RESNET_MFU_CONVENTION))
     cache_stamp_keys = {"decode": "per_token_s",
                         "serving": "ttft_p50_s",
+                        "serving_faults": "recovery_wall_s",
                         "speculative": "tokens_per_sec"}
     if name in cache_stamp_keys:
         # a decode/serving/speculative number without its cache-layout
@@ -998,6 +1128,17 @@ def _leg_promotable(name: str, leg: dict):
                            "%s: dense-vs-paged / fp32-vs-int8 "
                            "provenance unknown"
                            % (name, missing or "every timed sub-leg"))
+        if name == "serving_faults":
+            # a recovery wall time whose survivors LOST tokens measured
+            # a broken recovery, not a working one: greedy survivors are
+            # token-identical by contract, so tokens_lost != 0 makes the
+            # number structurally unpromotable
+            lossy = sorted(k for k, v in timed.items()
+                           if v.get("tokens_lost", 1) != 0)
+            if lossy:
+                return False, ("serving_faults leg lost tokens on %s: "
+                               "greedy survivors must be byte-identical "
+                               "to the fault-free run" % (lossy,))
         if name == "speculative":
             # a speculative tokens/s additionally needs its
             # acceptance_rate stamp: without it the number cannot say
@@ -1167,6 +1308,7 @@ def _measure_and_print():
                      ("mnist_k32_multistep", bench_mnist_multistep),
                      ("decode", bench_decode),
                      ("serving", bench_serving),
+                     ("serving_faults", bench_serving_faults),
                      ("speculative", bench_speculative)):
         try:
             legs[name] = fn(pt, jax, on_tpu)

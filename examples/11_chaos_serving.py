@@ -1,0 +1,132 @@
+"""Example 11: fault-tolerant serving — chaos, recovery, supervision.
+
+Examples 09/10 showed the serving engine and its HTTP front end on the
+happy path.  This one breaks things on purpose (docs/DESIGN.md §5f):
+
+1. **fault injection plane** (``serving.faults``): named seams at the
+   real failure points — pool step, prefill, paged block alloc, stream
+   delivery, HTTP write — driven by scripted schedules or a SEEDED
+   chaos mode, so every failure is replayable;
+2. **request-level recovery**: a failed step rebuilds the pool (same
+   compiled executables, fresh caches) and resubmits each victim's
+   prompt+committed tokens — greedy survivors finish byte-identical to
+   a fault-free run, which this script VERIFIES;
+3. **supervision**: ``Supervisor`` + ``engine.health()`` — the same
+   snapshot ``GET /healthz`` serves — carrying the last error, recovery
+   counters, and stall/restart accounting;
+4. **deadline-aware load shedding**: a deadline the observed tick rate
+   cannot meet is refused at admission with a Retry-After hint instead
+   of burning a slot.
+
+Run: python examples/11_chaos_serving.py [--tokens 8]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import argparse
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (DeadlineUnattainableError, ServingEngine,
+                                Supervisor, faults)
+
+
+def build_engine(model):
+    # paged cache + a generous retry budget; buckets include one near
+    # max_len so a recovery re-prefill (prompt + committed tokens) is
+    # always bucket-covered (§5f)
+    return ServingEngine(model, max_len=128, slots=2, buckets=[64, 128],
+                         max_queue=8, cache_layout="paged",
+                         block_size=32, max_retries=8)
+
+
+def run(engine, prompts, tokens):
+    streams = [engine.submit(p, tokens) for p in prompts]
+    while engine.pump(4):
+        pass
+    return [s.result(timeout_s=0) for s in streams]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=8)
+    args = ap.parse_args()
+
+    pt.seed(0)
+    model = TransformerLM(vocab_size=256, hidden_size=32, num_layers=1,
+                          num_heads=2, intermediate_size=128,
+                          max_position=256, causal=True, dropout=0.0)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 256, (n,)).astype("int32")
+               for n in (20, 35, 28)]
+
+    # -- fault-free reference ------------------------------------------
+    want = [st.tokens for st in run(build_engine(model), prompts,
+                                    args.tokens)]
+    print("fault-free run:", [len(w) for w in want], "tokens per request")
+
+    # -- seeded chaos: transient faults at the step/alloc/deliver seams
+    engine = build_engine(model)
+    plane = faults.FaultPlane(
+        chaos_seed=7, chaos_p=0.15, max_faults=5,
+        chaos_points=("pool.step", "pool.alloc_blocks",
+                      "stream.deliver"))
+    with faults.injected(plane):
+        statuses = run(engine, prompts, args.tokens)
+    print("chaos injected:", plane.injected or "(seed fired nothing)")
+    for st, w in zip(statuses, want):
+        identical = st.state == "DONE" and \
+            np.array_equal(np.asarray(st.tokens), w)
+        print("  %-6s %s tokens=%d byte-identical=%s"
+              % (st.state, st.request_id, st.new_tokens, identical))
+        assert identical, "greedy recovery must be token-identical"
+    health = engine.health()
+    print("health: state=%s recoveries=%d requests_recovered=%d "
+          "last_error=%r"
+          % (health["state"], health["recoveries"],
+             health["requests_recovered"],
+             (health["last_error"] or "")[:60]))
+    stats = engine.cache_stats()
+    print("allocator reconciled: mapped_blocks=%d free_blocks=%d"
+          % (stats["mapped_blocks"], stats["free_blocks"]))
+
+    # -- scripted permanent fault: typed FAILED, consumers unblock -----
+    engine2 = build_engine(model)
+    spec = faults.FaultSpec("pool.step",
+                            error=faults.PermanentInjectedFault,
+                            after=1, times=1)
+    with faults.injected(faults.FaultPlane([spec])):
+        statuses = run(engine2, prompts[:2], args.tokens)
+    for st in statuses:
+        print("permanent fault ->", st.state,
+              "error=%r" % (st.error or "")[:48])
+
+    # -- supervision: the watchdog surface (same data as GET /healthz)
+    sup = Supervisor(engine2, stall_timeout_s=2.0)
+    print("supervisor sweep on a healthy engine:", sup.check_once() or
+          "no action")
+
+    # -- deadline-aware shedding ---------------------------------------
+    engine3 = build_engine(model)
+    run(engine3, prompts[:1], 4)        # observe a real tick rate first
+    engine3.submit(prompts[0], 100)     # pile up a backlog
+    engine3.pump(2)
+    try:
+        engine3.submit(prompts[1], 20, deadline_s=1e-9)
+    except DeadlineUnattainableError as e:
+        print("shed at admission (retry after ~%.3gs): %s"
+              % (e.retry_after_s, str(e)[:72]))
+    while engine3.pump(64):
+        pass
+    print("shed counter:",
+          engine3.metrics.snapshot()["serving_requests_shed_total"])
+    print("done.")
+
+
+if __name__ == "__main__":
+    main()

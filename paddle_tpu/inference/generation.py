@@ -47,6 +47,22 @@ from ..jit.decode import DecodeSession, classify_finish
 __all__ = ["GenerationPool", "kv_reachable_bytes",
            "DuplicateRequestError"]
 
+# the serving fault plane, bound lazily: importing paddle_tpu.serving at
+# module scope here would be circular (serving.engine imports this
+# module), and the late bind keeps standalone pool users import-clean —
+# the first step()/refill pays one sys.modules lookup, after which
+# _fire is a bound-module attribute call that no-ops while no plane is
+# installed (see serving/faults.py)
+_faults = None
+
+
+def _fire(point: str) -> None:
+    global _faults
+    if _faults is None:
+        from ..serving import faults as _faults_mod
+        _faults = _faults_mod
+    _faults.fire(point)
+
 
 class DuplicateRequestError(AlreadyExistsError, InvalidArgumentError):
     """``submit()`` reused a request_id that is still queued, active, or
@@ -138,6 +154,7 @@ class GenerationPool:
             donate=donate, cache_layout=cache_layout,
             block_size=block_size)
         self._model = model
+        self._cache_dtype = cache_dtype
         from ..jit.speculative import model_vocab_size
         self._vocab = model_vocab_size(model)
         self.slots = int(slots)
@@ -449,11 +466,13 @@ class GenerationPool:
             # DecodeSession.generate) emits the request's FIRST token;
             # runs BEFORE the slot is popped so a prefill failure can
             # never leak a slot
+            _fire("pool.prefill")
             row_cache, tok, self._key = self._session.prefill(
                 req.ids[None], self._key)
             slot = self._free.pop()
             first = int(np.asarray(tok)[0])
             if self.cache_layout == "paged":
+                _fire("pool.alloc_blocks")
                 blocks = [self._free_blocks.pop() for _ in range(need)]
                 self._slot_blocks[slot] = blocks
                 # pad the table row to max_blocks with the scratch block:
@@ -499,6 +518,7 @@ class GenerationPool:
     def step(self) -> bool:
         """Refill free slots, run ONE batched decode step; False when the
         pool is drained (no queued or active requests)."""
+        _fire("pool.step")
         self._refill()
         if not self._active:
             return bool(self._queue)
@@ -525,7 +545,39 @@ class GenerationPool:
         """Drop the cached parameter/buffer value lists — call after
         mutating the model's weights (e.g. ``set_state_dict``) so later
         decode steps see the new values."""
+        _fire("weights.refresh")
         self._state_cache = None
+
+    def reset(self):
+        """Discard every request and all cache/allocator state — queue,
+        slots, results, paged free list, the K/V arrays themselves —
+        while KEEPING the compiled executables and the cached weight
+        value lists.  This is the serving engine's recovery primitive:
+        after a failed step nothing pool-side can be trusted, but
+        prompt + committed tokens fully determine greedy decode state
+        (the O(1)-cache contract), so a rebuilt-empty pool plus
+        re-prefilled resubmissions continues survivors
+        token-identically at the cost of a cache re-allocation — never
+        a recompile (``compile_counts()`` is unchanged, pinned by
+        tests)."""
+        self._queue.clear()
+        self._active.clear()
+        self._free = list(range(self.slots))
+        self._last_tok = np.zeros(self.slots, np.int32)
+        self._tok_dev = None
+        self._active_dev = None
+        self._membership_dirty = True
+        self._results.clear()
+        self._finish_reasons.clear()
+        self._used_rids.clear()
+        if self.cache_layout == "paged":
+            self._free_blocks = list(range(1, self._num_blocks))
+            self._slot_blocks = {}
+        self._cache = self._model.gen_decode_cache(
+            self.slots, self.max_len, self._cache_dtype, per_slot=True,
+            layout=self.cache_layout, block_size=self._block_size,
+            num_blocks=(self._num_blocks
+                        if self.cache_layout == "paged" else None))
 
     def run(self) -> Dict[object, np.ndarray]:
         """Drain queue + slots; {request_id: np.int32 token array}."""

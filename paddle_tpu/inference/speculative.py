@@ -43,7 +43,7 @@ from ..core.errors import InvalidArgumentError
 from ..jit.decode import DecodeSession, truncate_at_eos
 from ..jit.speculative import (acceptance_summary, check_draft_compatible,
                                greedy_accept)
-from .generation import GenerationPool
+from .generation import GenerationPool, _fire
 
 __all__ = ["SpeculativePool"]
 
@@ -219,6 +219,9 @@ class SpeculativePool(GenerationPool):
         """Refill free slots, run ONE speculative round (K draft steps,
         one verify, one draft fixup); every active slot commits 1 to
         ``spec_k + 1`` tokens.  False when the pool is drained."""
+        _fire("pool.step")  # same seam as the plain pool: the serving
+        # engine's recovery treats a failed round exactly like a failed
+        # decode step (rebuild + resubmit, token-identical greedy)
         self._refill()
         if not self._active:
             return bool(self._queue)
@@ -287,6 +290,15 @@ class SpeculativePool(GenerationPool):
         """Drop BOTH models' cached weight value lists (hot swap)."""
         super().refresh_weights()
         self._draft_state_cache = None
+
+    def reset(self):
+        """Base reset (queue/slots/target cache/allocator) plus a fresh
+        draft slot cache — the draft's state is as untrusted as the
+        target's after a failed round, and it rebuilds the same way:
+        re-allocation only, every compiled executable kept."""
+        super().reset()
+        self._draft_cache = self._draft_session._model.gen_decode_cache(
+            self.slots, self.max_len, "float32", per_slot=True)
 
     def acceptance_stats(self) -> dict:
         """{'spec_k', 'rounds', 'drafted', 'accepted',

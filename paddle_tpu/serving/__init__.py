@@ -14,6 +14,17 @@ The serving stack, bottom to top (docs/DESIGN.md §5a-§5c):
   (TTFT, inter-token latency, queue depth, occupancy, tokens/s) with
   prometheus text exposition.
 
+Fault tolerance (docs/DESIGN.md §5f): a failed ``pool.step()`` has a
+REQUEST-level blast radius — the engine rebuilds the pool and resubmits
+each victim's prompt+committed tokens, so greedy survivors continue
+token-identically, with typed transient-vs-permanent classification and
+a bounded per-request retry budget.  ``faults`` is the deterministic
+injection plane (named seams, scripted schedules, seeded chaos mode —
+a module-level no-op when off); ``Supervisor`` is the watchdog that
+restarts a dead loop and flags wedged ticks; ``ServingEngine.health()``
+backs ``GET /healthz``; deadline-aware admission sheds unattainable
+requests with the retryable ``DeadlineUnattainableError``.
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —
@@ -21,16 +32,20 @@ serving-oriented systems work (PAPERS.md, arXiv:2603.09555) treats the
 cached decode step as a component inside a request scheduler; this
 package is that scheduler.
 """
-from .engine import QueueFullError, ServingEngine
+from . import faults
+from .engine import (DeadlineUnattainableError, QueueFullError,
+                     ServingEngine)
 from .http import ServingHTTPFrontend, parse_generate_request
 from .metrics import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry)
 from .stream import RequestState, ResponseStream, StreamStatus
+from .supervisor import EngineHealth, Supervisor
 
 __all__ = [
-    "ServingEngine", "QueueFullError",
+    "ServingEngine", "QueueFullError", "DeadlineUnattainableError",
     "ResponseStream", "StreamStatus", "RequestState",
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "DEFAULT_TIME_BUCKETS",
     "ServingHTTPFrontend", "parse_generate_request",
+    "faults", "Supervisor", "EngineHealth",
 ]

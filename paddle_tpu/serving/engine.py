@@ -31,6 +31,28 @@ Design points (docs/DESIGN.md §5c):
   ``on_token`` hook at the actual first-token moment inside ``step()``;
   queue depth/occupancy are read per tick; the step loop reuses
   ``profiler.StepTimer`` for sustained tokens/s.
+- **Request-level blast radius.** A failed ``pool.step()`` no longer
+  fails every live request: prompt + committed tokens fully determine
+  greedy decode state (the O(1)-cache contract, PAPERS.md), so
+  ``_recover`` rebuilds the pool (same compiled executables, fresh
+  caches/allocator) and resubmits each victim's prompt+committed
+  tokens — greedy requests continue TOKEN-IDENTICALLY.  Retries are
+  bounded per request (``max_retries``) and typed
+  (``faults.classify_error``): permanent errors and exhausted budgets
+  finalize FAILED carrying the retry count and root error.
+- **Supervision surface.** Every tick stamps a lock-free heartbeat
+  (``supervisor.EngineHealth``); ``health()`` reads it WITHOUT the
+  engine lock (a wedged tick holds the lock — health is exactly what
+  you ask during a wedge) and backs ``GET /healthz``.  The
+  ``supervisor.Supervisor`` watchdog restarts a dead loop via
+  ``restart_loop()`` and opens stall episodes past its
+  ``stall_timeout_s``.
+- **Deadline-aware shedding.** A ``deadline_s`` submit that cannot
+  finish in time — given the live backlog and the OBSERVED tick rate —
+  is shed at admission with the typed, retryable
+  :class:`DeadlineUnattainableError` (carrying a ``retry_after_s``
+  hint, mapped to HTTP 503 + Retry-After) instead of burning a slot on
+  output its caller will throw away.
 """
 from __future__ import annotations
 
@@ -40,14 +62,16 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..core.errors import (InvalidArgumentError, NotFoundError,
-                           PreconditionNotMetError, UnavailableError)
+from ..core.errors import (InvalidArgumentError, PreconditionNotMetError,
+                           UnavailableError)
 from ..inference.generation import GenerationPool
 from ..profiler import StepTimer
+from . import faults
 from .metrics import MetricsRegistry
 from .stream import RequestState, ResponseStream, StreamStatus
+from .supervisor import EngineHealth
 
-__all__ = ["ServingEngine", "QueueFullError"]
+__all__ = ["ServingEngine", "QueueFullError", "DeadlineUnattainableError"]
 
 
 class QueueFullError(UnavailableError):
@@ -56,25 +80,44 @@ class QueueFullError(UnavailableError):
     engine never buffers beyond its declared bound."""
 
 
+class DeadlineUnattainableError(UnavailableError):
+    """Admission rejected: given the current backlog and the observed
+    per-tick decode rate, the request cannot finish inside its own
+    ``deadline_s`` — admitting it would burn a slot on output the
+    caller is contractually going to discard.  Typed and RETRYABLE;
+    ``retry_after_s`` estimates when the backlog will have drained
+    enough to make the same deadline feasible (the HTTP front end maps
+    it to 503 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class _Record:
-    """Engine-side per-request state (the pool keeps only slot state)."""
+    """Engine-side per-request state (the pool keeps only slot state).
+    ``prompt`` is retained host-side because it IS the recovery story:
+    prompt + ``tokens`` (the committed output) fully determine greedy
+    decode state, so a failed step resubmits their concatenation."""
 
-    __slots__ = ("rid", "stream", "state", "prompt_len", "max_new",
-                 "deadline_abs", "submit_t", "first_t", "last_t",
-                 "tokens")
+    __slots__ = ("rid", "stream", "state", "prompt", "prompt_len",
+                 "max_new", "deadline_abs", "submit_t", "first_t",
+                 "last_t", "tokens", "retries")
 
-    def __init__(self, rid, stream, prompt_len, max_new, deadline_abs,
+    def __init__(self, rid, stream, prompt, max_new, deadline_abs,
                  submit_t):
         self.rid = rid
         self.stream = stream
         self.state = RequestState.QUEUED
-        self.prompt_len = prompt_len
+        self.prompt = prompt
+        self.prompt_len = int(prompt.shape[0])
         self.max_new = max_new
         self.deadline_abs = deadline_abs
         self.submit_t = submit_t
         self.first_t = None
         self.last_t = None
         self.tokens = []
+        self.retries = 0
 
 
 class ServingEngine:
@@ -98,10 +141,14 @@ class ServingEngine:
                  max_queue: int = 64, clock=None,
                  metrics: Optional[MetricsRegistry] = None,
                  draft_model=None, spec_k: Optional[int] = None,
-                 **pool_kwargs):
+                 max_retries: int = 2, **pool_kwargs):
         if int(max_queue) < 1:
             raise InvalidArgumentError(
                 "max_queue must be >= 1, got %r" % (max_queue,))
+        if int(max_retries) < 0:
+            raise InvalidArgumentError(
+                "max_retries must be >= 0 (0 = never resubmit after a "
+                "step failure), got %r" % (max_retries,))
         if draft_model is not None:
             from ..inference.speculative import SpeculativePool
 
@@ -122,7 +169,9 @@ class ServingEngine:
             self._pool = GenerationPool(model, max_len, slots=slots,
                                         **pool_kwargs)
         self.max_queue = int(max_queue)
+        self.max_retries = int(max_retries)
         self._clock = clock if clock is not None else time.monotonic
+        self._health = EngineHealth()
         self._live: Dict[object, _Record] = {}
         # one reentrant lock serializes every pool mutation: submit and
         # cancel may race the background step loop; in pump mode it is
@@ -150,6 +199,21 @@ class ServingEngine:
         self._c_rejected = m.counter(
             "serving_admission_rejected_total",
             "submits refused with QueueFullError")
+        self._c_shed = m.counter(
+            "serving_requests_shed_total",
+            "deadline submits shed as unattainable at admission")
+        self._c_recovered = m.counter(
+            "serving_requests_recovered_total",
+            "requests resubmitted token-identically after a step failure")
+        self._c_recoveries = m.counter(
+            "serving_recoveries_total",
+            "pool rebuild + resubmit recovery events")
+        self._c_restarts = m.counter(
+            "serving_engine_restarts_total",
+            "dead background loops restarted by the supervisor")
+        self._c_stalled = m.counter(
+            "serving_ticks_stalled_total",
+            "ticks that exceeded the supervisor's stall timeout")
         self._c_tokens = m.counter(
             "serving_tokens_emitted_total", "tokens streamed to callers")
         self._g_queue = m.gauge(
@@ -197,11 +261,13 @@ class ServingEngine:
         """Admit one request; returns its :class:`ResponseStream`.
 
         Fails fast: :class:`QueueFullError` past ``max_queue`` waiting
-        requests (retryable), the pool's typed errors for invalid
-        prompts/budgets/duplicate ids, ``PreconditionNotMetError`` once
-        draining.  ``deadline_s`` is a wall-clock budget from NOW —
-        queued or decoding, the request is expired (slot and blocks
-        freed) at the first tick past it."""
+        requests (retryable), :class:`DeadlineUnattainableError` when
+        the observed tick rate says ``deadline_s`` cannot be met
+        (retryable, with a ``retry_after_s`` hint), the pool's typed
+        errors for invalid prompts/budgets/duplicate ids,
+        ``PreconditionNotMetError`` once draining.  ``deadline_s`` is a
+        wall-clock budget from NOW — queued or decoding, the request is
+        expired (slot and blocks freed) at the first tick past it."""
         if deadline_s is not None and not (float(deadline_s) > 0):
             # `not (x > 0)` instead of `x <= 0`: NaN fails both
             # comparisons, and a NaN deadline would otherwise admit a
@@ -221,13 +287,25 @@ class ServingEngine:
                     "serving queue is full (%d waiting >= max_queue=%d); "
                     "back off and retry, or raise max_queue/slots"
                     % (depth, self.max_queue))
+            if deadline_s is not None:
+                est = self._deadline_estimate_s(int(max_new_tokens))
+                if est is not None and est > float(deadline_s):
+                    self._c_shed.inc()
+                    raise DeadlineUnattainableError(
+                        "deadline_s=%.3g cannot be met: the live "
+                        "backlog and observed tick rate put completion "
+                        "~%.3gs out; shed at admission (retryable) — "
+                        "retry after ~%.3gs, or relax the deadline"
+                        % (float(deadline_s), est,
+                           max(0.001, est - float(deadline_s))),
+                        retry_after_s=max(0.001, est - float(deadline_s)))
             ids = np.asarray(getattr(input_ids, "value", input_ids))
             now = self._clock()
             rid = self._pool.submit(ids, max_new_tokens,
                                     request_id=request_id)
             stream = ResponseStream(self, rid, int(max_new_tokens))
             self._live[rid] = _Record(
-                rid, stream, int(ids.shape[0]), int(max_new_tokens),
+                rid, stream, ids.astype(np.int32), int(max_new_tokens),
                 None if deadline_s is None else now + float(deadline_s),
                 now)
             self._c_submitted.inc()
@@ -245,6 +323,13 @@ class ServingEngine:
         rec = self._live.get(rid)
         if rec is None:  # pool used standalone alongside the engine
             return
+        # deliver BEFORE committing: if stream delivery faults (the
+        # `stream.deliver` injection seam, or a real consumer-side
+        # error surfacing through the queue), the token is not yet in
+        # rec.tokens, so recovery re-prefills WITHOUT it and greedy
+        # decode regenerates exactly this token — delivered-once and
+        # committed stay equal, never one ahead of the other
+        rec.stream._put_token(int(tok))
         now = self._clock()
         if rec.first_t is None:
             rec.first_t = now
@@ -256,7 +341,6 @@ class ServingEngine:
         rec.tokens.append(int(tok))
         self._c_tokens.inc()
         self._tokens_total += 1
-        rec.stream._put_token(int(tok))
 
     def _on_finish(self, rid, tokens, reason):
         rec = self._live.pop(rid, None)
@@ -264,7 +348,11 @@ class ServingEngine:
             return
         self._pool.collect(rid)  # frees the rid; tokens already streamed
         self._c_done.inc()
-        self._finalize(rec, RequestState.DONE, reason, tokens)
+        # finalize from the ENGINE's record, not the pool's `tokens`:
+        # after a recovery the pool only saw the post-resubmit tail,
+        # while rec.tokens carries the request's full committed output
+        # (identical to `tokens` when no recovery happened)
+        self._finalize(rec, RequestState.DONE, reason, rec.tokens)
 
     # -- lifecycle transitions -------------------------------------------
     def _finalize(self, rec: _Record, state: str, reason: str, tokens,
@@ -307,37 +395,87 @@ class ServingEngine:
                 self._finalize(rec, RequestState.EXPIRED, "deadline",
                                rec.tokens)
 
-    def _fail_live(self, exc: BaseException) -> None:
-        """A pool step blew up mid-flight: the batched step serves every
-        live request, so none of their caches can be trusted — fail them
-        all (their streams get a terminal FAILED record with the error)
-        rather than leaving callers blocked on a stream that will never
-        end."""
+    def _fail_record(self, rec: _Record, exc: BaseException,
+                     why: str) -> None:
+        """Finalize one victim FAILED, carrying the retry count and the
+        root error (the satellite contract: post-mortems read the
+        stream's terminal record, not a debugger)."""
+        self._c_failed.inc()
+        self._finalize(
+            rec, RequestState.FAILED, "error", rec.tokens,
+            error=("%s (retries=%d/%d): %s"
+                   % (why, rec.retries, self.max_retries,
+                      str(exc)[:400]))[:500])
+
+    def _recover(self, exc: BaseException) -> None:
+        """A pool step blew up mid-flight.  The batched step serves
+        every live request, so none of the POOL's state can be trusted —
+        but the ENGINE's host-side records can: prompt + committed
+        tokens fully determine greedy decode state (the O(1)-cache
+        contract), so the blast radius is REQUEST-level, not
+        engine-level.  Victims whose typed classification is transient
+        and whose retry budget remains are resubmitted as
+        prompt+committed (greedy requests continue token-identically);
+        permanent errors and exhausted budgets finalize FAILED with the
+        retry count and root error.  The pool rebuild reuses every
+        compiled executable — recovery costs cache re-allocation plus
+        one re-prefill per survivor, never a recompile."""
+        kind = faults.classify_error(exc)
+        survivors = []
         for rid, rec in list(self._live.items()):
             self._live.pop(rid)
+            if kind == "permanent":
+                self._fail_record(rec, exc, "permanent step error")
+            elif rec.retries >= self.max_retries:
+                self._fail_record(rec, exc, "retry budget exhausted")
+            else:
+                rec.retries += 1
+                survivors.append(rec)
+        try:
+            self._pool.reset()
+        except Exception as reset_exc:  # noqa: BLE001 - rebuild itself died
+            for rec in survivors:
+                self._fail_record(rec, reset_exc, "pool rebuild failed")
+            raise
+        self._c_recoveries.inc()
+        resubmitted = 0
+        for rec in survivors:  # dict order == submit order: FIFO kept
             try:
-                self._pool.cancel(rid)
-            except NotFoundError:
-                pass
-            self._c_failed.inc()
-            self._finalize(rec, RequestState.FAILED, "error", rec.tokens,
-                           error=str(exc)[:500])
+                ids = rec.prompt if not rec.tokens else np.concatenate(
+                    [rec.prompt, np.asarray(rec.tokens, np.int32)])
+                self._pool.submit(ids, rec.max_new - len(rec.tokens),
+                                  request_id=rec.rid)
+            except Exception as sub_exc:  # noqa: BLE001 - per-victim
+                self._fail_record(rec, sub_exc, "resubmit failed")
+                continue
+            rec.state = RequestState.QUEUED
+            self._live[rec.rid] = rec
+            self._c_recovered.inc()
+            resubmitted += 1
+        self._health.note_recovery(resubmitted)
 
     # -- the scheduling tick (ONE code path for both drive modes) --------
     def _tick(self) -> bool:
-        self._expire()
-        if not self._live:
-            self._observe_gauges()
-            return False
-        self._h_queue.observe(self._pool.queue_depth)
+        self._health.note_tick_start(self._clock())
         try:
-            with self._timer:
-                self._pool.step()
-        except Exception as e:  # noqa: BLE001 - step is the blast radius
-            self._fail_live(e)
-            raise
-        self._observe_gauges()
-        return bool(self._live)
+            self._expire()
+            if not self._live:
+                self._observe_gauges()
+                return False
+            self._h_queue.observe(self._pool.queue_depth)
+            try:
+                with self._timer:
+                    self._pool.step()
+            except Exception as e:  # noqa: BLE001 - step is the blast radius
+                self._health.note_error(self._clock(), e,
+                                        faults.classify_error(e))
+                self._recover(e)
+            self._observe_gauges()
+            return bool(self._live)
+        finally:
+            # the heartbeat closes even when recovery re-raises: the
+            # loop thread dying is the DEAD-LOOP signal, not a stall
+            self._health.note_tick_end(self._clock())
 
     def _observe_gauges(self) -> None:
         pool = self._pool
@@ -408,22 +546,110 @@ class ServingEngine:
             try:
                 with self._lock:
                     work = self._tick()
-            except Exception:  # noqa: BLE001
-                work = False  # _tick already failed the live requests
+            except Exception as e:  # noqa: BLE001
+                # _tick's recovery already failed the live requests;
+                # record WHAT killed the tick and WHEN into health() so
+                # the parked loop is a post-mortem, not a mystery
+                with self._lock:
+                    self._health.note_error(self._clock(), e, "loop")
+                work = False
             if not work:
                 self._wake.wait(0.002)
                 self._wake.clear()
 
+    def restart_loop(self) -> bool:
+        """Supervisor entry point: replace a DEAD background loop with a
+        fresh one (counted in ``serving_engine_restarts_total``).  False
+        — with no side effects — while the old thread is still alive
+        (a live loop must not be doubled), when no loop was ever
+        started, or once draining/shutdown made restarts pointless."""
+        with self._lock:
+            t = self._thread
+            if t is None or t.is_alive() or self._draining \
+                    or self._stop.is_set():
+                return False
+            t.join(timeout=0)
+            self._thread = threading.Thread(
+                target=self._loop, name="serving-engine-step-loop",
+                daemon=True)
+            self._thread.start()
+            self._c_restarts.inc()
+            self._health.note_restart(self._clock())
+        self._wake.set()
+        return True
+
+    def _note_stall(self) -> None:
+        """Supervisor hook: one stall EPISODE was opened on this
+        engine's heartbeat (the supervisor already de-duplicated
+        polls)."""
+        self._c_stalled.inc()
+
+    def health(self) -> dict:
+        """Liveness/post-mortem snapshot — the ``GET /healthz`` body.
+
+        Deliberately LOCK-FREE: a wedged tick is holding the engine
+        lock, and health is exactly the question asked during a wedge.
+        Every field is a single-writer plain attribute (see
+        ``supervisor.EngineHealth``); a torn read costs staleness,
+        never a hang.  ``healthy`` is False while a stall episode is
+        open, while a started loop is dead, and after drain/shutdown."""
+        h = self._health
+        t = self._thread
+        loop_alive = None if t is None else t.is_alive()
+        if h.stall_open:
+            state = "wedged"
+        elif loop_alive is False and not self._draining \
+                and not self._stop.is_set():
+            state = "loop-dead"
+        elif self._draining:
+            state = "draining" if self._live else "stopped"
+        elif self._live:
+            state = "serving"
+        else:
+            state = "idle"
+        out = {"state": state,
+               "healthy": state in ("idle", "serving", "draining"),
+               "live_requests": len(self._live),
+               "queue_depth": self._pool.queue_depth,
+               "loop_alive": loop_alive,
+               "draining": self._draining}
+        out.update(h.snapshot())
+        return out
+
+    def _deadline_estimate_s(self, max_new_tokens: int
+                             ) -> Optional[float]:
+        """Seconds until a request admitted NOW would finish, from the
+        observed mean tick time and the live token backlog — None until
+        a tick has been measured (the engine never sheds on a guess).
+        The model is the pool's own behavior: each tick advances every
+        slot one token, so the backlog drains at ``slots`` tokens per
+        tick and the new request then needs ``max_new_tokens`` ticks of
+        its own.  Deliberately simple and stated here so the shed
+        decision is auditable from the error message."""
+        if not self._timer.total:
+            return None
+        step_s = self._timer.step_time
+        backlog = sum(r.max_new - len(r.tokens)
+                      for r in self._live.values())
+        return step_s * (backlog / self._pool.slots
+                         + float(max_new_tokens))
+
     # -- graceful teardown ----------------------------------------------
     def drain(self, timeout_s: Optional[float] = None) -> bool:
         """Stop admissions, finish every in-flight request.  True when
-        drained; False on timeout (threaded mode).  In pump mode this
-        simply pumps until dry."""
+        drained; False on timeout — honored in BOTH drive modes (pump
+        mode checks the wall clock between inline ticks).  Admissions
+        stay closed after a timed-out drain; call again to keep
+        waiting."""
         with self._lock:
             self._draining = True
         if self._thread is None:
-            while self.pump(16):
-                pass
+            deadline = None if timeout_s is None \
+                else time.monotonic() + timeout_s
+            while self.pump(1):
+                if deadline is not None and \
+                        time.monotonic() >= deadline:
+                    return False
             return True
         # the poll deadline uses REAL time on purpose: an injected
         # clock (deadline tests) governs request deadlines, but how

@@ -14,12 +14,20 @@ HTTP onto ``ServingEngine.submit`` and ``metrics.render_prometheus``:
   go back to the allocator instead of decoding for nobody.
 - ``GET /metrics`` — the Prometheus text exposition of the engine's
   registry (one scrape body).
+- ``GET /healthz`` — the engine's lock-free ``health()`` snapshot as
+  JSON: 200 while healthy (idle/serving/draining), 503 while a tick is
+  wedged past the supervisor's stall timeout, the loop thread is dead,
+  or the engine was shut down.  Reading health NEVER takes the engine
+  lock — a wedged tick is holding it, and the probe must answer
+  anyway.
 
 Error mapping is the engine's typed-error vocabulary, not guesswork:
 ``InvalidArgumentError`` → 400, ``DuplicateRequestError`` → 409,
 ``QueueFullError`` → 503 with ``Retry-After`` (the engine's retryable
-backpressure signal, verbatim), draining → 503 without one (a drained
-engine never reopens), anything else → 404/405.
+backpressure signal, verbatim), ``DeadlineUnattainableError`` → 503
+with its own ``retry_after_s`` hint rounded up into ``Retry-After``,
+draining → 503 without one (a drained engine never reopens), anything
+else → 404/405.
 
 Drive modes: with ``engine.start()`` (the owned step loop) handler
 threads just block on their streams — real serving.  Without it, the
@@ -39,7 +47,9 @@ import numpy as np
 
 from ..core.errors import InvalidArgumentError, PreconditionNotMetError
 from ..inference.generation import DuplicateRequestError
-from .engine import QueueFullError, ServingEngine
+from . import faults
+from .engine import (DeadlineUnattainableError, QueueFullError,
+                     ServingEngine)
 
 __all__ = ["ServingHTTPFrontend", "parse_generate_request"]
 
@@ -137,10 +147,18 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 - stdlib casing
-            if self.path.split("?", 1)[0] != "/metrics":
+            path = self.path.split("?", 1)[0]
+            if path == "/healthz":
+                # lock-free on purpose: the probe must answer while a
+                # wedged tick holds the engine lock
+                h = engine.health()
+                self._send_json(200 if h["healthy"] else 503, h)
+                return
+            if path != "/metrics":
                 self._send_json(404, {"error": "unknown path %r; the "
-                                      "front end serves POST /generate "
-                                      "and GET /metrics" % self.path})
+                                      "front end serves POST /generate, "
+                                      "GET /metrics and GET /healthz"
+                                      % self.path})
                 return
             body = engine.metrics.render_prometheus().encode()
             self.send_response(200)
@@ -153,8 +171,9 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
         def do_POST(self):  # noqa: N802 - stdlib casing
             if self.path.split("?", 1)[0] != "/generate":
                 self._send_json(404, {"error": "unknown path %r; the "
-                                      "front end serves POST /generate "
-                                      "and GET /metrics" % self.path})
+                                      "front end serves POST /generate, "
+                                      "GET /metrics and GET /healthz"
+                                      % self.path})
                 return
             try:
                 length = int(self.headers.get("Content-Length") or 0)
@@ -180,6 +199,15 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
                     self.rfile.read(length))
                 stream = engine.submit(ids, max_new, request_id=rid,
                                        deadline_s=deadline)
+            except DeadlineUnattainableError as e:
+                # deadline-aware load shedding: retryable, with the
+                # engine's own feasibility estimate as the hint
+                self._send_json(
+                    503, {"error": str(e), "retryable": True},
+                    headers=(("Retry-After",
+                              str(max(1, int(-(-e.retry_after_s // 1)))),
+                              ),))
+                return
             except QueueFullError as e:
                 # the engine's RETRYABLE backpressure, mapped verbatim
                 self._send_json(503, {"error": str(e), "retryable": True},
@@ -203,6 +231,10 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
                 self.send_header("Cache-Control", "no-store")
                 self.end_headers()
                 for tok in stream:
+                    # `http.write` seam: an injected OSError here is a
+                    # client disconnect — the except path below cancels
+                    # the request and reclaims its slot/blocks
+                    faults.fire("http.write")
                     self.wfile.write(
                         (json.dumps({"token": int(tok)}) + "\n").encode())
                     self.wfile.flush()

@@ -4,7 +4,12 @@ A deliberately small registry — no labels, no metric vectors, no
 background collection — because the engine records everything from the
 REAL code path: admission increments the counters inside ``submit()``,
 TTFT is observed by the pool's ``on_token`` hook the moment the prefill
-emits a request's first token, and KV-cache gauges read
+emits a request's first token, the robustness counters
+(``serving_requests_recovered_total``, ``serving_recoveries_total``,
+``serving_requests_shed_total``, ``serving_engine_restarts_total``,
+``serving_ticks_stalled_total``) increment inside the recovery /
+shedding / watchdog paths themselves (docs/DESIGN.md §5f), and KV-cache
+gauges read
 ``cache_stats()`` (the allocator's own accounting) after every step —
 ``serving_kv_reachable_bytes`` (what a step can READ right now) and
 ``serving_kv_resident_bytes`` (the whole pool allocation), both

@@ -28,6 +28,8 @@ import threading
 import time
 from typing import Optional
 
+from . import faults
+
 __all__ = ["RequestState", "ResponseStream", "StreamStatus"]
 
 
@@ -75,6 +77,11 @@ class ResponseStream:
 
     # -- engine side -----------------------------------------------------
     def _put_token(self, tok: int) -> None:
+        # `stream.deliver` is the injection seam for delivery failures;
+        # the engine delivers BEFORE committing a token, so a fault here
+        # means recovery regenerates exactly this token (no loss, no
+        # duplicate — see ServingEngine._on_token)
+        faults.fire("stream.deliver")
         self._q.put_nowait(tok)
 
     def _finalize(self, status: StreamStatus) -> None:

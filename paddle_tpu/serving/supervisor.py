@@ -1,0 +1,197 @@
+"""Tick supervision: the engine's health record and its watchdog.
+
+The serving engine's background loop has two failure shapes the loop
+itself cannot report: the thread DIES (an exception that escapes the
+tick's recovery — the loop is gone, nothing ticks again) and the tick
+WEDGES (a dispatch that hangs without raising — the loop is alive but
+frozen, holding the engine lock).  Both are invisible from inside; both
+need an observer with its own thread and NO dependency on the engine
+lock.  That observer is :class:`Supervisor`:
+
+- **dead loop**: the engine's thread handle exists but the thread is
+  not alive while the engine was neither stopped nor drained — the
+  supervisor restarts the loop (``ServingEngine.restart_loop``) and
+  counts it in ``serving_engine_restarts_total``;
+- **stalled tick**: a tick started more than ``stall_timeout_s`` ago
+  and never finished — the supervisor opens a STALL episode (counted
+  once per episode in ``serving_ticks_stalled_total``, closed by the
+  tick eventually finishing), which flips ``health()`` — and therefore
+  ``GET /healthz`` — to unhealthy for the duration.  A wedged python
+  thread cannot be killed, so the supervisor's job here is honest
+  visibility plus a restart the moment the thread dies or unwedges.
+
+:class:`EngineHealth` is the lock-free heartbeat record behind
+``ServingEngine.health()``: single-writer fields (the tick thread
+writes under the engine lock; the supervisor only opens stall
+episodes), read without any lock on purpose — health is exactly the
+question you ask WHILE the engine lock is wedged.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["EngineHealth", "Supervisor"]
+
+
+class EngineHealth:
+    """Mutable heartbeat/post-mortem record for one engine.
+
+    Plain attributes, no lock: every field is written by a single
+    writer (the ticking thread under the engine lock, or the supervisor
+    for ``stall_open``/``stalls``) and read lock-free by ``health()``
+    and the watchdog — a torn read costs at worst one poll interval of
+    staleness, never a deadlock against a wedged tick."""
+
+    def __init__(self):
+        self.tick_started_at: Optional[float] = None
+        self.tick_finished_at: Optional[float] = None
+        self.ticks_total = 0
+        self.last_error: Optional[str] = None
+        self.last_error_at: Optional[float] = None
+        self.last_error_kind: Optional[str] = None
+        self.restarts = 0
+        self.recoveries = 0
+        self.requests_recovered = 0
+        self.stalls = 0
+        self.stall_open = False
+
+    # -- written by the ticking thread (under the engine lock) -----------
+    def note_tick_start(self, now: float) -> None:
+        self.tick_started_at = now
+
+    def note_tick_end(self, now: float) -> None:
+        self.tick_finished_at = now
+        self.ticks_total += 1
+        self.stall_open = False  # a finished tick closes any episode
+
+    def note_error(self, now: float, exc: BaseException,
+                   kind: str) -> None:
+        """Record the last failure for post-mortems: the step error a
+        recovery handled, or the loop-killing error ``_loop`` caught —
+        either way ``health()`` carries WHAT and WHEN, so a parked loop
+        is never a debugger-only mystery."""
+        self.last_error = "%s: %s" % (type(exc).__name__, str(exc)[:300])
+        self.last_error_at = now
+        self.last_error_kind = kind
+
+    def note_recovery(self, resubmitted: int) -> None:
+        self.recoveries += 1
+        self.requests_recovered += resubmitted
+
+    def note_restart(self, now: float) -> None:
+        self.restarts += 1
+        self.stall_open = False  # the wedged loop is gone; fresh start
+
+    # -- written by the supervisor ---------------------------------------
+    def open_stall(self) -> bool:
+        """Open a stall episode; True only on the OPENING observation
+        (the caller counts episodes, not polls)."""
+        if self.stall_open:
+            return False
+        self.stall_open = True
+        self.stalls += 1
+        return True
+
+    def tick_busy(self) -> bool:
+        """A tick started and has not finished."""
+        return self.tick_started_at is not None and (
+            self.tick_finished_at is None
+            or self.tick_finished_at < self.tick_started_at)
+
+    def snapshot(self) -> dict:
+        return {
+            "ticks_total": self.ticks_total,
+            "last_tick_started_at": self.tick_started_at,
+            "last_tick_finished_at": self.tick_finished_at,
+            "last_error": self.last_error,
+            "last_error_at": self.last_error_at,
+            "last_error_kind": self.last_error_kind,
+            "restarts": self.restarts,
+            "recoveries": self.recoveries,
+            "requests_recovered": self.requests_recovered,
+            "ticks_stalled": self.stalls,
+        }
+
+
+class Supervisor:
+    """Watchdog over one :class:`~.engine.ServingEngine`.
+
+    ``check_once()`` is the whole policy — one sweep, returns the list
+    of actions taken (``"stall-detected"``, ``"loop-restarted"``) so
+    tests drive supervision deterministically with an injected clock.
+    ``start()`` runs the same sweep from an owned daemon thread every
+    ``poll_interval_s`` for real serving.  The supervisor NEVER takes
+    the engine lock: detection reads the lock-free health record, and
+    the only mutation it performs — restarting a DEAD loop — goes
+    through ``restart_loop()``, which can take the lock safely because
+    a dead thread by definition is not holding it."""
+
+    def __init__(self, engine, stall_timeout_s: float = 5.0,
+                 poll_interval_s: Optional[float] = None, clock=None):
+        if not float(stall_timeout_s) > 0.0:
+            raise InvalidArgumentError(
+                "stall_timeout_s must be > 0, got %r" % (stall_timeout_s,))
+        self.engine = engine
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.poll_interval_s = (max(0.005, self.stall_timeout_s / 4.0)
+                                if poll_interval_s is None
+                                else float(poll_interval_s))
+        # default to the ENGINE's clock, not time.monotonic: heartbeat
+        # timestamps are stamped in the engine's clock domain, and
+        # stall math across two time bases would misfire (an engine
+        # with an injected test clock would look permanently wedged)
+        self._clock = clock if clock is not None \
+            else getattr(engine, "_clock", time.monotonic)
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    # -- the one supervision sweep ---------------------------------------
+    def check_once(self) -> List[str]:
+        """Detect a stalled tick and/or a dead loop; return the actions
+        taken this sweep (possibly empty)."""
+        actions: List[str] = []
+        eng = self.engine
+        health = eng._health
+        now = self._clock()
+        if health.tick_busy() and \
+                now - health.tick_started_at >= self.stall_timeout_s:
+            if health.open_stall():
+                eng._note_stall()
+                actions.append("stall-detected")
+        thread = eng._thread
+        if thread is not None and not thread.is_alive() \
+                and not eng._stop.is_set() and not eng.draining:
+            if eng.restart_loop():
+                actions.append("loop-restarted")
+        return actions
+
+    # -- owned watchdog thread -------------------------------------------
+    def start(self) -> "Supervisor":
+        with self._lock:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="serving-engine-supervisor",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.check_once()
+            self._stop.wait(self.poll_interval_s)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=10.0)
+                self._thread = None
+
+    def is_running(self) -> bool:
+        return self._thread is not None

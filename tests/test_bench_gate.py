@@ -230,6 +230,54 @@ def test_live_speculative_leg_passes_its_own_gate():
     assert leg["selfdraft_batch4"]["acceptance_rate"] > 0.9
 
 
+def test_serving_faults_leg_gate():
+    """The robustness leg's structural gate: a recovery wall time whose
+    greedy survivors lost tokens measured a BROKEN recovery and must
+    never promote; missing cache stamps reject like every serving
+    leg."""
+    good = {"input_staged": False, "transfer_note": "host-side rebuild",
+            "faulted": {"cache_layout": "paged",
+                        "cache_dtype": "float32",
+                        "recovery_wall_s": 0.01, "tokens_lost": 0}}
+    ok, why = bench._leg_promotable("serving_faults", good)
+    assert ok, why
+    lossy = {"input_staged": False, "transfer_note": "x",
+             "faulted": dict(good["faulted"], tokens_lost=3)}
+    ok, why = bench._leg_promotable("serving_faults", lossy)
+    assert not ok and "lost tokens" in why and "faulted" in why
+    # a leg that never stamped tokens_lost cannot claim losslessness
+    unstamped = {"input_staged": False, "transfer_note": "x",
+                 "faulted": {"cache_layout": "paged",
+                             "cache_dtype": "float32",
+                             "recovery_wall_s": 0.01}}
+    assert not bench._leg_promotable("serving_faults", unstamped)[0]
+    # missing cache provenance rejects like the other serving legs
+    nostamp = {"input_staged": False, "transfer_note": "x",
+               "faulted": {"recovery_wall_s": 0.01, "tokens_lost": 0}}
+    ok, why = bench._leg_promotable("serving_faults", nostamp)
+    assert not ok and "cache_layout" in why
+
+
+@pytest.mark.slow
+def test_live_serving_faults_leg_passes_its_own_gate():
+    """The leg bench.py actually emits must satisfy its own gate (a
+    CPU-smoke run of the real leg) — slow-marked: it runs the traffic
+    twice plus a recovery, several seconds of compile+decode."""
+    import jax
+
+    import paddle_tpu as pt
+
+    leg = bench.bench_serving_faults(pt, jax, False)
+    ok, why = bench._leg_promotable("serving_faults", leg)
+    assert ok, why
+    sub = leg["faulted"]
+    assert sub["tokens_lost"] == 0
+    assert sub["requests_recovered"] == sub["requests"]
+    assert sub["requests_failed"] == 0
+    assert sub["recovery_wall_s"] > 0
+    assert sub["blocks_reclaimed"] is True
+
+
 def test_resnet_mfu_formula_pinned():
     """The one shared MFU formula (2 FLOPs/MAC, fwd + ~2x bwd): the
     staged-input measurement of 2026-07-30 (batch 128, 0.0863 s on the

@@ -1,0 +1,143 @@
+"""Chaos harness capstone: seeded random faults, hard invariants (§5f).
+
+Each seed runs the same scripted traffic twice through the serving
+engine — once clean, once under a seeded chaos plane that injects
+transient faults at the step/alloc/deliver seams — and asserts the
+recovery invariants the fault-tolerance work exists to provide:
+
+1. the engine NEVER hangs (the pump loop is iteration-bounded and must
+   drain);
+2. every request reaches a terminal state, and every surviving greedy
+   request's output is BYTE-IDENTICAL to the fault-free run (prompt +
+   committed tokens determine greedy state — the O(1)-cache contract);
+3. slots and paged blocks are fully reclaimed at drain
+   (``cache_stats()`` back to baseline);
+4. the counters reconcile: submitted = done + failed, emitted tokens =
+   the sum of terminal token counts (recovery re-emits nothing), and a
+   chaos run that actually injected mid-flight faults shows recovery
+   counters;
+5. recovery never recompiles: ``compile_counts()`` matches the clean
+   run's.
+
+The chaos plane is seeded and capped (``max_faults``), so every run is
+replayable and guaranteed to stop interfering — determinism is what
+makes a red run debuggable.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import RequestState, ServingEngine, faults
+from paddle_tpu.serving.faults import FaultPlane
+
+CHAOS_POINTS = ("pool.step", "pool.alloc_blocks", "stream.deliver")
+# retry budget > fault cap: transient-only chaos can then never exhaust
+# a request's budget, so EVERY request must survive token-identically
+MAX_FAULTS = 6
+MAX_RETRIES = 8
+
+
+def _tiny_model():
+    pt.seed(0)
+    return TransformerLM(vocab_size=128, hidden_size=32, num_layers=1,
+                         num_heads=2, intermediate_size=64,
+                         max_position=256, causal=True, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _engine(model):
+    return ServingEngine(model, max_len=64, slots=2, buckets=[32],
+                         cache_layout="paged", block_size=8,
+                         max_retries=MAX_RETRIES)
+
+
+def _traffic(seed):
+    rng = np.random.RandomState(seed)
+    lens = (5, 9, 7, 4)
+    budgets = (6, 5, 7, 4)
+    return [rng.randint(0, 128, (n,)).astype("int32")
+            for n in lens], budgets
+
+
+def _drive(eng, prompts, budgets):
+    streams = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+    iters = 0
+    while eng.pump(1):
+        iters += 1
+        # invariant 1: the engine never hangs — a bounded fault budget
+        # must always drain in bounded ticks
+        assert iters < 500, "chaos run failed to drain: engine wedged"
+    return streams
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_chaos_invariants_hold(model, seed):
+    prompts, budgets = _traffic(seed)
+
+    clean = _engine(model)
+    baseline = clean.cache_stats()
+    clean_streams = _drive(clean, prompts, budgets)
+    want = [s.result(timeout_s=0).tokens for s in clean_streams]
+    clean_counts = clean.compile_counts()
+
+    eng = _engine(model)
+    plane = FaultPlane(chaos_seed=seed, chaos_p=0.08,
+                       chaos_points=CHAOS_POINTS, max_faults=MAX_FAULTS)
+    with faults.injected(plane):
+        streams = _drive(eng, prompts, budgets)
+
+    # invariant 2: all terminal; transient-only chaos under a retry
+    # budget larger than the fault cap means every request SURVIVES,
+    # and every survivor is byte-identical to the fault-free run
+    statuses = [s.result(timeout_s=0) for s in streams]
+    assert all(st is not None for st in statuses)
+    for st, w in zip(statuses, want):
+        assert st.state == RequestState.DONE, (seed, st.state, st.error)
+        np.testing.assert_array_equal(st.tokens, w)
+
+    # invariant 3: slots and paged blocks fully reclaimed
+    stats = eng.cache_stats()
+    assert stats["mapped_blocks"] == 0
+    assert stats["free_blocks"] == baseline["free_blocks"]
+    assert eng.live_requests == 0 and eng.queue_depth == 0
+
+    # invariant 4: counters reconcile
+    snap = eng.metrics.snapshot()
+    assert snap["serving_requests_submitted_total"] == len(prompts)
+    assert snap["serving_requests_completed_total"] == len(prompts)
+    assert snap["serving_requests_failed_total"] == 0
+    assert snap["serving_tokens_emitted_total"] == \
+        sum(st.new_tokens for st in statuses) == sum(len(w) for w in want)
+    mid_flight = [rec for rec in plane.injected
+                  if rec[2] == "TransientInjectedFault"]
+    if mid_flight:
+        assert snap["serving_recoveries_total"] >= 1
+        assert snap["serving_requests_recovered_total"] >= 1
+        assert eng.health()["last_error"] is not None
+
+    # invariant 5: recovery is re-allocation, never a recompile
+    assert eng.compile_counts() == clean_counts
+
+
+def test_chaos_across_seeds_actually_injects(model):
+    # the 5-seed sweep must EXERCISE the machinery, not vacuously pass:
+    # at least one seed's plane fires at least one mid-flight fault.
+    # (Each seed's plane is replayable, so this check is deterministic —
+    # if chaos_p or the traffic shape changes and no seed faults any
+    # more, this test says so instead of the suite silently going soft.)
+    fired = 0
+    for seed in (0, 1, 2, 3, 4):
+        prompts, budgets = _traffic(seed)
+        eng = _engine(model)
+        plane = FaultPlane(chaos_seed=seed, chaos_p=0.08,
+                           chaos_points=CHAOS_POINTS,
+                           max_faults=MAX_FAULTS)
+        with faults.injected(plane):
+            _drive(eng, prompts, budgets)
+        fired += plane.fault_count
+    assert fired >= 1

@@ -23,6 +23,7 @@ SCRIPTS = [
     ("08_generate_serving.py", ["--tokens", "8"]),
     ("09_serving_engine.py", ["--tokens", "8"]),
     ("10_http_serving.py", ["--tokens", "8"]),
+    ("11_chaos_serving.py", ["--tokens", "8"]),
 ]
 
 

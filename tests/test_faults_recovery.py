@@ -1,0 +1,447 @@
+"""Fault injection plane + request-level recovery + supervision (§5f).
+
+The robustness contracts:
+
+- ``serving.faults`` is a deterministic, typed injection plane: named
+  points only, scripted schedules (raise on the Nth hit, delay), a
+  seeded chaos mode, and a module-level no-op when uninstalled;
+- a failed ``pool.step()`` has REQUEST-level blast radius: transient
+  victims are resubmitted (prompt + committed tokens) and greedy
+  survivors finish TOKEN-IDENTICAL to a fault-free run, with no new
+  compiles (``compile_counts()`` unchanged — recovery is re-allocation,
+  never re-trace);
+- permanent errors and exhausted retry budgets finalize FAILED carrying
+  the retry count and root error; consumers unblock, and the pool-level
+  ``cancel``/``collect`` raise the typed NotFound instead of hanging;
+- ``drain(timeout_s)`` honors the deadline in BOTH drive modes;
+- the supervisor detects stalled ticks and dead loops, restarts the
+  loop, and ``health()`` carries the post-mortem (last error + when).
+
+Everything here drives the engine in deterministic pump mode except the
+two loop-lifecycle tests, which need a real (idle, compile-free)
+background thread.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import (InvalidArgumentError, NotFoundError,
+                                    PreconditionNotMetError)
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (DeadlineUnattainableError, RequestState,
+                                ServingEngine, Supervisor, faults)
+from paddle_tpu.serving.faults import (FaultPlane, FaultSpec,
+                                       PermanentInjectedFault,
+                                       TransientInjectedFault)
+
+
+def _tiny_model(vocab=128, hidden=32, heads=2, layers=1,
+                max_position=256):
+    pt.seed(0)
+    return TransformerLM(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_heads=heads, intermediate_size=2 * hidden,
+        max_position=max_position, causal=True, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- the fault plane itself (no engine, no jax) ---------------------------
+
+def test_fault_spec_validation():
+    with pytest.raises(InvalidArgumentError, match="fault point"):
+        FaultSpec("pool.stepp", error=TransientInjectedFault)
+    with pytest.raises(InvalidArgumentError, match="neither"):
+        FaultSpec("pool.step")
+    with pytest.raises(InvalidArgumentError, match="times"):
+        FaultSpec("pool.step", error=TransientInjectedFault, times=0)
+    with pytest.raises(InvalidArgumentError, match="chaos_seed"):
+        FaultPlane(chaos_p=0.5)
+    with pytest.raises(InvalidArgumentError, match="chaos points"):
+        FaultPlane(chaos_seed=0, chaos_p=0.5, chaos_points=("nope",))
+
+
+def test_scripted_schedule_counts_hits_and_times():
+    plane = FaultPlane([FaultSpec("pool.step",
+                                  error=TransientInjectedFault,
+                                  after=2, times=2)])
+    fired = []
+    for i in range(6):
+        try:
+            plane.fire("pool.step")
+        except TransientInjectedFault as e:
+            fired.append((i, e.point, e.hit))
+    # skips hits 1-2, fires on 3 and 4, then exhausted
+    assert fired == [(2, "pool.step", 3), (3, "pool.step", 4)]
+    assert plane.hits["pool.step"] == 6
+    assert [k for _, _, k in plane.injected] == \
+        ["TransientInjectedFault"] * 2
+
+
+def test_delay_spec_sleeps_and_logs():
+    plane = FaultPlane([FaultSpec("pool.step", delay_s=0.05)])
+    t0 = time.monotonic()
+    plane.fire("pool.step")   # wedge, no raise
+    assert time.monotonic() - t0 >= 0.05
+    plane.fire("pool.step")   # schedule exhausted: clean
+    assert plane.injected == [("pool.step", 1, "delay")]
+
+
+def test_chaos_mode_is_seed_deterministic_and_capped():
+    def run(seed):
+        plane = FaultPlane(chaos_seed=seed, chaos_p=0.3,
+                           chaos_points=("pool.step",), max_faults=3)
+        log = []
+        for i in range(50):
+            try:
+                plane.fire("pool.step")
+            except TransientInjectedFault:
+                log.append(i)
+        return log, plane.fault_count
+
+    log_a, n_a = run(7)
+    log_b, n_b = run(7)
+    log_c, _ = run(8)
+    assert log_a == log_b and n_a == n_b  # replayable
+    assert log_a != log_c                 # seed actually matters
+    assert n_a == 3                       # max_faults cap holds
+
+
+def test_install_uninstall_and_disabled_noop():
+    assert faults.active() is None
+    faults.fire("pool.step")  # no plane: a no-op, not an error
+    plane = FaultPlane([FaultSpec("pool.step",
+                                  error=TransientInjectedFault)])
+    with faults.injected(plane):
+        assert faults.active() is plane
+        with pytest.raises(PreconditionNotMetError, match="installed"):
+            faults.install(FaultPlane([FaultSpec(
+                "pool.step", error=TransientInjectedFault)]))
+        with pytest.raises(TransientInjectedFault):
+            faults.fire("pool.step")
+    assert faults.active() is None
+    faults.uninstall()  # idempotent
+
+
+def test_classify_error_vocabulary():
+    assert faults.classify_error(TransientInjectedFault()) == "transient"
+    assert faults.classify_error(PermanentInjectedFault()) == "permanent"
+    assert faults.classify_error(RuntimeError("boom")) == "transient"
+    assert faults.classify_error(OSError("reset")) == "transient"
+    assert faults.classify_error(
+        InvalidArgumentError("bad")) == "permanent"
+    assert faults.classify_error(NotFoundError("gone")) == "permanent"
+
+    class Cooperating(Exception):
+        transient = False
+
+    assert faults.classify_error(Cooperating()) == "permanent"
+
+
+# -- request-level recovery ----------------------------------------------
+
+def _run_reference(model, prompts, budgets, **kw):
+    eng = ServingEngine(model, **kw)
+    streams = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+    while eng.pump(8):
+        pass
+    return [s.result(timeout_s=0).tokens for s in streams]
+
+
+def test_transient_step_fault_recovers_token_identical(model):
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 128, (n,)).astype("int32")
+               for n in (5, 9, 7)]
+    budgets = [6, 6, 6]
+    kw = dict(max_len=64, slots=2, buckets=[32], cache_layout="paged",
+              block_size=8)
+    want = _run_reference(model, prompts, budgets, **kw)
+
+    eng = ServingEngine(model, **kw)
+    base = eng.cache_stats()
+    plane = FaultPlane([FaultSpec("pool.step",
+                                  error=TransientInjectedFault,
+                                  after=3, times=1)])
+    with faults.injected(plane):
+        streams = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+        guard = 0
+        while eng.pump(1):
+            guard += 1
+            assert guard < 200, "engine failed to drain after recovery"
+    assert plane.injected, "the scripted fault never fired"
+    for s, w in zip(streams, want):
+        st = s.result(timeout_s=0)
+        assert st.state == RequestState.DONE
+        np.testing.assert_array_equal(st.tokens, w)
+        assert st.new_tokens == len(w)
+    snap = eng.metrics.snapshot()
+    assert snap["serving_requests_recovered_total"] == 3
+    assert snap["serving_recoveries_total"] == 1
+    assert snap["serving_requests_failed_total"] == 0
+    # emitted-token accounting reconciles: recovery re-emits nothing
+    assert snap["serving_tokens_emitted_total"] == \
+        sum(len(w) for w in want)
+    # slots and blocks fully reclaimed
+    stats = eng.cache_stats()
+    assert stats["mapped_blocks"] == 0
+    assert stats["free_blocks"] == base["free_blocks"]
+    # recovery re-allocated, never re-traced
+    counts = eng.compile_counts()
+    assert counts["prefill"] == 1
+    assert counts["pool_decode"] == 1 and counts["slot_insert"] == 1
+    # health carries the post-mortem even though everything recovered
+    h = eng.health()
+    assert h["recoveries"] == 1 and h["requests_recovered"] == 3
+    assert "TransientInjectedFault" in h["last_error"]
+    assert h["last_error_kind"] == "transient"
+    assert h["last_error_at"] is not None
+
+
+def test_alloc_and_deliver_faults_route_through_recovery(model):
+    # the non-step seams surface through pool.step() and recover the
+    # same way: a paged allocation fault and a stream-delivery fault
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, 128, (n,)).astype("int32") for n in (5, 8)]
+    kw = dict(max_len=64, slots=2, buckets=[32], cache_layout="paged",
+              block_size=8)
+    want = _run_reference(model, prompts, [5, 5], **kw)
+    for point, after in (("pool.alloc_blocks", 1), ("stream.deliver", 4)):
+        eng = ServingEngine(model, **kw)
+        plane = FaultPlane([FaultSpec(point,
+                                      error=TransientInjectedFault,
+                                      after=after, times=1)])
+        with faults.injected(plane):
+            streams = [eng.submit(p, 5) for p in prompts]
+            guard = 0
+            while eng.pump(1):
+                guard += 1
+                assert guard < 200
+        assert any(k == "TransientInjectedFault"
+                   for _, _, k in plane.injected), point
+        for s, w in zip(streams, want):
+            st = s.result(timeout_s=0)
+            assert st.state == RequestState.DONE, (point, st.error)
+            np.testing.assert_array_equal(st.tokens, w)
+        assert eng.cache_stats()["mapped_blocks"] == 0
+
+
+def test_permanent_fault_fails_with_retry_count_and_root_error(model):
+    eng = ServingEngine(model, max_len=64, slots=2, buckets=[16])
+    plane = FaultPlane([FaultSpec(
+        "pool.step", error=PermanentInjectedFault("poisoned " * 100))])
+    with faults.injected(plane):
+        a = eng.submit(np.zeros(4, np.int32), 6)
+        while eng.pump(4):
+            pass
+    st = a.result(timeout_s=0)
+    assert st.state == RequestState.FAILED
+    assert st.finish_reason == "error"
+    assert "permanent" in st.error and "retries=0/2" in st.error
+    assert "poisoned" in st.error and len(st.error) <= 500
+    # consumers unblock instead of hanging on a stream that never ends
+    assert list(a) == []
+    assert a.done()
+    # terminal request: engine cancel is a no-op False, pool-level
+    # cancel/collect raise the typed NotFound rather than hanging
+    assert eng.cancel(a.request_id) is False
+    with pytest.raises(NotFoundError):
+        eng._pool.cancel(a.request_id)
+    with pytest.raises(NotFoundError):
+        eng._pool.collect(a.request_id)
+    assert eng.metrics.snapshot()["serving_requests_failed_total"] == 1
+
+
+def test_retry_budget_exhaustion_is_typed_and_bounded(model):
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[16],
+                        max_retries=1)
+    plane = FaultPlane([FaultSpec("pool.step",
+                                  error=TransientInjectedFault,
+                                  times=10)])
+    with faults.injected(plane):
+        a = eng.submit(np.zeros(4, np.int32), 4)
+        guard = 0
+        while eng.pump(1):
+            guard += 1
+            assert guard < 50
+    st = a.result(timeout_s=0)
+    assert st.state == RequestState.FAILED
+    assert "retry budget exhausted" in st.error
+    assert "retries=1/1" in st.error
+    snap = eng.metrics.snapshot()
+    assert snap["serving_requests_failed_total"] == 1
+    assert snap["serving_requests_recovered_total"] == 1  # the one retry
+
+
+def test_speculative_engine_recovers_token_identical(model):
+    pt.seed(1)
+    draft = TransformerLM(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=2, intermediate_size=64,
+                          max_position=256, causal=True, dropout=0.0)
+    rng = np.random.RandomState(3)
+    prompts = [rng.randint(0, 128, (n,)).astype("int32") for n in (5, 7)]
+    kw = dict(max_len=64, slots=2, buckets=[32], draft_model=draft,
+              spec_k=3)
+    want = _run_reference(model, prompts, [6, 6], **kw)
+    eng = ServingEngine(model, **kw)
+    plane = FaultPlane([FaultSpec("pool.step",
+                                  error=TransientInjectedFault,
+                                  after=2, times=1)])
+    with faults.injected(plane):
+        streams = [eng.submit(p, 6) for p in prompts]
+        guard = 0
+        while eng.pump(1):
+            guard += 1
+            assert guard < 100
+    assert plane.injected
+    for s, w in zip(streams, want):
+        st = s.result(timeout_s=0)
+        assert st.state == RequestState.DONE, st.error
+        np.testing.assert_array_equal(st.tokens, w)
+    assert eng.metrics.snapshot()[
+        "serving_requests_recovered_total"] == 2
+
+
+# -- deadline-aware load shedding ----------------------------------------
+
+def test_unattainable_deadline_shed_at_admission(model):
+    eng = ServingEngine(model, max_len=128, slots=1, buckets=[16])
+    # before any observed tick there is no rate: never shed on a guess
+    warm = eng.submit(np.zeros(4, np.int32), 3, deadline_s=1e-9)
+    clockout = eng._expire  # the tiny deadline expires it at tick 1
+    assert warm is not None and clockout is not None
+    while eng.pump(8):
+        pass
+    # now the timer has real tick observations; build a backlog
+    busy = eng.submit(np.zeros(4, np.int32), 100)
+    eng.pump(2)
+    assert eng.request_state(busy.request_id) == RequestState.DECODING
+    with pytest.raises(DeadlineUnattainableError) as ei:
+        eng.submit(np.zeros(4, np.int32), 20, deadline_s=1e-9)
+    assert ei.value.retry_after_s > 0
+    assert "shed" in str(ei.value)
+    snap = eng.metrics.snapshot()
+    assert snap["serving_requests_shed_total"] == 1
+    # a feasible deadline is admitted: shedding is not a deadline ban
+    ok = eng.submit(np.zeros(4, np.int32), 5, deadline_s=1e6)
+    while eng.pump(200):
+        pass
+    assert ok.result(timeout_s=0).state == RequestState.DONE
+    assert busy.result(timeout_s=0).state == RequestState.DONE
+
+
+# -- drain honors timeout_s in pump mode (satellite) ----------------------
+
+def test_drain_timeout_honored_in_pump_mode(model):
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[16])
+    s = eng.submit(np.zeros(5, np.int32), 40)
+    eng.pump(1)
+    assert eng.drain(timeout_s=0.0) is False  # deadline hit, not done
+    assert eng.draining
+    with pytest.raises(PreconditionNotMetError):
+        eng.submit(np.zeros(4, np.int32), 2)
+    # in-flight work was NOT cancelled by the timeout; finishing the
+    # drain completes it
+    assert eng.drain() is True
+    assert s.result(timeout_s=0).state == RequestState.DONE
+    assert s.result(timeout_s=0).new_tokens == 40
+
+
+# -- supervision ----------------------------------------------------------
+
+def test_supervisor_stall_detection_and_healthz_state(model):
+    clock = FakeClock()
+    eng = ServingEngine(model, max_len=32, slots=1, buckets=[8],
+                        clock=clock)
+    sup = Supervisor(eng, stall_timeout_s=0.5, clock=clock)
+    assert eng.health()["healthy"] and eng.health()["state"] == "idle"
+    # fabricate a wedged tick: started, never finished, past timeout
+    eng._health.note_tick_start(clock())
+    clock.advance(0.4)
+    assert sup.check_once() == []       # not past the timeout yet
+    clock.advance(0.2)
+    assert sup.check_once() == ["stall-detected"]
+    assert sup.check_once() == []       # one episode, counted once
+    h = eng.health()
+    assert h["state"] == "wedged" and not h["healthy"]
+    assert h["ticks_stalled"] == 1
+    assert eng.metrics.snapshot()["serving_ticks_stalled_total"] == 1
+    # the tick finally completes: the episode closes, health recovers
+    eng._health.note_tick_end(clock())
+    h = eng.health()
+    assert h["healthy"] and h["ticks_stalled"] == 1
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_supervisor_restarts_dead_loop(model):
+    # the SystemExit that kills the loop below IS the scenario under
+    # test; pytest's threadexception plugin would otherwise surface it
+    # as a warning
+    eng = ServingEngine(model, max_len=32, slots=1, buckets=[8])
+    sup = Supervisor(eng, stall_timeout_s=5.0)
+    assert eng.restart_loop() is False  # no loop was ever started
+    eng.start()
+    try:
+        t_old = eng._thread
+
+        def boom():
+            raise SystemExit  # kills the loop thread (BaseException)
+
+        eng._tick = boom
+        t_old.join(timeout=10.0)
+        assert not t_old.is_alive()
+        del eng._tick  # restore the class method for the restarted loop
+        h = eng.health()
+        assert h["state"] == "loop-dead" and not h["healthy"]
+        assert sup.check_once() == ["loop-restarted"]
+        assert eng._thread is not t_old and eng._thread.is_alive()
+        assert eng.restart_loop() is False  # alive loop: refuse
+        h = eng.health()
+        assert h["restarts"] == 1 and h["healthy"]
+        assert eng.metrics.snapshot()[
+            "serving_engine_restarts_total"] == 1
+    finally:
+        eng.shutdown()
+    assert eng.restart_loop() is False  # shut down: restarts refuse
+
+
+def test_loop_records_error_into_health(model):
+    # satellite: a loop-killing error is recorded (what + when) instead
+    # of the loop parking silently
+    clock_before = time.monotonic()
+    eng = ServingEngine(model, max_len=32, slots=1, buckets=[8])
+    eng.start()
+    try:
+        def boom():
+            raise RuntimeError("post-mortem me")
+
+        eng._tick = boom
+        deadline = time.monotonic() + 10.0
+        while eng.health()["last_error"] is None \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        del eng._tick
+        h = eng.health()
+        assert h["last_error"] == "RuntimeError: post-mortem me"
+        assert h["last_error_kind"] == "loop"
+        assert h["last_error_at"] is not None
+        assert h["last_error_at"] >= clock_before
+        assert h["loop_alive"] is True  # the loop survived (caught it)
+    finally:
+        eng.shutdown()

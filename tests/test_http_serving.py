@@ -218,6 +218,115 @@ def test_get_metrics_renders_prometheus(model):
     assert text == eng.metrics.render_prometheus()
 
 
+# -- robustness surface: /healthz, shedding, disconnect seam -------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_healthz_flips_200_503_200_across_wedge_and_restart(model):
+    # the SystemExit killing the loop below IS the dead-loop scenario;
+    # pytest's threadexception plugin would otherwise warn about it
+    from paddle_tpu.serving import Supervisor
+
+    clock = _FakeClock()
+    eng = ServingEngine(model, max_len=32, slots=1, buckets=[8],
+                        clock=clock)
+    sup = Supervisor(eng, stall_timeout_s=0.5, clock=clock)
+    code, _, payload = _http(eng, "GET", "/healthz")
+    body = json.loads(payload)
+    assert code == 200 and body["healthy"] and body["state"] == "idle"
+    # an injected wedge: a tick that started and never finished, past
+    # the supervisor's stall timeout
+    eng._health.note_tick_start(clock())
+    clock.advance(1.0)
+    assert sup.check_once() == ["stall-detected"]
+    code, _, payload = _http(eng, "GET", "/healthz")
+    body = json.loads(payload)
+    assert code == 503
+    assert body["state"] == "wedged" and body["ticks_stalled"] == 1
+    # the wedge clears (tick completes): healthy again, episode closed
+    eng._health.note_tick_end(clock())
+    code, _, payload = _http(eng, "GET", "/healthz")
+    assert code == 200 and json.loads(payload)["healthy"]
+    # and across a WATCHDOG RESTART: kill the background loop, let the
+    # supervisor restart it, health reports the restart and stays 200
+    eng.start()
+    try:
+        t_old = eng._thread
+
+        def boom():
+            raise SystemExit
+
+        eng._tick = boom
+        t_old.join(timeout=10.0)
+        assert not t_old.is_alive()
+        del eng._tick
+        assert _http(eng, "GET", "/healthz")[0] == 503  # loop-dead
+        assert sup.check_once() == ["loop-restarted"]
+        code, _, payload = _http(eng, "GET", "/healthz")
+        body = json.loads(payload)
+        assert code == 200 and body["restarts"] == 1
+    finally:
+        eng.shutdown()
+
+
+def test_unattainable_deadline_maps_to_503_with_retry_after(model):
+    eng = ServingEngine(model, max_len=128, slots=1, buckets=[8])
+    # warm the tick-rate observation, then pile up a backlog
+    eng.submit(np.zeros(4, np.int32), 3)
+    while eng.pump(8):
+        pass
+    eng.submit(np.zeros(4, np.int32), 100)
+    eng.pump(2)
+    code, headers, payload = _http(
+        eng, "POST", "/generate",
+        json.dumps({"prompt": [1, 2], "max_new_tokens": 20,
+                    "deadline_s": 1e-9}).encode())
+    assert code == 503
+    assert int(headers["Retry-After"]) >= 1
+    body = json.loads(payload)
+    assert body["retryable"] is True and "shed" in body["error"]
+    assert eng.metrics.snapshot()["serving_requests_shed_total"] == 1
+    while eng.pump(200):
+        pass
+
+
+def test_http_write_fault_cancels_like_a_disconnect(model):
+    from paddle_tpu.serving import faults
+    from paddle_tpu.serving.faults import FaultPlane, FaultSpec
+
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[16],
+                        cache_layout="paged", block_size=8)
+    free0 = eng.cache_stats()["free_blocks"]
+    plane = FaultPlane([FaultSpec(
+        "http.write", error=ConnectionResetError("injected disconnect"),
+        after=2, times=1)])
+    with faults.injected(plane):
+        code, _, payload = _http(
+            eng, "POST", "/generate",
+            json.dumps({"prompt": [3, 1, 4],
+                        "max_new_tokens": 30}).encode())
+    assert code == 200  # headers + two token lines went out first
+    lines = [json.loads(l) for l in payload.splitlines()]
+    assert len(lines) == 2 and all("token" in l for l in lines)
+    # the disconnect cancelled the request: slot and blocks reclaimed,
+    # no terminal line was ever written for a consumer that left
+    assert eng.live_requests == 0
+    assert eng.cache_stats()["free_blocks"] == free0
+    assert eng.metrics.snapshot()[
+        "serving_requests_cancelled_total"] == 1
+
+
 # -- the real server (threaded: slow-marked per the tier-1 budget) -------
 
 @pytest.mark.slow

@@ -31,11 +31,14 @@ HOT_ROOTS = (
 
 # -- dynamic-dispatch edges the AST cannot resolve -----------------------
 # caller qualname suffix -> callee qualname suffixes.  These annotate
-# the three dynamic seams of the decode path: the session's model
-# indirection (self._model(...)), container iteration over LayerList,
-# and the pool's serving-layer lifecycle hooks.  Keeping them explicit
-# is the deal static analysis makes with dynamic dispatch — a new seam
-# needs a new line here, which review can see.
+# the dynamic seams of the decode path: the session's model indirection
+# (self._model(...)), container iteration over LayerList, the pool's
+# serving-layer lifecycle hooks, and the fault-injection plane (the
+# pool's `_fire` helper lazily binds serving.faults, and
+# `faults.fire` dispatches to the installed FaultPlane — both invisible
+# to the AST).  Keeping them explicit is the deal static analysis makes
+# with dynamic dispatch — a new seam needs a new line here, which
+# review can see.
 EXTRA_EDGES = {
     "DecodeSession._run_model": ("TransformerLM.forward",),
     "TransformerEncoder.forward": ("TransformerEncoderLayer.forward",),
@@ -48,6 +51,17 @@ EXTRA_EDGES = {
     "SpeculativePool.step": ("ServingEngine._on_token",
                              "ServingEngine._on_finish"),
     "ServingEngine._finalize": ("ResponseStream._finalize",),
+    # fault plane: the hot path's module-level no-op check fans into the
+    # installed plane, so the plane's own fire() is hot-path-audited
+    "_fire": ("fire",),
+    "fire": ("FaultPlane.fire",),
+    "ResponseStream._put_token": ("fire",),
+    "ServingEngine._on_token": ("ResponseStream._put_token",),
+    # recovery: the engine rebuilds whichever pool variant it owns and
+    # resubmits through the pool's host API — all behind self._pool
+    "ServingEngine._recover": ("GenerationPool.reset",
+                               "SpeculativePool.reset",
+                               "GenerationPool.submit"),
     "dynamic_decode": ("BeamSearchDecoder.initialize",
                        "BeamSearchDecoder.step",
                        "BeamSearchDecoder.finalize"),
