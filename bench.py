@@ -733,6 +733,33 @@ def bench_serving(pt, jax, on_tpu: bool):
         }
         best_tps = max(best_tps, tps)
     out["tokens_per_sec"] = round(best_tps, 1)
+    # tracing price: the SAME traffic through the (warmed) slots=8
+    # engine with the flight recorder ON vs OFF — the §5g tracing
+    # contract says the recorder must be effectively free on the tick
+    # path, and this stamp is where that claim is measured instead of
+    # asserted (min-of-2 per mode to shave scheduler noise;
+    # _leg_promotable refuses serving legs whose overhead exceeds 3%)
+    from paddle_tpu.serving import trace as serving_trace
+
+    def _traffic_wall(tracing: bool) -> float:
+        tracer = serving_trace.Tracer(capacity=4096) if tracing else None
+        if tracer is not None:
+            serving_trace.install(tracer)
+        try:
+            t0 = time.perf_counter()
+            streams = [engine.submit(p, gen) for p in prompts]
+            while engine.pump(16):
+                pass
+            for s in streams:
+                s.result(timeout_s=0)
+            return time.perf_counter() - t0
+        finally:
+            if tracer is not None:
+                serving_trace.uninstall()
+    off_wall = min(_traffic_wall(False), _traffic_wall(False))
+    on_wall = min(_traffic_wall(True), _traffic_wall(True))
+    out["trace_overhead_pct"] = round(
+        max(0.0, (on_wall - off_wall) / off_wall * 100.0), 2)
     return out
 
 
@@ -1152,6 +1179,19 @@ def _leg_promotable(name: str, leg: dict):
                 return False, ("speculative leg missing acceptance_rate "
                                "on %s: cannot tell a measured draft win "
                                "from wasted drafting" % (no_rate,))
+        if name == "serving":
+            # the §5g tracing contract is that the flight recorder is
+            # effectively free on the tick path; a serving number whose
+            # measured tracing-on overhead exceeds 3% was taken on an
+            # engine where the recorder IS part of the cost, and must
+            # not be presented as the scheduler's price (legacy records
+            # without the stamp predate tracing and stand as-is)
+            pct = leg.get("trace_overhead_pct")
+            if pct is not None and pct > 3.0:
+                return False, ("serving leg trace overhead %.3g%% > 3%%: "
+                               "tracing must be hot-path-free — this "
+                               "number measured the recorder, not the "
+                               "scheduler" % (pct,))
     return True, ""
 
 

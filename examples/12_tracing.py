@@ -1,0 +1,121 @@
+"""Example 12: request-scoped tracing + the tick flight recorder (§5g).
+
+Example 11 broke the serving stack on purpose and watched it recover;
+this one watches WHERE the time goes and WHAT happened — the
+observability leg (docs/DESIGN.md §5g):
+
+1. **tracing**: ``engine.start_trace()`` installs a bounded flight
+   recorder; every tick runs as a numbered span with per-phase children
+   (admit / prefill / decode / sample / deliver), and lifecycle
+   transitions, compile events, fault injections, recoveries and sheds
+   land in the ring.  Tracing off is a module-level no-op on the hot
+   path;
+2. **per-request timelines**: ``engine.request_trace(rid)`` — the
+   ``GET /debug/trace?rid=`` body — shows one request's path, including
+   the injection → recovery → completion sequence of a faulted run;
+3. **Chrome export**: ``engine.export_chrome_trace(path)`` writes
+   trace-event JSON (one track per request + per tick phase) that
+   chrome://tracing / Perfetto load directly;
+4. **deep timing**: an opt-in mode that syncs phase edges
+   (``block_until_ready``) for honest device attribution — every span
+   carries its ``deep`` flag so dispatch time can never masquerade as
+   device time.
+
+Run: python examples/12_tracing.py [--tokens 8]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import argparse
+import json
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import ServingEngine, faults
+
+
+def build_engine(model):
+    return ServingEngine(model, max_len=128, slots=2, buckets=[64, 128],
+                         max_queue=8, cache_layout="paged",
+                         block_size=32, max_retries=4)
+
+
+def run(engine, prompts, tokens):
+    streams = [engine.submit(p, tokens, request_id="req-%d" % i)
+               for i, p in enumerate(prompts)]
+    while engine.pump(4):
+        pass
+    return [s.result(timeout_s=0) for s in streams]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=8)
+    args = ap.parse_args()
+
+    pt.seed(0)
+    model = TransformerLM(vocab_size=256, hidden_size=32, num_layers=1,
+                          num_heads=2, intermediate_size=128,
+                          max_position=256, causal=True, dropout=0.0)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 256, (n,)).astype("int32")
+               for n in (20, 35, 28)]
+
+    # -- trace a faulted run: the timeline carries its own post-mortem
+    engine = build_engine(model)
+    tracer = engine.start_trace(capacity=2048)
+    spec = faults.FaultSpec("pool.step",
+                            error=faults.TransientInjectedFault,
+                            after=2, times=1)
+    with faults.injected(faults.FaultPlane([spec])):
+        statuses = run(engine, prompts, args.tokens)
+    engine.stop_trace()
+    print("states:", [st.state for st in statuses])
+    events = tracer.recorder.snapshot()
+    print("flight recorder: %d events (capacity %d, dropped %d)"
+          % (len(events), tracer.recorder.capacity,
+             tracer.recorder.dropped))
+    by_name = {}
+    for e in events:
+        by_name[e.name] = by_name.get(e.name, 0) + 1
+    print("event counts:", dict(sorted(by_name.items())))
+
+    # -- one request's timeline (the GET /debug/trace?rid= body)
+    recovered = [e.rid for e in events if e.name == "recovery.resubmit"]
+    rid = recovered[0] if recovered else statuses[0].request_id
+    tl = engine.request_trace(rid)
+    print("timeline for %s:" % rid)
+    for e in tl["events"]:
+        print("  %-18s %s" % (e["name"],
+                              "dur=%.1fus" % (e["dur_s"] * 1e6)
+                              if "dur_s" in e else ""))
+
+    # -- Chrome/Perfetto export (load in chrome://tracing)
+    path = os.path.join(tempfile.mkdtemp(prefix="paddle_tpu_trace_"),
+                        "serving_trace.json")
+    engine.export_chrome_trace(path)
+    doc = json.load(open(path))
+    print("chrome trace: %d events -> %s" % (len(doc["traceEvents"]),
+                                             path))
+
+    # -- deep timing: phase edges synced, spans flagged honest
+    engine2 = build_engine(model)
+    engine2.start_trace(capacity=512, deep_timing=True)
+    run(engine2, prompts[:1], args.tokens)
+    engine2.stop_trace()
+    deep = json.loads(engine2.export_chrome_trace())
+    phase = [e for e in deep["traceEvents"]
+             if e.get("cat") == "phase" and e["name"] == "tick.decode"]
+    print("deep-timing tick.decode spans: %d, all flagged deep=%s"
+          % (len(phase), all(e["args"]["deep"] for e in phase)))
+    print("done.")
+
+
+if __name__ == "__main__":
+    main()

@@ -64,6 +64,21 @@ def _fire(point: str) -> None:
     _faults.fire(point)
 
 
+# the serving trace plane, bound lazily for the same circularity reason
+# as _faults above: _trace_active() costs one bound-module attribute
+# read returning None while no tracer is installed, so the tick phases
+# below pay nothing when tracing is off (serving/trace.py)
+_trace = None
+
+
+def _trace_active():
+    global _trace
+    if _trace is None:
+        from ..serving import trace as _trace_mod
+        _trace = _trace_mod
+    return _trace.active()
+
+
 class DuplicateRequestError(AlreadyExistsError, InvalidArgumentError):
     """``submit()`` reused a request_id that is still queued, active, or
     awaiting collection.  Subclasses ``InvalidArgumentError`` so callers
@@ -451,6 +466,7 @@ class GenerationPool:
         return len(self._active)
 
     def _refill(self):
+        tr = _trace_active()
         while self._queue and self._free:
             if self.cache_layout == "paged":
                 # admission control: FIFO head waits until enough blocks
@@ -467,8 +483,18 @@ class GenerationPool:
             # runs BEFORE the slot is popped so a prefill failure can
             # never leak a slot
             _fire("pool.prefill")
-            row_cache, tok, self._key = self._session.prefill(
-                req.ids[None], self._key)
+            if tr is None:
+                row_cache, tok, self._key = self._session.prefill(
+                    req.ids[None], self._key)
+            else:
+                with tr.span("tick.prefill", rid=req.rid,
+                             prompt_tokens=len(req.ids)):
+                    row_cache, tok, self._key = self._session.prefill(
+                        req.ids[None], self._key)
+                    if tr.deep:
+                        # deep-timing honesty: the prefill span ends at
+                        # the fusion boundary, not at dispatch return
+                        jax.block_until_ready(row_cache)
             slot = self._free.pop()
             first = int(np.asarray(tok)[0])
             if self.cache_layout == "paged":
@@ -517,18 +543,58 @@ class GenerationPool:
 
     def step(self) -> bool:
         """Refill free slots, run ONE batched decode step; False when the
-        pool is drained (no queued or active requests)."""
+        pool is drained (no queued or active requests).
+
+        With a tracer installed (serving/trace.py) each phase of the
+        tick is spanned — admit (refill incl. per-request prefill),
+        decode (the batched dispatch; ``deep_timing`` syncs it at the
+        edge), sample (the per-tick host download of the sampled ids),
+        deliver (the host loop committing tokens and firing hooks) —
+        through the tracing-off-is-a-no-op branches below."""
         _fire("pool.step")
-        self._refill()
+        tr = _trace_active()
+        if tr is None:
+            self._refill()
+        else:
+            with tr.span("tick.admit"):
+                self._refill()
         if not self._active:
             return bool(self._queue)
         params, bufs = self._sync_step_inputs()
+        if tr is None:
+            tok_dev = self._dispatch(params, bufs)
+            tok = np.asarray(tok_dev)
+        else:
+            with tr.span("tick.decode"):
+                tok_dev = self._dispatch(params, bufs)
+                if tr.deep:
+                    # deep-timing honesty: close the decode span at the
+                    # device edge, not at dispatch return
+                    jax.block_until_ready(tok_dev)
+            with tr.span("tick.sample"):
+                # the per-tick host download of the sampled ids — the
+                # designed sync point whether or not it is spanned
+                tok = np.asarray(tok_dev)
+        self._tok_dev = tok_dev  # feeds straight back next step
+        self._last_tok = tok.astype(np.int32)
+        if tr is None:
+            self._deliver(tok)
+        else:
+            with tr.span("tick.deliver"):
+                self._deliver(tok)
+        return bool(self._active or self._queue)
+
+    def _dispatch(self, params, bufs):
+        """The one batched decode dispatch (cache donated and rebound in
+        the same statement)."""
         self._cache, tok_dev, self._key = self._decode_jit(
             params, bufs, self._cache, self._tok_dev, self._active_dev,
             self._key)
-        self._tok_dev = tok_dev  # feeds straight back next step
-        tok = np.asarray(tok_dev)
-        self._last_tok = tok.astype(np.int32)
+        return tok_dev
+
+    def _deliver(self, tok) -> None:
+        """Commit the step's sampled token to every active slot: append,
+        fire ``on_token``, finish rows hitting EOS/budget."""
         for slot in list(self._active):
             state = self._active[slot]
             t = int(tok[slot])
@@ -539,7 +605,6 @@ class GenerationPool:
             if state.remaining == 0 or \
                     (self.eos_id is not None and t == self.eos_id):
                 self._finish(slot)
-        return bool(self._active or self._queue)
 
     def refresh_weights(self):
         """Drop the cached parameter/buffer value lists — call after

@@ -43,7 +43,7 @@ from ..core.errors import InvalidArgumentError
 from ..jit.decode import DecodeSession, truncate_at_eos
 from ..jit.speculative import (acceptance_summary, check_draft_compatible,
                                greedy_accept)
-from .generation import GenerationPool, _fire
+from .generation import GenerationPool, _fire, _trace_active
 
 __all__ = ["SpeculativePool"]
 
@@ -218,17 +218,56 @@ class SpeculativePool(GenerationPool):
     def step(self) -> bool:
         """Refill free slots, run ONE speculative round (K draft steps,
         one verify, one draft fixup); every active slot commits 1 to
-        ``spec_k + 1`` tokens.  False when the pool is drained."""
+        ``spec_k + 1`` tokens.  False when the pool is drained.
+
+        With a tracer installed (serving/trace.py) the round gets the
+        same phase spans as the plain pool's tick — admit, decode (the
+        whole draft+verify+fixup device round), sample (the batched
+        download), deliver — through tracing-off-is-a-no-op branches."""
         _fire("pool.step")  # same seam as the plain pool: the serving
         # engine's recovery treats a failed round exactly like a failed
         # decode step (rebuild + resubmit, token-identical greedy)
-        self._refill()
+        tr = _trace_active()
+        if tr is None:
+            self._refill()
+        else:
+            with tr.span("tick.admit"):
+                self._refill()
         if not self._active:
             return bool(self._queue)
         params, bufs = self._sync_step_inputs()
         if self._draft_state_cache is None:
             self._draft_state_cache = self._draft_session._state_vals()
         dparams, dbufs = self._draft_state_cache
+        if tr is None:
+            emitted_dev, m_dev, pending_dev = self._spec_round(
+                params, bufs, dparams, dbufs)
+            emitted, m_host = jax.device_get((emitted_dev, m_dev))
+        else:
+            with tr.span("tick.decode", spec_k=self.spec_k):
+                emitted_dev, m_dev, pending_dev = self._spec_round(
+                    params, bufs, dparams, dbufs)
+                if tr.deep:
+                    # deep-timing honesty: close the round's span at
+                    # the device edge, not at dispatch return
+                    jax.block_until_ready(m_dev)
+            with tr.span("tick.sample"):
+                emitted, m_host = jax.device_get((emitted_dev, m_dev))
+        if tr is None:
+            self._deliver_round(emitted, m_host)
+        else:
+            with tr.span("tick.deliver"):
+                self._deliver_round(emitted, m_host)
+        if not self._membership_dirty:
+            # steady state: every slot committed its full round, so the
+            # device-resident pending vector is already next round's
+            # draft input
+            self._tok_dev = pending_dev
+        return bool(self._active or self._queue)
+
+    def _spec_round(self, params, bufs, dparams, dbufs):
+        """The round's device work: K draft steps, one verify, one
+        draft fixup.  Returns ``(emitted_dev, m_dev, pending_dev)``."""
         k = self.spec_k
         t0 = time.perf_counter() if self._time_split else 0.0
         d_toks = []
@@ -255,14 +294,20 @@ class SpeculativePool(GenerationPool):
         self._draft_cache = self._draft_fixup_jit(
             dparams, dbufs, self._draft_cache, d_toks[-1], m_dev,
             self._active_dev)
-        # ONE batched download for the round (tools/analysis
-        # host-sync-in-hot-path): device_get starts both transfers
-        # before blocking, where two np.asarray calls would pay two
-        # sequential host round trips per round over a thin transport
-        emitted, m_host = jax.device_get((emitted_dev, m_dev))
+        return emitted_dev, m_dev, pending_dev
+
+    def _deliver_round(self, emitted, m_host) -> None:
+        """Commit each slot's accepted chunk: acceptance accounting,
+        per-token ``on_token`` hooks, EOS/budget finishes.
+
+        The caller already did the round's ONE batched download
+        (tools/analysis host-sync-in-hot-path): ``jax.device_get``
+        starts both transfers before blocking, where two np.asarray
+        calls would pay two sequential host round trips per round over
+        a thin transport."""
         n_active = len(self._active)
         self._rounds += 1
-        self._drafted += k * n_active
+        self._drafted += self.spec_k * n_active
         self._accepted += int(m_host[list(self._active)].sum())
         for slot in list(self._active):
             state = self._active[slot]
@@ -279,12 +324,6 @@ class SpeculativePool(GenerationPool):
                     (self.eos_id is not None and
                      int(take[-1]) == self.eos_id):
                 self._finish(slot)
-        if not self._membership_dirty:
-            # steady state: every slot committed its full round, so the
-            # device-resident pending vector is already next round's
-            # draft input
-            self._tok_dev = pending_dev
-        return bool(self._active or self._queue)
 
     def refresh_weights(self):
         """Drop BOTH models' cached weight value lists (hot swap)."""

@@ -19,7 +19,7 @@ import os
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["LogWriter", "export_chrome_tracing"]
+__all__ = ["LogWriter", "export_chrome_tracing", "chrome_trace_json"]
 
 
 class LogWriter:
@@ -63,6 +63,25 @@ class LogWriter:
         return out
 
 
+def chrome_trace_json(trace_events: List[dict],
+                      path: Optional[str] = None) -> str:
+    """Serialize a prepared chrome trace-event list to the standard
+    ``{"traceEvents": [...]}`` JSON document (chrome://tracing /
+    Perfetto load it directly); returns the JSON string and writes it to
+    ``path`` when given.  The one shared writer behind BOTH trace
+    exports — the dispatch profiler's op-table
+    (:func:`export_chrome_tracing`) and the serving flight recorder
+    (``serving.trace.export_chrome_trace``) — so the on-disk format
+    cannot fork."""
+    s = json.dumps({"traceEvents": trace_events, "displayTimeUnit": "ms"})
+    if path is not None:
+        if not path.endswith(".json"):
+            path += ".json"
+        with open(path, "w") as f:
+            f.write(s)
+    return s
+
+
 def export_chrome_tracing(path: str, op_times: Optional[List] = None) -> str:
     """Write the collected op-time table as chrome trace events.
 
@@ -87,9 +106,7 @@ def export_chrome_tracing(path: str, op_times: Optional[List] = None) -> str:
             "ts": start * 1e6, "dur": dur * 1e6,
             "cat": "op",
         })
-    out = {"traceEvents": events, "displayTimeUnit": "ms"}
     if not path.endswith(".json"):
         path += ".json"
-    with open(path, "w") as f:
-        json.dump(out, f)
+    chrome_trace_json(events, path)
     return path

@@ -25,6 +25,18 @@ restarts a dead loop and flags wedged ticks; ``ServingEngine.health()``
 backs ``GET /healthz``; deadline-aware admission sheds unattainable
 requests with the retryable ``DeadlineUnattainableError``.
 
+Observability (docs/DESIGN.md §5g): ``metrics`` is the aggregate
+surface, ``supervisor`` the liveness surface, and ``trace`` the
+request-scoped one — a bounded flight recorder plus span/event tracing
+of the full request path (lifecycle transitions, tick phases, compile
+events, fault injections, recoveries, sheds, restarts), a module-level
+no-op when off, with an opt-in deep-timing mode that syncs phase edges
+for honest device attribution.  Export via
+``ServingEngine.export_chrome_trace()`` (Chrome/Perfetto JSON),
+``GET /debug/trace?rid=<id>`` / ``GET /debug/flightrec`` on the HTTP
+front end, and automatic post-mortem dumps into ``EngineHealth`` when
+supervision trips.
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —
@@ -32,7 +44,7 @@ serving-oriented systems work (PAPERS.md, arXiv:2603.09555) treats the
 cached decode step as a component inside a request scheduler; this
 package is that scheduler.
 """
-from . import faults
+from . import faults, trace
 from .engine import (DeadlineUnattainableError, QueueFullError,
                      ServingEngine)
 from .http import ServingHTTPFrontend, parse_generate_request
@@ -40,6 +52,7 @@ from .metrics import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry)
 from .stream import RequestState, ResponseStream, StreamStatus
 from .supervisor import EngineHealth, Supervisor
+from .trace import FlightRecorder, TraceEvent, Tracer
 
 __all__ = [
     "ServingEngine", "QueueFullError", "DeadlineUnattainableError",
@@ -48,4 +61,5 @@ __all__ = [
     "DEFAULT_TIME_BUCKETS",
     "ServingHTTPFrontend", "parse_generate_request",
     "faults", "Supervisor", "EngineHealth",
+    "trace", "Tracer", "FlightRecorder", "TraceEvent",
 ]

@@ -53,6 +53,13 @@ Design points (docs/DESIGN.md §5c):
   :class:`DeadlineUnattainableError` (carrying a ``retry_after_s``
   hint, mapped to HTTP 503 + Retry-After) instead of burning a slot on
   output its caller will throw away.
+- **Request-scoped tracing.** With a tracer installed
+  (``start_trace()`` / ``serving.trace``) every tick runs inside a
+  numbered span, lifecycle transitions / recoveries / sheds / compiles
+  land in the bounded flight recorder, and
+  ``export_chrome_trace()`` / ``request_trace()`` /
+  ``flight_recorder()`` expose the timeline (docs/DESIGN.md §5g).
+  Tracing off is a module-level no-op on the tick path.
 """
 from __future__ import annotations
 
@@ -62,11 +69,11 @@ from typing import Dict, Optional
 
 import numpy as np
 
-from ..core.errors import (InvalidArgumentError, PreconditionNotMetError,
-                           UnavailableError)
+from ..core.errors import (InvalidArgumentError, NotFoundError,
+                           PreconditionNotMetError, UnavailableError)
 from ..inference.generation import GenerationPool
 from ..profiler import StepTimer
-from . import faults
+from . import faults, trace
 from .metrics import MetricsRegistry
 from .stream import RequestState, ResponseStream, StreamStatus
 from .supervisor import EngineHealth
@@ -183,6 +190,14 @@ class ServingEngine:
         self._wake = threading.Event()
         self._timer = StepTimer()  # profiler's step-time/throughput helper
         self._tokens_total = 0
+        # tracing state (serving/trace.py): the last tracer a tick
+        # observed (or start_trace installed) stays referenced so
+        # export_chrome_trace()/post-mortem dumps work after
+        # stop_trace(); the watermarks feed the drop counter and the
+        # compile-event diffing — all touched only while tracing is ON
+        self._tracer: Optional[trace.Tracer] = None
+        self._trace_dropped_seen = 0
+        self._compile_seen: Optional[dict] = None
 
         self.metrics = metrics if metrics is not None else MetricsRegistry()
         m = self.metrics
@@ -216,6 +231,10 @@ class ServingEngine:
             "ticks that exceeded the supervisor's stall timeout")
         self._c_tokens = m.counter(
             "serving_tokens_emitted_total", "tokens streamed to callers")
+        self._c_trace_dropped = m.counter(
+            "serving_trace_events_dropped_total",
+            "flight-recorder ring overflow: trace events evicted "
+            "before export (bounded tracing is observable, not silent)")
         self._g_queue = m.gauge(
             "serving_queue_depth", "requests waiting for a slot")
         self._h_queue = m.histogram(
@@ -291,6 +310,9 @@ class ServingEngine:
                 est = self._deadline_estimate_s(int(max_new_tokens))
                 if est is not None and est > float(deadline_s):
                     self._c_shed.inc()
+                    trace.instant("shed", rid=request_id,
+                                  deadline_s=float(deadline_s),
+                                  estimate_s=est)
                     raise DeadlineUnattainableError(
                         "deadline_s=%.3g cannot be met: the live "
                         "backlog and observed tick rate put completion "
@@ -309,6 +331,10 @@ class ServingEngine:
                 None if deadline_s is None else now + float(deadline_s),
                 now)
             self._c_submitted.inc()
+            trace.instant("req.queued", rid=rid,
+                          prompt_tokens=int(ids.shape[0]),
+                          max_new_tokens=int(max_new_tokens),
+                          deadline_s=deadline_s)
             self._g_queue.set(self._pool.queue_depth)
         self._wake.set()
         return stream
@@ -318,6 +344,8 @@ class ServingEngine:
         rec = self._live.get(rid)
         if rec is not None:
             rec.state = RequestState.PREFILLING
+            trace.instant("req.prefilling", rid=rid, slot=slot,
+                          prompt_tokens=prompt_len)
 
     def _on_token(self, rid, tok):
         rec = self._live.get(rid)
@@ -334,6 +362,8 @@ class ServingEngine:
         if rec.first_t is None:
             rec.first_t = now
             rec.state = RequestState.DECODING
+            trace.instant("req.decoding", rid=rid,
+                          ttft_s=now - rec.submit_t)
             self._h_ttft.observe(now - rec.submit_t)
         else:
             self._h_itl.observe(now - rec.last_t)
@@ -361,6 +391,13 @@ class ServingEngine:
         toks = np.asarray(tokens if tokens is not None else rec.tokens,
                           np.int32)
         rec.state = state
+        # every terminal path (done / cancelled / expired / failed —
+        # including drain()/shutdown()'s cancels) funnels through here,
+        # so an exported request timeline always closes with a terminal
+        # mark, never mid-span
+        trace.instant("req." + state.lower(), rid=rec.rid,
+                      reason=reason, new_tokens=int(toks.size),
+                      error=error)
         rec.stream._finalize(StreamStatus(
             request_id=rec.rid, state=state, finish_reason=reason,
             tokens=toks, prompt_tokens=rec.prompt_len,
@@ -438,6 +475,8 @@ class ServingEngine:
                 self._fail_record(rec, reset_exc, "pool rebuild failed")
             raise
         self._c_recoveries.inc()
+        trace.instant("recovery", kind=kind, error=str(exc)[:200],
+                      survivors=len(survivors))
         resubmitted = 0
         for rec in survivors:  # dict order == submit order: FIFO kept
             try:
@@ -451,11 +490,52 @@ class ServingEngine:
             rec.state = RequestState.QUEUED
             self._live[rec.rid] = rec
             self._c_recovered.inc()
+            trace.instant("recovery.resubmit", rid=rec.rid,
+                          retries=rec.retries,
+                          committed_tokens=len(rec.tokens))
             resubmitted += 1
         self._health.note_recovery(resubmitted)
 
     # -- the scheduling tick (ONE code path for both drive modes) --------
     def _tick(self) -> bool:
+        tr = trace.active()
+        if tr is None:
+            return self._run_tick()
+        return self._run_tick_traced(tr)
+
+    def _run_tick_traced(self, tr) -> bool:
+        """The traced twin of the tick: same ``_run_tick`` body inside a
+        numbered ``tick`` span, plus compile-event diffing and the
+        drop-counter mirror.  All tracer bookkeeping writes re-take the
+        (reentrant) engine lock the driving thread already holds, so the
+        lock discipline stays textual."""
+        if tr is not self._tracer:
+            with self._lock:
+                self._tracer = tr
+                self._trace_dropped_seen = 0
+                self._compile_seen = None
+        if self._compile_seen is None:
+            with self._lock:
+                # baseline BEFORE the tick so a cold engine's very first
+                # traced tick reports its own compiles as events
+                self._compile_seen = self._pool.compile_counts()
+        with tr.span("tick", tick=tr.next_tick()):
+            work = self._run_tick()
+        counts = self._pool.compile_counts()
+        if counts != self._compile_seen:
+            for key, n in counts.items():
+                if n != self._compile_seen.get(key):
+                    tr.instant("compile", what=key, count=int(n))
+            with self._lock:
+                self._compile_seen = counts
+        dropped = tr.recorder.dropped
+        if dropped > self._trace_dropped_seen:
+            self._c_trace_dropped.inc(dropped - self._trace_dropped_seen)
+            with self._lock:
+                self._trace_dropped_seen = dropped
+        return work
+
+    def _run_tick(self) -> bool:
         self._health.note_tick_start(self._clock())
         try:
             self._expire()
@@ -549,9 +629,11 @@ class ServingEngine:
             except Exception as e:  # noqa: BLE001
                 # _tick's recovery already failed the live requests;
                 # record WHAT killed the tick and WHEN into health() so
-                # the parked loop is a post-mortem, not a mystery
+                # the parked loop is a post-mortem, not a mystery —
+                # and ship the flight recorder's tail with it
                 with self._lock:
                     self._health.note_error(self._clock(), e, "loop")
+                    self._dump_flight("loop-error")
                 work = False
             if not work:
                 self._wake.wait(0.002)
@@ -575,6 +657,7 @@ class ServingEngine:
             self._thread.start()
             self._c_restarts.inc()
             self._health.note_restart(self._clock())
+            trace.instant("restart")
         self._wake.set()
         return True
 
@@ -583,6 +666,17 @@ class ServingEngine:
         engine's heartbeat (the supervisor already de-duplicated
         polls)."""
         self._c_stalled.inc()
+        trace.instant("stall")
+
+    def _dump_flight(self, reason: str) -> None:
+        """Attach the flight recorder's tail to the health record so
+        the post-mortem (``health()`` / ``GET /healthz``) ships its own
+        timeline.  No-op when no tracer was ever active."""
+        tr = trace.active() or self._tracer
+        if tr is not None:
+            self._health.note_flight_dump(self._clock(), reason,
+                                          tr.recorder.tail_dicts(),
+                                          trace_now=tr.now())
 
     def health(self) -> dict:
         """Liveness/post-mortem snapshot — the ``GET /healthz`` body.
@@ -692,6 +786,101 @@ class ServingEngine:
                 with self._lock:
                     if self._thread is t:
                         self._thread = None
+        with self._lock:
+            # a drain that wedged left records live: close their TRACE
+            # timelines (terminal mark only — the streams stay as they
+            # are, the engine is stopped) so an export after shutdown
+            # never ends a request track mid-span.  Normal shutdowns
+            # have no leftovers: drain finishes requests and
+            # drain=False cancels them, both through _finalize.
+            for rid in list(self._live):
+                trace.instant("req.aborted", rid=rid, reason="shutdown")
+
+    # -- tracing / flight recorder ---------------------------------------
+    def start_trace(self, capacity: int = 4096,
+                    deep_timing: bool = False) -> "trace.Tracer":
+        """Build + install a process-wide tracer (serving/trace.py) and
+        bind it to this engine for export; returns it.  ``deep_timing``
+        opts into the honest-device-attribution mode (phase-edge
+        ``block_until_ready`` syncs; every span flagged ``deep``).
+        Refuses to stack on an already-installed tracer."""
+        t = trace.Tracer(capacity=capacity, deep_timing=deep_timing)
+        trace.install(t)
+        with self._lock:
+            self._tracer = t
+            self._trace_dropped_seen = 0
+            self._compile_seen = None
+        return t
+
+    def stop_trace(self) -> Optional["trace.Tracer"]:
+        """Uninstall the process-wide tracer (idempotent when none is
+        active); returns the tracer that was active, whose recorder
+        stays exportable through this engine.  Refuses to kill ANOTHER
+        engine's tracer: in a multi-engine process, stop the trace from
+        the engine that owns it (or via ``serving.trace.uninstall()``
+        when you really mean process-wide)."""
+        t = trace.active()
+        if t is not None and t is not self._tracer:
+            # covers both a diverged tracer AND an engine that never
+            # traced at all — either way the live tracer belongs to
+            # someone else and must not be silently killed
+            raise PreconditionNotMetError(
+                "the installed tracer is not this engine's: stop it "
+                "from the engine that started it (a manually installed "
+                "tracer is adopted by the first traced tick), or call "
+                "serving.trace.uninstall() to stop tracing "
+                "process-wide")
+        trace.uninstall()
+        return t
+
+    def _trace_source(self) -> "trace.Tracer":
+        tr = trace.active() or self._tracer
+        if tr is None:
+            raise PreconditionNotMetError(
+                "no tracer was ever active on this engine: call "
+                "start_trace() (or serving.trace.install) and run "
+                "traffic before exporting a timeline")
+        return tr
+
+    def export_chrome_trace(self, path: Optional[str] = None) -> str:
+        """Chrome/Perfetto trace-event JSON of the flight recorder —
+        one track per request (lifecycle spans closed by the terminal
+        mark) and one per tick phase, every phase span carrying its
+        ``deep`` honesty flag.  Returns the JSON string; also writes
+        ``path`` when given.  Exports the ACTIVE tracer, falling back
+        to the last tracer this engine saw (so export-after-stop
+        works)."""
+        return trace.export_chrome_trace(
+            self._trace_source().recorder.snapshot(), path=path)
+
+    def request_trace(self, request_id) -> dict:
+        """One request's timeline as plain JSON-safe dicts — the
+        ``GET /debug/trace?rid=<id>`` body.  String forms of the id
+        match too (HTTP query params arrive as strings); unknown ids
+        raise :class:`NotFoundError`."""
+        events = [e for e in self._trace_source().recorder.snapshot()
+                  if e.rid is not None and (
+                      e.rid == request_id
+                      or str(e.rid) == str(request_id))]
+        if not events:
+            raise NotFoundError(
+                "no trace events recorded for request_id %r (unknown "
+                "id, or its events were evicted by the ring — see "
+                "serving_trace_events_dropped_total)" % (request_id,))
+        return {"request_id": request_id,
+                "events": [e.to_dict() for e in events]}
+
+    def flight_recorder(self) -> dict:
+        """The flight recorder's full state as JSON-safe dicts — the
+        ``GET /debug/flightrec`` body: capacity, drop count, the
+        deep-timing flag, and every retained event oldest-first."""
+        tr = self._trace_source()
+        rec = tr.recorder
+        return {"capacity": rec.capacity,
+                "dropped": rec.dropped,
+                "total_events": rec.total_events,
+                "deep_timing": tr.deep,
+                "events": [e.to_dict() for e in rec.snapshot()]}
 
     # -- passthroughs / introspection ------------------------------------
     def refresh_weights(self) -> None:
@@ -700,6 +889,7 @@ class ServingEngine:
         current weights (call after ``set_state_dict``)."""
         with self._lock:
             self._pool.refresh_weights()
+            trace.instant("weights.refresh")
 
     def compile_counts(self) -> dict:
         """The pool's compile accounting — the exactly-two-compiles

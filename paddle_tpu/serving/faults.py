@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..core.errors import (InvalidArgumentError, NotFoundError,
                            PreconditionNotMetError)
+from . import trace
 
 __all__ = ["POINTS", "FaultSpec", "FaultPlane", "InjectedFaultError",
            "TransientInjectedFault", "PermanentInjectedFault",
@@ -215,6 +216,15 @@ class FaultPlane:
                 self.injected.append(
                     (point, hit,
                      type(err).__name__ if err is not None else "delay"))
+        if err is not None or delay > 0.0:
+            # the flight recorder sees every injection the moment it
+            # fires (a no-op when tracing is off), so a post-mortem
+            # timeline carries its own fault schedule
+            trace.instant(
+                "fault.injected", point=point, hit=hit,
+                error=(type(err).__name__ if err is not None
+                       else "delay"),
+                delay_s=(delay if delay > 0.0 else None))
         if delay > 0.0:
             time.sleep(delay)
         if err is not None:

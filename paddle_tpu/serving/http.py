@@ -17,9 +17,19 @@ HTTP onto ``ServingEngine.submit`` and ``metrics.render_prometheus``:
 - ``GET /healthz`` — the engine's lock-free ``health()`` snapshot as
   JSON: 200 while healthy (idle/serving/draining), 503 while a tick is
   wedged past the supervisor's stall timeout, the loop thread is dead,
-  or the engine was shut down.  Reading health NEVER takes the engine
-  lock — a wedged tick is holding it, and the probe must answer
-  anyway.
+  or the engine was shut down.  The body is the FULL snapshot — state,
+  the last loop error (what/when/kind), restart/stall/recovery
+  counters, and the flight-recorder post-mortem dump when supervision
+  attached one — so the probe response IS the post-mortem.  Reading
+  health NEVER takes the engine lock — a wedged tick is holding it,
+  and the probe must answer anyway.
+- ``GET /debug/trace?rid=<id>`` — one request's trace timeline as JSON
+  (``ServingEngine.request_trace``): 400 without ``rid``, 404 for an
+  unknown id or when no tracer was ever active.
+- ``GET /debug/flightrec`` — the whole flight recorder
+  (``ServingEngine.flight_recorder``): capacity, drop count, the
+  deep-timing flag, every retained event; 404 when no tracer was ever
+  active (docs/DESIGN.md §5g).
 
 Error mapping is the engine's typed-error vocabulary, not guesswork:
 ``InvalidArgumentError`` → 400, ``DuplicateRequestError`` → 409,
@@ -42,10 +52,12 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional, Tuple
+from urllib.parse import parse_qs
 
 import numpy as np
 
-from ..core.errors import InvalidArgumentError, PreconditionNotMetError
+from ..core.errors import (InvalidArgumentError, NotFoundError,
+                           PreconditionNotMetError)
 from ..inference.generation import DuplicateRequestError
 from . import faults
 from .engine import (DeadlineUnattainableError, QueueFullError,
@@ -147,17 +159,40 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: N802 - stdlib casing
-            path = self.path.split("?", 1)[0]
+            path, _, query = self.path.partition("?")
             if path == "/healthz":
                 # lock-free on purpose: the probe must answer while a
-                # wedged tick holds the engine lock
+                # wedged tick holds the engine lock.  The body is the
+                # FULL health() snapshot (last error what/when/kind,
+                # restart/stall counters, flight-recorder dump), not
+                # just a status code
                 h = engine.health()
                 self._send_json(200 if h["healthy"] else 503, h)
+                return
+            if path == "/debug/trace":
+                rid = parse_qs(query).get("rid", [None])[0]
+                if rid is None:
+                    self._send_json(400, {
+                        "error": "rid query parameter required: "
+                                 "GET /debug/trace?rid=<request id>"})
+                    return
+                try:
+                    self._send_json(200, engine.request_trace(rid))
+                except (NotFoundError, PreconditionNotMetError) as e:
+                    self._send_json(404, {"error": str(e)})
+                return
+            if path == "/debug/flightrec":
+                try:
+                    self._send_json(200, engine.flight_recorder())
+                except PreconditionNotMetError as e:
+                    self._send_json(404, {"error": str(e)})
                 return
             if path != "/metrics":
                 self._send_json(404, {"error": "unknown path %r; the "
                                       "front end serves POST /generate, "
-                                      "GET /metrics and GET /healthz"
+                                      "GET /metrics, GET /healthz, "
+                                      "GET /debug/trace?rid=<id> and "
+                                      "GET /debug/flightrec"
                                       % self.path})
                 return
             body = engine.metrics.render_prometheus().encode()

@@ -198,6 +198,20 @@ class MetricsRegistry:
                   ) -> Histogram:
         return self._get(Histogram, name, help, buckets=buckets)
 
+    def reset_all(self) -> None:
+        """Zero every registered metric IN PLACE — counters and gauges
+        to 0, histogram counts cleared — keeping the registrations,
+        bucket layouts, and metric object identities (the engine holds
+        direct references).  Test isolation for suites sharing one
+        registry/engine, and the warm-outside-the-timed-region
+        discipline bench legs apply per-histogram, available for a whole
+        registry at once."""
+        for m in self._metrics.values():
+            if isinstance(m, Histogram):
+                m.reset()
+            else:
+                m.value = 0.0
+
     def snapshot(self) -> dict:
         """{name: value | {count, sum, buckets}} — plain python, JSON
         and test friendly."""

@@ -33,6 +33,7 @@ import time
 from typing import List, Optional
 
 from ..core.errors import InvalidArgumentError
+from . import trace
 
 __all__ = ["EngineHealth", "Supervisor"]
 
@@ -58,6 +59,10 @@ class EngineHealth:
         self.requests_recovered = 0
         self.stalls = 0
         self.stall_open = False
+        # post-mortem timeline: the flight recorder's tail, attached by
+        # the supervisor on stall/restart and by the dying loop itself
+        # ({"reason", "at", "events"} — already JSON-safe dicts)
+        self.flight_dump: Optional[dict] = None
 
     # -- written by the ticking thread (under the engine lock) -----------
     def note_tick_start(self, now: float) -> None:
@@ -85,6 +90,20 @@ class EngineHealth:
     def note_restart(self, now: float) -> None:
         self.restarts += 1
         self.stall_open = False  # the wedged loop is gone; fresh start
+
+    def note_flight_dump(self, now: float, reason: str, events: list,
+                         trace_now: Optional[float] = None) -> None:
+        """Attach the flight recorder's tail (JSON-safe event dicts):
+        every stall, watchdog restart, and loop-killing error ships the
+        timeline that led up to it — one field write, so the lock-free
+        read discipline holds (a torn read sees the previous dump,
+        never a mix).  ``at`` is in the ENGINE clock domain (consistent
+        with every other timestamp in this snapshot); the events' ``ts``
+        live in the TRACER's clock, so ``trace_now`` — the tracer clock
+        at dump time — is stamped alongside to let a consumer align
+        the two."""
+        self.flight_dump = {"reason": reason, "at": now,
+                            "trace_now": trace_now, "events": events}
 
     # -- written by the supervisor ---------------------------------------
     def open_stall(self) -> bool:
@@ -114,6 +133,7 @@ class EngineHealth:
             "recoveries": self.recoveries,
             "requests_recovered": self.requests_recovered,
             "ticks_stalled": self.stalls,
+            "flight_dump": self.flight_dump,
         }
 
 
@@ -168,6 +188,17 @@ class Supervisor:
                 and not eng._stop.is_set() and not eng.draining:
             if eng.restart_loop():
                 actions.append("loop-restarted")
+        if actions:
+            # every supervised incident ships its own timeline: dump
+            # the flight recorder's tail into the health record the
+            # moment a stall opens or a dead loop is restarted, so
+            # GET /healthz IS the post-mortem (no-op when no tracer
+            # was ever active on the engine)
+            tr = trace.active() or getattr(eng, "_tracer", None)
+            if tr is not None:
+                health.note_flight_dump(now, "+".join(actions),
+                                        tr.recorder.tail_dicts(),
+                                        trace_now=tr.now())
         return actions
 
     # -- owned watchdog thread -------------------------------------------

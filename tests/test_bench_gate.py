@@ -166,6 +166,26 @@ def test_serving_leg_no_timed_subleg_rejected():
     assert not ok and "cache_layout" in why
 
 
+def test_serving_leg_trace_overhead_gate():
+    # the §5g tracing contract measured, not asserted: a serving leg
+    # whose tracing-on tick time exceeds tracing-off by >3% measured
+    # the recorder, not the scheduler — unpromotable
+    base = {"tokens_per_sec": 100.0, "transfer_note": "negligible",
+            "batch8": {"ttft_p50_s": 0.01, "cache_layout": "dense",
+                       "cache_dtype": "float32"}}
+    ok, why = bench._leg_promotable(
+        "serving", dict(base, trace_overhead_pct=1.4))
+    assert ok, why
+    ok, why = bench._leg_promotable(
+        "serving", dict(base, trace_overhead_pct=3.0))
+    assert ok, why  # the bound is inclusive: exactly 3% promotes
+    ok, why = bench._leg_promotable(
+        "serving", dict(base, trace_overhead_pct=7.2))
+    assert not ok and "trace overhead" in why
+    # legacy records predating the stamp keep promoting
+    assert bench._leg_promotable("serving", base)[0]
+
+
 def test_speculative_leg_missing_acceptance_rejected():
     # a speculative tokens/s number without its acceptance-rate stamp
     # cannot say whether it measured a draft that mostly landed or

@@ -24,6 +24,7 @@ SCRIPTS = [
     ("09_serving_engine.py", ["--tokens", "8"]),
     ("10_http_serving.py", ["--tokens", "8"]),
     ("11_chaos_serving.py", ["--tokens", "8"]),
+    ("12_tracing.py", ["--tokens", "8"]),
 ]
 
 

@@ -218,6 +218,68 @@ def test_get_metrics_renders_prometheus(model):
     assert text == eng.metrics.render_prometheus()
 
 
+# -- observability surface: /healthz body, /debug endpoints --------------
+
+def test_healthz_body_is_the_full_snapshot(model):
+    # the body is the FULL health() snapshot (the watchdog records it;
+    # the endpoint must not drop it): state, the last loop error
+    # what/when/kind, restart + stall counters, the flight-dump slot
+    eng = ServingEngine(model, max_len=32, slots=1, buckets=[8])
+    code, _, payload = _http(eng, "GET", "/healthz")
+    body = json.loads(payload)
+    assert code == 200
+    for field in ("state", "healthy", "live_requests", "queue_depth",
+                  "loop_alive", "draining", "ticks_total",
+                  "last_error", "last_error_at", "last_error_kind",
+                  "restarts", "recoveries", "requests_recovered",
+                  "ticks_stalled", "flight_dump"):
+        assert field in body, field
+    # and after a recorded error the what/when/kind ride the body
+    eng._health.note_error(1.25, RuntimeError("boom"), "loop")
+    body = json.loads(_http(eng, "GET", "/healthz")[2])
+    assert "boom" in body["last_error"]
+    assert body["last_error_at"] == 1.25
+    assert body["last_error_kind"] == "loop"
+
+
+def test_debug_trace_and_flightrec_endpoints(model):
+    from paddle_tpu.serving import trace
+
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[16])
+    # tracing never enabled: both endpoints 404 with an actionable hint
+    code, _, payload = _http(eng, "GET", "/debug/flightrec")
+    assert code == 404 and b"start_trace" in payload
+    code, _, payload = _http(eng, "GET", "/debug/trace?rid=x")
+    assert code == 404
+    eng.start_trace(capacity=512)
+    try:
+        code, _, payload = _http(
+            eng, "POST", "/generate",
+            json.dumps({"prompt": [3, 1, 4], "max_new_tokens": 3,
+                        "request_id": "job-1"}).encode())
+        assert code == 200
+        # per-request timeline: queued -> ... -> done, JSON round-trip
+        code, _, payload = _http(eng, "GET", "/debug/trace?rid=job-1")
+        assert code == 200
+        tl = json.loads(payload)
+        names = [e["name"] for e in tl["events"]]
+        assert names[0] == "req.queued" and names[-1] == "req.done"
+        # missing rid -> 400; unknown rid -> 404
+        code, _, payload = _http(eng, "GET", "/debug/trace")
+        assert code == 400 and b"rid" in payload
+        assert _http(eng, "GET", "/debug/trace?rid=ghost")[0] == 404
+        # the whole recorder, with its bounds and honesty flags
+        code, _, payload = _http(eng, "GET", "/debug/flightrec")
+        assert code == 200
+        rec = json.loads(payload)
+        assert rec["capacity"] == 512 and rec["deep_timing"] is False
+        assert rec["dropped"] == 0 and rec["events"]
+    finally:
+        eng.stop_trace()
+    # the engine keeps the last tracer: export still served post-stop
+    assert _http(eng, "GET", "/debug/flightrec")[0] == 200
+
+
 # -- robustness surface: /healthz, shedding, disconnect seam -------------
 
 class _FakeClock:

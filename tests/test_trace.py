@@ -1,0 +1,521 @@
+"""Request-scoped tracing + the tick flight recorder (§5g).
+
+The contracts pinned here, in order of load-bearing-ness:
+
+1. tracing OFF is a true no-op — an uninstalled tracer's ring buffer
+   stays byte-for-byte untouched by a full serving run (the static
+   analysis side of the same contract — zero new hot-path findings —
+   is pinned by tests/test_static_analysis.py's full-repo gate);
+2. a chaos-seeded run's flight recorder RECONCILES with the recovery
+   counters: injection events == the plane's log, recovery events ==
+   ``serving_recoveries_total``, resubmit events ==
+   ``serving_requests_recovered_total``, and every recovered request
+   shows injection → recovery → byte-identical completion in ts order;
+3. the Chrome export round-trips through ``json.loads`` with
+   monotonically ordered events per (pid, tid) track and closed
+   request timelines;
+4. the ring is bounded and its overflow observable
+   (``serving_trace_events_dropped_total``);
+5. the deep-timing honesty flag rides every span;
+6. terminal trace events exist for every request after drain/shutdown
+   (timelines never end mid-span).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import (NotFoundError,
+                                    PreconditionNotMetError)
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (MetricsRegistry, RequestState,
+                                ServingEngine, Supervisor, faults,
+                                trace)
+from paddle_tpu.serving.faults import FaultPlane, FaultSpec
+from paddle_tpu.serving.trace import FlightRecorder, TraceEvent, Tracer
+
+
+def _tiny_model():
+    pt.seed(0)
+    return TransformerLM(vocab_size=128, hidden_size=32, num_layers=1,
+                         num_heads=2, intermediate_size=64,
+                         max_position=256, causal=True, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    # a failing test must not leak a process-global tracer (or fault
+    # plane) into the next one
+    yield
+    trace.uninstall()
+    faults.uninstall()
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_len", 64)
+    kw.setdefault("slots", 2)
+    kw.setdefault("buckets", [32])
+    return ServingEngine(model, **kw)
+
+
+def _run(eng, prompts, budget):
+    streams = [eng.submit(p, budget) for p in prompts]
+    while eng.pump(8):
+        pass
+    return [s.result(timeout_s=0) for s in streams]
+
+
+def _prompts(n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 128, (k,)).astype("int32")
+            for k in (5, 9, 7, 4, 6)[:n]]
+
+
+# -- 1. tracing off is a true no-op ---------------------------------------
+
+def test_trace_off_buffer_untouched(model):
+    tracer = Tracer(capacity=64)  # built but never installed
+    eng = _engine(model)
+    _run(eng, _prompts(2), 5)
+    assert len(tracer.recorder) == 0
+    assert tracer.recorder.total_events == 0
+    assert tracer.recorder.dropped == 0
+    assert trace.active() is None
+    assert eng._tracer is None
+    assert eng.metrics.snapshot()[
+        "serving_trace_events_dropped_total"] == 0
+    # and the output is what it always was: token-identical engine runs
+    # need no tracer — pinned elsewhere; here we only pin the no-op
+
+
+def test_module_instant_is_noop_when_off():
+    trace.instant("req.queued", rid="x")  # must not raise, nor record
+    assert trace.active() is None
+
+
+# -- lifecycle + phases ---------------------------------------------------
+
+def test_lifecycle_and_phase_events(model):
+    eng = _engine(model)
+    tracer = eng.start_trace(capacity=1024)
+    try:
+        statuses = _run(eng, _prompts(2), 5)
+    finally:
+        eng.stop_trace()
+    assert all(st.state == RequestState.DONE for st in statuses)
+    evs = tracer.recorder.snapshot()
+    names = {e.name for e in evs}
+    for phase in ("tick", "tick.admit", "tick.prefill", "tick.decode",
+                  "tick.sample", "tick.deliver"):
+        assert phase in names, phase
+    # per-request lifecycle in timestamp order
+    for st in statuses:
+        mine = [e for e in evs if e.rid == st.request_id]
+        life = [e.name for e in mine if e.name.startswith("req.")]
+        assert life == ["req.queued", "req.prefilling", "req.decoding",
+                        "req.done"]
+        ts = [e.ts for e in mine]
+        assert ts == sorted(ts)
+    # spans carry durations and the (off) deep flag; ticks are numbered
+    spans = [e for e in evs if e.dur_s is not None]
+    assert spans and all(e.dur_s >= 0 for e in spans)
+    assert all(e.deep is False for e in spans)
+    ticks = [e.meta["tick"] for e in evs if e.name == "tick"]
+    assert ticks == list(range(1, len(ticks) + 1))
+    # the cold engine's compiles surfaced as compile events
+    assert "compile" in names
+
+
+def test_deep_timing_flag_rides_every_span(model):
+    eng = _engine(model)
+    tracer = eng.start_trace(capacity=1024, deep_timing=True)
+    try:
+        statuses = _run(eng, _prompts(1), 4)
+    finally:
+        eng.stop_trace()
+    assert statuses[0].state == RequestState.DONE
+    spans = [e for e in tracer.recorder.snapshot() if e.dur_s is not None]
+    assert spans and all(e.deep is True for e in spans)
+    # and in the export: every phase span's args say deep=true
+    d = json.loads(eng.export_chrome_trace())
+    phase_spans = [e for e in d["traceEvents"]
+                   if e.get("ph") == "X" and e.get("cat") == "phase"]
+    assert phase_spans
+    assert all(e["args"]["deep"] is True for e in phase_spans)
+
+
+# -- ring bounds + drop observability -------------------------------------
+
+def test_ring_bounded_and_drops_counted(model):
+    eng = _engine(model)
+    tracer = eng.start_trace(capacity=8)
+    try:
+        _run(eng, _prompts(3), 6)
+    finally:
+        eng.stop_trace()
+    rec = tracer.recorder
+    assert len(rec) <= 8
+    assert rec.dropped > 0
+    assert rec.total_events == len(rec) + rec.dropped
+    # the engine mirrors ring overflow into the metrics registry (the
+    # last accounting pass runs at the final tick, after the last span)
+    assert eng.metrics.snapshot()[
+        "serving_trace_events_dropped_total"] == rec.dropped
+    # the recorder keeps the NEWEST events (flight-recorder semantics):
+    # the oldest retained event was recorded after `dropped` others
+    assert len(rec.snapshot()) == len(rec)
+
+
+def test_recorder_validates_capacity():
+    from paddle_tpu.core.errors import InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError, match="capacity"):
+        FlightRecorder(0)
+
+
+def test_install_refuses_stacking():
+    t = Tracer()
+    with trace.tracing(t):
+        with pytest.raises(PreconditionNotMetError, match="already"):
+            trace.install(Tracer())
+    assert trace.active() is None  # context manager always uninstalls
+
+
+def test_stop_trace_refuses_to_kill_another_engines_tracer(model):
+    eng1 = _engine(model)
+    eng2 = _engine(model)
+    # eng2 had its own (finished) trace session: its last-tracer
+    # reference survives stop_trace for export
+    eng2.start_trace()
+    eng2.stop_trace()
+    t1 = eng1.start_trace()
+    try:
+        # eng2's teardown must not silently kill eng1's live tracing
+        with pytest.raises(PreconditionNotMetError, match="not this"):
+            eng2.stop_trace()
+        assert trace.active() is t1  # eng1's tracing survived
+        # an engine that NEVER traced refuses too (its _tracer is None)
+        eng3 = _engine(model)
+        with pytest.raises(PreconditionNotMetError, match="not this"):
+            eng3.stop_trace()
+        assert trace.active() is t1
+    finally:
+        assert eng1.stop_trace() is t1
+    assert trace.active() is None
+    assert eng1.stop_trace() is None  # idempotent once nothing is on
+
+
+def test_speculative_engine_gets_phase_spans(model):
+    pt.seed(1)
+    draft = _tiny_model()
+    eng = ServingEngine(model, max_len=64, slots=2, buckets=[32],
+                        draft_model=draft, spec_k=3)
+    tracer = eng.start_trace(capacity=2048)
+    try:
+        statuses = _run(eng, _prompts(2), 6)
+    finally:
+        eng.stop_trace()
+    assert all(st.state == RequestState.DONE for st in statuses)
+    names = {e.name for e in tracer.recorder.snapshot()}
+    for phase in ("tick", "tick.admit", "tick.prefill", "tick.decode",
+                  "tick.sample", "tick.deliver"):
+        assert phase in names, phase
+    decode = [e for e in tracer.recorder.snapshot()
+              if e.name == "tick.decode"]
+    assert decode and all(e.meta["spec_k"] == 3 for e in decode)
+
+
+# -- chrome export --------------------------------------------------------
+
+def test_chrome_export_roundtrip_and_track_ordering(model):
+    eng = _engine(model, cache_layout="paged", block_size=8)
+    eng.start_trace(capacity=2048)
+    try:
+        statuses = _run(eng, _prompts(3), 5)
+    finally:
+        eng.stop_trace()
+    js = eng.export_chrome_trace()
+    d = json.loads(js)  # round-trips
+    evs = d["traceEvents"]
+    assert d["displayTimeUnit"] == "ms"
+    # monotonically ordered per (pid, tid) track
+    per_track = {}
+    for e in evs:
+        if "ts" in e:
+            per_track.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+    assert per_track
+    for ts in per_track.values():
+        assert ts == sorted(ts)
+    # one request track per request, lifecycle spans closed by the
+    # terminal instant (no open spans after a full drain)
+    req_threads = [e for e in evs if e.get("ph") == "M"
+                   and e["name"] == "thread_name" and e["pid"] == 1]
+    assert len(req_threads) == len(statuses)
+    life = [e for e in evs if e.get("cat") == "lifecycle"]
+    assert not any(e.get("args", {}).get("open") for e in life)
+    terminals = [e for e in life if e.get("ph") == "i"]
+    assert len(terminals) == len(statuses)
+    assert all(e["name"] == "DONE" for e in terminals)
+    # phase tracks exist on pid 0
+    phase_names = {e["name"] for e in evs if e.get("cat") == "phase"}
+    assert {"tick", "tick.decode"} <= phase_names
+
+
+def test_export_writes_path(model, tmp_path):
+    eng = _engine(model)
+    eng.start_trace()
+    try:
+        _run(eng, _prompts(1), 3)
+    finally:
+        eng.stop_trace()
+    p = str(tmp_path / "trace.json")
+    js = eng.export_chrome_trace(path=p)
+    with open(p) as f:
+        assert json.load(f) == json.loads(js)
+
+
+def test_export_without_tracer_is_typed(model):
+    eng = _engine(model)
+    with pytest.raises(PreconditionNotMetError, match="start_trace"):
+        eng.export_chrome_trace()
+    with pytest.raises(PreconditionNotMetError):
+        eng.flight_recorder()
+
+
+def test_request_trace_lookup_and_404(model):
+    eng = _engine(model)
+    eng.start_trace()
+    try:
+        _run(eng, [_prompts(1)[0]], 3)  # auto rid 0
+    finally:
+        eng.stop_trace()
+    tl = eng.request_trace(0)
+    assert tl["request_id"] == 0
+    assert [e["name"] for e in tl["events"]][-1] == "req.done"
+    # string form matches too (HTTP query params arrive as strings)
+    assert eng.request_trace("0")["events"] == tl["events"]
+    with pytest.raises(NotFoundError, match="nope"):
+        eng.request_trace("nope")
+
+
+# -- 2. chaos reconciliation (the §5g acceptance criterion) ---------------
+
+CHAOS_POINTS = ("pool.step", "pool.alloc_blocks", "stream.deliver")
+
+
+def _chaos_engine(model):
+    return ServingEngine(model, max_len=64, slots=2, buckets=[32],
+                         cache_layout="paged", block_size=8,
+                         max_retries=8)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_chaos_flight_recorder_reconciles(model, seed):
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, 128, (n,)).astype("int32")
+               for n in (5, 9, 7, 4)]
+
+    clean = _chaos_engine(model)
+    want = {st.request_id: st.tokens
+            for st in _run(clean, prompts, 6)}
+
+    eng = _chaos_engine(model)
+    tracer = eng.start_trace(capacity=4096)
+    plane = FaultPlane(chaos_seed=seed, chaos_p=0.08,
+                       chaos_points=CHAOS_POINTS, max_faults=6)
+    try:
+        with faults.injected(plane):
+            statuses = _run(eng, prompts, 6)
+    finally:
+        eng.stop_trace()
+    evs = tracer.recorder.snapshot()
+    snap = eng.metrics.snapshot()
+
+    # every request survived byte-identical (transient-only chaos under
+    # a retry budget larger than the fault cap)
+    for st in statuses:
+        assert st.state == RequestState.DONE, (seed, st.state, st.error)
+        np.testing.assert_array_equal(st.tokens, want[st.request_id])
+
+    # the recorder reconciles EXACTLY with the plane and the counters
+    injected = [e for e in evs if e.name == "fault.injected"]
+    assert len(injected) == plane.fault_count
+    assert [(e.meta["point"], e.meta["hit"], e.meta["error"])
+            for e in injected] == list(plane.injected)
+    recoveries = [e for e in evs if e.name == "recovery"]
+    assert len(recoveries) == snap["serving_recoveries_total"]
+    resubmits = [e for e in evs if e.name == "recovery.resubmit"]
+    assert len(resubmits) == snap["serving_requests_recovered_total"]
+
+    # every recovered request: injection -> recovery -> completion in
+    # timestamp order, and the chrome export round-trips ordered
+    for ev in resubmits:
+        inj_before = [i for i in injected if i.ts <= ev.ts]
+        assert inj_before, "resubmit with no prior injection event"
+        done = [e for e in evs
+                if e.rid == ev.rid and e.name == "req.done"]
+        assert done and done[-1].ts >= ev.ts
+    d = json.loads(eng.export_chrome_trace())
+    per_track = {}
+    for e in d["traceEvents"]:
+        if "ts" in e:
+            per_track.setdefault((e["pid"], e["tid"]), []).append(e["ts"])
+    for ts in per_track.values():
+        assert ts == sorted(ts)
+
+
+# -- supervision post-mortem dumps ----------------------------------------
+
+class _FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_stall_dumps_flight_recorder_into_health(model):
+    clock = _FakeClock()
+    eng = _engine(model, clock=clock)
+    sup = Supervisor(eng, stall_timeout_s=0.5, clock=clock)
+    eng.start_trace(capacity=256)
+    try:
+        _run(eng, _prompts(1), 3)
+        assert eng.health()["flight_dump"] is None  # healthy: no dump
+        clock.advance(0.001)  # the wedged tick starts AFTER the last
+        eng._health.note_tick_start(clock())  # finished one (a wedge)
+        clock.advance(1.0)
+        assert sup.check_once() == ["stall-detected"]
+    finally:
+        eng.stop_trace()
+    h = eng.health()
+    dump = h["flight_dump"]
+    assert dump is not None and dump["reason"] == "stall-detected"
+    assert dump["events"], "post-mortem must ship its timeline"
+    # 'at' is engine-clock (the injected FakeClock); the events' ts are
+    # tracer-clock — trace_now is the alignment stamp across the two
+    assert dump["at"] == clock()
+    assert dump["trace_now"] >= max(e["ts"] for e in dump["events"])
+    names = [e["name"] for e in dump["events"]]
+    assert "tick" in names
+    json.dumps(h)  # the whole healthz body stays JSON-serializable
+    # a "stall" trace event was recorded too
+    assert any(e.name == "stall"
+               for e in eng._tracer.recorder.snapshot())
+
+
+def test_stall_without_tracer_dumps_nothing(model):
+    clock = _FakeClock()
+    eng = _engine(model, clock=clock)
+    sup = Supervisor(eng, stall_timeout_s=0.5, clock=clock)
+    eng._health.note_tick_start(clock())
+    clock.advance(1.0)
+    assert sup.check_once() == ["stall-detected"]
+    assert eng.health()["flight_dump"] is None
+
+
+# -- 6. drain/shutdown close every timeline -------------------------------
+
+def test_shutdown_cancel_emits_terminal_events(model):
+    eng = _engine(model)
+    tracer = eng.start_trace(capacity=1024)
+    try:
+        streams = [eng.submit(p, 20) for p in _prompts(2)]
+        eng.pump(2)  # mid-generation
+        eng.shutdown(drain=False)
+    finally:
+        eng.stop_trace()
+    evs = tracer.recorder.snapshot()
+    for s in streams:
+        terminal = [e for e in evs if e.rid == s.request_id
+                    and e.name in trace.TERMINAL_EVENTS]
+        assert terminal, "shutdown left a request timeline open"
+        assert terminal[-1].name == "req.cancelled"
+    d = json.loads(eng.export_chrome_trace())
+    life = [e for e in d["traceEvents"] if e.get("cat") == "lifecycle"]
+    assert life and not any(e.get("args", {}).get("open") for e in life)
+
+
+def test_drain_emits_terminal_events(model):
+    eng = _engine(model)
+    tracer = eng.start_trace(capacity=1024)
+    try:
+        streams = [eng.submit(p, 4) for p in _prompts(2)]
+        assert eng.drain() is True
+    finally:
+        eng.stop_trace()
+    evs = tracer.recorder.snapshot()
+    for s in streams:
+        assert any(e.rid == s.request_id and e.name == "req.done"
+                   for e in evs)
+
+
+# -- satellites: metrics reset, shed/expiry events ------------------------
+
+def test_metrics_reset_all():
+    m = MetricsRegistry()
+    c = m.counter("c_total", "x")
+    g = m.gauge("g", "x")
+    h = m.histogram("h_seconds", "x", buckets=(0.1, 1.0))
+    c.inc(3)
+    g.set(7.5)
+    h.observe(0.05)
+    h.observe(2.0)
+    m.reset_all()
+    snap = m.snapshot()
+    assert snap["c_total"] == 0.0 and snap["g"] == 0.0
+    assert snap["h_seconds"]["count"] == 0
+    assert snap["h_seconds"]["sum"] == 0.0
+    # registrations + identities survive (the engine holds references)
+    assert m.counter("c_total") is c
+    assert m.histogram("h_seconds", buckets=(0.1, 1.0)) is h
+    c.inc()
+    assert m.snapshot()["c_total"] == 1.0
+
+
+def test_shed_and_expiry_events(model):
+    from paddle_tpu.serving import DeadlineUnattainableError
+
+    clock = _FakeClock()
+    eng = _engine(model, max_len=128, slots=1, clock=clock,
+                  buckets=[32])
+    tracer = eng.start_trace(capacity=1024)
+    try:
+        # warm the tick-rate observation, then pile a backlog.  The
+        # long request's deadline is generous enough to pass the
+        # feasibility estimate (which runs on REAL observed tick time)
+        # while the injected deadline clock controls its expiry.
+        _run(eng, _prompts(1), 3)
+        eng.submit(_prompts(1)[0], 100, request_id="long",
+                   deadline_s=1e6)
+        eng.pump(2)
+        with pytest.raises(DeadlineUnattainableError):
+            eng.submit(_prompts(1)[0], 20, deadline_s=1e-9)
+        clock.advance(2e6)  # the long request expires
+        eng.pump(1)
+    finally:
+        eng.stop_trace()
+        eng.shutdown(drain=False)
+    evs = tracer.recorder.snapshot()
+    assert any(e.name == "shed" for e in evs)
+    assert any(e.rid == "long" and e.name == "req.expired"
+               for e in evs)
+
+
+def test_recorder_tail_dicts_bounded():
+    rec = FlightRecorder(capacity=100)
+    for i in range(50):
+        rec.append(TraceEvent(float(i), "e%d" % i))
+    tail = rec.tail_dicts(10)
+    assert len(tail) == 10
+    assert tail[-1]["name"] == "e49"
+    json.dumps(tail)

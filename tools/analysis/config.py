@@ -44,12 +44,15 @@ EXTRA_EDGES = {
     "TransformerEncoder.forward": ("TransformerEncoderLayer.forward",),
     "TransformerDecoder.forward": ("TransformerDecoderLayer.forward",),
     "GenerationPool.step": ("ServingEngine._on_token",
-                            "ServingEngine._on_finish"),
+                            "ServingEngine._on_finish",
+                            "Tracer.span"),
     "GenerationPool._refill": ("ServingEngine._on_admit",
                                "ServingEngine._on_token",
-                               "ServingEngine._on_finish"),
+                               "ServingEngine._on_finish",
+                               "Tracer.span"),
     "SpeculativePool.step": ("ServingEngine._on_token",
-                             "ServingEngine._on_finish"),
+                             "ServingEngine._on_finish",
+                             "Tracer.span"),
     "ServingEngine._finalize": ("ResponseStream._finalize",),
     # fault plane: the hot path's module-level no-op check fans into the
     # installed plane, so the plane's own fire() is hot-path-audited
@@ -57,6 +60,20 @@ EXTRA_EDGES = {
     "fire": ("FaultPlane.fire",),
     "ResponseStream._put_token": ("fire",),
     "ServingEngine._on_token": ("ResponseStream._put_token",),
+    # trace plane (serving/trace.py): the hot path's module-level no-op
+    # check (`trace.instant` / `_trace_active()`) fans into the
+    # installed Tracer; span context managers (`with tr.span(...)`) and
+    # the recorder append behind them are invisible to the AST, so the
+    # whole emission path is declared here and hot-path-audited like
+    # the fault plane's
+    "instant": ("Tracer.instant",),
+    "ServingEngine._run_tick_traced": ("Tracer.span", "Tracer.instant"),
+    "Tracer.span": ("_Span.__enter__", "_Span.__exit__"),
+    "_Span.__exit__": ("Tracer._emit",),
+    "Tracer.instant": ("Tracer._emit",),
+    "Tracer._emit": ("FlightRecorder.append",),
+    # the fault plane reports every injection into the trace plane
+    "FaultPlane.fire": ("instant",),
     # recovery: the engine rebuilds whichever pool variant it owns and
     # resubmits through the pool's host API — all behind self._pool
     "ServingEngine._recover": ("GenerationPool.reset",
