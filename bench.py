@@ -571,9 +571,27 @@ def bench_decode(pt, jax, on_tpu: bool):
                                   (batch, prefill)).astype("int32")
                 m = measure_decode_marginal(sess, ids, gen)
                 tps = batch / m["per_token_s"]
+                # compiler-reported cost-model columns next to the
+                # measured ones (docs/DESIGN.md §5h): what XLA says one
+                # decode step costs, per token, from the EXACT
+                # executable the timed loop ran (last_cost = this
+                # batch's decode step, the most recent compile) — the
+                # honest basis for "are we at the hardware roofline"
+                # questions.  Missing analyses stamp None, never a
+                # fake 0 a later report would flag as a regression
+                cost = sess._decode_jit.last_cost() or {}
+                flops = cost.get("flops")
+                nbytes = cost.get("bytes_accessed")
                 legs["%s_%s_batch%d" % (layout, tag, batch)] = dict(
                     m, cache_layout=layout, cache_dtype=cache_dtype,
                     decode_tokens_per_sec=round(tps, 1),
+                    cost_flops_per_token=(None if flops is None
+                                          else flops / batch),
+                    cost_bytes_per_token=(None if nbytes is None
+                                          else nbytes / batch),
+                    cost_hbm_reserved_bytes=cost.get(
+                        "hbm_reserved_bytes"),
+                    cost_kv_cache_bytes=cost.get("kv_cache_bytes"),
                     kv_reachable_bytes=kv_reachable_bytes(
                         [max_len] * batch, layout=layout,
                         block_size=DECODE_BLOCK_SIZE, dtype=cache_dtype,
@@ -718,12 +736,20 @@ def bench_serving(pt, jax, on_tpu: bool):
         tps = toks / wall
         stats = engine.cache_stats()
         itl = engine.metrics.histogram("serving_inter_token_seconds")
+        # the engine's compiler-reported cost model (jit.aot via
+        # ServingEngine.cost_report) stamped beside the measured
+        # figures: per-token FLOPs/bytes and the step executable's HBM
+        # reservation, from the artifact this leg actually ran
+        cost = engine.cost_report().get("derived") or {}
         out["batch%d" % slots] = {
             "slots": slots,
             "requests": len(prompts),
             "cache_layout": stats["cache_layout"],
             "cache_dtype": stats["cache_dtype"],
             "kv_resident_bytes": stats["pool_bytes"],
+            "cost_flops_per_token": cost.get("flops_per_token"),
+            "cost_bytes_per_token": cost.get("bytes_per_token"),
+            "cost_hbm_reserved_bytes": cost.get("hbm_reserved_bytes"),
             "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 5),
             "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 5),
             "itl_p50_s": _histogram_quantile(itl, 0.5),
@@ -961,10 +987,13 @@ def bench_speculative(pt, jax, on_tpu: bool):
     plain = GenerationPool(target, max_len, slots=slots,
                            buckets=[prefill])
     plain_tps, plain_wall = timed_run(plain)
+    plain_cost = plain.cost_report().get("derived") or {}
     out["plain_batch%d" % slots] = {
         "cache_layout": "dense", "cache_dtype": "float32",
         "tokens_per_sec": round(plain_tps, 1),
         "wall_s": round(plain_wall, 4),
+        "cost_flops_per_token": plain_cost.get("flops_per_token"),
+        "cost_bytes_per_token": plain_cost.get("bytes_per_token"),
     }
     # only plain_tps is needed past this point: drop the plain pool's
     # slots x max_len KV cache before building the speculative pools
@@ -979,10 +1008,16 @@ def bench_speculative(pt, jax, on_tpu: bool):
                                time_split=True)
         tps, wall = timed_run(pool)
         st = pool.acceptance_stats()  # timed region only (post-reset)
+        spec_cost = pool.cost_report().get("derived") or {}
         sub = {
             "cache_layout": "dense", "cache_dtype": "float32",
             "tokens_per_sec": round(tps, 1),
             "wall_s": round(wall, 4),
+            # compiler-reported round cost at the MEASURED acceptance
+            # rate (the derivation's basis field says so) — the cost
+            # model the speedup_vs_plain stamp can be checked against
+            "cost_flops_per_token": spec_cost.get("flops_per_token"),
+            "cost_bytes_per_token": spec_cost.get("bytes_per_token"),
             "speedup_vs_plain": round(tps / plain_tps, 4),
             "acceptance_rate": round(st["acceptance_rate"], 4),
             "rounds": st["rounds"],

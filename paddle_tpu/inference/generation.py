@@ -42,6 +42,7 @@ import numpy as np
 
 from ..core.errors import (AlreadyExistsError, InvalidArgumentError,
                            NotFoundError)
+from ..jit import aot
 from ..jit.decode import DecodeSession, classify_finish
 
 __all__ = ["GenerationPool", "kv_reachable_bytes",
@@ -209,6 +210,23 @@ class GenerationPool:
         # is in-place
         self._insert_jit = jax.jit(self._insert,
                                    donate_argnums=(0,) if donate else ())
+        # compilation routes through the AOT path (jit.aot) so the
+        # pool's executables carry the compiler's own cost/memory
+        # attribution (cost_report()).  Shapes are pool-fixed — the
+        # token vector keys the one batched decode executable, the
+        # insert's slot/length/table args are traced scalars/vectors —
+        # so each wrapper holds exactly the executables the
+        # compile-count contract already pins
+        self._decode_jit = aot.AotFunction(
+            self._decode_jit,
+            key_fn=lambda p, b, cache, toks, *r: aot.shape_key(toks),
+            name="pool_decode",
+            meta_fn=lambda p, b, cache, *r: {
+                "kv_cache_bytes": aot.kv_arg_bytes(cache)})
+        self._insert_jit = aot.AotFunction(
+            self._insert_jit,
+            key_fn=lambda pool_cache, row_cache, *r: "slot_insert",
+            name="slot_insert")
         self._key = jax.random.PRNGKey(seed)
         self._queue: collections.deque = collections.deque()
         self._active: Dict[int, _SlotState] = {}
@@ -665,6 +683,53 @@ class GenerationPool:
         counts["pool_decode"] = int(self._decode_jit._cache_size())
         counts["slot_insert"] = int(self._insert_jit._cache_size())
         return counts
+
+    def cost_version(self) -> int:
+        """Total AOT compilations across the pool's executables — the
+        cheap fingerprint the serving engine polls per tick so cost
+        gauges refresh only when an executable actually changed."""
+        return (self._session.cost_version()
+                + self._decode_jit.compiles + self._insert_jit.compiles)
+
+    def _derived_costs(self, step_entry: Optional[dict],
+                       tokens_per_step_per_slot: float = 1.0,
+                       basis: str = "decode step advances every slot "
+                                    "one token") -> dict:
+        """The per-token derivation shared with the speculative pool:
+        one batched step's compiler-reported FLOPs/bytes divided over
+        the tokens it commits.  ``step_entry`` is the steady-state step
+        executable's attribution (None before its first compile)."""
+        if not step_entry or "flops" not in step_entry:
+            return {}
+        tokens = self.slots * float(tokens_per_step_per_slot)
+        return {
+            "step_flops": step_entry["flops"],
+            "step_bytes_accessed": step_entry["bytes_accessed"],
+            "hbm_reserved_bytes": step_entry.get("hbm_reserved_bytes"),
+            "kv_cache_bytes": step_entry.get("kv_cache_bytes"),
+            "flops_per_token": step_entry["flops"] / tokens,
+            "bytes_per_token": step_entry["bytes_accessed"] / tokens,
+            "tokens_per_step": tokens,
+            "basis": basis,
+        }
+
+    def cost_report(self) -> dict:
+        """Cost/memory attribution of every executable this pool runs,
+        read off the compiled artifacts (``jit.aot``), plus a
+        ``derived`` block: the batched decode step's FLOPs and
+        bytes-accessed divided over the ``slots`` tokens it commits —
+        the per-token cost model the serving gauges surface
+        (``serving_step_flops`` / ``serving_step_bytes_accessed`` /
+        ``serving_hbm_reserved_bytes``) and bench legs stamp next to
+        their measured figures.  ``kv_cache_bytes`` (the decode
+        executable's cache-argument payload) reconciles exactly with
+        ``cache_stats()['pool_bytes']`` for every layout x dtype
+        (test-pinned)."""
+        rep = self._session.cost_report()
+        rep["pool_decode"] = self._decode_jit.cost_report()
+        rep["slot_insert"] = self._insert_jit.cost_report()
+        rep["derived"] = self._derived_costs(self._decode_jit.last_cost())
+        return rep
 
     def cache_stats(self) -> dict:
         """Live KV-cache accounting: layout, allocator occupancy, and

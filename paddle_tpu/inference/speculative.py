@@ -40,6 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.errors import InvalidArgumentError
+from ..jit import aot
 from ..jit.decode import DecodeSession, truncate_at_eos
 from ..jit.speculative import (acceptance_summary, check_draft_compatible,
                                greedy_accept)
@@ -116,6 +117,28 @@ class SpeculativePool(GenerationPool):
         self._draft_insert_jit = jax.jit(
             self._draft_insert, donate_argnums=(0,) if donate else ())
         self._verify_jit = jax.jit(self._pool_verify, donate_argnums=dn)
+        # AOT routing (jit.aot): same contract as the base pool — every
+        # shape is pool-fixed, so each wrapper holds exactly the
+        # executables the compile-count tests pin, and the verify step
+        # (the target's whole per-round dispatch) carries the target
+        # cache's kv_cache_bytes for the reconciliation contract
+        self._draft_decode_jit = aot.AotFunction(
+            self._draft_decode_jit,
+            key_fn=lambda p, b, cache, toks, *r: aot.shape_key(toks),
+            name="draft_decode")
+        self._draft_fixup_jit = aot.AotFunction(
+            self._draft_fixup_jit,
+            key_fn=lambda p, b, cache, toks, *r: aot.shape_key(toks),
+            name="draft_fixup")
+        self._draft_insert_jit = aot.AotFunction(
+            self._draft_insert_jit,
+            key_fn=lambda *a: "draft_insert", name="draft_insert")
+        self._verify_jit = aot.AotFunction(
+            self._verify_jit,
+            key_fn=lambda p, b, cache, chunk, *r: aot.shape_key(chunk),
+            name="verify",
+            meta_fn=lambda p, b, cache, *r: {
+                "kv_cache_bytes": aot.kv_arg_bytes(cache)})
         self._draft_state_cache = None
         self._drafted = 0
         self._accepted = 0
@@ -376,3 +399,73 @@ class SpeculativePool(GenerationPool):
         counts["draft_insert"] = int(
             self._draft_insert_jit._cache_size())
         return counts
+
+    def cost_version(self) -> int:
+        return (super().cost_version()
+                + self._draft_session.cost_version()
+                + self._verify_jit.compiles
+                + self._draft_decode_jit.compiles
+                + self._draft_fixup_jit.compiles
+                + self._draft_insert_jit.compiles)
+
+    def cost_report(self) -> dict:
+        """Base report plus the speculative executables; the round's
+        device work is ``spec_k`` draft steps + one verify + one
+        fixup, so ``derived`` divides the ROUND's compiler-reported
+        FLOPs/bytes over the tokens a round commits — ``slots x (1 +
+        acceptance_rate x spec_k)``, using the MEASURED acceptance rate
+        (worst case 1 token/slot before any round), and says so in
+        ``basis`` so the per-token figure is auditable."""
+        rep = super().cost_report()
+        # the target's 1-token executables are unused here, exactly as
+        # in compile_counts: the verify chunk IS the target's step
+        rep.pop("decode", None)
+        rep.pop("pool_decode", None)
+        rep["verify"] = self._verify_jit.cost_report()
+        rep["draft_prefill"] = \
+            self._draft_session._prefill_jit.cost_report()
+        rep["draft_decode"] = self._draft_decode_jit.cost_report()
+        rep["draft_fixup"] = self._draft_fixup_jit.cost_report()
+        rep["draft_insert"] = self._draft_insert_jit.cost_report()
+        verify = self._verify_jit.last_cost()
+        draft = self._draft_decode_jit.last_cost()
+        fixup = self._draft_fixup_jit.last_cost()
+        if not verify or "flops" not in verify or not draft \
+                or "flops" not in draft:
+            rep["derived"] = {}
+            return rep
+        acc = acceptance_summary(self.spec_k, self._rounds,
+                                 self._drafted,
+                                 self._accepted)["acceptance_rate"]
+        fixup_flops = (fixup or {}).get("flops", 0.0)
+        fixup_bytes = (fixup or {}).get("bytes_accessed", 0.0)
+        # the round's HBM reservation spans TWO resident executables —
+        # the verify step (target weights + target cache) and the
+        # draft step (draft weights + draft cache); the fixup aliases
+        # the draft step's buffers, so summing it too would double
+        # count.  A speculative engine's gauge must carry the draft
+        # side: reporting verify alone would under-provision exactly
+        # the engines that run two models
+        verify_hbm = verify.get("hbm_reserved_bytes")
+        draft_hbm = draft.get("hbm_reserved_bytes")
+        round_hbm = None if verify_hbm is None or draft_hbm is None \
+            else verify_hbm + draft_hbm
+        round_entry = {
+            "flops": self.spec_k * draft["flops"] + verify["flops"]
+            + fixup_flops,
+            "bytes_accessed": self.spec_k * draft["bytes_accessed"]
+            + verify["bytes_accessed"] + fixup_bytes,
+            "hbm_reserved_bytes": round_hbm,
+            "kv_cache_bytes": verify.get("kv_cache_bytes"),
+        }
+        rep["derived"] = self._derived_costs(
+            round_entry,
+            tokens_per_step_per_slot=1.0 + acc * self.spec_k,
+            basis="speculative round (spec_k=%d draft steps + verify + "
+                  "fixup) commits slots x (1 + acceptance_rate x "
+                  "spec_k) tokens at the measured acceptance_rate=%.4f"
+                  % (self.spec_k, acc))
+        rep["derived"]["acceptance_rate"] = acc
+        rep["derived"]["hbm_verify_bytes"] = verify_hbm
+        rep["derived"]["hbm_draft_bytes"] = draft_hbm
+        return rep

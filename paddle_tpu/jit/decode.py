@@ -40,6 +40,7 @@ from ..core.errors import InvalidArgumentError
 from ..core.random import next_key
 from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
+from . import aot
 
 __all__ = ["DecodeSession", "sample_logits", "default_buckets",
            "FINISH_EOS", "FINISH_LENGTH", "classify_finish",
@@ -238,6 +239,23 @@ class DecodeSession:
         self._prefill_jit = jax.jit(self._prefill)
         self._decode_jit = jax.jit(self._decode,
                                    donate_argnums=(2,) if donate else ())
+        # compilation routes through the AOT path (jit.aot.AotFunction:
+        # lower().compile() + the artifact's cost/memory attribution).
+        # The executable-cache keys name the ONE argument whose shape
+        # varies — the padded prompt for prefill (batch x bucket), the
+        # token vector for decode (batch) — because the weights and the
+        # cache are shape-fixed per session; compile counting
+        # (_cache_size) and donation semantics are unchanged
+        self._prefill_jit = aot.AotFunction(
+            self._prefill_jit,
+            key_fn=lambda p, b, ids, *r: aot.shape_key(ids),
+            name="prefill")
+        self._decode_jit = aot.AotFunction(
+            self._decode_jit,
+            key_fn=lambda p, b, cache, tok, *r: aot.shape_key(tok),
+            name="decode",
+            meta_fn=lambda p, b, cache, *r: {
+                "kv_cache_bytes": aot.kv_arg_bytes(cache)})
 
     # -- traced bodies ---------------------------------------------------
     def _run_model(self, param_vals, buf_vals, ids, cache):
@@ -402,3 +420,19 @@ class DecodeSession:
         observable contract behind 'exactly two compiles per bucket'."""
         return {"prefill": int(self._prefill_jit._cache_size()),
                 "decode": int(self._decode_jit._cache_size())}
+
+    def cost_report(self) -> dict:
+        """Per-executable cost/memory attribution read off the compiled
+        artifacts (``jit.aot``): ``{"prefill": {key: entry}, "decode":
+        {key: entry}}`` where each entry carries the optimized HLO's
+        FLOPs / bytes-accessed, the ``memory_analysis()`` HBM breakdown,
+        and (decode) the cache argument's ``kv_cache_bytes``.  A read of
+        compile-time analysis — never a compile or a sync."""
+        return {"prefill": self._prefill_jit.cost_report(),
+                "decode": self._decode_jit.cost_report()}
+
+    def cost_version(self) -> int:
+        """Monotonic fingerprint of the executable set (total AOT
+        compilations): consumers re-read ``cost_report()`` only when
+        this moves, so steady-state polling costs two int reads."""
+        return self._prefill_jit.compiles + self._decode_jit.compiles

@@ -47,6 +47,7 @@ import numpy as np
 
 from ..core.errors import InvalidArgumentError
 from ..core.random import next_key
+from . import aot
 from .decode import DecodeSession, truncate_at_eos
 
 __all__ = ["SpeculativeDecodeSession", "check_draft_compatible",
@@ -173,6 +174,15 @@ class SpeculativeDecodeSession:
         # input cache and returns the successor (index rewound in-trace)
         self._verify_jit = jax.jit(self._verify,
                                    donate_argnums=(2,) if donate else ())
+        # AOT routing (jit.aot): the fixed-K verify chunk keys the one
+        # verify executable; its entry carries the target cache's
+        # kv_cache_bytes like every decode-family step
+        self._verify_jit = aot.AotFunction(
+            self._verify_jit,
+            key_fn=lambda p, b, cache, chunk: aot.shape_key(chunk),
+            name="verify",
+            meta_fn=lambda p, b, cache, *r: {
+                "kv_cache_bytes": aot.kv_arg_bytes(cache)})
         self._drafted = 0
         self._accepted = 0
         self._rounds = 0
@@ -312,3 +322,19 @@ class SpeculativeDecodeSession:
             "draft_prefill": int(self._draft._prefill_jit._cache_size()),
             "draft_decode": int(self._draft._decode_jit._cache_size()),
         }
+
+    def cost_report(self) -> dict:
+        """Per-executable cost/memory attribution (``jit.aot``) for the
+        session's fixed compile budget: target prefill bucket(s) + the
+        one verify step, draft prefill + decode — read off the compiled
+        artifacts, never a compile."""
+        return {
+            "prefill": self._target._prefill_jit.cost_report(),
+            "verify": self._verify_jit.cost_report(),
+            "draft_prefill": self._draft._prefill_jit.cost_report(),
+            "draft_decode": self._draft._decode_jit.cost_report(),
+        }
+
+    def cost_version(self) -> int:
+        return (self._target.cost_version() + self._draft.cost_version()
+                + self._verify_jit.compiles)

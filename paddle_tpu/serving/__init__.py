@@ -37,6 +37,15 @@ for honest device attribution.  Export via
 front end, and automatic post-mortem dumps into ``EngineHealth`` when
 supervision trips.
 
+The observatory (docs/DESIGN.md §5h): ``ServingEngine.cost_report()``
+reads XLA's cost/memory analyses off the AOT-compiled decode
+executables (per-token FLOPs/bytes, HBM reservation, cache footprint
+reconciled against ``kv_reachable_bytes``), ``slo`` tracks declarative
+objectives with fast/slow burn-rate alerting (``GET /slo``, folded
+into ``health()``), and ``log`` emits structured JSON lines at the
+admission/terminal/recovery/shed/restart edges — both planes
+module-level no-ops when unconfigured.
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —
@@ -44,12 +53,14 @@ serving-oriented systems work (PAPERS.md, arXiv:2603.09555) treats the
 cached decode step as a component inside a request scheduler; this
 package is that scheduler.
 """
-from . import faults, trace
+from . import faults, log, slo, trace
 from .engine import (DeadlineUnattainableError, QueueFullError,
                      ServingEngine)
 from .http import ServingHTTPFrontend, parse_generate_request
+from .log import JsonLinesLogger
 from .metrics import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry)
+from .slo import Objective, SLOTracker
 from .stream import RequestState, ResponseStream, StreamStatus
 from .supervisor import EngineHealth, Supervisor
 from .trace import FlightRecorder, TraceEvent, Tracer
@@ -62,4 +73,6 @@ __all__ = [
     "ServingHTTPFrontend", "parse_generate_request",
     "faults", "Supervisor", "EngineHealth",
     "trace", "Tracer", "FlightRecorder", "TraceEvent",
+    "slo", "Objective", "SLOTracker",
+    "log", "JsonLinesLogger",
 ]

@@ -74,6 +74,7 @@ from ..core.errors import (InvalidArgumentError, NotFoundError,
 from ..inference.generation import GenerationPool
 from ..profiler import StepTimer
 from . import faults, trace
+from . import log as slog
 from .metrics import MetricsRegistry
 from .stream import RequestState, ResponseStream, StreamStatus
 from .supervisor import EngineHealth
@@ -148,7 +149,7 @@ class ServingEngine:
                  max_queue: int = 64, clock=None,
                  metrics: Optional[MetricsRegistry] = None,
                  draft_model=None, spec_k: Optional[int] = None,
-                 max_retries: int = 2, **pool_kwargs):
+                 max_retries: int = 2, slo=None, **pool_kwargs):
         if int(max_queue) < 1:
             raise InvalidArgumentError(
                 "max_queue must be >= 1, got %r" % (max_queue,))
@@ -178,7 +179,18 @@ class ServingEngine:
         self.max_queue = int(max_queue)
         self.max_retries = int(max_retries)
         self._clock = clock if clock is not None else time.monotonic
+        # birth stamp on the ENGINE clock: health() derives uptime_s
+        # from it, so /healthz says how long this engine has served
+        self._started_at = self._clock()
         self._health = EngineHealth()
+        # the SLO tracker (serving/slo.py) is opt-in: None — the
+        # default — costs one is-None test at each observation seam,
+        # keeping the tick path clean when objectives are not declared
+        # (its gauges are bound onto self.metrics below)
+        self._slo = slo
+        # cost-attribution fingerprint: gauges refresh only when the
+        # pool's executable set changes (jit.aot cost_version)
+        self._cost_seen = 0
         self._live: Dict[object, _Record] = {}
         # one reentrant lock serializes every pool mutation: submit and
         # cancel may race the background step loop; in pump mode it is
@@ -268,6 +280,26 @@ class ServingEngine:
             "serving_ttft_seconds", "submit-to-first-token latency")
         self._h_itl = m.histogram(
             "serving_inter_token_seconds", "gap between consecutive tokens")
+        # cost attribution read off the compiled artifacts (jit.aot):
+        # what one batched step ASKS the hardware for, per the
+        # compiler's own cost/memory analyses — refreshed only when an
+        # executable changes, so the steady-state tick pays an int
+        # compare (docs/DESIGN.md §5h)
+        self._g_step_flops = m.gauge(
+            "serving_step_flops",
+            "optimized-HLO FLOPs of one batched decode step/round "
+            "(XLA cost_analysis of the compiled executable)")
+        self._g_step_bytes = m.gauge(
+            "serving_step_bytes_accessed",
+            "optimized-HLO bytes accessed by one batched decode "
+            "step/round (XLA cost_analysis)")
+        self._g_hbm_reserved = m.gauge(
+            "serving_hbm_reserved_bytes",
+            "HBM the decode step's executable reserves: arguments + "
+            "outputs - donated aliases + temps + generated code "
+            "(XLA memory_analysis)")
+        if self._slo is not None:
+            self._slo.bind_metrics(m)
 
         # the engine IS the pool's lifecycle observer
         self._pool.on_admit = self._on_admit
@@ -313,6 +345,9 @@ class ServingEngine:
                     trace.instant("shed", rid=request_id,
                                   deadline_s=float(deadline_s),
                                   estimate_s=est)
+                    slog.emit("req.shed", rid=request_id,
+                              deadline_s=float(deadline_s),
+                              estimate_s=round(est, 6))
                     raise DeadlineUnattainableError(
                         "deadline_s=%.3g cannot be met: the live "
                         "backlog and observed tick rate put completion "
@@ -335,6 +370,11 @@ class ServingEngine:
                           prompt_tokens=int(ids.shape[0]),
                           max_new_tokens=int(max_new_tokens),
                           deadline_s=deadline_s)
+            slog.emit("req.admitted", rid=rid,
+                      prompt_tokens=int(ids.shape[0]),
+                      max_new_tokens=int(max_new_tokens),
+                      deadline_s=deadline_s,
+                      queue_depth=self._pool.queue_depth)
             self._g_queue.set(self._pool.queue_depth)
         self._wake.set()
         return stream
@@ -365,8 +405,13 @@ class ServingEngine:
             trace.instant("req.decoding", rid=rid,
                           ttft_s=now - rec.submit_t)
             self._h_ttft.observe(now - rec.submit_t)
+            if self._slo is not None:
+                self._slo.observe_latency("ttft", now - rec.submit_t)
         else:
             self._h_itl.observe(now - rec.last_t)
+            if self._slo is not None:
+                self._slo.observe_latency("inter_token",
+                                          now - rec.last_t)
         rec.last_t = now
         rec.tokens.append(int(tok))
         self._c_tokens.inc()
@@ -394,10 +439,19 @@ class ServingEngine:
         # every terminal path (done / cancelled / expired / failed —
         # including drain()/shutdown()'s cancels) funnels through here,
         # so an exported request timeline always closes with a terminal
-        # mark, never mid-span
+        # mark, never mid-span — and the SLO tracker and structured log
+        # see every terminal for the same reason
         trace.instant("req." + state.lower(), rid=rec.rid,
                       reason=reason, new_tokens=int(toks.size),
                       error=error)
+        if self._slo is not None:
+            self._slo.observe_terminal(state)
+        slog.emit("req.terminal", rid=rec.rid, state=state,
+                  finish_reason=reason, new_tokens=int(toks.size),
+                  ttft_s=(None if rec.first_t is None
+                          else round(rec.first_t - rec.submit_t, 6)),
+                  total_s=round(now - rec.submit_t, 6),
+                  retries=rec.retries or None, error=error)
         rec.stream._finalize(StreamStatus(
             request_id=rec.rid, state=state, finish_reason=reason,
             tokens=toks, prompt_tokens=rec.prompt_len,
@@ -495,6 +549,9 @@ class ServingEngine:
                           committed_tokens=len(rec.tokens))
             resubmitted += 1
         self._health.note_recovery(resubmitted)
+        slog.emit("engine.recovery", kind=kind,
+                  survivors=len(survivors), resubmitted=resubmitted,
+                  error=str(exc)[:200])
 
     # -- the scheduling tick (ONE code path for both drive modes) --------
     def _tick(self) -> bool:
@@ -554,7 +611,11 @@ class ServingEngine:
             return bool(self._live)
         finally:
             # the heartbeat closes even when recovery re-raises: the
-            # loop thread dying is the DEAD-LOOP signal, not a stall
+            # loop thread dying is the DEAD-LOOP signal, not a stall —
+            # and the SLO windows roll on EVERY tick (idle included),
+            # so an alert drains while the engine sits healthy-idle
+            if self._slo is not None:
+                self._slo.note_tick()
             self._health.note_tick_end(self._clock())
 
     def _observe_gauges(self) -> None:
@@ -573,6 +634,18 @@ class ServingEngine:
         if self._timer.total:
             self._g_tps.set(self._tokens_total / self._timer.total)
             self._g_step.set(self._timer.step_time)
+        # cost gauges refresh only when the executable set changed
+        # (a compile): the steady-state price is one int compare
+        version = pool.cost_version()
+        if version != self._cost_seen:
+            self._cost_seen = version
+            derived = pool.cost_report().get("derived") or {}
+            if derived:
+                self._g_step_flops.set(derived.get("step_flops", 0.0))
+                self._g_step_bytes.set(
+                    derived.get("step_bytes_accessed", 0.0))
+                self._g_hbm_reserved.set(
+                    derived.get("hbm_reserved_bytes") or 0.0)
 
     # -- drive mode 1: synchronous pump (deterministic, test/bench) ------
     def pump(self, steps: int = 1) -> bool:
@@ -658,6 +731,7 @@ class ServingEngine:
             self._c_restarts.inc()
             self._health.note_restart(self._clock())
             trace.instant("restart")
+            slog.emit("engine.restart")
         self._wake.set()
         return True
 
@@ -667,6 +741,7 @@ class ServingEngine:
         polls)."""
         self._c_stalled.inc()
         trace.instant("stall")
+        slog.emit("engine.stall")
 
     def _dump_flight(self, reason: str) -> None:
         """Attach the flight recorder's tail to the health record so
@@ -701,12 +776,22 @@ class ServingEngine:
             state = "serving"
         else:
             state = "idle"
+        now = self._clock()
         out = {"state": state,
                "healthy": state in ("idle", "serving", "draining"),
                "live_requests": len(self._live),
                "queue_depth": self._pool.queue_depth,
                "loop_alive": loop_alive,
-               "draining": self._draining}
+               "draining": self._draining,
+               # birth + age on the engine's monotonic clock: a probe
+               # distinguishes "just restarted" from "long-lived" at a
+               # glance, and uptime_s is injected-clock-deterministic
+               "started_at": self._started_at,
+               "uptime_s": max(0.0, now - self._started_at)}
+        if self._slo is not None:
+            # SLO state rides the post-mortem: a stall dump says which
+            # promises were burning when the engine wedged
+            out["slo"] = self._slo.health_summary()
         out.update(h.snapshot())
         return out
 
@@ -899,6 +984,34 @@ class ServingEngine:
     def cache_stats(self) -> dict:
         """Live KV accounting (``GenerationPool.cache_stats``)."""
         return self._pool.cache_stats()
+
+    def cost_report(self) -> dict:
+        """Per-executable cost/memory attribution read off the pool's
+        compiled artifacts (``GenerationPool.cost_report`` /
+        ``SpeculativePool.cost_report``): optimized-HLO FLOPs and
+        bytes-accessed, the ``memory_analysis()`` HBM breakdown, the
+        decode step's ``kv_cache_bytes``, and the ``derived`` per-token
+        cost model behind the ``serving_step_*`` gauges.  A read of
+        compile-time analysis — never a compile, never a device sync
+        (compile counts before and after are identical, test-pinned)."""
+        return self._pool.cost_report()
+
+    def slo_snapshot(self) -> dict:
+        """The SLO tracker's full state — the ``GET /slo`` body.
+        Raises :class:`PreconditionNotMetError` when the engine was
+        built without objectives (``slo=None``)."""
+        if self._slo is None:
+            raise PreconditionNotMetError(
+                "no SLO tracker is configured on this engine: pass "
+                "slo=serving.slo.SLOTracker([...objectives...]) at "
+                "construction to declare objectives")
+        return self._slo.snapshot()
+
+    @property
+    def slo(self):
+        """The engine's :class:`~.slo.SLOTracker` (None when SLO
+        tracking is off)."""
+        return self._slo
 
     def acceptance_stats(self) -> Optional[dict]:
         """Speculative acceptance accounting

@@ -187,10 +187,20 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
                 except PreconditionNotMetError as e:
                     self._send_json(404, {"error": str(e)})
                 return
+            if path == "/slo":
+                # objectives + burn rates + alert state (serving/slo.py);
+                # 404 when the engine declared no objectives — absence
+                # is a configuration fact, not an empty result
+                try:
+                    self._send_json(200, engine.slo_snapshot())
+                except PreconditionNotMetError as e:
+                    self._send_json(404, {"error": str(e)})
+                return
             if path != "/metrics":
                 self._send_json(404, {"error": "unknown path %r; the "
                                       "front end serves POST /generate, "
                                       "GET /metrics, GET /healthz, "
+                                      "GET /slo, "
                                       "GET /debug/trace?rid=<id> and "
                                       "GET /debug/flightrec"
                                       % self.path})

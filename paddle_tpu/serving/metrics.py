@@ -30,7 +30,7 @@ from typing import Dict, Optional, Sequence
 from ..core.errors import InvalidArgumentError
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "DEFAULT_TIME_BUCKETS"]
+           "DEFAULT_TIME_BUCKETS", "escape_help", "escape_label_value"]
 
 # latency buckets spanning sub-millisecond CPU test steps to the
 # multi-second TTFTs of a cold bucket compile on a loaded server
@@ -42,6 +42,21 @@ _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
 def _fmt(v: float) -> str:
     return "%.10g" % float(v)
+
+
+def escape_help(s: str) -> str:
+    """Prometheus text-format HELP escaping: ``\\`` and newline (a raw
+    newline would split one HELP across two exposition lines, breaking
+    the scrape; the format spec says escape exactly these two)."""
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def escape_label_value(s: str) -> str:
+    """Prometheus label-value escaping: ``\\``, newline, and ``"`` (the
+    value is double-quoted in the exposition, so an unescaped quote
+    truncates it mid-value)."""
+    return str(s).replace("\\", "\\\\").replace("\n", "\\n") \
+        .replace('"', '\\"')
 
 
 class _Metric:
@@ -218,18 +233,24 @@ class MetricsRegistry:
         return {name: m.snapshot() for name, m in self._metrics.items()}
 
     def render_prometheus(self) -> str:
-        """Text exposition format (one scrape body)."""
+        """Text exposition format (one scrape body).  HELP strings and
+        label values are escaped per the format spec (``\\``/newline,
+        plus ``"`` in label values) — a metric whose help text quotes an
+        error message must not be able to corrupt the whole scrape."""
         lines = []
         for m in self._metrics.values():
             if m.help:
-                lines.append("# HELP %s %s" % (m.name, m.help))
+                lines.append("# HELP %s %s"
+                             % (m.name, escape_help(m.help)))
             lines.append("# TYPE %s %s" % (m.name, m.kind))
             if isinstance(m, Histogram):
                 running = 0
                 for b, c in zip(m.buckets, m._counts):
                     running += c
                     lines.append('%s_bucket{le="%s"} %d'
-                                 % (m.name, _fmt(b), running))
+                                 % (m.name,
+                                    escape_label_value(_fmt(b)),
+                                    running))
                 lines.append('%s_bucket{le="+Inf"} %d'
                              % (m.name, m.count))
                 lines.append("%s_sum %s" % (m.name, _fmt(m.sum)))

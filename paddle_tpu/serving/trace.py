@@ -217,6 +217,14 @@ class Tracer:
         ``ts`` with the dump moment across the two clock domains."""
         return self._clock()
 
+    @property
+    def tick(self) -> int:
+        """The CURRENT tick number (0 before the first traced tick) —
+        the join key the structured log (serving/log.py) stamps on
+        every event so log lines and flight-recorder timelines align
+        by number."""
+        return self._ticks
+
     def next_tick(self) -> int:
         """The engine's tick sequence number under THIS tracer (restarts
         at 1 with a fresh tracer — tick numbering is a trace-lifetime

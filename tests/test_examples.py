@@ -25,6 +25,7 @@ SCRIPTS = [
     ("10_http_serving.py", ["--tokens", "8"]),
     ("11_chaos_serving.py", ["--tokens", "8"]),
     ("12_tracing.py", ["--tokens", "8"]),
+    ("13_observatory.py", ["--tokens", "8"]),
 ]
 
 

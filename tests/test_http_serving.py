@@ -232,7 +232,8 @@ def test_healthz_body_is_the_full_snapshot(model):
                   "loop_alive", "draining", "ticks_total",
                   "last_error", "last_error_at", "last_error_kind",
                   "restarts", "recoveries", "requests_recovered",
-                  "ticks_stalled", "flight_dump"):
+                  "ticks_stalled", "flight_dump", "started_at",
+                  "uptime_s"):
         assert field in body, field
     # and after a recorded error the what/when/kind ride the body
     eng._health.note_error(1.25, RuntimeError("boom"), "loop")
@@ -240,6 +241,57 @@ def test_healthz_body_is_the_full_snapshot(model):
     assert "boom" in body["last_error"]
     assert body["last_error_at"] == 1.25
     assert body["last_error_kind"] == "loop"
+
+
+def test_healthz_started_at_and_uptime_track_the_engine_clock(model):
+    # uptime is derived on the ENGINE's monotonic clock, so an
+    # injected clock pins it exactly: birth at 100, probed at 103.5
+    fake = {"now": 100.0}
+    eng = ServingEngine(model, max_len=32, slots=1, buckets=[8],
+                        clock=lambda: fake["now"])
+    body = json.loads(_http(eng, "GET", "/healthz")[2])
+    assert body["started_at"] == 100.0
+    assert body["uptime_s"] == 0.0
+    fake["now"] = 103.5
+    body = json.loads(_http(eng, "GET", "/healthz")[2])
+    assert body["started_at"] == 100.0
+    assert body["uptime_s"] == 3.5
+
+
+def test_slo_endpoint(model):
+    from paddle_tpu.serving import Objective, SLOTracker
+
+    # no tracker configured: 404 with an actionable hint, same
+    # convention as the never-traced /debug endpoints
+    eng = ServingEngine(model, max_len=32, slots=1, buckets=[8])
+    code, _, payload = _http(eng, "GET", "/slo")
+    assert code == 404 and b"SLOTracker" in payload
+    # with objectives declared, the body is the tracker's snapshot
+    tracker = SLOTracker(
+        [Objective("availability", "availability", 0.99),
+         Objective("ttft_p95", "ttft", 0.95, threshold_s=10.0)],
+        fast_window=2, slow_window=8)
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[16],
+                        slo=tracker)
+    code, _, payload = _http(
+        eng, "POST", "/generate",
+        json.dumps({"prompt": [3, 1, 4],
+                    "max_new_tokens": 3}).encode())
+    assert code == 200
+    code, headers, payload = _http(eng, "GET", "/slo")
+    assert code == 200
+    assert headers["Content-Type"] == "application/json"
+    body = json.loads(payload)
+    assert body["fast_window_ticks"] == 2
+    assert body["alerts_active"] == 0
+    names = {o["name"]: o for o in body["objectives"]}
+    assert set(names) == {"availability", "ttft_p95"}
+    assert names["ttft_p95"]["threshold_s"] == 10.0
+    assert names["availability"]["total_good"] == 1  # the DONE request
+    # the SLO state also rides /healthz (the post-mortem contract)
+    health = json.loads(_http(eng, "GET", "/healthz")[2])
+    assert health["slo"] == {"alerts_active": 0, "alerting": [],
+                             "ticks": tracker.ticks}
 
 
 def test_debug_trace_and_flightrec_endpoints(model):

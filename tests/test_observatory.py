@@ -1,0 +1,521 @@
+"""The serving observatory (docs/DESIGN.md §5h): cost/memory
+attribution read off the compiled artifacts, SLO burn-rate tracking,
+structured JSON logs, and the metrics-exposition satellites.
+
+The attribution contract is RECONCILIATION, not plausibility: the
+compiler-reported cache footprint of the decode executable must equal
+the pool's own ``kv_reachable_bytes``-based accounting EXACTLY, for
+every cache layout x dtype — and reading the report must never compile
+(the exactly-two-compiles contract is pinned before and after)."""
+import io
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import (InvalidArgumentError,
+                                    PreconditionNotMetError)
+from paddle_tpu.inference import GenerationPool, SpeculativePool
+from paddle_tpu.jit import DecodeSession
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (Histogram, MetricsRegistry, Objective,
+                                ServingEngine, SLOTracker, faults)
+from paddle_tpu.serving import log as slog
+from paddle_tpu.serving.metrics import escape_help, escape_label_value
+
+
+def _tiny_model(seed=0, hidden=32):
+    pt.seed(seed)
+    return TransformerLM(vocab_size=128, hidden_size=hidden,
+                         num_layers=1, num_heads=2,
+                         intermediate_size=64, max_position=256,
+                         causal=True, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+@pytest.fixture(scope="module")
+def draft():
+    return _tiny_model(seed=1)
+
+
+def _prompt(rng, n=6):
+    return rng.randint(0, 128, (n,)).astype("int32")
+
+
+# -- cost/memory attribution from the compiled artifact ------------------
+
+def test_session_cost_report_reads_the_artifact(model):
+    sess = DecodeSession(model, max_len=48, buckets=[16])
+    rng = np.random.RandomState(0)
+    out = sess.generate(rng.randint(0, 128, (1, 10)).astype("int32"), 6)
+    assert sess.compile_counts() == {"prefill": 1, "decode": 1}
+    rep = sess.cost_report()
+    (pk, prefill), = rep["prefill"].items()
+    (dk, decode), = rep["decode"].items()
+    assert pk == "1x16_int32" and dk == "1_int32"  # bucket/batch keyed
+    for entry in (prefill, decode):
+        # compiler-reported, so only sanity-bounded here (the exact
+        # values are XLA's); zero would mean we read nothing
+        assert entry["flops"] > 0
+        assert entry["bytes_accessed"] > 0
+        assert entry["argument_bytes"] > 0
+        assert entry["hbm_reserved_bytes"] >= entry["temp_bytes"]
+    # the decode step's cache-argument payload: 2 (K+V) x layers x
+    # heads x max_len x head_dim x 4 bytes — compiler avals vs hand math
+    assert decode["kv_cache_bytes"] == 2 * 1 * 2 * 48 * 16 * 4
+    # reporting reads compile-time analysis: no new executables, and a
+    # second identical generate stays at the pinned budget
+    sess.generate(rng.randint(0, 128, (1, 10)).astype("int32"), 6)
+    assert sess.compile_counts() == {"prefill": 1, "decode": 1}
+    assert sess.cost_version() == 2
+
+
+@pytest.mark.parametrize("layout", ["dense", "paged"])
+@pytest.mark.parametrize("dtype", ["float32", "int8"])
+def test_pool_cost_report_reconciles_kv_bytes(model, layout, dtype):
+    # THE reconciliation contract: the executable's cache-argument
+    # bytes (jit.aot.kv_arg_bytes over the avals XLA compiled for)
+    # equal the allocator's own pool_bytes accounting EXACTLY — for
+    # dense and paged layouts, fp32 and int8 dtypes
+    kw = dict(cache_layout="paged", block_size=8) \
+        if layout == "paged" else {}
+    pool = GenerationPool(model, max_len=48, slots=2, buckets=[16],
+                          cache_dtype=dtype, **kw)
+    rng = np.random.RandomState(0)
+    for _ in range(2):
+        pool.submit(_prompt(rng), 5)
+    pool.run()
+    rep = pool.cost_report()
+    stats = pool.cache_stats()
+    derived = rep["derived"]
+    assert derived["kv_cache_bytes"] == stats["pool_bytes"], \
+        (layout, dtype)
+    # the whole-argument footprint CONTAINS the cache (plus weights,
+    # tokens, mask, key), never less
+    (step,) = rep["pool_decode"].values()
+    assert step["argument_bytes"] >= derived["kv_cache_bytes"]
+    assert derived["flops_per_token"] == step["flops"] / pool.slots
+    assert derived["bytes_per_token"] == \
+        step["bytes_accessed"] / pool.slots
+    # attribution is a read, never a compile: the budget is unchanged
+    assert pool.compile_counts() == {
+        "prefill": 1, "decode": 0, "pool_decode": 1, "slot_insert": 1}
+
+
+def test_speculative_pool_cost_report(model, draft):
+    pool = SpeculativePool(model, draft, max_len=64, spec_k=2, slots=2,
+                           buckets=[16])
+    rng = np.random.RandomState(0)
+    pool.generate([_prompt(rng), _prompt(rng)], 6)
+    rep = pool.cost_report()
+    derived = rep["derived"]
+    # the verify step's cache argument IS the target pool cache
+    assert derived["kv_cache_bytes"] == \
+        pool.cache_stats()["pool_bytes"]
+    assert derived["acceptance_rate"] == \
+        pool.acceptance_stats()["acceptance_rate"]
+    # round cost = K draft steps + verify + fixup, spread over the
+    # measured tokens/round (the basis string makes that auditable)
+    (verify,) = rep["verify"].values()
+    (dstep,) = rep["draft_decode"].values()
+    (fixup,) = rep["draft_fixup"].values()
+    want = pool.spec_k * dstep["flops"] + verify["flops"] \
+        + fixup["flops"]
+    assert derived["step_flops"] == want
+    assert "acceptance_rate" in derived["basis"] or \
+        "acceptance" in derived["basis"]
+    # the target's unused 1-token executables are absent, exactly as
+    # in compile_counts
+    assert "pool_decode" not in rep and "decode" not in rep
+
+
+def test_engine_cost_gauges_and_report(model):
+    eng = ServingEngine(model, max_len=48, slots=2, buckets=[16])
+    rng = np.random.RandomState(0)
+    for _ in range(2):
+        eng.submit(_prompt(rng), 4)
+    while eng.pump(4):
+        pass
+    counts = eng.compile_counts()
+    rep = eng.cost_report()
+    assert rep["derived"]["step_flops"] > 0
+    assert eng.compile_counts() == counts  # report never compiles
+    snap = eng.metrics.snapshot()
+    assert snap["serving_step_flops"] == rep["derived"]["step_flops"]
+    assert snap["serving_step_bytes_accessed"] == \
+        rep["derived"]["step_bytes_accessed"]
+    assert snap["serving_hbm_reserved_bytes"] == \
+        rep["derived"]["hbm_reserved_bytes"]
+
+
+# -- SLO tracker: objectives, burn rates, multi-window alerting ----------
+
+def test_objective_validation():
+    with pytest.raises(InvalidArgumentError, match="kind"):
+        Objective("x", "latency", 0.95, threshold_s=1.0)
+    with pytest.raises(InvalidArgumentError, match="target"):
+        Objective("x", "ttft", 1.0, threshold_s=1.0)
+    with pytest.raises(InvalidArgumentError, match="threshold_s"):
+        Objective("x", "ttft", 0.95)
+    with pytest.raises(InvalidArgumentError, match="threshold_s"):
+        Objective("x", "availability", 0.99, threshold_s=1.0)
+    with pytest.raises(InvalidArgumentError, match="identifier"):
+        Objective("bad name!", "ttft", 0.95, threshold_s=1.0)
+    with pytest.raises(InvalidArgumentError, match="bare string"):
+        # a str IS a Sequence[str]: frozenset('FAILED') would match
+        # nothing and the objective would never alert
+        Objective("x", "availability", 0.99, bad_states="FAILED")
+    with pytest.raises(InvalidArgumentError, match="unknown terminal"):
+        Objective("x", "availability", 0.99, bad_states=("FAILD",))
+    assert Objective("x", "availability", 0.99,
+                     bad_states=("FAILED", "EXPIRED")).bad_states == \
+        frozenset(("FAILED", "EXPIRED"))
+    with pytest.raises(InvalidArgumentError, match="unique"):
+        SLOTracker([Objective("a", "availability", 0.9),
+                    Objective("a", "availability", 0.8)])
+    with pytest.raises(InvalidArgumentError, match="fast_window"):
+        SLOTracker([Objective("a", "availability", 0.9)],
+                   fast_window=10, slow_window=5)
+
+
+def test_burn_rate_math_is_deterministic():
+    tr = SLOTracker([Objective("avail", "availability", 0.9)],
+                    fast_window=2, slow_window=4, burn_threshold=1.0)
+    # tick 1: 1 good, 1 bad -> bad fraction 0.5, budget 0.1 -> burn 5
+    tr.observe_terminal("DONE")
+    tr.observe_terminal("FAILED")
+    tr.note_tick()
+    st = tr.snapshot()["objectives"][0]
+    assert st["fast_burn_rate"] == pytest.approx(5.0)
+    assert st["slow_burn_rate"] == pytest.approx(5.0)
+    assert st["alert_active"]  # both windows burning
+    # two clean ticks roll the bad tick out of the FAST window
+    for _ in range(2):
+        tr.observe_terminal("DONE")
+        tr.note_tick()
+    st = tr.snapshot()["objectives"][0]
+    assert st["fast_burn_rate"] == 0.0
+    assert st["slow_burn_rate"] > 1.0  # slow window still remembers
+    assert not st["alert_active"]      # ...but the pair gates the alert
+    assert st["alerts_fired"] == 1
+
+
+def test_alert_needs_both_windows_burning():
+    # a long good history keeps the SLOW window under threshold while a
+    # single bad tick spikes the fast window: no page (the de-noiser
+    # half of the multiwindow pairing)
+    tr = SLOTracker([Objective("avail", "availability", 0.5)],
+                    fast_window=1, slow_window=50, burn_threshold=1.0)
+    for _ in range(20):
+        for _ in range(5):
+            tr.observe_terminal("DONE")
+        tr.note_tick()
+    tr.observe_terminal("FAILED")
+    tr.note_tick()
+    st = tr.snapshot()["objectives"][0]
+    assert st["fast_burn_rate"] >= 1.0
+    assert st["slow_burn_rate"] < 1.0
+    assert not st["alert_active"]
+
+
+def test_fast_window_running_sums_match_recount():
+    # the roll path keeps RUNNING fast-window sums (no per-tick window
+    # copy); pin them against a brute-force recount over a long drive,
+    # including the slow_window == fast_window eviction edge
+    import random
+
+    for fast, slow in ((2, 4), (3, 3), (1, 6)):
+        tr = SLOTracker([Objective("avail", "availability", 0.9)],
+                        fast_window=fast, slow_window=slow)
+        st = tr._states["avail"]
+        rng = random.Random(0)
+        history = []
+        for _ in range(25):
+            g, b = rng.randrange(4), rng.randrange(3)
+            for _ in range(g):
+                tr.observe_terminal("DONE")
+            for _ in range(b):
+                tr.observe_terminal("FAILED")
+            tr.note_tick()
+            history.append((g, b))
+            want_fast = history[-fast:]
+            assert st.fast_good == sum(x[0] for x in want_fast), \
+                (fast, slow, len(history))
+            assert st.fast_bad == sum(x[1] for x in want_fast)
+            want_slow = history[-slow:]
+            assert st.slow_good == sum(x[0] for x in want_slow)
+            assert st.slow_bad == sum(x[1] for x in want_slow)
+
+
+def test_latency_objective_threshold_split():
+    tr = SLOTracker([Objective("ttft", "ttft", 0.5, threshold_s=1.0)],
+                    fast_window=1, slow_window=2)
+    tr.observe_latency("ttft", 0.2)    # good
+    tr.observe_latency("ttft", 3.0)    # bad
+    tr.observe_latency("inter_token", 99.0)  # other kind: ignored
+    tr.note_tick()
+    st = tr.snapshot()["objectives"][0]
+    assert st["window_good"] == 1 and st["window_bad"] == 1
+    assert st["fast_burn_rate"] == pytest.approx(1.0)  # 0.5/0.5
+
+
+def test_slo_chaos_alert_flips_and_clears(model):
+    # THE acceptance contract: a seeded-chaos run must flip a burn-rate
+    # alert and the alert must clear after recovery, visible through
+    # health() (GET /slo visibility is pinned in test_http_serving).
+    # max_retries=0 turns every transient injection into a FAILED
+    # terminal — deterministic availability burn, no wall clock
+    tracker = SLOTracker([Objective("availability", "availability",
+                                    0.5)],
+                         fast_window=3, slow_window=10)
+    eng = ServingEngine(model, max_len=48, slots=2, buckets=[16],
+                        slo=tracker, max_retries=0)
+    t = eng.start_trace(capacity=512)
+    try:
+        rng = np.random.RandomState(0)
+        # warm traffic (compiles outside the chaos window)
+        eng.submit(_prompt(rng), 3)
+        while eng.pump(4):
+            pass
+        assert eng.health()["slo"] == {"alerts_active": 0,
+                                       "alerting": [],
+                                       "ticks": tracker.ticks}
+        plane = faults.FaultPlane(chaos_seed=7, chaos_p=1.0,
+                                  chaos_points=("pool.step",),
+                                  max_faults=2)
+        with faults.injected(plane):
+            # two chaos waves: with max_retries=0 one injection fails
+            # every live request at once and drains the pool, so each
+            # wave pays exactly one injection
+            for wave in range(2):
+                for i in range(2):
+                    eng.submit(_prompt(rng), 3,
+                               request_id="c%d-%d" % (wave, i))
+                while eng.pump(8):
+                    pass
+        assert plane.fault_count == 2  # the chaos actually injected
+        snap = eng.slo_snapshot()
+        (obj,) = snap["objectives"]
+        assert obj["alert_active"] and obj["alerts_fired"] == 1
+        assert snap["alerts_active"] == 1
+        assert eng.health()["slo"]["alerting"] == ["availability"]
+        assert eng.metrics.snapshot()[
+            "serving_slo_availability_alert_active"] == 1.0
+        # recovery: clean traffic drains the fast window -> alert clears
+        for i in range(6):
+            eng.submit(_prompt(rng), 2, request_id="r%d" % i)
+            while eng.pump(4):
+                pass
+        (obj,) = eng.slo_snapshot()["objectives"]
+        assert not obj["alert_active"]
+        assert eng.health()["slo"]["alerting"] == []
+        assert eng.metrics.snapshot()[
+            "serving_slo_availability_alert_active"] == 0.0
+        # the flip and the clear both landed in the flight recorder
+        names = [e.name for e in t.recorder.snapshot()]
+        assert "slo.alert" in names and "slo.alert_cleared" in names
+    finally:
+        eng.stop_trace()
+
+
+def test_slo_snapshot_requires_tracker(model):
+    eng = ServingEngine(model, max_len=48, slots=1, buckets=[16])
+    assert eng.slo is None
+    assert "slo" not in eng.health()
+    with pytest.raises(PreconditionNotMetError, match="SLO"):
+        eng.slo_snapshot()
+
+
+def test_slo_prometheus_export(model):
+    tracker = SLOTracker([Objective("ttft_p95", "ttft", 0.95,
+                                    threshold_s=10.0)],
+                         fast_window=2, slow_window=4)
+    eng = ServingEngine(model, max_len=48, slots=1, buckets=[16],
+                        slo=tracker)
+    rng = np.random.RandomState(0)
+    eng.submit(_prompt(rng), 3)
+    while eng.pump(4):
+        pass
+    text = eng.metrics.render_prometheus()
+    for suffix in ("burn_rate_fast", "burn_rate_slow", "alert_active",
+                   "budget_remaining"):
+        assert "serving_slo_ttft_p95_%s" % suffix in text
+
+
+# -- structured logging ---------------------------------------------------
+
+def test_log_module_noop_when_unconfigured():
+    assert slog.active() is None
+    slog.emit("req.terminal", rid=1, state="DONE")  # must not raise
+
+
+def test_log_install_refuses_stacking():
+    logger = slog.JsonLinesLogger(stream=io.StringIO())
+    slog.install(logger)
+    try:
+        with pytest.raises(PreconditionNotMetError, match="installed"):
+            slog.install(slog.JsonLinesLogger(stream=io.StringIO()))
+    finally:
+        slog.uninstall()
+    assert slog.active() is None
+
+
+def test_log_json_lines_carry_the_request_edges(model):
+    eng = ServingEngine(model, max_len=48, slots=2, buckets=[16],
+                        max_retries=1)
+    rng = np.random.RandomState(0)
+    buf = io.StringIO()
+    with slog.logging_to(buf) as logger:
+        eng.submit(_prompt(rng), 3, request_id="req-a")
+        while eng.pump(4):
+            pass
+        plane = faults.FaultPlane([faults.FaultSpec(
+            "pool.step", error=faults.TransientInjectedFault, times=1)])
+        with faults.injected(plane):
+            eng.submit(_prompt(rng), 3, request_id="req-b")
+            while eng.pump(8):
+                pass
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    assert logger.events_emitted == len(lines)
+    by_event = {}
+    for rec in lines:
+        by_event.setdefault(rec["event"], []).append(rec)
+    admitted = by_event["req.admitted"]
+    assert {r["rid"] for r in admitted} == {"req-a", "req-b"}
+    assert all("ts" in r and "queue_depth" in r for r in admitted)
+    terminals = by_event["req.terminal"]
+    done = [r for r in terminals if r["rid"] == "req-a"][0]
+    assert done["state"] == "DONE" and done["finish_reason"] in \
+        ("eos", "length")
+    assert "ttft_s" in done and "total_s" in done
+    recovery = by_event["engine.recovery"][0]
+    assert recovery["kind"] == "transient"
+    assert recovery["resubmitted"] == 1
+    # no logger installed anymore: the seam is silent again
+    before = logger.events_emitted
+    eng.submit(_prompt(rng), 2)
+    while eng.pump(4):
+        pass
+    assert logger.events_emitted == before
+
+
+def test_log_lines_carry_trace_tick_correlation(model):
+    eng = ServingEngine(model, max_len=48, slots=1, buckets=[16])
+    rng = np.random.RandomState(0)
+    buf = io.StringIO()
+    tracer = eng.start_trace(capacity=256)
+    try:
+        with slog.logging_to(buf):
+            eng.submit(_prompt(rng), 3, request_id="t-1")
+            while eng.pump(4):
+                pass
+    finally:
+        eng.stop_trace()
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    terminal = [r for r in lines if r["event"] == "req.terminal"][0]
+    # the terminal fired inside a numbered traced tick: its tick field
+    # joins the log line to the flight recorder's timeline
+    assert 1 <= terminal["tick"] <= tracer.tick
+
+
+def test_shed_edge_is_logged(model):
+    fake = {"now": 0.0}
+    eng = ServingEngine(model, max_len=48, slots=1, buckets=[16],
+                        clock=lambda: fake["now"])
+    rng = np.random.RandomState(0)
+    buf = io.StringIO()
+    with slog.logging_to(buf):
+        eng.submit(_prompt(rng), 4)
+        fake["now"] += 1.0
+        while eng.pump(8):
+            fake["now"] += 1.0
+        # observed tick time ~1s: a 1ms-deadline request is hopeless
+        with pytest.raises(Exception):
+            eng.submit(_prompt(rng), 8, deadline_s=0.001)
+    events = [json.loads(l)["event"] for l in buf.getvalue().splitlines()]
+    assert "req.shed" in events
+
+
+# -- metrics satellites: exposition escaping + histogram edges ------------
+
+def _unescape(s):
+    out, i = [], 0
+    while i < len(s):
+        if s[i] == "\\" and i + 1 < len(s):
+            nxt = s[i + 1]
+            out.append({"\\": "\\", "n": "\n", '"': '"'}.get(nxt,
+                                                             "\\" + nxt))
+            i += 2
+        else:
+            out.append(s[i])
+            i += 1
+    return "".join(out)
+
+
+def test_render_prometheus_escapes_hostile_help():
+    hostile = 'quoted "help" with \\backslash\nand a newline'
+    reg = MetricsRegistry()
+    reg.counter("evil_total", hostile).inc()
+    reg.gauge("fine", "plain help").set(1)
+    text = reg.render_prometheus()
+    help_lines = [l for l in text.splitlines()
+                  if l.startswith("# HELP evil_total ")]
+    # ONE exposition line, and it round-trips to the original string
+    assert len(help_lines) == 1
+    rendered = help_lines[0][len("# HELP evil_total "):]
+    assert "\n" not in rendered
+    assert _unescape(rendered) == hostile
+    # the scrape body still parses line-by-line: every line is a
+    # comment or a sample
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or line.split()[0].split("{")[0] \
+            .replace("_", "").replace(":", "").isalnum()
+
+
+def test_escape_label_value_round_trips():
+    hostile = 'le="\\ evil\nvalue"'
+    escaped = escape_label_value(hostile)
+    assert "\n" not in escaped
+    # quotes and backslashes are escaped, so embedding in a quoted
+    # label cannot terminate it early
+    assert '"' not in escaped.replace('\\"', "")
+    assert _unescape(escaped) == hostile
+    assert escape_help("a\\b\nc") == "a\\\\b\\nc"
+
+
+def test_histogram_quantile_edges():
+    h = Histogram("h", buckets=(0.001, 0.01, 0.1))
+    assert h.quantile(0.5) is None  # empty
+    h.observe(0.005)
+    # a single observation answers EVERY quantile with its bucket's
+    # upper bound — including the q=0 edge
+    for q in (0.0, 0.5, 1.0):
+        assert h.quantile(q) == 0.01
+    h.observe(2.0)  # overflow bucket
+    assert h.quantile(0.0) == 0.01
+    assert h.quantile(1.0) == float("inf")
+    with pytest.raises(InvalidArgumentError, match="quantile"):
+        h.quantile(1.5)
+
+
+def test_histogram_reset_keeps_bucket_identity():
+    h = Histogram("h", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    before = h.snapshot()
+    buckets_obj = h.buckets
+    h.reset()
+    after = h.snapshot()
+    # same structure (same bucket keys, zeroed values), same bucket
+    # tuple identity — the engine holds direct references
+    assert list(after["buckets"]) == list(before["buckets"])
+    assert h.buckets is buckets_obj
+    assert after["count"] == 0 and after["sum"] == 0.0
+    assert all(v == 0 for v in after["buckets"].values())
+    h.observe(0.5)
+    assert h.quantile(1.0) == 1.0  # still buckets correctly

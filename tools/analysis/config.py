@@ -53,7 +53,6 @@ EXTRA_EDGES = {
     "SpeculativePool.step": ("ServingEngine._on_token",
                              "ServingEngine._on_finish",
                              "Tracer.span"),
-    "ServingEngine._finalize": ("ResponseStream._finalize",),
     # fault plane: the hot path's module-level no-op check fans into the
     # installed plane, so the plane's own fire() is hot-path-audited
     "_fire": ("fire",),
@@ -74,6 +73,30 @@ EXTRA_EDGES = {
     "Tracer._emit": ("FlightRecorder.append",),
     # the fault plane reports every injection into the trace plane
     "FaultPlane.fire": ("instant",),
+    # AOT compile-and-call wrapper (jit/aot.py): the pool/session jit
+    # attributes resolve to their traced bodies via the jit bindings,
+    # but the WRAPPER's dispatch (key lookup + compiled call) sits on
+    # the same hot path and is declared here so the host-sync rule
+    # audits it; the compile-miss path runs once per executable, never
+    # in steady state, but is reachable and therefore audited too
+    "GenerationPool._dispatch": ("AotFunction.__call__",),
+    "SpeculativePool._spec_round": ("AotFunction.__call__",),
+    "AotFunction.__call__": ("AotFunction._compile_miss",),
+    "AotFunction._compile_miss": ("analyze_compiled", "kv_arg_bytes"),
+    # SLO plane (serving/slo.py): fed from the engine's tick path
+    # behind is-None guards; the tracker's own emission (alert flips
+    # into the trace + structured log) is declared so the whole seam
+    # is hot-path-audited like the fault/trace planes
+    "ServingEngine._run_tick": ("SLOTracker.note_tick",),
+    "ServingEngine._on_token": ("SLOTracker.observe_latency",),
+    "SLOTracker.note_tick": ("_ObjectiveState.roll", "instant",
+                             "emit"),
+    # structured-log plane (serving/log.py): module-level `emit` is
+    # the is-None seam; the installed logger's emit is behind it
+    "emit": ("JsonLinesLogger.emit",),
+    "ServingEngine._finalize": ("ResponseStream._finalize",
+                                "SLOTracker.observe_terminal",
+                                "emit"),
     # recovery: the engine rebuilds whichever pool variant it owns and
     # resubmits through the pool's host API — all behind self._pool
     "ServingEngine._recover": ("GenerationPool.reset",
