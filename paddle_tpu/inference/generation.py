@@ -30,6 +30,30 @@ runs out mid-request), the FIFO head defers when blocks are scarce, and
 ``_finish`` returns blocks for reuse.  Cache HBM then scales with the
 token budget (``num_blocks``), not max_len × slots, while every shape
 stays static and greedy results stay token-identical to dense.
+
+Two paged-only extensions ride the allocator (docs/DESIGN.md §5i):
+
+- ``prefill_chunk_tokens=C`` replaces the one-shot bucketed prefill
+  with ONE fixed-shape chunk executable: each tick spends at most C
+  tokens of prompt work (one padded ``[C]`` chunk through the per-slot
+  table-addressed write path) before the batched decode step runs, so
+  a long prompt can no longer monopolize a tick — TTFT of the long
+  prompt and inter-token latency of every resident request are both
+  bounded.  Chunk K/V land through the SAME attention/masking
+  discipline as decode, so position ``p``'s K/V are bit-identical
+  however the prompt is chunked (masked contributions are exactly
+  zero; per-position projections see only position ``p``).
+- ``prefix_sharing=True`` makes the allocator REFCOUNT-aware and keeps
+  a hash-keyed prefix index over resident FULL prompt blocks (key =
+  hash of the block's token ids chained on the parent block's key).
+  Admission matches an incoming prompt against the longest resident
+  prefix, maps those physical blocks into the new slot's table
+  READ-ONLY (refcount bumped; a shared block is full and writes only
+  ever land at positions past the matched prefix, in the request's own
+  freshly allocated blocks — copy-on-write by construction), and
+  chunk-prefills only the unmatched suffix.  Greedy output is
+  byte-identical to a sharing-off run; release/cancel/reset decref
+  instead of free, and ``cache_stats()`` counts shared blocks once.
 """
 from __future__ import annotations
 
@@ -140,6 +164,49 @@ class _SlotState:
         self.remaining = remaining
 
 
+class _PrefillState:
+    """A slot admitted under chunked prefill whose prompt is still being
+    processed: ``pos`` is the next absolute position to run (the shared
+    prefix, if any, was mapped at admission and is never re-run).
+    ``indexed``/``chain_key`` track incremental prefix indexing: full
+    blocks enter the index AS CHUNKS COMPLETE THEM (a full block is
+    immutable the moment its last position is written), so a hot prefix
+    is shareable while its first owner is still prefilling the tail."""
+
+    __slots__ = ("rid", "ids", "pos", "max_new_tokens", "indexed",
+                 "chain_key")
+
+    def __init__(self, rid, ids, pos: int, max_new_tokens: int,
+                 matched_blocks: int = 0, chain_key=None):
+        self.rid = rid
+        self.ids = ids
+        self.pos = pos
+        self.max_new_tokens = max_new_tokens
+        # matched blocks are already in the index; indexing resumes
+        # after them, continuing their hash chain
+        self.indexed = matched_blocks
+        self.chain_key = chain_key
+
+
+class _PrefixEntry:
+    """One prefix-index chain link.  ``tokens`` (the exact ids the
+    block covers) guards against hash collisions: a colliding key must
+    compare token-equal before its K/V are shared — a false match would
+    silently serve another prompt's cache.  ``blocks`` lists EVERY
+    resident physical block holding this content (identical prompts
+    that prefilled concurrently each compute their own copy — the K/V
+    are bit-identical, so any of them is shareable); a block leaves the
+    list when its refcount hits 0, and the entry dies with its last
+    block."""
+
+    __slots__ = ("blocks", "tokens", "parent_key")
+
+    def __init__(self, block: int, tokens: tuple, parent_key):
+        self.blocks = [block]
+        self.tokens = tokens
+        self.parent_key = parent_key
+
+
 class GenerationPool:
     """Continuous batching: submit prompts, drain one decode step at a
     time, collect per-request token arrays.
@@ -156,9 +223,42 @@ class GenerationPool:
                  top_p: float = 1.0, eos_id: Optional[int] = None,
                  cache_dtype="float32", donate: Optional[bool] = None,
                  seed: int = 0, cache_layout: str = "dense",
-                 block_size: int = 32, num_blocks: Optional[int] = None):
+                 block_size: int = 32, num_blocks: Optional[int] = None,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefix_sharing: bool = False):
         if slots < 1:
             raise InvalidArgumentError("GenerationPool needs slots >= 1")
+        if prefill_chunk_tokens is not None and cache_layout != "paged":
+            # the chunk path writes through the block table (per-slot
+            # scatter routed to the scratch block past the reservation);
+            # the dense layout keeps its one-shot bucketed prefill, so
+            # dense pools are byte-for-byte unaffected by this feature
+            raise InvalidArgumentError(
+                "prefill_chunk_tokens is a paged-cache knob (chunk "
+                "writes route through the block table); pass "
+                "cache_layout='paged' (got %r)" % (cache_layout,))
+        if prefill_chunk_tokens is not None \
+                and int(prefill_chunk_tokens) < 1:
+            raise InvalidArgumentError(
+                "prefill_chunk_tokens must be >= 1 tokens of prompt "
+                "work per tick, got %r" % (prefill_chunk_tokens,))
+        if prefix_sharing and cache_layout != "paged":
+            raise InvalidArgumentError(
+                "prefix_sharing shares physical KV blocks through the "
+                "block table; pass cache_layout='paged' (got %r)"
+                % (cache_layout,))
+        if prefix_sharing and prefill_chunk_tokens is None:
+            # the win of a prefix hit is skipping straight to the
+            # unmatched suffix, and ONLY the chunk executable can start
+            # a prompt mid-way (bucketed prefill always runs from token
+            # 0, which would recompute the shared prefix it just
+            # mapped) — so sharing without chunking is a misconfig, not
+            # a degraded mode
+            raise InvalidArgumentError(
+                "prefix_sharing needs prefill_chunk_tokens: admission "
+                "skips the matched prefix and chunk-prefills only the "
+                "suffix — pass prefill_chunk_tokens=<tokens per tick> "
+                "(e.g. the block size or a small multiple)")
         # the session owns the model binding, the sampling config and the
         # bucketed batch-1 prefill; the pool adds the slot-batched layer.
         # The session shares the pool's cache layout so a paged pool gets
@@ -193,6 +293,13 @@ class GenerationPool:
             self._num_blocks = num_blocks
             self._free_blocks: List[int] = list(range(1, num_blocks))
             self._slot_blocks: Dict[int, List[int]] = {}
+            # refcount per RESIDENT physical block (absent = free).  A
+            # freshly allocated block starts at 1; prefix sharing bumps
+            # it per additional table row mapping the block; release/
+            # finish/cancel DECREF, and only refcount 0 returns a block
+            # to _free_blocks — so a block can never be freed out from
+            # under another slot's table row
+            self._block_refs: Dict[int, int] = {}
         elif num_blocks is not None:
             raise InvalidArgumentError(
                 "num_blocks is a paged-cache knob; pass "
@@ -227,6 +334,51 @@ class GenerationPool:
             self._insert_jit,
             key_fn=lambda pool_cache, row_cache, *r: "slot_insert",
             name="slot_insert")
+        # chunked prefill + prefix sharing (paged only; docs §5i).  The
+        # executables exist only when the knob is on, so a plain pool's
+        # compile_counts()/cost_report() keys are exactly the pinned
+        # pre-existing set
+        self._chunk_tokens = (None if prefill_chunk_tokens is None
+                              else int(prefill_chunk_tokens))
+        self.prefix_sharing = bool(prefix_sharing)
+        self._prefilling: Dict[int, _PrefillState] = {}
+        self._chunk_jit = None
+        self._admit_jit = None
+        if self._chunk_tokens is not None:
+            dn = (2,) if donate else ()
+            self._chunk_jit = aot.AotFunction(
+                jax.jit(self._prefill_chunk, donate_argnums=dn),
+                key_fn=lambda p, b, cache, toks, *r: aot.shape_key(toks),
+                name="prefill_chunk",
+                meta_fn=lambda p, b, cache, *r: {
+                    "kv_cache_bytes": aot.kv_arg_bytes(cache)})
+            self._admit_jit = aot.AotFunction(
+                jax.jit(self._admit, donate_argnums=(0,) if donate
+                        else ()),
+                key_fn=lambda *a: "slot_admit", name="slot_admit")
+        # prefix index: chain-hash key -> resident full block (entries
+        # removed the moment their block's refcount hits 0), plus the
+        # reverse map used for that removal.  Hit accounting is
+        # cumulative (the serving gauges and bench legs read it)
+        self._prefix_index: Dict[int, _PrefixEntry] = {}
+        self._block_keys: Dict[int, int] = {}
+        # head-of-queue match memo: a blocked FIFO head would otherwise
+        # re-walk its whole prefix chain (tuple-build + hash per block)
+        # EVERY tick until blocks free.  The epoch bumps on any
+        # allocator/index mutation, so a memoized match is exactly as
+        # fresh as a recomputed one
+        self._prefix_epoch = 0
+        self._head_match = None
+        self._prefix_queries = 0
+        self._prefix_hits = 0
+        self._prefix_tokens_matched = 0
+        self._prefix_blocks_matched = 0
+        self._chunks_total = 0
+        self._chunk_tokens_total = 0
+        # the engine's _on_admit reads this right after the pool fires
+        # on_admit (same synchronous call chain): matched prefix tokens
+        # of the LAST admission, None when sharing is off
+        self.last_admit_prefix_tokens: Optional[int] = None
         self._key = jax.random.PRNGKey(seed)
         self._queue: collections.deque = collections.deque()
         self._active: Dict[int, _SlotState] = {}
@@ -309,12 +461,17 @@ class GenerationPool:
         frozen (their cache index does not advance, their token output is
         forced to 0) so a free slot can never creep past max_len.
 
-        Paged: an inactive slot's table row is zeroed BEFORE the step so
+        Paged: an inactive slot's table row is zeroed FOR THE STEP so
         its (discarded) write lands in the scratch block — its old blocks
         may already belong to a refilled request, and a stale-table write
-        would corrupt that request's cache."""
+        would corrupt that request's cache.  The ORIGINAL rows are
+        restored in the returned cache: under chunked prefill an
+        inactive slot can be mid-prompt, and persisting the zeroed row
+        would wipe the mapping its next chunk writes through."""
         sess = self._session
+        tables = None
         if self.cache_layout == "paged":
+            tables = [c.table for c in cache]
             cache = [c._replace(table=jnp.where(active[:, None],
                                                 c.table, 0))
                      for c in cache]
@@ -323,7 +480,56 @@ class GenerationPool:
         tok, key = sess._sample(logits[:, 0], key)
         new_cache = [c._replace(index=jnp.where(active, c.index, old.index))
                      for c, old in zip(new_cache, cache)]
+        if tables is not None:
+            new_cache = [c._replace(table=t)
+                         for c, t in zip(new_cache, tables)]
         return new_cache, jnp.where(active, tok, 0), key
+
+    def _admit(self, cache, slot, row, index):
+        """Map an admitted request's table row (shared prefix blocks +
+        freshly allocated suffix blocks, scratch-padded) and set its
+        cache index to the matched prefix length — the chunked-prefill
+        admission write.  No K/V move: the shared blocks are already
+        resident and the suffix is computed by later chunk calls."""
+        return [c._replace(table=c.table.at[slot].set(row),
+                           index=c.index.at[slot].set(
+                               jnp.asarray(index, jnp.int32)))
+                for c in cache]
+
+    def _prefill_chunk(self, param_vals, buf_vals, cache, toks, slot,
+                       start, length, key):
+        """One fixed-shape prompt chunk for ONE slot: run ``toks`` (a
+        ``[C]`` vector holding ``length`` real tokens, zero-padded at
+        the back to the fixed C) from
+        absolute position ``start`` through the slot's table row, and
+        sample the token at offset ``length - 1`` (only the final
+        chunk's sample — the request's FIRST token — is ever used).
+
+        The forward is a batch-1 view over the GLOBAL block pools: the
+        slot's table row is sliced out, so writes scatter into the same
+        physical blocks the batched decode step reads, through the same
+        per-slot addressing (positions past the table span land in the
+        scratch block).  Pad positions write garbage into the request's
+        OWN future positions — masked until real tokens overwrite them,
+        exactly the bucketed prefill's pad discipline — and can never
+        touch a SHARED block: shared blocks end before ``start``, and
+        every written position is >= start."""
+        sess = self._session
+        views = [c._replace(
+            table=jax.lax.dynamic_slice(
+                c.table, (slot, 0), (1, c.table.shape[1])),
+            index=jnp.full((1,), start, jnp.int32)) for c in cache]
+        logits, new_views = sess._run_model(param_vals, buf_vals,
+                                            toks[None], views)
+        last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
+                                            axis=0, keepdims=False)
+        tok, key = sess._sample(last[None], key)
+        out = [c._replace(k=v.k, v=v.v, k_scale=v.k_scale,
+                          v_scale=v.v_scale,
+                          index=c.index.at[slot].set(
+                              jnp.asarray(start + length, jnp.int32)))
+               for c, v in zip(cache, new_views)]
+        return out, tok[0], key
 
     # -- host API --------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int, request_id=None):
@@ -357,8 +563,12 @@ class GenerationPool:
         if max_new_tokens < 1:
             raise InvalidArgumentError("max_new_tokens must be >= 1")
         # fail at SUBMIT time, not mid-refill: a prompt no bucket covers
-        # would otherwise raise after the slot bookkeeping started
-        self._session._bucket_for(len(ids))
+        # would otherwise raise after the slot bookkeeping started.
+        # Chunked prefill needs no bucket at all — every prompt is
+        # processed as fixed-shape [C] chunks, so prompts past the
+        # largest bucket are servable there
+        if self._chunk_tokens is None:
+            self._session._bucket_for(len(ids))
         if self.cache_layout == "paged":
             # a request must fit an EMPTY pool, else _refill could never
             # admit it and the pool would stall forever on a full queue
@@ -401,6 +611,39 @@ class GenerationPool:
         span = min(prompt_len + max_new_tokens, self.max_len)
         return -(-span // self._block_size)
 
+    def _alloc_blocks(self, n: int) -> List[int]:
+        """Pop ``n`` fresh blocks off the free list at refcount 1."""
+        self._prefix_epoch += 1
+        blocks = [self._free_blocks.pop() for _ in range(n)]
+        for b in blocks:
+            self._block_refs[b] = 1
+        return blocks
+
+    def _release_blocks(self, slot: int) -> None:
+        """DECREF every block the slot's table row maps; blocks hitting
+        refcount 0 return to the free list and leave the prefix index
+        (an index entry must always name a RESIDENT block).  A block
+        another slot still shares stays resident — the refcount is what
+        makes mid-generation release safe under sharing."""
+        if self.cache_layout != "paged":
+            return
+        self._prefix_epoch += 1
+        for b in self._slot_blocks.pop(slot, ()):
+            left = self._block_refs.get(b, 1) - 1
+            if left > 0:
+                self._block_refs[b] = left
+                continue
+            self._block_refs.pop(b, None)
+            self._free_blocks.append(b)
+            key = self._block_keys.pop(b, None)
+            if key is not None:
+                entry = self._prefix_index.get(key)
+                if entry is not None:
+                    if b in entry.blocks:
+                        entry.blocks.remove(b)
+                    if not entry.blocks:
+                        del self._prefix_index[key]
+
     def _finish(self, slot: int):
         state = self._active.pop(slot)
         tokens = np.asarray(state.tokens, np.int32)
@@ -408,29 +651,31 @@ class GenerationPool:
         reason = classify_finish(tokens, self.eos_id)
         self._finish_reasons[state.rid] = reason
         self._free.append(slot)
-        if self.cache_layout == "paged":
-            # returned blocks are immediately reusable: the slot's stale
-            # table row is masked to the scratch block inside every
-            # decode step until a refill overwrites it
-            self._free_blocks.extend(self._slot_blocks.pop(slot, ()))
+        # refcount-0 blocks are immediately reusable: the slot's stale
+        # table row is masked to the scratch block inside every decode
+        # step until a refill overwrites it; shared blocks stay resident
+        self._release_blocks(slot)
         self._membership_dirty = True
         if self.on_finish is not None:
             self.on_finish(state.rid, tokens, reason)
 
     def release(self, slot: int):
-        """Free ``slot`` (and its paged blocks) WITHOUT recording a
-        result — the cancellation path.  Mid-generation release is as
+        """Free ``slot`` (decref'ing its paged blocks) WITHOUT recording
+        a result — the cancellation path, covering both DECODING and
+        (chunked) still-PREFILLING slots.  Mid-generation release is as
         safe as ``_finish``: the freed slot's stale table row is masked
         to the scratch block inside every decode step until a refill
-        overwrites it.  Returns the request id the slot was serving."""
-        if slot not in self._active:
+        overwrites it, and shared blocks outlive the release via their
+        refcount.  Returns the request id the slot was serving."""
+        state = self._active.pop(slot, None) \
+            or self._prefilling.pop(slot, None)
+        if state is None:
             raise NotFoundError(
-                "slot %r is not active (active slots: %s)"
-                % (slot, sorted(self._active)))
-        state = self._active.pop(slot)
+                "slot %r is not active or prefilling (active slots: "
+                "%s, prefilling: %s)"
+                % (slot, sorted(self._active), sorted(self._prefilling)))
         self._free.append(slot)
-        if self.cache_layout == "paged":
-            self._free_blocks.extend(self._slot_blocks.pop(slot, ()))
+        self._release_blocks(slot)
         self._used_rids.discard(state.rid)
         self._membership_dirty = True
         return state.rid
@@ -438,16 +683,18 @@ class GenerationPool:
     def cancel(self, request_id):
         """Abort one request wherever it lives: ``"queued"`` (removed
         from the wait queue), ``"active"`` (its slot and paged blocks
-        freed mid-generation), or ``"finished"`` (the uncollected result
-        discarded).  The ``on_finish`` hook does NOT fire — cancellation
-        is the caller's decision, not a completion.  Unknown ids raise
+        freed mid-generation — chunked mid-PREFILL slots count as
+        active), or ``"finished"`` (the uncollected result discarded).
+        The ``on_finish`` hook does NOT fire — cancellation is the
+        caller's decision, not a completion.  Unknown ids raise
         :class:`NotFoundError`."""
         for i, req in enumerate(self._queue):
             if req.rid == request_id:
                 del self._queue[i]
                 self._used_rids.discard(request_id)
                 return "queued"
-        for slot, state in self._active.items():
+        for slot, state in list(self._active.items()) \
+                + list(self._prefilling.items()):
             if state.rid == request_id:
                 self.release(slot)
                 return "active"
@@ -483,19 +730,229 @@ class GenerationPool:
         """Slots currently decoding."""
         return len(self._active)
 
+    @property
+    def prefilling_count(self) -> int:
+        """Slots admitted under chunked prefill whose prompt is still
+        being processed (0 on a non-chunked pool)."""
+        return len(self._prefilling)
+
+    @property
+    def prefill_chunk_tokens(self) -> Optional[int]:
+        """The per-tick prompt-work bound (None = one-shot prefill)."""
+        return self._chunk_tokens
+
+    def _shared_block_count(self) -> int:
+        """Blocks currently referenced beyond their first owner — the
+        live HBM the prefix index is saving (0 for dense pools)."""
+        if self.cache_layout != "paged":
+            return 0
+        return sum(r - 1 for r in self._block_refs.values() if r > 1)
+
+    def reset_prefix_stats(self) -> None:
+        """Zero the cumulative hit/query/chunk counters — bench legs
+        and sweeps call this between warmup and the timed region so the
+        stamped hit rate covers exactly the measured traffic (the warm
+        request is an admission query that can never hit)."""
+        self._prefix_queries = self._prefix_hits = 0
+        self._prefix_tokens_matched = self._prefix_blocks_matched = 0
+        self._chunks_total = self._chunk_tokens_total = 0
+
+    def prefix_stats(self) -> dict:
+        """Host-side prefix-sharing / chunked-prefill accounting: the
+        quantities the serving gauges (``serving_prefix_hit_rate``,
+        ``serving_prefix_blocks_shared``,
+        ``serving_prefill_chunks_total``) and the bench leg stamp.
+        Queries/hits are cumulative over admissions;
+        ``blocks_shared_now`` is the live count of references beyond
+        each block's first owner (HBM being saved right now)."""
+        q = self._prefix_queries
+        return {
+            "enabled": self.prefix_sharing,
+            "queries": q,
+            "hits": self._prefix_hits,
+            "hit_rate": (self._prefix_hits / q) if q else 0.0,
+            "tokens_matched": self._prefix_tokens_matched,
+            "blocks_matched": self._prefix_blocks_matched,
+            "blocks_shared_now": self._shared_block_count(),
+            "indexed_blocks": len(self._prefix_index),
+            "prefill_chunk_tokens": self._chunk_tokens,
+            "prefill_chunks_total": self._chunks_total,
+            "prefill_chunk_tokens_total": self._chunk_tokens_total,
+        }
+
+    def _on_activated(self, slot: int, rid, ids) -> None:
+        """Subclass hook: a slot just became ACTIVE with its first
+        token committed (fires for both the bucketed one-shot prefill
+        and the chunked path's final chunk).  The speculative pool uses
+        it to prefill its draft twin."""
+
+    def _activate(self, slot: int, rid, ids, first: int,
+                  max_new_tokens: int) -> None:
+        """Promote a slot to decoding: its prompt is fully resident and
+        ``first`` (the token sampled at the last prompt position) is
+        committed.  One code path for both prefill modes, so the hook
+        order (``on_admit`` at slot-take, then ``_on_activated``, then
+        ``on_token``) cannot diverge between them."""
+        self._active[slot] = _SlotState(rid, first, max_new_tokens - 1)
+        self._last_tok[slot] = first
+        self._membership_dirty = True
+        finishes = max_new_tokens - 1 == 0 or \
+            (self.eos_id is not None and first == self.eos_id)
+        if not finishes:
+            # a slot that finishes on its very first token never
+            # decodes, so the subclass hook (the speculative pool's
+            # draft prefill + splice) would be pure wasted device work
+            self._on_activated(slot, rid, ids)
+        if self.on_token is not None:
+            self.on_token(rid, first)
+        if finishes:
+            self._finish(slot)
+
+    def _match_prefix(self, ids):
+        """Longest resident block-aligned prefix of ``ids`` in the
+        prefix index: ``(physical_blocks, matched_tokens,
+        last_matched_chain_key)``.
+
+        Block-granular by design: only FULL blocks are ever indexed, a
+        full block is never written again (writes advance
+        monotonically), so a matched block is immutable — the
+        copy-on-write rule degenerates to never-write-shared.  The walk
+        is chained (each key hashes the parent's key with the block's
+        token ids) and each hit is verified token-equal against the
+        entry, so a hash collision cannot splice another prompt's K/V.
+        The FINAL prompt position is never matched — the request's
+        first output token is sampled from the logits there, so at
+        least one suffix token always runs through the chunk path."""
+        bs = self._block_size
+        limit = (len(ids) - 1) // bs
+        blocks: List[int] = []
+        key = None
+        last_matched = None
+        for j in range(limit):
+            toks = tuple(int(t) for t in ids[j * bs:(j + 1) * bs])
+            parent, key = key, hash((key, toks))
+            entry = self._prefix_index.get(key)
+            if entry is None or entry.tokens != toks \
+                    or entry.parent_key != parent:
+                break
+            blocks.append(entry.blocks[-1])
+            last_matched = key
+        return blocks, len(blocks) * bs, last_matched
+
+    def _index_full_blocks(self, slot: int, st: _PrefillState) -> None:
+        """Advance the slot's incremental prefix indexing: every PROMPT
+        block whose last position is now written (``pos`` passed its
+        end) becomes immutable and enters the index — so a hot shared
+        prefix is matchable while its first owner is still prefilling
+        the tail, not only after it activates.  Generated-token blocks
+        are deliberately never indexed: the shareable traffic shape is
+        common system prompts / few-shot prefixes, which live in the
+        prompt."""
+        bs = self._block_size
+        blocks = self._slot_blocks.get(slot)
+        if blocks is None:
+            return
+        if (st.indexed + 1) * bs <= st.pos:
+            self._prefix_epoch += 1
+        while (st.indexed + 1) * bs <= st.pos:
+            j = st.indexed
+            toks = tuple(int(t) for t in st.ids[j * bs:(j + 1) * bs])
+            key = hash((st.chain_key, toks))
+            entry = self._prefix_index.get(key)
+            if entry is None:
+                self._prefix_index[key] = _PrefixEntry(
+                    blocks[j], toks, st.chain_key)
+                self._block_keys[blocks[j]] = key
+            elif entry.tokens == toks \
+                    and entry.parent_key == st.chain_key:
+                # same content already indexed: a concurrent duplicate
+                # prompt computed its own bit-identical copy — list it,
+                # so the chain survives whichever owner frees first
+                if blocks[j] not in entry.blocks:
+                    entry.blocks.append(blocks[j])
+                    self._block_keys[blocks[j]] = key
+            else:
+                # hash COLLISION with a different chain: listing this
+                # block under the entry would let _match_prefix serve
+                # its K/V against the entry's verified tokens — the
+                # exact splice the collision guard exists to prevent.
+                # The chain is unmatchable past this link either way
+                # (lookups re-verify tokens+parent), so stop indexing
+                # this slot's prompt entirely
+                st.indexed = len(st.ids) // bs
+                return
+            st.chain_key = key
+            st.indexed += 1
+
+    def _admit_chunked(self, req: _Request, need: int, matched_blocks,
+                       matched_len: int, chain_key) -> None:
+        """Chunked-prefill admission: map the matched prefix blocks
+        READ-ONLY (refcounts bumped), allocate fresh blocks for
+        everything from ``matched_len`` on (suffix + generation — every
+        position this request will WRITE), point the slot's table row
+        at them and set its index to ``matched_len``.  No prompt
+        forward runs here: ``_chunk_work`` processes the unmatched
+        suffix at most ``prefill_chunk_tokens`` per tick."""
+        _fire("pool.alloc_blocks")
+        slot = self._free.pop()
+        for b in matched_blocks:
+            self._block_refs[b] += 1
+        blocks = list(matched_blocks) + \
+            self._alloc_blocks(need - len(matched_blocks))
+        self._slot_blocks[slot] = blocks
+        padded = np.zeros(self._max_blocks, np.int32)
+        padded[:len(blocks)] = blocks
+        self._cache = self._admit_jit(
+            self._cache, jnp.asarray(slot, jnp.int32),
+            jnp.asarray(padded), jnp.asarray(matched_len, jnp.int32))
+        self._prefilling[slot] = _PrefillState(
+            req.rid, req.ids, matched_len, req.max_new_tokens,
+            matched_blocks=len(matched_blocks), chain_key=chain_key)
+        if self.prefix_sharing:
+            self._prefix_queries += 1
+            if matched_len:
+                self._prefix_hits += 1
+                self._prefix_tokens_matched += matched_len
+                self._prefix_blocks_matched += len(matched_blocks)
+            self.last_admit_prefix_tokens = matched_len
+        else:
+            self.last_admit_prefix_tokens = None
+        if self.on_admit is not None:
+            self.on_admit(req.rid, slot, len(req.ids))
+
     def _refill(self):
         tr = _trace_active()
         while self._queue and self._free:
+            matched_blocks, matched_len, chain_key = [], 0, None
             if self.cache_layout == "paged":
                 # admission control: FIFO head waits until enough blocks
                 # are free for its whole reservation (skipping ahead to a
-                # smaller later request would starve long prompts)
+                # smaller later request would starve long prompts).
+                # With sharing, matched blocks come off the requirement:
+                # a hit admits under block pressure a cold prompt could
+                # not
                 head = self._queue[0]
                 need = self._blocks_needed(len(head.ids),
                                            head.max_new_tokens)
-                if need > len(self._free_blocks):
+                if self.prefix_sharing:
+                    sig = (head.rid, self._prefix_epoch)
+                    if self._head_match is not None \
+                            and self._head_match[0] == sig:
+                        matched_blocks, matched_len, chain_key = \
+                            self._head_match[1]
+                    else:
+                        matched_blocks, matched_len, chain_key = \
+                            self._match_prefix(head.ids)
+                        self._head_match = (
+                            sig, (matched_blocks, matched_len,
+                                  chain_key))
+                if need - len(matched_blocks) > len(self._free_blocks):
                     break
             req = self._queue.popleft()
+            if self._chunk_tokens is not None:
+                self._admit_chunked(req, need, matched_blocks,
+                                    matched_len, chain_key)
+                continue
             # bucketed batch-1 prefill (compiled per bucket, shared with
             # DecodeSession.generate) emits the request's FIRST token;
             # runs BEFORE the slot is popped so a prefill failure can
@@ -517,7 +974,7 @@ class GenerationPool:
             first = int(np.asarray(tok)[0])
             if self.cache_layout == "paged":
                 _fire("pool.alloc_blocks")
-                blocks = [self._free_blocks.pop() for _ in range(need)]
+                blocks = self._alloc_blocks(need)
                 self._slot_blocks[slot] = blocks
                 # pad the table row to max_blocks with the scratch block:
                 # unreserved logical blocks are never read (masked past
@@ -532,17 +989,63 @@ class GenerationPool:
                 self._cache = self._insert_jit(
                     self._cache, row_cache, jnp.asarray(slot, jnp.int32),
                     jnp.asarray(len(req.ids), jnp.int32))
-            self._active[slot] = _SlotState(req.rid, first,
-                                            req.max_new_tokens - 1)
-            self._last_tok[slot] = first
-            self._membership_dirty = True
+            self.last_admit_prefix_tokens = None
             if self.on_admit is not None:
                 self.on_admit(req.rid, slot, len(req.ids))
-            if self.on_token is not None:
-                self.on_token(req.rid, first)
-            if self._active[slot].remaining == 0 or \
-                    (self.eos_id is not None and first == self.eos_id):
-                self._finish(slot)
+            self._activate(slot, req.rid, req.ids, first,
+                           req.max_new_tokens)
+
+    def _chunk_work(self, tr) -> None:
+        """At most ``prefill_chunk_tokens`` of prompt work this tick:
+        ONE padded ``[C]`` chunk call advancing the OLDEST prefilling
+        slot (FIFO — concurrent admissions' prompts serialize, each
+        tick still runs the batched decode step for every active slot).
+        The final chunk's sampled token activates the slot."""
+        if not self._prefilling:
+            return
+        slot = next(iter(self._prefilling))
+        st = self._prefilling[slot]
+        n = min(self._chunk_tokens, len(st.ids) - st.pos)
+        toks = np.zeros(self._chunk_tokens, np.int32)
+        toks[:n] = st.ids[st.pos:st.pos + n]
+        if self._state_cache is None:
+            self._state_cache = self._session._state_vals()
+        params, bufs = self._state_cache
+        _fire("pool.prefill")
+        if tr is None:
+            self._cache, tok_dev, self._key = self._chunk_jit(
+                params, bufs, self._cache, jnp.asarray(toks),
+                jnp.asarray(slot, jnp.int32),
+                jnp.asarray(st.pos, jnp.int32),
+                jnp.asarray(n, jnp.int32), self._key)
+        else:
+            with tr.span("tick.prefill", rid=st.rid, chunk_tokens=n,
+                         pos=st.pos, prompt_tokens=len(st.ids)):
+                self._cache, tok_dev, self._key = self._chunk_jit(
+                    params, bufs, self._cache, jnp.asarray(toks),
+                    jnp.asarray(slot, jnp.int32),
+                    jnp.asarray(st.pos, jnp.int32),
+                    jnp.asarray(n, jnp.int32), self._key)
+                if tr.deep:
+                    # deep-timing honesty: close the chunk span at the
+                    # device edge, not at dispatch return
+                    jax.block_until_ready(tok_dev)
+        self._chunks_total += 1
+        self._chunk_tokens_total += n
+        st.pos += n
+        if self.prefix_sharing:
+            # blocks this chunk completed are immutable now: index them
+            # immediately, so a queued request sharing this prefix can
+            # match it at ITS admission, mid-prefill
+            self._index_full_blocks(slot, st)
+        if st.pos < len(st.ids):
+            return
+        # prompt fully resident: the chunk's sample IS the first token
+        # (the one host sync of the chunk path — intermediate chunks'
+        # samples are never fetched)
+        self._prefilling.pop(slot)
+        first = int(np.asarray(tok_dev))
+        self._activate(slot, st.rid, st.ids, first, st.max_new_tokens)
 
     def _sync_step_inputs(self):
         """The shared pre-step protocol (also the speculative pool's):
@@ -576,8 +1079,13 @@ class GenerationPool:
         else:
             with tr.span("tick.admit"):
                 self._refill()
+        if self._chunk_tokens is not None:
+            # bounded prompt work BEFORE the decode dispatch: a freshly
+            # completed short prompt still gets its first decode step
+            # this same tick (no TTFT penalty vs the one-shot prefill)
+            self._chunk_work(tr)
         if not self._active:
-            return bool(self._queue)
+            return bool(self._queue or self._prefilling)
         params, bufs = self._sync_step_inputs()
         if tr is None:
             tok_dev = self._dispatch(params, bufs)
@@ -600,7 +1108,7 @@ class GenerationPool:
         else:
             with tr.span("tick.deliver"):
                 self._deliver(tok)
-        return bool(self._active or self._queue)
+        return bool(self._active or self._queue or self._prefilling)
 
     def _dispatch(self, params, bufs):
         """The one batched decode dispatch (cache donated and rebound in
@@ -645,6 +1153,7 @@ class GenerationPool:
         tests)."""
         self._queue.clear()
         self._active.clear()
+        self._prefilling.clear()
         self._free = list(range(self.slots))
         self._last_tok = np.zeros(self.slots, np.int32)
         self._tok_dev = None
@@ -656,6 +1165,16 @@ class GenerationPool:
         if self.cache_layout == "paged":
             self._free_blocks = list(range(1, self._num_blocks))
             self._slot_blocks = {}
+            self._block_refs = {}
+            # the prefix index names physical blocks in the cache being
+            # discarded: it MUST clear with them, or a post-recovery
+            # admission would map freed-then-reused blocks as a "shared
+            # prefix" and the rebuild-and-resubmit contract (byte-
+            # identical survivors) would silently break
+            self._prefix_index.clear()
+            self._block_keys.clear()
+            self._prefix_epoch += 1
+            self._head_match = None
         self._cache = self._model.gen_decode_cache(
             self.slots, self.max_len, self._cache_dtype, per_slot=True,
             layout=self.cache_layout, block_size=self._block_size,
@@ -682,14 +1201,24 @@ class GenerationPool:
         counts = self._session.compile_counts()
         counts["pool_decode"] = int(self._decode_jit._cache_size())
         counts["slot_insert"] = int(self._insert_jit._cache_size())
+        if self._chunk_jit is not None:
+            # chunked prefill adds a FIXED pair: one [C] chunk shape +
+            # one admission write — never a compile per prompt length
+            # (the retrace-hazard contract, pinned by tests)
+            counts["prefill_chunk"] = int(self._chunk_jit._cache_size())
+            counts["slot_admit"] = int(self._admit_jit._cache_size())
         return counts
 
     def cost_version(self) -> int:
         """Total AOT compilations across the pool's executables — the
         cheap fingerprint the serving engine polls per tick so cost
         gauges refresh only when an executable actually changed."""
-        return (self._session.cost_version()
-                + self._decode_jit.compiles + self._insert_jit.compiles)
+        version = (self._session.cost_version()
+                   + self._decode_jit.compiles
+                   + self._insert_jit.compiles)
+        if self._chunk_jit is not None:
+            version += self._chunk_jit.compiles + self._admit_jit.compiles
+        return version
 
     def _derived_costs(self, step_entry: Optional[dict],
                        tokens_per_step_per_slot: float = 1.0,
@@ -728,6 +1257,12 @@ class GenerationPool:
         rep = self._session.cost_report()
         rep["pool_decode"] = self._decode_jit.cost_report()
         rep["slot_insert"] = self._insert_jit.cost_report()
+        if self._chunk_jit is not None:
+            # the chunk executable's attribution rides the same AOT
+            # path: what one tick's bounded prompt work asks of the
+            # hardware, from the artifact
+            rep["prefill_chunk"] = self._chunk_jit.cost_report()
+            rep["slot_admit"] = self._admit_jit.cost_report()
         rep["derived"] = self._derived_costs(self._decode_jit.last_cost())
         return rep
 
@@ -751,17 +1286,33 @@ class GenerationPool:
                  "dense_equiv_bytes": dense_bytes}
         if self.cache_layout == "paged":
             bs = self._block_size
+            mapped = self._num_blocks - 1 - len(self._free_blocks)
+            # each UNIQUE resident block counted once (a prefix-shared
+            # block is readable by several slots but occupies its HBM
+            # once), at its readable tokens: a block at logical index j
+            # covers [j*bs, (j+1)*bs) capped at max_len — the ragged
+            # final block's over-hang is masked, never attended, so it
+            # must not be counted (and sharing is prefix-aligned, so a
+            # shared block has the same logical index for every owner).
+            # Pre-sharing this reduces exactly to the per-slot-span
+            # kv_reachable_bytes formula
+            seen: Dict[int, int] = {}
+            for blocks in self._slot_blocks.values():
+                for j, b in enumerate(blocks):
+                    seen.setdefault(b, j)
+            per_token = dense_bytes // (self.slots * self.max_len)
+            reachable = per_token * sum(
+                max(0, min((j + 1) * bs, self.max_len) - j * bs)
+                for j in seen.values())
             stats.update(
                 block_size=bs,
                 num_blocks=self._num_blocks,
                 free_blocks=len(self._free_blocks),
-                mapped_blocks=self._num_blocks - 1 -
-                len(self._free_blocks),
-                # tokens = each slot's mapped span: ONE formula with the
-                # bench/sweep records (incl. the ragged-final-block cap)
-                reachable_bytes=kv_reachable_bytes(
-                    [len(b) * bs for b in self._slot_blocks.values()],
-                    layout="paged", block_size=bs, **dims),
+                mapped_blocks=mapped,
+                reachable_bytes=reachable,
+                # blocks referenced beyond their first owner — the live
+                # HBM the prefix index is currently saving
+                shared_blocks=self._shared_block_count(),
                 pool_bytes=self._num_blocks * bs *
                 dense_bytes // (self.slots * self.max_len))
         else:

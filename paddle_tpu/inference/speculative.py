@@ -77,7 +77,9 @@ class SpeculativePool(GenerationPool):
                  cache_layout: str = "dense", block_size: int = 32,
                  num_blocks: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 top_p: float = 1.0, time_split: bool = False):
+                 top_p: float = 1.0, time_split: bool = False,
+                 prefill_chunk_tokens: Optional[int] = None,
+                 prefix_sharing: bool = False):
         if float(temperature) != 0.0:
             raise InvalidArgumentError(
                 "speculative decoding is greedy-only (temperature=0): "
@@ -92,12 +94,18 @@ class SpeculativePool(GenerationPool):
         # DROP-IN for GenerationPool under ServingEngine's **pool_kwargs
         # — at temperature=0 the base pool ignores them exactly as the
         # plain pool does, rather than dying on an untyped TypeError
+        # chunked prefill + prefix sharing apply to the TARGET cache
+        # verbatim (the base pool machinery); the draft twin keeps its
+        # bucketed dense prefill — the draft is small by design, and its
+        # prompt forward runs once at activation, not per tick
         super().__init__(model, max_len, slots=slots, buckets=buckets,
                          eos_id=eos_id, cache_dtype=cache_dtype,
                          donate=donate, seed=seed, top_k=top_k,
                          top_p=top_p,
                          cache_layout=cache_layout, block_size=block_size,
-                         num_blocks=num_blocks)
+                         num_blocks=num_blocks,
+                         prefill_chunk_tokens=prefill_chunk_tokens,
+                         prefix_sharing=prefix_sharing)
         self.spec_k = int(spec_k)
         # the draft session owns the draft binding and its bucketed
         # batch-1 prefill (compiled once per bucket); its decode step is
@@ -202,7 +210,13 @@ class SpeculativePool(GenerationPool):
         discipline), emitted tokens zeroed, index unchanged."""
         sess = self._session
         idx0 = cache[0].index                                # [slots]
+        tables = None
         if self.cache_layout == "paged":
+            # inactive rows' tables are zeroed FOR the step (scratch-
+            # routed writes) but restored in the returned cache: under
+            # chunked prefill an inactive slot can be mid-prompt, and
+            # persisting the zeroed row would wipe its mapping
+            tables = [c.table for c in cache]
             cache = [c._replace(table=jnp.where(active[:, None],
                                                 c.table, 0))
                      for c in cache]
@@ -211,6 +225,9 @@ class SpeculativePool(GenerationPool):
         m, emitted = greedy_accept(logits, chunk, active)    # [S], [S,K+1]
         new_idx = jnp.where(active, idx0 + m + 1, idx0)
         new_cache = [c._replace(index=new_idx) for c in new_cache]
+        if tables is not None:
+            new_cache = [c._replace(table=t)
+                         for c, t in zip(new_cache, tables)]
         # pending = each row's LAST emitted token, the next round's
         # draft input — computed here so the steady state feeds straight
         # back on-device
@@ -218,25 +235,30 @@ class SpeculativePool(GenerationPool):
         return new_cache, emitted, m, pending
 
     # -- host API --------------------------------------------------------
-    def _refill(self):
-        """Base refill (target prefill + splice + first token) plus the
-        draft-side twin: every NEWLY admitted slot gets a draft prefill
-        of the same prompt spliced into the draft slot cache (the
-        draft's own sampled first token is discarded — the target's is
-        the ground truth the draft continues from)."""
-        before = {slot: st.rid for slot, st in self._active.items()}
-        pending_ids = {req.rid: req.ids for req in self._queue}
-        super()._refill()
-        for slot, st in self._active.items():
-            if before.get(slot) == st.rid:
-                continue
-            ids = pending_ids[st.rid]
-            row_cache, _tok, self._key = self._draft_session.prefill(
-                ids[None], self._key)
-            self._draft_cache = self._draft_insert_jit(
-                self._draft_cache, row_cache,
-                jnp.asarray(slot, jnp.int32),
-                jnp.asarray(len(ids), jnp.int32))
+    def _on_activated(self, slot, rid, ids):
+        """The draft-side twin of slot activation: the newly activated
+        slot gets a draft prefill of the same prompt spliced into the
+        draft slot cache (the draft's own sampled first token is
+        discarded — the target's is the ground truth the draft
+        continues from).  Fires for BOTH prefill modes — the bucketed
+        one-shot path and the chunked path's final chunk — because the
+        base pool funnels every activation through ``_activate``."""
+        row_cache, _tok, self._key = self._draft_session.prefill(
+            ids[None], self._key)
+        self._draft_cache = self._draft_insert_jit(
+            self._draft_cache, row_cache,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(len(ids), jnp.int32))
+
+    def submit(self, input_ids, max_new_tokens: int, request_id=None):
+        ids = np.asarray(getattr(input_ids, "value", input_ids))
+        if self._chunk_tokens is not None and ids.ndim == 1 and ids.size:
+            # the TARGET needs no bucket under chunked prefill, but the
+            # draft twin still prefills through its buckets at
+            # activation — fail at submit, not mid-tick
+            self._draft_session._bucket_for(ids.shape[0])
+        return super().submit(input_ids, max_new_tokens,
+                              request_id=request_id)
 
     def step(self) -> bool:
         """Refill free slots, run ONE speculative round (K draft steps,
@@ -256,8 +278,13 @@ class SpeculativePool(GenerationPool):
         else:
             with tr.span("tick.admit"):
                 self._refill()
+        if self._chunk_tokens is not None:
+            # bounded target-side prompt work before the round, exactly
+            # the base pool's interleaving (draft prefill still happens
+            # at activation, via _on_activated)
+            self._chunk_work(tr)
         if not self._active:
-            return bool(self._queue)
+            return bool(self._queue or self._prefilling)
         params, bufs = self._sync_step_inputs()
         if self._draft_state_cache is None:
             self._draft_state_cache = self._draft_session._state_vals()
@@ -286,7 +313,7 @@ class SpeculativePool(GenerationPool):
             # device-resident pending vector is already next round's
             # draft input
             self._tok_dev = pending_dev
-        return bool(self._active or self._queue)
+        return bool(self._active or self._queue or self._prefilling)
 
     def _spec_round(self, params, bufs, dparams, dbufs):
         """The round's device work: K draft steps, one verify, one

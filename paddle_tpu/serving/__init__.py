@@ -46,6 +46,16 @@ into ``health()``), and ``log`` emits structured JSON lines at the
 admission/terminal/recovery/shed/restart edges — both planes
 module-level no-ops when unconfigured.
 
+Real traffic shapes (docs/DESIGN.md §5i): paged pools take
+``prefill_chunk_tokens=`` (bounded chunked prefill interleaved with
+decode — a long prompt can no longer blow resident requests'
+inter-token p95) and ``prefix_sharing=True`` (refcounted blocks + a
+chain-hashed prefix index: admission maps a resident shared prefix
+read-only and prefills only the suffix, byte-identical to sharing-off)
+— surfaced as ``serving_prefix_hit_rate`` /
+``serving_prefix_blocks_shared`` / ``serving_prefill_chunks_total``
+and the ``prefix_hit_tokens`` stamp on ``req.admitted`` log lines.
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —

@@ -267,6 +267,26 @@ class ServingEngine:
             "serving_kv_free_blocks",
             "paged allocator free blocks") \
             if self._pool.cache_layout == "paged" else None
+        # prefix-sharing / chunked-prefill surface (docs §5i): gauges
+        # exist only when the feature is on, like the paged free-block
+        # gauge — a dense engine's /metrics is unchanged
+        self._g_prefix_hit = m.gauge(
+            "serving_prefix_hit_rate",
+            "admissions that matched a resident prefix / admissions "
+            "(cumulative, prefix sharing)") \
+            if getattr(self._pool, "prefix_sharing", False) else None
+        self._g_prefix_shared = m.gauge(
+            "serving_prefix_blocks_shared",
+            "KV blocks currently referenced beyond their first owner "
+            "(live HBM the prefix index is saving)") \
+            if getattr(self._pool, "prefix_sharing", False) else None
+        self._c_chunks = m.counter(
+            "serving_prefill_chunks_total",
+            "fixed-shape prompt chunks dispatched (chunked prefill: "
+            "at most prefill_chunk_tokens of prompt work per tick)") \
+            if getattr(self._pool, "prefill_chunk_tokens", None) \
+            is not None else None
+        self._chunks_seen = 0
         self._g_accept = m.gauge(
             "serving_acceptance_rate",
             "accepted draft tokens / drafted (speculative pool)") \
@@ -338,8 +358,11 @@ class ServingEngine:
                     "serving queue is full (%d waiting >= max_queue=%d); "
                     "back off and retry, or raise max_queue/slots"
                     % (depth, self.max_queue))
+            ids = np.asarray(getattr(input_ids, "value", input_ids))
             if deadline_s is not None:
-                est = self._deadline_estimate_s(int(max_new_tokens))
+                est = self._deadline_estimate_s(
+                    int(max_new_tokens),
+                    int(ids.shape[0]) if ids.ndim else 0)
                 if est is not None and est > float(deadline_s):
                     self._c_shed.inc()
                     trace.instant("shed", rid=request_id,
@@ -356,7 +379,6 @@ class ServingEngine:
                         % (float(deadline_s), est,
                            max(0.001, est - float(deadline_s))),
                         retry_after_s=max(0.001, est - float(deadline_s)))
-            ids = np.asarray(getattr(input_ids, "value", input_ids))
             now = self._clock()
             rid = self._pool.submit(ids, max_new_tokens,
                                     request_id=request_id)
@@ -370,11 +392,9 @@ class ServingEngine:
                           prompt_tokens=int(ids.shape[0]),
                           max_new_tokens=int(max_new_tokens),
                           deadline_s=deadline_s)
-            slog.emit("req.admitted", rid=rid,
-                      prompt_tokens=int(ids.shape[0]),
-                      max_new_tokens=int(max_new_tokens),
-                      deadline_s=deadline_s,
-                      queue_depth=self._pool.queue_depth)
+            # the req.admitted log line is emitted at POOL admission
+            # (_on_admit, when the request takes a slot): only there is
+            # the prefix-hit outcome known, and the line must carry it
             self._g_queue.set(self._pool.queue_depth)
         self._wake.set()
         return stream
@@ -384,8 +404,21 @@ class ServingEngine:
         rec = self._live.get(rid)
         if rec is not None:
             rec.state = RequestState.PREFILLING
+            # matched prefix tokens of THIS admission (the pool stamps
+            # it right before firing the hook; None = sharing off, and
+            # the logger drops None fields)
+            hit = getattr(self._pool, "last_admit_prefix_tokens", None)
             trace.instant("req.prefilling", rid=rid, slot=slot,
-                          prompt_tokens=prompt_len)
+                          prompt_tokens=prompt_len,
+                          prefix_hit_tokens=hit)
+            slog.emit("req.admitted", rid=rid, slot=slot,
+                      prompt_tokens=prompt_len,
+                      max_new_tokens=rec.max_new,
+                      deadline_s=(None if rec.deadline_abs is None
+                                  else round(rec.deadline_abs
+                                             - rec.submit_t, 6)),
+                      queue_depth=self._pool.queue_depth,
+                      prefix_hit_tokens=hit)
 
     def _on_token(self, rid, tok):
         rec = self._live.get(rid)
@@ -631,6 +664,19 @@ class ServingEngine:
         if self._g_accept is not None:
             self._g_accept.set(
                 pool.acceptance_stats()["acceptance_rate"])
+        if self._g_prefix_hit is not None or self._c_chunks is not None:
+            pstats = pool.prefix_stats()
+            if self._g_prefix_hit is not None:
+                self._g_prefix_hit.set(pstats["hit_rate"])
+                self._g_prefix_shared.set(pstats["blocks_shared_now"])
+            if self._c_chunks is not None:
+                # counter semantics on /metrics: increment by the
+                # pool's delta since the last tick (the pool keeps the
+                # cumulative host-side count)
+                total = pstats["prefill_chunks_total"]
+                if total > self._chunks_seen:
+                    self._c_chunks.inc(total - self._chunks_seen)
+                    self._chunks_seen = total
         if self._timer.total:
             self._g_tps.set(self._tokens_total / self._timer.total)
             self._g_step.set(self._timer.step_time)
@@ -795,23 +841,39 @@ class ServingEngine:
         out.update(h.snapshot())
         return out
 
-    def _deadline_estimate_s(self, max_new_tokens: int
-                             ) -> Optional[float]:
+    def _deadline_estimate_s(self, max_new_tokens: int,
+                             prompt_len: int = 0) -> Optional[float]:
         """Seconds until a request admitted NOW would finish, from the
         observed mean tick time and the live token backlog — None until
         a tick has been measured (the engine never sheds on a guess).
         The model is the pool's own behavior: each tick advances every
         slot one token, so the backlog drains at ``slots`` tokens per
         tick and the new request then needs ``max_new_tokens`` ticks of
-        its own.  Deliberately simple and stated here so the shed
-        decision is auditable from the error message."""
+        its own.  Under chunked prefill, prompt work is ALSO tick work
+        the token backlog cannot see: each not-yet-decoding prompt
+        (plus this request's own) consumes ``ceil(len/C)`` serialized
+        chunk ticks, so they are added — a long-prompt burst must shed,
+        not admit-then-expire.  Deliberately simple and stated here so
+        the shed decision is auditable from the error message."""
         if not self._timer.total:
             return None
         step_s = self._timer.step_time
         backlog = sum(r.max_new - len(r.tokens)
                       for r in self._live.values())
-        return step_s * (backlog / self._pool.slots
-                         + float(max_new_tokens))
+        ticks = backlog / self._pool.slots + float(max_new_tokens)
+        chunk = getattr(self._pool, "prefill_chunk_tokens", None)
+        if chunk:
+            # not-yet-decoding = state QUEUED/PREFILLING, not
+            # first_t-is-None: a recovery-resubmitted victim already
+            # streamed tokens (first_t set) but still owes a FULL
+            # re-prefill of prompt + committed through the chunk path
+            pending = prompt_len + sum(
+                r.prompt_len + len(r.tokens)
+                for r in self._live.values()
+                if r.state in (RequestState.QUEUED,
+                               RequestState.PREFILLING))
+            ticks += -(-pending // chunk)
+        return step_s * ticks
 
     # -- graceful teardown ----------------------------------------------
     def drain(self, timeout_s: Optional[float] = None) -> bool:
@@ -1012,6 +1074,24 @@ class ServingEngine:
         """The engine's :class:`~.slo.SLOTracker` (None when SLO
         tracking is off)."""
         return self._slo
+
+    def prefix_stats(self) -> dict:
+        """Prefix-sharing / chunked-prefill accounting
+        (``GenerationPool.prefix_stats``): hit rate, matched tokens /
+        blocks, live shared blocks, chunk totals — what the
+        ``serving_prefix_*`` gauges and the bench leg stamp."""
+        return self._pool.prefix_stats()
+
+    def reset_prefix_stats(self) -> None:
+        """Zero the pool's cumulative prefix/chunk counters — bench
+        legs call this between warmup and the timed region so the
+        stamped hit rate covers exactly the measured traffic."""
+        with self._lock:
+            self._pool.reset_prefix_stats()
+            # the chunk-counter watermark must restart with the pool's
+            # count: left at its old high-water mark, the next chunks
+            # up to it would never reach serving_prefill_chunks_total
+            self._chunks_seen = 0
 
     def acceptance_stats(self) -> Optional[dict]:
         """Speculative acceptance accounting

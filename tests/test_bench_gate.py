@@ -306,3 +306,56 @@ def test_resnet_mfu_formula_pinned():
     assert bench.RESNET50_FWD_FLOPS == 2 * 4.089e9
     got = bench.resnet50_mfu(128, 0.0863, 197e12)
     assert abs(got - 0.1847) < 2e-4, got
+
+
+def test_serving_prefix_leg_gate():
+    """The prefix-sharing leg's structural gate: a sharing-on sub-leg
+    without its prefix_hit_rate stamp cannot tell a measured sharing
+    win from plain chunked prefill and must never promote; the
+    sharing-off sub-leg is exempt (its index is disabled by
+    construction) but still needs the cache stamps."""
+    good = {"input_staged": False, "transfer_note": "same traffic",
+            "sharing_on": {"cache_layout": "paged",
+                           "cache_dtype": "float32",
+                           "ttft_p50_s": 0.01, "prefix_hit_rate": 0.6},
+            "sharing_off": {"cache_layout": "paged",
+                            "cache_dtype": "float32",
+                            "ttft_p50_s": 0.02}}
+    ok, why = bench._leg_promotable("serving_prefix", good)
+    assert ok, why
+    unhit = {"input_staged": False, "transfer_note": "x",
+             "sharing_on": {"cache_layout": "paged",
+                            "cache_dtype": "float32",
+                            "ttft_p50_s": 0.01},
+             "sharing_off": dict(good["sharing_off"])}
+    ok, why = bench._leg_promotable("serving_prefix", unhit)
+    assert not ok and "prefix_hit_rate" in why and "sharing_on" in why
+    # missing cache provenance rejects like the other serving legs
+    nostamp = {"input_staged": False, "transfer_note": "x",
+               "sharing_on": {"ttft_p50_s": 0.01,
+                              "prefix_hit_rate": 0.6}}
+    ok, why = bench._leg_promotable("serving_prefix", nostamp)
+    assert not ok and "cache_layout" in why
+
+
+@pytest.mark.slow
+def test_live_serving_prefix_leg_passes_its_own_gate():
+    """The leg bench.py actually emits must satisfy its own gate (a
+    CPU-smoke run of the real leg) — slow-marked: it runs the zipf
+    traffic three times (calibration + both modes)."""
+    import jax
+
+    import paddle_tpu as pt
+
+    leg = bench.bench_serving_prefix(pt, jax, False)
+    ok, why = bench._leg_promotable("serving_prefix", leg)
+    assert ok, why
+    on, off = leg["sharing_on"], leg["sharing_off"]
+    # the zipf corpus MUST produce hits, and the off leg must not (its
+    # index is disabled — a nonzero off hit rate means the flag leaks)
+    assert on["prefix_hit_rate"] > 0
+    assert off["prefix_hit_rate"] == 0
+    assert on["prefix_blocks_saved_bytes"] > 0
+    # both modes ran under the same calibrated TTFT promise
+    assert leg["slo_ttft_threshold_s"] > 0
+    assert "slo_ttft_burn_slow" in on and "slo_ttft_burn_slow" in off

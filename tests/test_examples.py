@@ -26,6 +26,7 @@ SCRIPTS = [
     ("11_chaos_serving.py", ["--tokens", "8"]),
     ("12_tracing.py", ["--tokens", "8"]),
     ("13_observatory.py", ["--tokens", "8"]),
+    ("14_prefix_serving.py", ["--tokens", "8"]),
 ]
 
 

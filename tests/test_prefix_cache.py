@@ -1,0 +1,518 @@
+"""Prefix-cache sharing + chunked prefill (docs/DESIGN.md §5i).
+
+Pins the contracts the refcounted paged allocator lives on:
+
+- GREEDY TOKEN IDENTITY: with sharing enabled, every request's output
+  is byte-identical to a sharing-disabled run of the same traffic
+  (paged × fp32/int8), and the chunked-prefill pool is byte-identical
+  to the one-shot bucketed pool — chunk boundaries change bytes
+  touched per tick, never math (masked attention contributions are
+  exactly zero; per-position projections see only their own position);
+- DENSE UNAFFECTED: both knobs are paged-only and reject dense pools
+  with typed errors;
+- COMPILE BUDGET: chunked prefill adds exactly TWO executables (one
+  [C] chunk shape + one admission write) whatever the prompt lengths,
+  and the steady-state ``cost_version()`` never moves across ticks;
+- ALLOCATOR INVARIANTS under randomized admit/cancel/churn with shared
+  prefixes: free + unique resident + scratch == num_blocks, refcounts
+  equal the number of table rows mapping each block, no block is both
+  free and referenced, and the prefix index only ever names resident
+  blocks;
+- BOUNDED INTERFERENCE: a long prompt prefilling in chunks never
+  stalls a resident request's token cadence (one token per tick,
+  deterministic);
+- RECOVERY: ``reset()`` clears the prefix index with the cache it
+  names, and the 5-seed chaos suite holds byte-identity with sharing
+  enabled (recovery re-prefills run through the chunk path — no
+  bucket-coverage constraint).
+"""
+import io
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.inference import GenerationPool, SpeculativePool
+from paddle_tpu.models import TransformerLM
+
+
+def _tiny_model(layers=2):
+    pt.seed(0)
+    return TransformerLM(vocab_size=128, hidden_size=32,
+                         num_layers=layers, num_heads=2,
+                         intermediate_size=64, max_position=256,
+                         causal=True, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _shared_prompts(rng, prefix_len=20, tails=(5, 9, 3, 13)):
+    prefix = rng.randint(0, 128, (prefix_len,)).astype("int32")
+    prompts = [np.concatenate(
+        [prefix, rng.randint(0, 128, (n,)).astype("int32")])
+        for n in tails]
+    prompts.append(rng.randint(0, 128, (12,)).astype("int32"))  # cold
+    return prompts
+
+
+def _pool(model, sharing, dtype="float32", slots=2, chunk=8,
+          num_blocks=None):
+    return GenerationPool(model, max_len=64, slots=slots, buckets=[64],
+                          cache_layout="paged", block_size=8,
+                          cache_dtype=dtype, num_blocks=num_blocks,
+                          prefill_chunk_tokens=chunk,
+                          prefix_sharing=sharing)
+
+
+def _check_allocator(pool):
+    """The hard allocator invariants, checked from host state alone."""
+    free = pool._free_blocks
+    refs = pool._block_refs
+    assert len(set(free)) == len(free), "duplicate free blocks"
+    assert not set(free) & set(refs), "block both free and referenced"
+    assert all(r >= 1 for r in refs.values()), "refcount < 1 resident"
+    assert 0 not in refs and 0 not in free, "scratch block leaked"
+    assert len(free) + len(refs) + 1 == pool._num_blocks
+    mapped = [b for blocks in pool._slot_blocks.values()
+              for b in blocks]
+    counts = {}
+    for b in mapped:
+        counts[b] = counts.get(b, 0) + 1
+    assert counts == dict(refs), \
+        "refcounts diverged from table-row references"
+    for entry in pool._prefix_index.values():
+        for b in entry.blocks:
+            assert b in refs, "prefix index names a freed block"
+
+
+# -- knob validation ------------------------------------------------------
+def test_chunk_and_sharing_knobs_require_paged(model):
+    with pytest.raises(InvalidArgumentError, match="paged"):
+        GenerationPool(model, max_len=32, slots=1, buckets=[16],
+                       prefill_chunk_tokens=8)
+    with pytest.raises(InvalidArgumentError, match="paged"):
+        GenerationPool(model, max_len=32, slots=1, buckets=[16],
+                       prefix_sharing=True)
+    with pytest.raises(InvalidArgumentError,
+                       match="prefill_chunk_tokens"):
+        GenerationPool(model, max_len=32, slots=1, buckets=[16],
+                       cache_layout="paged", prefix_sharing=True)
+    with pytest.raises(InvalidArgumentError, match=">= 1"):
+        GenerationPool(model, max_len=32, slots=1, buckets=[16],
+                       cache_layout="paged", prefill_chunk_tokens=0)
+
+
+# -- greedy token identity ------------------------------------------------
+def test_chunked_pool_token_identical_to_bucketed(model):
+    # the chunk executable vs the one-shot bucketed prefill: different
+    # dispatch schedule, identical math — byte-for-byte
+    rng = np.random.RandomState(0)
+    prompts = _shared_prompts(rng)
+    bucketed = GenerationPool(model, max_len=64, slots=2, buckets=[64],
+                              cache_layout="paged", block_size=8)
+    want = bucketed.generate(prompts, 6)
+    chunked = _pool(model, sharing=False)
+    got = chunked.generate(prompts, 6)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "int8"])
+def test_sharing_on_off_byte_identical(model, dtype):
+    # the acceptance contract: sharing must only change WHERE prefix
+    # K/V come from, never their values — and the traffic is arranged
+    # so the index actually fires (a vacuous pass would pin nothing)
+    rng = np.random.RandomState(1)
+    prompts = _shared_prompts(rng)
+    outs, hits = {}, 0
+    for sharing in (True, False):
+        pool = _pool(model, sharing, dtype=dtype)
+        rids = [pool.submit(prompts[0], 6)]
+        for _ in range(6):  # let the first owner's blocks get indexed
+            pool.step()
+        rids += [pool.submit(p, 6) for p in prompts[1:]]
+        results = pool.run()
+        outs[sharing] = [results[r] for r in rids]
+        if sharing:
+            hits = pool.prefix_stats()["hits"]
+            assert pool.prefix_stats()["hit_rate"] > 0
+    assert hits >= 1, "traffic produced no prefix hits: test is vacuous"
+    for a, b in zip(outs[True], outs[False]):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_speculative_pool_inherits_sharing_and_chunking(model):
+    pt.seed(1)
+    draft = TransformerLM(vocab_size=128, hidden_size=32, num_layers=1,
+                          num_heads=2, intermediate_size=64,
+                          max_position=256, causal=True, dropout=0.0)
+    rng = np.random.RandomState(2)
+    prompts = _shared_prompts(rng)
+    plain = GenerationPool(model, max_len=64, slots=2, buckets=[64],
+                           cache_layout="paged", block_size=8)
+    want = plain.generate(prompts, 6)
+    spec = SpeculativePool(model, draft, max_len=64, spec_k=3, slots=2,
+                           buckets=[64], cache_layout="paged",
+                           block_size=8, prefill_chunk_tokens=8,
+                           prefix_sharing=True)
+    got = spec.generate(prompts, 6)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+    counts = spec.compile_counts()
+    assert counts["prefill_chunk"] == 1 and counts["slot_admit"] == 1
+
+
+# -- compile budget -------------------------------------------------------
+def test_chunked_compile_counts_pinned(model):
+    # varied prompt lengths, varied suffix lengths after a hit: the
+    # chunk executable compiles ONCE ([C] is the only shape), admission
+    # once — and the bucketed prefill never runs at all
+    pool = _pool(model, sharing=True)
+    rng = np.random.RandomState(3)
+    prefix = rng.randint(0, 128, (16,)).astype("int32")
+    for n in (3, 9, 21, 40):
+        ids = np.concatenate([prefix,
+                              rng.randint(0, 128, (n,)).astype("int32")])
+        pool.generate([ids], 4)
+    assert pool.compile_counts() == {
+        "prefill": 0, "decode": 0, "pool_decode": 1, "slot_insert": 0,
+        "prefill_chunk": 1, "slot_admit": 1}
+    # steady state: more traffic, cost_version frozen
+    version = pool.cost_version()
+    pool.generate([prefix], 4)
+    assert pool.cost_version() == version
+
+
+def test_chunked_pool_serves_prompts_beyond_buckets(model):
+    # chunked prefill needs no bucket: a prompt past the largest bucket
+    # is served as [C] chunks (the bucketed pool would reject it)
+    pool = GenerationPool(model, max_len=64, slots=1, buckets=[16],
+                          cache_layout="paged", block_size=8,
+                          prefill_chunk_tokens=8)
+    rng = np.random.RandomState(4)
+    ids = rng.randint(0, 128, (40,)).astype("int32")
+    out = pool.generate([ids], 4)[0]
+    sess_pool = GenerationPool(model, max_len=64, slots=1, buckets=[64],
+                               cache_layout="paged", block_size=8)
+    np.testing.assert_array_equal(out, sess_pool.generate([ids], 4)[0])
+
+
+# -- bounded interference (the TTFT/ITL tentpole claim) -------------------
+def test_long_prompt_prefill_never_stalls_resident_decode(model):
+    # R1 decodes; R2's long prompt arrives.  Every tick must still
+    # advance R1 by exactly one token while R2 prefills in chunks —
+    # deterministic, no wall clocks
+    pool = _pool(model, sharing=False, chunk=8)
+    rng = np.random.RandomState(5)
+    r1 = pool.submit(rng.randint(0, 128, (5,)).astype("int32"), 20)
+    pool.step()  # R1 admitted + prefilled (short) + first decode
+    slot1 = next(s for s, st in pool._active.items() if st.rid == r1)
+    pool.submit(rng.randint(0, 128, (48,)).astype("int32"), 4)
+    while pool.prefilling_count:
+        before = len(pool._active[slot1].tokens)
+        pool.step()
+        assert len(pool._active[slot1].tokens) == before + 1, \
+            "a prefilling prompt stalled a resident request's cadence"
+    pool.run()
+
+
+# -- allocator invariants under churn ------------------------------------
+@pytest.mark.parametrize("seed", [0, 1])
+def test_allocator_invariants_under_shared_churn(model, seed):
+    # randomized admit/step/cancel churn over zipf-ish shared prompts
+    # in a BLOCK-CONSTRAINED pool: deferrals, hits, mid-prefill
+    # cancels — the invariants must hold after every single operation
+    rng = np.random.RandomState(seed)
+    pool = _pool(model, sharing=True, num_blocks=24)
+    prefixes = [rng.randint(0, 128, (16,)).astype("int32")
+                for _ in range(2)]
+    live = []
+    for op in range(60):
+        roll = rng.rand()
+        if roll < 0.35 and len(live) < 8:
+            ids = np.concatenate(
+                [prefixes[rng.randint(2)],
+                 rng.randint(0, 128,
+                             (rng.randint(1, 10),)).astype("int32")])
+            live.append(pool.submit(ids, int(rng.randint(1, 6))))
+        elif roll < 0.5 and live:
+            rid = live.pop(rng.randint(len(live)))
+            try:
+                pool.cancel(rid)
+            except Exception:
+                pass  # already finished: collect below
+        else:
+            pool.step()
+        _check_allocator(pool)
+        for rid in list(live):
+            if rid in pool._results:
+                pool.collect(rid)
+                live.remove(rid)
+    while pool.step():
+        _check_allocator(pool)
+    _check_allocator(pool)
+    stats = pool.cache_stats()
+    assert stats["mapped_blocks"] == 0
+    assert stats["free_blocks"] == stats["num_blocks"] - 1
+    assert pool._prefix_index == {} and pool._block_keys == {}
+
+
+def test_reset_clears_prefix_index(model):
+    # the recovery-path pin: reset() discards the cache the index
+    # names, so the index MUST die with it — a stale entry would map
+    # freed-then-reused blocks as a "shared prefix" after a rebuild
+    pool = _pool(model, sharing=True)
+    rng = np.random.RandomState(6)
+    prefix = rng.randint(0, 128, (16,)).astype("int32")
+    pool.submit(np.concatenate([prefix, prefix[:5]]), 8)
+    for _ in range(5):
+        pool.step()
+    assert pool._prefix_index, "churn produced no index entries"
+    pool.reset()
+    assert pool._prefix_index == {} and pool._block_keys == {}
+    assert pool._block_refs == {}
+    assert pool.prefilling_count == 0
+    _check_allocator(pool)
+
+
+def test_shared_blocks_counted_once(model):
+    # two live requests over one prefix: the shared blocks occupy HBM
+    # once and the accounting must say so
+    pool = _pool(model, sharing=True)
+    rng = np.random.RandomState(7)
+    prefix = rng.randint(0, 128, (16,)).astype("int32")
+    a = np.concatenate([prefix, rng.randint(0, 128, (5,)).astype("int32")])
+    b = np.concatenate([prefix, rng.randint(0, 128, (7,)).astype("int32")])
+    pool.submit(a, 30)
+    for _ in range(6):
+        pool.step()  # a resident + indexed, still decoding
+    pool.submit(b, 30)
+    pool.step()
+    stats = pool.cache_stats()
+    assert stats["shared_blocks"] == 2  # 16 tokens / block_size 8
+    need_a = pool._blocks_needed(len(a), 30)
+    need_b = pool._blocks_needed(len(b), 30)
+    assert stats["mapped_blocks"] == need_a + need_b - 2
+    _check_allocator(pool)
+    pool.run()
+
+
+def test_cancel_mid_prefill_reclaims_everything(model):
+    pool = _pool(model, sharing=True)
+    rng = np.random.RandomState(8)
+    rid = pool.submit(rng.randint(0, 128, (48,)).astype("int32"), 4)
+    pool.step()  # admitted, first chunk done, still prefilling
+    assert pool.prefilling_count == 1
+    assert pool.cancel(rid) == "active"
+    assert pool.prefilling_count == 0
+    _check_allocator(pool)
+    stats = pool.cache_stats()
+    assert stats["mapped_blocks"] == 0
+    # the pool serves cleanly afterwards
+    out = pool.generate([rng.randint(0, 128, (9,)).astype("int32")], 3)
+    assert out[0].shape == (3,)
+
+# -- serving-engine surface ----------------------------------------------
+def _engine(model, sharing=True, **kw):
+    from paddle_tpu.serving import ServingEngine
+
+    kw.setdefault("max_retries", 8)
+    return ServingEngine(model, max_len=64, slots=2, buckets=[64],
+                         cache_layout="paged", block_size=8,
+                         prefill_chunk_tokens=8, prefix_sharing=sharing,
+                         **kw)
+
+
+def test_engine_gauges_and_admitted_log_carry_prefix_hit(model):
+    from paddle_tpu.serving import log as slog
+
+    eng = _engine(model)
+    rng = np.random.RandomState(9)
+    prefix = rng.randint(0, 128, (16,)).astype("int32")
+    buf = io.StringIO()
+    with slog.logging_to(buf):
+        eng.submit(np.concatenate(
+            [prefix, rng.randint(0, 128, (5,)).astype("int32")]), 12,
+            request_id="warm")
+        eng.pump(6)  # warm request resident + indexed, still decoding
+        eng.submit(np.concatenate(
+            [prefix, rng.randint(0, 128, (7,)).astype("int32")]), 4,
+            request_id="hot")
+        while eng.pump(8):
+            pass
+    lines = [json.loads(l) for l in buf.getvalue().splitlines()]
+    admitted = {l["rid"]: l for l in lines
+                if l["event"] == "req.admitted"}
+    assert admitted["warm"]["prefix_hit_tokens"] == 0
+    assert admitted["hot"]["prefix_hit_tokens"] == 16
+    assert "queue_depth" in admitted["hot"]
+    snap = eng.metrics.snapshot()
+    assert snap["serving_prefix_hit_rate"] == 0.5
+    assert snap["serving_prefill_chunks_total"] >= 3
+    rendered = eng.metrics.render_prometheus()
+    for name in ("serving_prefix_hit_rate",
+                 "serving_prefix_blocks_shared",
+                 "serving_prefill_chunks_total"):
+        assert name in rendered
+
+
+def test_dense_engine_metrics_unchanged(model):
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, max_len=48, slots=1, buckets=[16])
+    snap = eng.metrics.snapshot()
+    assert "serving_prefix_hit_rate" not in snap
+    assert "serving_prefill_chunks_total" not in snap
+
+
+def test_engine_cost_report_attributes_chunk_executable(model):
+    eng = _engine(model)
+    rng = np.random.RandomState(10)
+    eng.submit(rng.randint(0, 128, (20,)).astype("int32"), 3)
+    while eng.pump(8):
+        pass
+    rep = eng.cost_report()
+    assert "prefill_chunk" in rep and rep["prefill_chunk"]
+    entry = next(iter(rep["prefill_chunk"].values()))
+    assert "flops" in entry or "cost_analysis_unavailable" in entry
+    # steady state: cost_version (and thus the gauges) frozen
+    version = eng._pool.cost_version()
+    eng.submit(rng.randint(0, 128, (20,)).astype("int32"), 3)
+    while eng.pump(8):
+        pass
+    assert eng._pool.cost_version() == version
+
+
+def test_recovery_with_sharing_is_byte_identical(model):
+    # a transient step fault mid-traffic: reset() drops cache + prefix
+    # index, victims resubmit through the chunk path, survivors finish
+    # byte-identical to the fault-free run (prompts here EXCEED the
+    # admission bucket — recovery needs no bucket coverage under
+    # chunked prefill)
+    from paddle_tpu.serving import faults
+    from paddle_tpu.serving.faults import FaultPlane, FaultSpec
+
+    rng = np.random.RandomState(11)
+    prefix = rng.randint(0, 128, (16,)).astype("int32")
+    prompts = [np.concatenate(
+        [prefix, rng.randint(0, 128, (n,)).astype("int32")])
+        for n in (5, 9)]
+
+    clean = _engine(model)
+    want = []
+    for p in prompts:
+        s = clean.submit(p, 6)
+        clean.pump(4)
+        want.append(s)
+    while clean.pump(8):
+        pass
+    want = [s.result(timeout_s=0).tokens for s in want]
+
+    eng = _engine(model)
+    plane = FaultPlane([FaultSpec(
+        "pool.step", error=faults.TransientInjectedFault, after=3,
+        times=1)])
+    with faults.injected(plane):
+        streams = []
+        for p in prompts:
+            streams.append(eng.submit(p, 6))
+            eng.pump(4)
+        while eng.pump(8):
+            pass
+    statuses = [s.result(timeout_s=0) for s in streams]
+    assert plane.fault_count == 1, "fault never fired: vacuous test"
+    for st, w in zip(statuses, want):
+        assert st.state == "DONE", (st.state, st.error)
+        np.testing.assert_array_equal(st.tokens, w)
+    assert eng.metrics.snapshot()["serving_recoveries_total"] == 1
+    _check_allocator(eng._pool)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_chaos_with_sharing_holds_invariants(model, seed):
+    # the §5f chaos harness over SHARING traffic: seeded transient
+    # faults at the step/alloc/deliver seams; every survivor must be
+    # byte-identical to the fault-free run, blocks and refcounts must
+    # reconcile at drain, and recovery must never recompile
+    from paddle_tpu.serving import RequestState, faults
+    from paddle_tpu.serving.faults import FaultPlane
+
+    rng = np.random.RandomState(seed)
+    prefix = rng.randint(0, 128, (16,)).astype("int32")
+    prompts = [np.concatenate(
+        [prefix, rng.randint(0, 128, (n,)).astype("int32")])
+        for n in (5, 9, 7)]
+    budgets = (6, 5, 4)
+
+    def drive(eng):
+        streams = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+        iters = 0
+        while eng.pump(1):
+            iters += 1
+            assert iters < 500, "chaos run failed to drain: wedged"
+        return streams
+
+    clean = _engine(model)
+    want = [s.result(timeout_s=0).tokens for s in drive(clean)]
+    clean_counts = clean.compile_counts()
+
+    eng = _engine(model)
+    plane = FaultPlane(chaos_seed=seed, chaos_p=0.08,
+                      chaos_points=("pool.step", "pool.alloc_blocks",
+                                    "stream.deliver"),
+                      max_faults=6)
+    with faults.injected(plane):
+        streams = drive(eng)
+    for s, w in zip(streams, want):
+        st = s.result(timeout_s=0)
+        assert st.state == RequestState.DONE, (seed, st.state, st.error)
+        np.testing.assert_array_equal(st.tokens, w)
+    _check_allocator(eng._pool)
+    stats = eng.cache_stats()
+    assert stats["mapped_blocks"] == 0
+    assert eng.compile_counts() == clean_counts
+
+
+def test_reachable_bytes_keeps_ragged_cap_and_leq_dense(model):
+    # max_len=60 with block_size=8: a full-span reservation is 8 blocks
+    # = 64 token positions, but the final block's over-hang past 60 is
+    # masked and must not count — paged reachable <= dense, always
+    pool = GenerationPool(model, max_len=60, slots=1, buckets=[60],
+                          cache_layout="paged", block_size=8,
+                          prefill_chunk_tokens=16, prefix_sharing=True)
+    rng = np.random.RandomState(12)
+    pool.submit(rng.randint(0, 128, (50,)).astype("int32"), 10)
+    for _ in range(5):
+        pool.step()
+    stats = pool.cache_stats()
+    assert stats["mapped_blocks"] == 8  # ceil(60/8)
+    assert stats["reachable_bytes"] <= stats["dense_equiv_bytes"]
+    from paddle_tpu.inference import kv_reachable_bytes
+    assert stats["reachable_bytes"] == kv_reachable_bytes(
+        [60], max_len=60, num_layers=2, num_heads=2, head_dim=16,
+        layout="paged", block_size=8)
+    pool.run()
+
+
+def test_engine_reset_prefix_stats_keeps_chunk_counter_moving(model):
+    # the /metrics chunk counter must keep incrementing after the
+    # bench warmup reset (a stale watermark would swallow the next
+    # chunks up to the old high-water mark)
+    eng = _engine(model)
+    rng = np.random.RandomState(13)
+    eng.submit(rng.randint(0, 128, (20,)).astype("int32"), 3)
+    while eng.pump(8):
+        pass
+    before = eng.metrics.snapshot()["serving_prefill_chunks_total"]
+    assert before > 0
+    eng.reset_prefix_stats()
+    eng.submit(rng.randint(0, 128, (20,)).astype("int32"), 3)
+    while eng.pump(8):
+        pass
+    after = eng.metrics.snapshot()["serving_prefill_chunks_total"]
+    assert after > before, (before, after)

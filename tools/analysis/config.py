@@ -50,6 +50,19 @@ EXTRA_EDGES = {
                                "ServingEngine._on_token",
                                "ServingEngine._on_finish",
                                "Tracer.span"),
+    # prefix-sharing admission + chunked prefill (docs §5i): the
+    # admission match and the chunk dispatch are new hot-path seams —
+    # the admission write and the chunk executable dispatch through
+    # AotFunction wrappers (invisible attribute calls), activation fans
+    # into the serving hooks and the speculative pool's draft-twin
+    # prefill, so the whole path stays hot-path-audited
+    "GenerationPool._admit_chunked": ("AotFunction.__call__",
+                                      "ServingEngine._on_admit"),
+    "GenerationPool._chunk_work": ("AotFunction.__call__",
+                                   "Tracer.span"),
+    "GenerationPool._activate": ("ServingEngine._on_token",
+                                 "ServingEngine._on_finish",
+                                 "SpeculativePool._on_activated"),
     "SpeculativePool.step": ("ServingEngine._on_token",
                              "ServingEngine._on_finish",
                              "Tracer.span"),

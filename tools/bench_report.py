@@ -48,6 +48,13 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "mfu": ("higher", 0.10),
     "acceptance_rate": ("higher", 0.20),
     "speedup_vs_plain": ("higher", 0.20),
+    # prefix sharing: a hit-rate drop means the index stopped firing on
+    # the same zipf traffic (deterministic corpus, so tight-ish), and
+    # the blocks it saves are byte accounting; TTFT-improvement
+    # shrinking is gated loosely like the other wall-clock columns
+    "prefix_hit_rate": ("higher", 0.10),
+    "prefix_blocks_saved_bytes": ("higher", 0.10),
+    "ttft_p95_improvement_pct": ("higher_abs", 10.0),
     # latency family: lower is better
     "step_time_s": ("lower", 0.15),
     "per_token_s": ("lower", 0.15),
@@ -206,6 +213,12 @@ def diff_leg(leg_name: str, prev: dict, latest: dict) -> List[dict]:
         if direction == "lower_abs":
             regressed = l > p + threshold
             improved = l < p - threshold
+        elif direction == "higher_abs":
+            # absolute points in the good-is-higher direction (e.g. a
+            # percentage-improvement column whose base can sit near 0,
+            # where a relative threshold would be noise)
+            regressed = l < p - threshold
+            improved = l > p + threshold
         elif p == 0:
             # no relative base: any appearance of a nonzero value in
             # the bad direction is flagged only for lower-is-better
@@ -332,7 +345,7 @@ def render_markdown(report: dict) -> str:
                             key=lambda r: (r["status"] != "regressed",
                                            r["metric"])):
                 thr = ("±%.0f abs" % r["threshold"]
-                       if r["direction"] == "lower_abs"
+                       if r["direction"] in ("lower_abs", "higher_abs")
                        else "%s ±%.0f%%" % (r["direction"],
                                             r["threshold"] * 100))
                 lines.append("| %s | %s | %s | %s | %s | %s |"

@@ -24,6 +24,13 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
   tok/s and bytes columns for dense AND paged, so the bandwidth win is
   measured where it is claimed to live.
 
+- a PROMPT-REUSE axis (``--prompt-reuse 0.0 0.5 0.9``): at each
+  fraction f, f of the prompts share one common prefix and the rest are
+  cold; the paged pool runs with prefix sharing + chunked prefill and
+  every row records its measured hit-rate column next to tok/s — so
+  the "shared system prompts make serving cheaper" claim carries its
+  own evidence of how often the index actually fired.
+
 - plain-vs-SPECULATIVE tokens/s with a ``--speculate K`` axis: the
   draft/verify pool (``inference.SpeculativePool``, K draft tokens per
   round against a 1-layer draft twin) timed against the plain pool at
@@ -33,7 +40,8 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
 
 Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
      [--gen 64] [--block-sizes 16 32 64 128]
-     [--cache-dtypes float32 int8] [--speculate K] [--cpu-smoke]
+     [--cache-dtypes float32 int8] [--speculate K]
+     [--prompt-reuse f ...] [--cpu-smoke]
      [--out decode_sweep.json]
 Writes the JSON report to --out (default: decode_sweep.json in the
 CWD — never into tools/, a measurement artifact is not source);
@@ -178,6 +186,82 @@ def speculative_sweep(pt, cfg, batches, buckets, gen, spec_k):
     return legs
 
 
+def prefix_reuse_sweep(pt, cfg, batches, buckets, gen, reuse_fracs):
+    """Tokens/s AND measured prefix-hit-rate per (bucket, batch, reuse
+    fraction): at fraction f, round(f * n) of the prompts open with one
+    shared prefix (the bucket's front half) and the rest are cold.  The
+    pool runs paged + chunked prefill + prefix sharing, so each row's
+    hit-rate column says how often the index fired on exactly the
+    traffic the tok/s was measured on.  Submissions are STAGGERED (one
+    step between submits, prompt order shuffled): the index holds
+    RESIDENT blocks only, so a same-instant burst would admit every
+    sharer before the first owner indexed a block and the axis would
+    structurally read 0.  batch=1 rows still honestly read ~0 — with
+    one slot there is never a resident sharer to hit."""
+    from paddle_tpu.inference import GenerationPool
+    from paddle_tpu.models import TransformerLM
+
+    pt.seed(0)
+    model = TransformerLM(**cfg, dropout=0.0)
+    rng = np.random.RandomState(0)
+    legs = []
+    for bucket in buckets:
+        max_len = bucket + gen
+        prefix_len = bucket // 2
+        block = max(8, prefix_len // 4)
+        prefix = rng.randint(0, cfg["vocab_size"],
+                             (prefix_len,)).astype("int32")
+        for batch in batches:
+            n = max(4, 4 * batch)  # enough requests that reuse can fire
+            for frac in reuse_fracs:
+                shared = int(round(frac * n))
+                prompts = []
+                for i in range(n):
+                    tail = rng.randint(0, cfg["vocab_size"],
+                                       (bucket - prefix_len,)) \
+                        .astype("int32")
+                    if i < shared:
+                        prompts.append(np.concatenate([prefix, tail]))
+                    else:
+                        prompts.append(np.concatenate(
+                            [rng.randint(0, cfg["vocab_size"],
+                                         (prefix_len,)).astype("int32"),
+                             tail]))
+                pool = GenerationPool(
+                    model, max_len, slots=batch, buckets=[bucket],
+                    cache_layout="paged", block_size=block,
+                    prefill_chunk_tokens=block * 2,
+                    prefix_sharing=True)
+                rng.shuffle(prompts)
+                pool.generate([prompts[-1]], 2)  # compile + warm
+                # the warm request is one query that can never hit;
+                # reset so the columns cover the measured traffic only
+                pool.reset_prefix_stats()
+                t0 = time.perf_counter()
+                rids = []
+                for p in prompts:
+                    rids.append(pool.submit(p, gen))
+                    pool.step()
+                results = pool.run()
+                wall = time.perf_counter() - t0
+                outs = [results[r] for r in rids]
+                stats = pool.prefix_stats()
+                rate = stats["hit_rate"]
+                tps = sum(len(o) for o in outs) / wall
+                legs.append(dict(
+                    batch=batch, prefill=bucket, generated=gen,
+                    prompt_reuse=frac, requests=n, block_size=block,
+                    prefill_chunk_tokens=block * 2,
+                    cache_layout="paged", cache_dtype="float32",
+                    prefix_hit_rate=round(rate, 4),
+                    prefix_tokens_matched=stats["tokens_matched"],
+                    decode_tokens_per_sec=round(tps, 1)))
+                print("bucket %-5d batch %-3d  reuse %.2f  hit %.3f  "
+                      "%8.1f tok/s"
+                      % (bucket, batch, frac, rate, tps), flush=True)
+    return legs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
@@ -193,6 +277,12 @@ def main():
                     default=["float32", "int8"],
                     help="KV cache storage dtypes to sweep (int8 = "
                          "quantized cache with per-head fp32 scales)")
+    ap.add_argument("--prompt-reuse", type=float, nargs="*", default=[],
+                    metavar="F",
+                    help="also sweep prefix sharing at these reuse "
+                         "fractions (each F = fraction of prompts "
+                         "opening with one shared prefix; rows record "
+                         "hit-rate AND tok/s columns)")
     ap.add_argument("--speculate", type=int, default=0, metavar="K",
                     help="also sweep the speculative draft/verify pool "
                          "at K draft tokens per round (0 = off); every "
@@ -247,6 +337,15 @@ def main():
         spec_legs = speculative_sweep(pt, cfg, args.batches,
                                       args.buckets, args.gen,
                                       args.speculate)
+    reuse_legs = None
+    if args.prompt_reuse:
+        bad = [f for f in args.prompt_reuse if not 0.0 <= f <= 1.0]
+        if bad:
+            sys.exit("--prompt-reuse fractions must be in [0, 1], "
+                     "got %s" % bad)
+        reuse_legs = prefix_reuse_sweep(pt, cfg, args.batches,
+                                        args.buckets, args.gen,
+                                        args.prompt_reuse)
     report = {"measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                            time.gmtime()),
               "backend": jax.devices()[0].device_kind,
@@ -258,9 +357,11 @@ def main():
               "block_sizes": args.block_sizes,
               "cache_dtypes": args.cache_dtypes,
               "spec_k": args.speculate or None,
+              "prompt_reuse": args.prompt_reuse or None,
               "compile_counts": compiles,
               "legs": legs,
-              "speculative_legs": spec_legs}
+              "speculative_legs": spec_legs,
+              "prompt_reuse_legs": reuse_legs}
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print("report:", args.out)
