@@ -54,6 +54,35 @@ Two paged-only extensions ride the allocator (docs/DESIGN.md §5i):
   chunk-prefills only the unmatched suffix.  Greedy output is
   byte-identical to a sharing-off run; release/cancel/reset decref
   instead of free, and ``cache_stats()`` counts shared blocks once.
+
+Traffic-grade scheduling rides the same allocator (docs/DESIGN.md §5j):
+
+- ``submit()`` takes ``priority=`` / ``tenant=`` / ``deadline=``
+  scheduling metadata, and ``_refill`` picks the next request to admit
+  by ``(priority desc, deadline asc, arrival)`` instead of strict
+  FIFO, with an optional per-tenant slot cap (``tenant_slot_cap=``) so
+  one tenant's burst cannot monopolize the pool.  The block-wait
+  discipline is preserved per the CHOSEN candidate: when the best
+  candidate cannot reserve its blocks, admission waits rather than
+  skipping ahead — no starvation within the declared ordering.
+- ``preempt(rid)`` evicts one actively-decoding request mid-flight by
+  SPILLING its K/V to a host-RAM block pool — a second tier under the
+  free-list allocator.  The victim's written blocks are downloaded in
+  one batched ``device_get`` (int8 scales ride along), its device
+  blocks move to a reclaimable SPILLED tier (content intact — the
+  free/resident/spilled/scratch partition is exact:
+  ``free + resident + spilled + scratch == num_blocks``), and the
+  allocator reclaims spilled device copies lazily, only when an
+  allocation actually needs them (the host copy is the survivor).
+  Resume (driven by ``_refill`` under the same priority ordering)
+  re-maps still-resident spilled blocks in place — zero copy — and
+  uploads host copies into fresh blocks for anything reclaimed, then
+  restores the slot's table row, cache index and last-token input:
+  greedy decode continues BYTE-IDENTICALLY to an uninterrupted run
+  (K/V are restored bit-exact, and prompt + committed tokens determine
+  greedy state — the O(1)-cache contract).  Spill and resume are
+  eager host-side array ops: no tracked executable is touched, so
+  ``compile_counts()`` is unchanged across preemption (test-pinned).
 """
 from __future__ import annotations
 
@@ -65,7 +94,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.errors import (AlreadyExistsError, InvalidArgumentError,
-                           NotFoundError)
+                           NotFoundError, PreconditionNotMetError)
 from ..jit import aot
 from ..jit.decode import DecodeSession, classify_finish
 
@@ -87,6 +116,17 @@ def _fire(point: str) -> None:
         from ..serving import faults as _faults_mod
         _faults = _faults_mod
     _faults.fire(point)
+
+
+def _pow2_at_least(n: int) -> int:
+    """Smallest power of two >= n (>= 1).  The spill tier pads its
+    eager gather/scatter index vectors to these buckets so preempting
+    victims of every length compiles O(log max_blocks) eager shapes,
+    not one per distinct written-block count."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
 
 
 # the serving trace plane, bound lazily for the same circularity reason
@@ -151,17 +191,37 @@ def kv_reachable_bytes(tokens, max_len: int, num_layers: int,
     return sum(min(-(-t // bs) * bs, int(max_len))
                for t in toks) * per_token
 
+# scheduling metadata rides every queued request: ``priority`` (higher
+# admits first), ``tenant`` (fairness-cap key), ``deadline`` (a number
+# on the caller's clock — the serving engine passes its absolute
+# deadline; the pool only ever compares it, None sorting last),
+# ``seq`` (arrival order, the FIFO tie-break)
 _Request = collections.namedtuple(
-    "_Request", ["rid", "ids", "max_new_tokens"])
+    "_Request", ["rid", "ids", "max_new_tokens", "priority", "tenant",
+                 "deadline", "seq"],
+    defaults=(0, None, None, 0))
 
 
 class _SlotState:
-    __slots__ = ("rid", "tokens", "remaining")
+    """One actively-decoding slot.  ``ids`` (the prompt) is retained so
+    preemption can spill and resume without the serving layer's help:
+    the cache index to restore is ``len(ids) + len(tokens) - 1``, and
+    the speculative pool's draft twin re-prefills from it."""
 
-    def __init__(self, rid, first_token: int, remaining: int):
+    __slots__ = ("rid", "ids", "tokens", "remaining", "priority",
+                 "tenant", "deadline", "seq")
+
+    def __init__(self, rid, ids, tokens, remaining: int,
+                 priority: int = 0, tenant=None, deadline=None,
+                 seq: int = 0):
         self.rid = rid
-        self.tokens = [first_token]
+        self.ids = ids
+        self.tokens = tokens
         self.remaining = remaining
+        self.priority = priority
+        self.tenant = tenant
+        self.deadline = deadline
+        self.seq = seq
 
 
 class _PrefillState:
@@ -174,10 +234,12 @@ class _PrefillState:
     is shareable while its first owner is still prefilling the tail."""
 
     __slots__ = ("rid", "ids", "pos", "max_new_tokens", "indexed",
-                 "chain_key")
+                 "chain_key", "priority", "tenant", "deadline", "seq")
 
     def __init__(self, rid, ids, pos: int, max_new_tokens: int,
-                 matched_blocks: int = 0, chain_key=None):
+                 matched_blocks: int = 0, chain_key=None,
+                 priority: int = 0, tenant=None, deadline=None,
+                 seq: int = 0):
         self.rid = rid
         self.ids = ids
         self.pos = pos
@@ -186,6 +248,46 @@ class _PrefillState:
         # after them, continuing their hash chain
         self.indexed = matched_blocks
         self.chain_key = chain_key
+        self.priority = priority
+        self.tenant = tenant
+        self.deadline = deadline
+        self.seq = seq
+
+
+class _SpillState:
+    """One preempted request parked in the host-RAM spill tier.
+
+    ``host`` holds the victim's WRITTEN blocks' K/V (one numpy array
+    per layer per field, ``[written, ...block shape]`` — int8 caches
+    carry their fp32 scales too); ``dev_blocks[j]`` is the physical
+    device block that still holds block ``j``'s content (a spilled
+    block stays device-resident until the allocator actually needs it
+    — resume then re-maps it with ZERO copy), or None once reclaimed
+    or when block ``j`` was prefix-shared at preempt time (the host
+    copy is then the only restorable source).  ``total_blocks`` is the
+    admission-time reservation span, re-acquired in full at resume so
+    a resumed request keeps the no-preemption-mid-decode invariant."""
+
+    __slots__ = ("rid", "ids", "tokens", "remaining", "priority",
+                 "tenant", "deadline", "seq", "total_blocks", "written",
+                 "dev_blocks", "host", "host_bytes", "preempts")
+
+    def __init__(self, st: "_SlotState", total_blocks: int,
+                 written: int, host, host_bytes: int):
+        self.rid = st.rid
+        self.ids = st.ids
+        self.tokens = st.tokens
+        self.remaining = st.remaining
+        self.priority = st.priority
+        self.tenant = st.tenant
+        self.deadline = st.deadline
+        self.seq = st.seq
+        self.total_blocks = total_blocks
+        self.written = written
+        self.dev_blocks = [None] * written
+        self.host = host
+        self.host_bytes = host_bytes
+        self.preempts = 1
 
 
 class _PrefixEntry:
@@ -225,9 +327,14 @@ class GenerationPool:
                  seed: int = 0, cache_layout: str = "dense",
                  block_size: int = 32, num_blocks: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
-                 prefix_sharing: bool = False):
+                 prefix_sharing: bool = False,
+                 tenant_slot_cap: Optional[int] = None):
         if slots < 1:
             raise InvalidArgumentError("GenerationPool needs slots >= 1")
+        if tenant_slot_cap is not None and int(tenant_slot_cap) < 1:
+            raise InvalidArgumentError(
+                "tenant_slot_cap must be >= 1 slots per tenant (or None "
+                "for no fairness cap), got %r" % (tenant_slot_cap,))
         if prefill_chunk_tokens is not None and cache_layout != "paged":
             # the chunk path writes through the block table (per-slot
             # scatter routed to the scratch block past the reservation);
@@ -383,6 +490,30 @@ class GenerationPool:
         self._queue: collections.deque = collections.deque()
         self._active: Dict[int, _SlotState] = {}
         self._free: List[int] = list(range(self.slots))
+        # traffic-grade scheduling state (docs §5j): the per-tenant
+        # fairness cap, the arrival counter behind the FIFO tie-break,
+        # and the host-RAM spill tier — preempted requests parked with
+        # their K/V host copies, plus the reverse map from a still-
+        # device-resident spilled block to its owner (the allocator
+        # reclaims through it under pressure).  ``admission_blocked``
+        # is refreshed by every _refill: True when the chosen candidate
+        # could not reserve its blocks — the serving engine's
+        # degradation ladder reads it to decide preemption is worth it
+        self._tenant_cap = (None if tenant_slot_cap is None
+                            else int(tenant_slot_cap))
+        self._seq = 0
+        self._spilled: Dict[object, _SpillState] = {}
+        self._spill_owner: Dict[int, tuple] = {}
+        self.admission_blocked = False
+        self._preempts_total = 0
+        self._resumes_total = 0
+        self._spill_bytes_total = 0
+        self._upload_bytes_total = 0
+        self._spill_reclaims_total = 0
+        # serving-layer hook: on_resume(rid, info) after a preempted
+        # request's slot is re-activated (fires inside _refill, like
+        # on_admit)
+        self.on_resume = None
         self._last_tok = np.zeros(self.slots, np.int32)
         # device-resident copies of the step inputs: in steady state the
         # decoded token vector feeds straight back and the active mask is
@@ -532,8 +663,26 @@ class GenerationPool:
         return out, tok[0], key
 
     # -- host API --------------------------------------------------------
-    def submit(self, input_ids, max_new_tokens: int, request_id=None):
-        """Queue one prompt (1-D ids); returns the request id."""
+    def submit(self, input_ids, max_new_tokens: int, request_id=None,
+               priority: int = 0, tenant=None, deadline=None):
+        """Queue one prompt (1-D ids); returns the request id.
+
+        ``priority`` (int, higher admits first), ``tenant`` (hashable
+        fairness-cap key) and ``deadline`` (a NUMBER on any consistent
+        clock — the pool only compares it; earlier wins within a
+        priority class, and None sorts last as infinitely lax) are
+        SCHEDULING metadata consumed by ``_refill``'s candidate
+        selection; all default to the strict-FIFO behavior."""
+        if deadline is not None and (isinstance(deadline, bool)
+                                     or not isinstance(deadline,
+                                                       (int, float))):
+            # the candidate ordering mixes deadlines with the
+            # float('inf') sentinel for deadline-less requests: a
+            # non-numeric "orderable" would TypeError mid-refill,
+            # killing every later step — reject it at the submit edge
+            raise InvalidArgumentError(
+                "deadline must be a number on the caller's clock (or "
+                "None for no deadline), got %r" % (deadline,))
         ids = np.asarray(getattr(input_ids, "value", input_ids))
         if ids.ndim != 1:
             raise InvalidArgumentError(
@@ -599,8 +748,10 @@ class GenerationPool:
             rid = self._next_rid
             self._next_rid += 1
         self._used_rids.add(rid)
+        self._seq += 1
         self._queue.append(_Request(rid, ids.astype(np.int32),
-                                    int(max_new_tokens)))
+                                    int(max_new_tokens), int(priority),
+                                    tenant, deadline, self._seq))
         return rid
 
     def _blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
@@ -612,12 +763,53 @@ class GenerationPool:
         return -(-span // self._block_size)
 
     def _alloc_blocks(self, n: int) -> List[int]:
-        """Pop ``n`` fresh blocks off the free list at refcount 1."""
+        """Pop ``n`` fresh blocks at refcount 1: the free list first,
+        then — under pressure — RECLAIM spilled device copies (lowest-
+        priority victim first; its host copy is the survivor, so the
+        preempted request stays resumable, just via the upload path)."""
         self._prefix_epoch += 1
-        blocks = [self._free_blocks.pop() for _ in range(n)]
+        blocks = []
+        for _ in range(n):
+            if not self._free_blocks:
+                self._reclaim_one_spilled()
+            blocks.append(self._free_blocks.pop())
         for b in blocks:
             self._block_refs[b] = 1
         return blocks
+
+    def _reclaim_one_spilled(self) -> None:
+        """Drop ONE spilled block's device copy back to the free list
+        (its owner's ``dev_blocks`` entry goes None — resume for that
+        block becomes a host upload).  Victim order: lowest priority,
+        then oldest arrival — the least important parked request loses
+        its zero-copy resume first."""
+        owners = [sp for sp in self._spilled.values()
+                  if any(b is not None for b in sp.dev_blocks)]
+        if not owners:
+            raise PreconditionNotMetError(
+                "allocator invariant broken: no free block and no "
+                "reclaimable spilled block (callers must check "
+                "availability before allocating)")
+        sp = min(owners, key=lambda s: (s.priority, s.seq))
+        j = next(i for i, b in enumerate(sp.dev_blocks) if b is not None)
+        b = sp.dev_blocks[j]
+        sp.dev_blocks[j] = None
+        self._spill_owner.pop(b, None)
+        self._free_blocks.append(b)
+        self._spill_reclaims_total += 1
+
+    def _forget_block_key(self, b: int) -> None:
+        """Remove ``b`` from the prefix index (an index entry must
+        always name a RESIDENT block — freed and spilled blocks both
+        leave it)."""
+        key = self._block_keys.pop(b, None)
+        if key is not None:
+            entry = self._prefix_index.get(key)
+            if entry is not None:
+                if b in entry.blocks:
+                    entry.blocks.remove(b)
+                if not entry.blocks:
+                    del self._prefix_index[key]
 
     def _release_blocks(self, slot: int) -> None:
         """DECREF every block the slot's table row maps; blocks hitting
@@ -635,14 +827,7 @@ class GenerationPool:
                 continue
             self._block_refs.pop(b, None)
             self._free_blocks.append(b)
-            key = self._block_keys.pop(b, None)
-            if key is not None:
-                entry = self._prefix_index.get(key)
-                if entry is not None:
-                    if b in entry.blocks:
-                        entry.blocks.remove(b)
-                    if not entry.blocks:
-                        del self._prefix_index[key]
+            self._forget_block_key(b)
 
     def _finish(self, slot: int):
         state = self._active.pop(slot)
@@ -698,6 +883,18 @@ class GenerationPool:
             if state.rid == request_id:
                 self.release(slot)
                 return "active"
+        sp = self._spilled.pop(request_id, None)
+        if sp is not None:
+            # a parked victim dies in place: its still-device-resident
+            # spilled blocks return to the free list, its host copies
+            # drop with the record
+            self._prefix_epoch += 1
+            for b in sp.dev_blocks:
+                if b is not None:
+                    self._spill_owner.pop(b, None)
+                    self._free_blocks.append(b)
+            self._used_rids.discard(request_id)
+            return "preempted"
         if request_id in self._results:
             del self._results[request_id]
             self._finish_reasons.pop(request_id, None)
@@ -740,6 +937,222 @@ class GenerationPool:
     def prefill_chunk_tokens(self) -> Optional[int]:
         """The per-tick prompt-work bound (None = one-shot prefill)."""
         return self._chunk_tokens
+
+    @property
+    def preempted_count(self) -> int:
+        """Requests parked in the host-RAM spill tier."""
+        return len(self._spilled)
+
+    # -- preemption / host-RAM spill tier (docs §5j) ---------------------
+    def _preempt_guard(self, slot: int, st: _SlotState) -> None:
+        """Subclass veto point: raise a typed error when this slot
+        cannot be safely preempted (the speculative pool requires draft
+        bucket coverage for the resume-time re-prefill)."""
+
+    def can_preempt(self, request_id) -> bool:
+        """True when ``preempt(request_id)`` would succeed right now:
+        the request is actively DECODING on a paged pool and every
+        subclass resume precondition holds.  The serving engine's
+        degradation ladder filters victims through this instead of
+        catching mid-tick errors."""
+        if self.cache_layout != "paged":
+            return False
+        for slot, st in self._active.items():
+            if st.rid == request_id:
+                try:
+                    self._preempt_guard(slot, st)
+                except Exception:  # noqa: BLE001 - veto, reason unused
+                    return False
+                return True
+        return False
+
+    def preempt(self, request_id) -> dict:
+        """Evict one actively-decoding request, spilling its K/V to the
+        host-RAM tier; returns an info dict (``blocks_spilled``,
+        ``blocks_freed``, ``spill_bytes``, ``committed_tokens``).
+
+        The victim's WRITTEN blocks are downloaded in one batched
+        ``device_get`` (the deliberate spill-boundary host sync —
+        int8 K/V and their fp32 scales ride together), then every
+        block the victim held is decref'd: exclusively-owned written
+        blocks move to the SPILLED tier (device content intact,
+        reclaimable under pressure), unwritten reservation blocks go
+        straight to the free list (nothing to keep), and prefix-shared
+        blocks stay resident under their other owners (the host copy
+        is the victim's restorable source).  The slot is freed; resume
+        happens through ``_refill`` under the normal priority
+        ordering.  Host-side bookkeeping plus eager array ops only —
+        no tracked executable runs, so ``compile_counts()`` is
+        unchanged (test-pinned)."""
+        if self.cache_layout != "paged":
+            raise PreconditionNotMetError(
+                "preemption spills paged K/V blocks to the host tier; "
+                "a dense pool has no block granularity to spill — use "
+                "cache_layout='paged'")
+        slot = next((s for s, st in self._active.items()
+                     if st.rid == request_id), None)
+        if slot is None:
+            raise NotFoundError(
+                "request_id %r is not actively decoding (queued, "
+                "prefilling, already-preempted and finished requests "
+                "cannot be preempted; active: %s)"
+                % (request_id,
+                   sorted(str(st.rid) for st in self._active.values())))
+        st = self._active[slot]
+        self._preempt_guard(slot, st)
+        bs = self._block_size
+        # K/V are written for positions [0, pos): the last committed
+        # token's K/V is NOT yet written (it is the next step's input)
+        pos = len(st.ids) + len(st.tokens) - 1
+        written = -(-pos // bs)
+        blocks = self._slot_blocks.pop(slot)
+        # the gather index is padded to a power-of-two bucket so the
+        # eager gather compiles O(log max_blocks) distinct shapes over
+        # the pool's lifetime, not one per victim length — padding rows
+        # read the scratch block (block 0), harmless and never restored
+        padded_n = _pow2_at_least(written)
+        gidx = np.zeros(padded_n, np.int32)
+        gidx[:written] = blocks[:written]
+        gather = jnp.asarray(gidx)
+        # ONE batched download of everything resume must be able to
+        # restore — the spill boundary's deliberate host sync
+        host = jax.device_get([
+            (c.k[gather], c.v[gather])
+            + ((c.k_scale[gather], c.v_scale[gather])
+               if c.k_scale is not None else ())
+            for c in self._cache])
+        # honest byte accounting: the pad rows are not spilled content
+        host_bytes = sum(arr[:written].nbytes
+                         for layer in host for arr in layer)
+        self._active.pop(slot)
+        self._free.append(slot)
+        self._membership_dirty = True
+        self._prefix_epoch += 1
+        sp = _SpillState(st, len(blocks), written, host, host_bytes)
+        freed = 0
+        for j, b in enumerate(blocks):
+            left = self._block_refs.get(b, 1) - 1
+            if left > 0:
+                # prefix-shared: other owners keep it resident; the
+                # victim restores from its host copy at resume
+                self._block_refs[b] = left
+                continue
+            self._block_refs.pop(b, None)
+            self._forget_block_key(b)
+            if j < written:
+                self._spill_owner[b] = (st.rid, j)
+                sp.dev_blocks[j] = b
+            else:
+                self._free_blocks.append(b)
+                freed += 1
+        self._spilled[st.rid] = sp
+        self._preempts_total += 1
+        self._spill_bytes_total += host_bytes
+        return {"rid": st.rid, "slot": slot, "blocks_spilled": written,
+                "blocks_freed": freed, "spill_bytes": host_bytes,
+                "committed_tokens": len(st.tokens)}
+
+    def _resume(self, sp: _SpillState) -> None:
+        """Re-activate one parked request into a free slot: re-map its
+        still-device-resident spilled blocks IN PLACE (zero copy),
+        allocate fresh blocks for everything else and upload the host
+        copies of reclaimed/shared written blocks into them, then
+        restore the table row, cache index and last-token input.  The
+        restored K/V are bit-exact, so greedy decode continues
+        byte-identically (eager array ops only — no tracked compile)."""
+        slot = self._free.pop()
+        blocks: List[int] = []
+        upload: List[tuple] = []  # (logical j, physical block)
+        for j in range(sp.total_blocks):
+            b = sp.dev_blocks[j] if j < sp.written else None
+            if b is not None:
+                # fast path: the device copy survived — re-map it
+                self._spill_owner.pop(b, None)
+                self._block_refs[b] = 1
+                blocks.append(b)
+            else:
+                nb = self._alloc_blocks(1)[0]
+                blocks.append(nb)
+                if j < sp.written:
+                    upload.append((j, nb))
+        self._slot_blocks[slot] = blocks
+        pos = len(sp.ids) + len(sp.tokens) - 1
+        padded = np.zeros(self._max_blocks, np.int32)
+        padded[:len(blocks)] = blocks
+        row = jnp.asarray(padded)
+        pos_dev = jnp.asarray(pos, jnp.int32)
+        if upload:
+            # same power-of-two padding discipline as the spill gather:
+            # pad target ids with block 0, whose write lands in the
+            # scratch block — garbage there is the §5b masking contract
+            n_up = len(upload)
+            padded_n = _pow2_at_least(n_up)
+            sel = np.zeros(padded_n, np.intp)
+            sel[:n_up] = [j for j, _ in upload]
+            ids = np.zeros(padded_n, np.int32)
+            ids[:n_up] = [b for _, b in upload]
+            ids_dev = jnp.asarray(ids)
+        new_cache = []
+        for layer, c in enumerate(self._cache):
+            upd = dict(table=c.table.at[slot].set(row),
+                       index=c.index.at[slot].set(pos_dev))
+            if upload:
+                fields = sp.host[layer]
+                upd["k"] = c.k.at[ids_dev].set(jnp.asarray(fields[0][sel]))
+                upd["v"] = c.v.at[ids_dev].set(jnp.asarray(fields[1][sel]))
+                if c.k_scale is not None:
+                    upd["k_scale"] = c.k_scale.at[ids_dev].set(
+                        jnp.asarray(fields[2][sel]))
+                    upd["v_scale"] = c.v_scale.at[ids_dev].set(
+                        jnp.asarray(fields[3][sel]))
+            new_cache.append(c._replace(**upd))
+        self._cache = new_cache
+        state = _SlotState(sp.rid, sp.ids, sp.tokens, sp.remaining,
+                           priority=sp.priority, tenant=sp.tenant,
+                           deadline=sp.deadline, seq=sp.seq)
+        self._active[slot] = state
+        self._last_tok[slot] = sp.tokens[-1]
+        self._membership_dirty = True
+        self._prefix_epoch += 1
+        self._resumes_total += 1
+        if upload:
+            # honest byte accounting: pad rows are not paged-in content
+            self._upload_bytes_total += sum(
+                fields[i][sel[:n_up]].nbytes for fields in sp.host
+                for i in range(len(fields)))
+        self._on_resumed(slot, sp)
+        if self.on_resume is not None:
+            self.on_resume(sp.rid, {
+                "slot": slot, "blocks_remapped": len(blocks) - len(upload)
+                - (sp.total_blocks - sp.written),
+                "blocks_uploaded": len(upload),
+                "committed_tokens": len(sp.tokens)})
+
+    def _on_resumed(self, slot: int, sp: _SpillState) -> None:
+        """Subclass hook: a preempted request just resumed decoding in
+        ``slot`` with its K/V restored.  The speculative pool re-prefills
+        its draft twin here."""
+
+    def spill_stats(self) -> dict:
+        """Host-side spill-tier accounting — what the serving gauges
+        (``serving_spilled_*``) and the overload bench leg stamp.
+        ``spilled_blocks_device`` counts reclaimable device-resident
+        spilled copies (part of the exact free/resident/spilled/scratch
+        partition of ``num_blocks``); ``spilled_blocks_host`` counts
+        written blocks whose content is held host-side (every spilled
+        request's written span, device-resident or not)."""
+        return {
+            "enabled": self.cache_layout == "paged",
+            "preempts_total": self._preempts_total,
+            "resumes_total": self._resumes_total,
+            "spilled_requests": len(self._spilled),
+            "spilled_blocks_device": len(self._spill_owner),
+            "spilled_blocks_host": sum(sp.written
+                                       for sp in self._spilled.values()),
+            "spill_bytes_total": self._spill_bytes_total,
+            "upload_bytes_total": self._upload_bytes_total,
+            "reclaims_total": self._spill_reclaims_total,
+        }
 
     def _shared_block_count(self) -> int:
         """Blocks currently referenced beyond their first owner — the
@@ -787,13 +1200,16 @@ class GenerationPool:
         it to prefill its draft twin."""
 
     def _activate(self, slot: int, rid, ids, first: int,
-                  max_new_tokens: int) -> None:
+                  max_new_tokens: int, priority: int = 0, tenant=None,
+                  deadline=None, seq: int = 0) -> None:
         """Promote a slot to decoding: its prompt is fully resident and
         ``first`` (the token sampled at the last prompt position) is
         committed.  One code path for both prefill modes, so the hook
         order (``on_admit`` at slot-take, then ``_on_activated``, then
         ``on_token``) cannot diverge between them."""
-        self._active[slot] = _SlotState(rid, first, max_new_tokens - 1)
+        self._active[slot] = _SlotState(
+            rid, ids, [first], max_new_tokens - 1, priority=priority,
+            tenant=tenant, deadline=deadline, seq=seq)
         self._last_tok[slot] = first
         self._membership_dirty = True
         finishes = max_new_tokens - 1 == 0 or \
@@ -907,7 +1323,9 @@ class GenerationPool:
             jnp.asarray(padded), jnp.asarray(matched_len, jnp.int32))
         self._prefilling[slot] = _PrefillState(
             req.rid, req.ids, matched_len, req.max_new_tokens,
-            matched_blocks=len(matched_blocks), chain_key=chain_key)
+            matched_blocks=len(matched_blocks), chain_key=chain_key,
+            priority=req.priority, tenant=req.tenant,
+            deadline=req.deadline, seq=req.seq)
         if self.prefix_sharing:
             self._prefix_queries += 1
             if matched_len:
@@ -920,35 +1338,119 @@ class GenerationPool:
         if self.on_admit is not None:
             self.on_admit(req.rid, slot, len(req.ids))
 
+    def _tenant_counts(self) -> Optional[Dict]:
+        """Live slots per tenant (active + prefilling), None when no
+        fairness cap is configured."""
+        if self._tenant_cap is None:
+            return None
+        counts: Dict = {}
+        for st in list(self._active.values()) \
+                + list(self._prefilling.values()):
+            if st.tenant is not None:
+                counts[st.tenant] = counts.get(st.tenant, 0) + 1
+        return counts
+
+    def tenant_at_cap(self, tenant) -> bool:
+        """True when ``tenant`` currently holds its full fairness-cap
+        share of slots — ``_pick_candidate`` would defer its queued
+        requests right now.  The engine's preempt rung uses this to
+        avoid evicting a victim for a request the refill cannot admit
+        anyway (always False without a cap or for tenant-less
+        requests)."""
+        if self._tenant_cap is None or tenant is None:
+            return False
+        counts = self._tenant_counts()
+        return counts.get(tenant, 0) >= self._tenant_cap
+
+    def _pick_candidate(self, tenants):
+        """The next request a free slot should serve: queued admissions
+        and parked (preempted) resumes compete in ONE ordering —
+        ``(priority desc, deadline asc, arrival asc)`` — so a spilled
+        high-priority request outranks a cold low-priority one and vice
+        versa, and deadline-aware slot selection falls out of the same
+        comparison.  Tenants at their fairness cap are skipped (a slot
+        freeing later lifts the cap — never starvation, just deferral).
+        Returns ``("queued", _Request) | ("resume", _SpillState) |
+        None``."""
+        best = best_key = None
+        inf = float("inf")
+        for req in self._queue:
+            if tenants is not None and req.tenant is not None \
+                    and tenants.get(req.tenant, 0) >= self._tenant_cap:
+                continue
+            key = (-req.priority,
+                   inf if req.deadline is None else req.deadline,
+                   req.seq)
+            if best_key is None or key < best_key:
+                best, best_key = ("queued", req), key
+        for sp in self._spilled.values():
+            if tenants is not None and sp.tenant is not None \
+                    and tenants.get(sp.tenant, 0) >= self._tenant_cap:
+                continue
+            key = (-sp.priority,
+                   inf if sp.deadline is None else sp.deadline,
+                   sp.seq)
+            if best_key is None or key < best_key:
+                best, best_key = ("resume", sp), key
+        return best
+
     def _refill(self):
         tr = _trace_active()
-        while self._queue and self._free:
+        self.admission_blocked = False
+        while (self._queue or self._spilled) and self._free:
+            pick = self._pick_candidate(self._tenant_counts())
+            if pick is None:
+                break  # every candidate is tenant-capped right now
+            kind, item = pick
+            if kind == "resume":
+                # re-acquire the fresh blocks the resume needs (blocks
+                # still in the spill tier re-map for free; the tier's
+                # OTHER entries are reclaimable on top of the free list)
+                own = sum(1 for b in item.dev_blocks if b is not None)
+                need_fresh = item.total_blocks - own
+                avail = len(self._free_blocks) \
+                    + len(self._spill_owner) - own
+                if need_fresh > avail:
+                    self.admission_blocked = True
+                    break  # block-wait on the CHOSEN candidate
+                self._spilled.pop(item.rid)
+                self._resume(item)
+                continue
+            req = item
             matched_blocks, matched_len, chain_key = [], 0, None
             if self.cache_layout == "paged":
-                # admission control: FIFO head waits until enough blocks
-                # are free for its whole reservation (skipping ahead to a
-                # smaller later request would starve long prompts).
-                # With sharing, matched blocks come off the requirement:
-                # a hit admits under block pressure a cold prompt could
-                # not
-                head = self._queue[0]
-                need = self._blocks_needed(len(head.ids),
-                                           head.max_new_tokens)
+                # admission control: the chosen candidate waits until
+                # enough blocks are free (+reclaimable from the spill
+                # tier) for its whole reservation — skipping ahead to a
+                # smaller request would starve long prompts within the
+                # declared priority ordering.  With sharing, matched
+                # blocks come off the requirement: a hit admits under
+                # block pressure a cold prompt could not
+                need = self._blocks_needed(len(req.ids),
+                                           req.max_new_tokens)
                 if self.prefix_sharing:
-                    sig = (head.rid, self._prefix_epoch)
+                    sig = (req.rid, self._prefix_epoch)
                     if self._head_match is not None \
                             and self._head_match[0] == sig:
                         matched_blocks, matched_len, chain_key = \
                             self._head_match[1]
                     else:
                         matched_blocks, matched_len, chain_key = \
-                            self._match_prefix(head.ids)
+                            self._match_prefix(req.ids)
                         self._head_match = (
                             sig, (matched_blocks, matched_len,
                                   chain_key))
-                if need - len(matched_blocks) > len(self._free_blocks):
+                if need - len(matched_blocks) > \
+                        len(self._free_blocks) + len(self._spill_owner):
+                    self.admission_blocked = True
                     break
-            req = self._queue.popleft()
+            # remove by IDENTITY: _Request is a namedtuple holding a
+            # numpy array — value equality would compare prompt arrays
+            # element-wise the moment two rids ever collided
+            for i, q in enumerate(self._queue):
+                if q is req:
+                    del self._queue[i]
+                    break
             if self._chunk_tokens is not None:
                 self._admit_chunked(req, need, matched_blocks,
                                     matched_len, chain_key)
@@ -993,7 +1495,9 @@ class GenerationPool:
             if self.on_admit is not None:
                 self.on_admit(req.rid, slot, len(req.ids))
             self._activate(slot, req.rid, req.ids, first,
-                           req.max_new_tokens)
+                           req.max_new_tokens, priority=req.priority,
+                           tenant=req.tenant, deadline=req.deadline,
+                           seq=req.seq)
 
     def _chunk_work(self, tr) -> None:
         """At most ``prefill_chunk_tokens`` of prompt work this tick:
@@ -1045,7 +1549,9 @@ class GenerationPool:
         # samples are never fetched)
         self._prefilling.pop(slot)
         first = int(np.asarray(tok_dev))
-        self._activate(slot, st.rid, st.ids, first, st.max_new_tokens)
+        self._activate(slot, st.rid, st.ids, first, st.max_new_tokens,
+                       priority=st.priority, tenant=st.tenant,
+                       deadline=st.deadline, seq=st.seq)
 
     def _sync_step_inputs(self):
         """The shared pre-step protocol (also the speculative pool's):
@@ -1085,7 +1591,8 @@ class GenerationPool:
             # this same tick (no TTFT penalty vs the one-shot prefill)
             self._chunk_work(tr)
         if not self._active:
-            return bool(self._queue or self._prefilling)
+            return bool(self._queue or self._prefilling
+                        or self._spilled)
         params, bufs = self._sync_step_inputs()
         if tr is None:
             tok_dev = self._dispatch(params, bufs)
@@ -1108,7 +1615,8 @@ class GenerationPool:
         else:
             with tr.span("tick.deliver"):
                 self._deliver(tok)
-        return bool(self._active or self._queue or self._prefilling)
+        return bool(self._active or self._queue or self._prefilling
+                    or self._spilled)
 
     def _dispatch(self, params, bufs):
         """The one batched decode dispatch (cache donated and rebound in
@@ -1162,6 +1670,14 @@ class GenerationPool:
         self._results.clear()
         self._finish_reasons.clear()
         self._used_rids.clear()
+        # the spill tier names physical blocks of the cache being
+        # discarded AND host copies of state the engine will resubmit
+        # from its own records: both die with the pool (the engine's
+        # recovery resubmits a preempted victim's prompt+committed like
+        # any other survivor — byte-identical either way)
+        self._spilled.clear()
+        self._spill_owner.clear()
+        self.admission_blocked = False
         if self.cache_layout == "paged":
             self._free_blocks = list(range(1, self._num_blocks))
             self._slot_blocks = {}
@@ -1286,7 +1802,12 @@ class GenerationPool:
                  "dense_equiv_bytes": dense_bytes}
         if self.cache_layout == "paged":
             bs = self._block_size
-            mapped = self._num_blocks - 1 - len(self._free_blocks)
+            # resident = unique blocks some live slot's table row maps
+            # (== the refcounted set); spilled device copies are a
+            # THIRD state — not free, not resident — so the partition
+            # free + mapped + spilled + scratch == num_blocks is exact
+            # (test-pinned under preemption churn)
+            mapped = len(self._block_refs)
             # each UNIQUE resident block counted once (a prefix-shared
             # block is readable by several slots but occupies its HBM
             # once), at its readable tokens: a block at logical index j
@@ -1309,6 +1830,7 @@ class GenerationPool:
                 num_blocks=self._num_blocks,
                 free_blocks=len(self._free_blocks),
                 mapped_blocks=mapped,
+                spilled_blocks=len(self._spill_owner),
                 reachable_bytes=reachable,
                 # blocks referenced beyond their first owner — the live
                 # HBM the prefix index is currently saving

@@ -148,6 +148,16 @@ class SpeculativePool(GenerationPool):
             meta_fn=lambda p, b, cache, *r: {
                 "kv_cache_bytes": aot.kv_arg_bytes(cache)})
         self._draft_state_cache = None
+        # the RUNTIME spec-K: the serving engine's degradation ladder
+        # steps it down under SLO burn (fewer draft steps per round =
+        # less wasted draft work when acceptance pays badly under
+        # pressure) and restores it when the alert clears.  spec_k
+        # stays the compiled CEILING; the first round at a NEW k_active
+        # compiles one verify executable for its [slots, k+1] chunk
+        # (cached — stepping back and forth is free thereafter), and
+        # the fixup executable takes k as a traced scalar so its one
+        # compilation serves every setting
+        self._spec_k_active = self.spec_k
         self._drafted = 0
         self._accepted = 0
         self._rounds = 0
@@ -169,23 +179,28 @@ class SpeculativePool(GenerationPool):
         return new_cache, jnp.where(active, tok, 0)
 
     def _draft_fixup(self, param_vals, buf_vals, cache, toks, accepted,
-                     active):
+                     active, k_eff):
         """Post-verify draft maintenance, one dispatch: the catch-up
         write (fully-accepted rows never wrote d_K's K/V — ``toks`` is
         the d_K vector) plus the rejection REWIND (every active row's
         index moves to its accepted prefix: active rows advanced exactly
-        ``spec_k`` during drafting, so the rewound index is
-        ``idx - spec_k + accepted + 1`` — for catch-up rows that equals
-        the position just written).  Rows with a partial acceptance also
-        write ``toks`` at their stale position; harmless, because the
-        next round's chunk overwrites every stale row before the index
-        could ever reach it."""
+        ``k_eff`` during drafting, so the rewound index is
+        ``idx - k_eff + accepted + 1`` — for catch-up rows that equals
+        the position just written).  ``k_eff`` is a TRACED scalar, not a
+        closure constant: the runtime spec-K (``set_spec_k``) changes
+        the round's draft count without retracing, and a baked-in
+        ``self.spec_k`` would silently rewind by the wrong amount the
+        moment the executable (keyed on ``toks``'s shape alone) was
+        reused at a different setting.  Rows with a partial acceptance
+        also write ``toks`` at their stale position; harmless, because
+        the next round's chunk overwrites every stale row before the
+        index could ever reach it."""
         sess = self._draft_session
         idx_pre = cache[0].index
         _logits, new_cache = sess._run_model(param_vals, buf_vals,
                                              toks[:, None], cache)
         new_idx = jnp.where(active,
-                            idx_pre - self.spec_k + accepted + 1,
+                            idx_pre - k_eff + accepted + 1,
                             idx_pre)
         return [c._replace(index=new_idx) for c in new_cache]
 
@@ -250,7 +265,8 @@ class SpeculativePool(GenerationPool):
             jnp.asarray(slot, jnp.int32),
             jnp.asarray(len(ids), jnp.int32))
 
-    def submit(self, input_ids, max_new_tokens: int, request_id=None):
+    def submit(self, input_ids, max_new_tokens: int, request_id=None,
+               priority: int = 0, tenant=None, deadline=None):
         ids = np.asarray(getattr(input_ids, "value", input_ids))
         if self._chunk_tokens is not None and ids.ndim == 1 and ids.size:
             # the TARGET needs no bucket under chunked prefill, but the
@@ -258,7 +274,59 @@ class SpeculativePool(GenerationPool):
             # activation — fail at submit, not mid-tick
             self._draft_session._bucket_for(ids.shape[0])
         return super().submit(input_ids, max_new_tokens,
-                              request_id=request_id)
+                              request_id=request_id, priority=priority,
+                              tenant=tenant, deadline=deadline)
+
+    def set_spec_k(self, k: int) -> None:
+        """Change the RUNTIME draft count per round, within the
+        compiled ceiling ``[1, spec_k]`` — the degradation ladder's
+        reduce-spec-K rung.  Takes effect next round; greedy output is
+        token-identical at every setting (acceptance always emits the
+        target's own argmax tokens).  The first round at a new ``k``
+        compiles one verify executable for the narrower chunk, cached
+        thereafter; the draft/fixup executables are shared across every
+        setting (``k`` is traced data in the fixup)."""
+        k = int(k)
+        if not 1 <= k <= self.spec_k:
+            raise InvalidArgumentError(
+                "spec_k override must be in [1, %d] (the constructed "
+                "spec_k is the compiled ceiling — headroom was reserved "
+                "for it at construction), got %r" % (self.spec_k, k))
+        self._spec_k_active = k
+
+    @property
+    def spec_k_active(self) -> int:
+        """The runtime draft count per round (<= the ``spec_k``
+        ceiling; stepped down/up by the degradation ladder)."""
+        return self._spec_k_active
+
+    def _preempt_guard(self, slot, st) -> None:
+        """Preempting a speculative slot requires the draft twin to be
+        re-prefillable at resume: the draft's bucketed prefill must
+        cover prompt+committed-1 positions — the same bucket-coverage
+        constraint deep recovery already imposes (docs/DESIGN.md §5f).
+        Checked at PREEMPT time so the failure is a typed error at the
+        decision point, never a mid-refill surprise at resume."""
+        self._draft_session._bucket_for(
+            len(st.ids) + max(0, len(st.tokens) - 1))
+
+    def _on_resumed(self, slot, sp) -> None:
+        """Restore the draft twin for a resumed slot: re-prefill it
+        over prompt + committed[:-1] — exactly the positions the target
+        cache was restored to (index = prompt+committed-1; the LAST
+        committed token is the next round's first chunk element, its
+        K/V unwritten on both sides).  The draft K/V only shape
+        PROPOSALS — greedy acceptance emits the target's own argmax
+        either way — so this is an acceptance-rate restoration, with
+        byte-identity guaranteed by the target side alone."""
+        ids = sp.ids if len(sp.tokens) <= 1 else np.concatenate(
+            [sp.ids, np.asarray(sp.tokens[:-1], np.int32)])
+        row_cache, _tok, self._key = self._draft_session.prefill(
+            ids[None], self._key)
+        self._draft_cache = self._draft_insert_jit(
+            self._draft_cache, row_cache,
+            jnp.asarray(slot, jnp.int32),
+            jnp.asarray(len(ids), jnp.int32))
 
     def step(self) -> bool:
         """Refill free slots, run ONE speculative round (K draft steps,
@@ -284,7 +352,8 @@ class SpeculativePool(GenerationPool):
             # at activation, via _on_activated)
             self._chunk_work(tr)
         if not self._active:
-            return bool(self._queue or self._prefilling)
+            return bool(self._queue or self._prefilling
+                        or self._spilled)
         params, bufs = self._sync_step_inputs()
         if self._draft_state_cache is None:
             self._draft_state_cache = self._draft_session._state_vals()
@@ -313,12 +382,14 @@ class SpeculativePool(GenerationPool):
             # device-resident pending vector is already next round's
             # draft input
             self._tok_dev = pending_dev
-        return bool(self._active or self._queue or self._prefilling)
+        return bool(self._active or self._queue or self._prefilling
+                    or self._spilled)
 
     def _spec_round(self, params, bufs, dparams, dbufs):
         """The round's device work: K draft steps, one verify, one
-        draft fixup.  Returns ``(emitted_dev, m_dev, pending_dev)``."""
-        k = self.spec_k
+        draft fixup (K = the runtime ``spec_k_active``).  Returns
+        ``(emitted_dev, m_dev, pending_dev)``."""
+        k = self._spec_k_active
         t0 = time.perf_counter() if self._time_split else 0.0
         d_toks = []
         tok = self._tok_dev
@@ -340,10 +411,11 @@ class SpeculativePool(GenerationPool):
             jax.block_until_ready(m_dev)
             self._verify_time_s += time.perf_counter() - t1
         # catch-up + rewind for the draft cache (one dispatch; d_K is
-        # the catch-up token, rows that rewind ignore its write)
+        # the catch-up token, rows that rewind ignore its write; the
+        # round's k rides as traced data)
         self._draft_cache = self._draft_fixup_jit(
             dparams, dbufs, self._draft_cache, d_toks[-1], m_dev,
-            self._active_dev)
+            self._active_dev, jnp.asarray(k, jnp.int32))
         return emitted_dev, m_dev, pending_dev
 
     def _deliver_round(self, emitted, m_host) -> None:
@@ -357,7 +429,7 @@ class SpeculativePool(GenerationPool):
         a thin transport."""
         n_active = len(self._active)
         self._rounds += 1
-        self._drafted += self.spec_k * n_active
+        self._drafted += self._spec_k_active * n_active
         self._accepted += int(m_host[list(self._active)].sum())
         for slot in list(self._active):
             state = self._active[slot]
@@ -396,6 +468,7 @@ class SpeculativePool(GenerationPool):
         measured quantities the serving gauge and the bench leg stamp."""
         stats = acceptance_summary(self.spec_k, self._rounds,
                                    self._drafted, self._accepted)
+        stats["spec_k_active"] = self._spec_k_active
         if self._time_split:
             stats["draft_time_s"] = self._draft_time_s
             stats["verify_time_s"] = self._verify_time_s

@@ -56,6 +56,21 @@ read-only and prefills only the suffix, byte-identical to sharing-off)
 ``serving_prefix_blocks_shared`` / ``serving_prefill_chunks_total``
 and the ``prefix_hit_tokens`` stamp on ``req.admitted`` log lines.
 
+Traffic-grade scheduling (docs/DESIGN.md §5j): requests carry
+``priority`` classes (``PRIORITY_CLASSES`` or any int) and optional
+``tenant`` fairness keys; admission is (priority, deadline, arrival)-
+ordered with per-tenant slot caps, and ``ServingEngine.preempt()``
+evicts a decoding victim by spilling its paged K/V (int8 scales
+included) to a host-RAM block tier — resumed BYTE-identically with no
+new compiles.  ``degrade=True`` closes the loop on the SLO plane: the
+multi-window burn alert drives a ladder (preempt low-priority → reduce
+spec-K → tighten admission, ``AdmissionTightenedError`` at the door)
+that steps down while the alert burns and back up as it clears, every
+decision emitted as ``sched.preempt``/``sched.resume``/
+``sched.degrade``/``sched.restore`` flight-recorder events and
+structured-log lines.  A degraded engine is HEALTHY: ``GET /healthz``
+stays 200 and carries the level.
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —
@@ -64,7 +79,8 @@ cached decode step as a component inside a request scheduler; this
 package is that scheduler.
 """
 from . import faults, log, slo, trace
-from .engine import (DeadlineUnattainableError, QueueFullError,
+from .engine import (PRIORITY_CLASSES, AdmissionTightenedError,
+                     DeadlineUnattainableError, QueueFullError,
                      ServingEngine)
 from .http import ServingHTTPFrontend, parse_generate_request
 from .log import JsonLinesLogger
@@ -77,6 +93,7 @@ from .trace import FlightRecorder, TraceEvent, Tracer
 
 __all__ = [
     "ServingEngine", "QueueFullError", "DeadlineUnattainableError",
+    "AdmissionTightenedError", "PRIORITY_CLASSES",
     "ResponseStream", "StreamStatus", "RequestState",
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "DEFAULT_TIME_BUCKETS",

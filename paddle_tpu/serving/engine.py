@@ -53,6 +53,18 @@ Design points (docs/DESIGN.md §5c):
   :class:`DeadlineUnattainableError` (carrying a ``retry_after_s``
   hint, mapped to HTTP 503 + Retry-After) instead of burning a slot on
   output its caller will throw away.
+- **Traffic-grade scheduling, SLO-closed-loop.** Requests carry a
+  ``priority`` class and an optional ``tenant`` fairness key; the
+  pool admits by (priority, deadline, arrival) with per-tenant slot
+  caps, and ``preempt()`` evicts a decoding victim by spilling its
+  paged K/V to a host-RAM tier, to be resumed BYTE-identically (the
+  docs/DESIGN.md §5j contract).  With ``degrade=True`` the SLO
+  tracker's multi-window burn alert drives a degradation LADDER —
+  preempt low-priority, reduce spec-K, tighten admission — stepping
+  down while the alert burns and back up when it clears, with every
+  decision emitted as a ``sched.*`` flight-recorder event and
+  structured-log line so overload behavior is post-hoc auditable.
+  Degraded is healthy: ``/healthz`` stays 200 and carries the level.
 - **Request-scoped tracing.** With a tracer installed
   (``start_trace()`` / ``serving.trace``) every tick runs inside a
   numbered span, lifecycle transitions / recoveries / sheds / compiles
@@ -79,7 +91,29 @@ from .metrics import MetricsRegistry
 from .stream import RequestState, ResponseStream, StreamStatus
 from .supervisor import EngineHealth
 
-__all__ = ["ServingEngine", "QueueFullError", "DeadlineUnattainableError"]
+__all__ = ["ServingEngine", "QueueFullError", "DeadlineUnattainableError",
+           "AdmissionTightenedError", "PRIORITY_CLASSES"]
+
+# named priority classes the HTTP schema (and convenience callers)
+# accept; priorities are plain ints underneath — higher admits first,
+# ties broken by deadline then arrival (docs/DESIGN.md §5j)
+PRIORITY_CLASSES = {"low": -1, "normal": 0, "high": 1}
+
+
+def _normalize_priority(priority) -> int:
+    if isinstance(priority, str):
+        if priority not in PRIORITY_CLASSES:
+            raise InvalidArgumentError(
+                "unknown priority class %r; named classes are %s, or "
+                "pass an int (higher admits first)"
+                % (priority, sorted(PRIORITY_CLASSES)))
+        return PRIORITY_CLASSES[priority]
+    if isinstance(priority, bool) or not isinstance(
+            priority, (int, np.integer)):
+        raise InvalidArgumentError(
+            "priority must be an int or one of %s, got %r"
+            % (sorted(PRIORITY_CLASSES), priority))
+    return int(priority)
 
 
 class QueueFullError(UnavailableError):
@@ -102,6 +136,20 @@ class DeadlineUnattainableError(UnavailableError):
         self.retry_after_s = float(retry_after_s)
 
 
+class AdmissionTightenedError(UnavailableError):
+    """Admission rejected by the degradation ladder's tighten-admission
+    rung: while the SLO burn alert holds the engine at its deepest
+    degradation level, submits BELOW the configured priority floor are
+    shed at the door so the capacity they would take keeps the
+    high-priority promises alive.  Typed and RETRYABLE — the ladder
+    steps back up when the alert clears, and the request will admit
+    then (the HTTP front end maps this to 503 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
+
+
 class _Record:
     """Engine-side per-request state (the pool keeps only slot state).
     ``prompt`` is retained host-side because it IS the recovery story:
@@ -110,10 +158,11 @@ class _Record:
 
     __slots__ = ("rid", "stream", "state", "prompt", "prompt_len",
                  "max_new", "deadline_abs", "submit_t", "first_t",
-                 "last_t", "tokens", "retries")
+                 "last_t", "tokens", "retries", "priority", "tenant",
+                 "preempts", "preempted_at")
 
     def __init__(self, rid, stream, prompt, max_new, deadline_abs,
-                 submit_t):
+                 submit_t, priority=0, tenant=None):
         self.rid = rid
         self.stream = stream
         self.state = RequestState.QUEUED
@@ -126,6 +175,10 @@ class _Record:
         self.last_t = None
         self.tokens = []
         self.retries = 0
+        self.priority = priority
+        self.tenant = tenant
+        self.preempts = 0
+        self.preempted_at = None
 
 
 class ServingEngine:
@@ -149,7 +202,11 @@ class ServingEngine:
                  max_queue: int = 64, clock=None,
                  metrics: Optional[MetricsRegistry] = None,
                  draft_model=None, spec_k: Optional[int] = None,
-                 max_retries: int = 2, slo=None, **pool_kwargs):
+                 max_retries: int = 2, slo=None, degrade: bool = False,
+                 degrade_max_level: int = 3,
+                 degrade_dwell_ticks: int = 2,
+                 degrade_clear_ticks: int = 3,
+                 degrade_admit_floor=1, **pool_kwargs):
         if int(max_queue) < 1:
             raise InvalidArgumentError(
                 "max_queue must be >= 1, got %r" % (max_queue,))
@@ -157,6 +214,25 @@ class ServingEngine:
             raise InvalidArgumentError(
                 "max_retries must be >= 0 (0 = never resubmit after a "
                 "step failure), got %r" % (max_retries,))
+        if degrade and slo is None:
+            # the ladder's control signal IS the SLO alert: without
+            # objectives there is nothing to step on, and a silently
+            # inert ladder would read as "degradation configured"
+            raise InvalidArgumentError(
+                "degrade=True needs an SLO tracker: the ladder steps on "
+                "the multi-window burn alert — pass "
+                "slo=serving.slo.SLOTracker([...objectives...])")
+        if degrade and not 1 <= int(degrade_max_level) <= 3:
+            raise InvalidArgumentError(
+                "degrade_max_level must be in [1, 3] (1 preempt, "
+                "2 +reduce-spec-K, 3 +tighten-admission), got %r"
+                % (degrade_max_level,))
+        if degrade and (int(degrade_dwell_ticks) < 1
+                        or int(degrade_clear_ticks) < 1):
+            raise InvalidArgumentError(
+                "degrade_dwell_ticks and degrade_clear_ticks must be "
+                ">= 1 tick, got %r / %r"
+                % (degrade_dwell_ticks, degrade_clear_ticks))
         if draft_model is not None:
             from ..inference.speculative import SpeculativePool
 
@@ -191,6 +267,27 @@ class ServingEngine:
         # cost-attribution fingerprint: gauges refresh only when the
         # pool's executable set changes (jit.aot cost_version)
         self._cost_seen = 0
+        # degradation ladder (docs §5j): level 0 = normal service;
+        # each alert-active tick past the dwell steps DOWN one rung
+        # (1 preempt low-priority, 2 +reduce spec-K, 3 +tighten
+        # admission), each clear_ticks alert-free run steps back UP.
+        # ticks_since_change starts "infinite" so the FIRST alerting
+        # tick escalates without waiting out a dwell it never began
+        self._degrade_on = bool(degrade)
+        self._degrade_level = 0
+        self._degrade_max = int(degrade_max_level)
+        self._degrade_dwell = int(degrade_dwell_ticks)
+        self._degrade_clear = int(degrade_clear_ticks)
+        self._degrade_floor = _normalize_priority(degrade_admit_floor)
+        self._degrade_ticks_since_change = 1 << 30
+        self._degrade_clean_ticks = 0
+        self._degrade_transitions = 0
+        self._spec_k_full = getattr(self._pool, "spec_k", None)
+        # the runtime spec-K the ladder found when it ENGAGED the
+        # reduce rung (None while disengaged): restore returns to the
+        # operator's setting, never blindly to the construction-time
+        # ceiling — a manual set_spec_k survives a ladder excursion
+        self._spec_k_saved = None
         self._live: Dict[object, _Record] = {}
         # one reentrant lock serializes every pool mutation: submit and
         # cancel may race the background step loop; in pump mode it is
@@ -243,6 +340,39 @@ class ServingEngine:
             "ticks that exceeded the supervisor's stall timeout")
         self._c_tokens = m.counter(
             "serving_tokens_emitted_total", "tokens streamed to callers")
+        # traffic-grade scheduling surface (docs §5j): preemption /
+        # spill-tier / degradation accounting.  The spill gauges exist
+        # only on paged pools (the spill tier is block-granular), like
+        # the free-block gauge; the ladder gauge only when degrade=True
+        self._c_preempts = m.counter(
+            "serving_preemptions_total",
+            "active requests evicted mid-decode (K/V spilled to the "
+            "host-RAM tier)")
+        self._c_resumes = m.counter(
+            "serving_resumes_total",
+            "preempted requests resumed (K/V re-mapped or paged back "
+            "in from host RAM)")
+        self._c_spill_bytes = m.counter(
+            "serving_spill_bytes_total",
+            "K/V bytes copied device-to-host at preemption (int8 "
+            "caches count int8 K/V + fp32 scales)")
+        self._c_tightened = m.counter(
+            "serving_admission_tightened_total",
+            "submits shed below the priority floor while the "
+            "degradation ladder holds tighten-admission")
+        self._g_preempted = m.gauge(
+            "serving_preempted_requests",
+            "live requests currently parked in the spill tier")
+        self._g_spilled_blocks = m.gauge(
+            "serving_spilled_blocks",
+            "paged KV blocks in the reclaimable spilled tier "
+            "(device-resident copies of preempted requests' K/V)") \
+            if self._pool.cache_layout == "paged" else None
+        self._g_degrade = m.gauge(
+            "serving_degrade_level",
+            "degradation ladder level (0 normal, 1 preempt, "
+            "2 +reduce-spec-K, 3 +tighten-admission)") \
+            if self._degrade_on else None
         self._c_trace_dropped = m.counter(
             "serving_trace_events_dropped_total",
             "flight-recorder ring overflow: trace events evicted "
@@ -325,20 +455,33 @@ class ServingEngine:
         self._pool.on_admit = self._on_admit
         self._pool.on_token = self._on_token
         self._pool.on_finish = self._on_finish
+        self._pool.on_resume = self._on_resume
 
     # -- admission -------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int, request_id=None,
-               deadline_s: Optional[float] = None) -> ResponseStream:
+               deadline_s: Optional[float] = None, priority=0,
+               tenant=None) -> ResponseStream:
         """Admit one request; returns its :class:`ResponseStream`.
+
+        ``priority`` (an int, or a named class from
+        ``PRIORITY_CLASSES``: higher admits first, preempts last, and
+        survives admission tightening) and ``tenant`` (a hashable
+        fairness-cap key when the pool was built with
+        ``tenant_slot_cap=``) are scheduling metadata passed through to
+        the pool's candidate selection (docs/DESIGN.md §5j).
 
         Fails fast: :class:`QueueFullError` past ``max_queue`` waiting
         requests (retryable), :class:`DeadlineUnattainableError` when
         the observed tick rate says ``deadline_s`` cannot be met
-        (retryable, with a ``retry_after_s`` hint), the pool's typed
-        errors for invalid prompts/budgets/duplicate ids,
-        ``PreconditionNotMetError`` once draining.  ``deadline_s`` is a
-        wall-clock budget from NOW — queued or decoding, the request is
-        expired (slot and blocks freed) at the first tick past it."""
+        (retryable, with a ``retry_after_s`` hint),
+        :class:`AdmissionTightenedError` for below-floor priorities
+        while the degradation ladder holds its deepest rung
+        (retryable), the pool's typed errors for invalid
+        prompts/budgets/duplicate ids, ``PreconditionNotMetError`` once
+        draining.  ``deadline_s`` is a wall-clock budget from NOW —
+        queued or decoding, the request is expired (slot and blocks
+        freed) at the first tick past it."""
+        priority = _normalize_priority(priority)
         if deadline_s is not None and not (float(deadline_s) > 0):
             # `not (x > 0)` instead of `x <= 0`: NaN fails both
             # comparisons, and a NaN deadline would otherwise admit a
@@ -351,6 +494,24 @@ class ServingEngine:
                 raise PreconditionNotMetError(
                     "engine is draining/shut down: admissions are "
                     "stopped (drain()/shutdown() was called)")
+            if self._degrade_level >= 3 and priority < self._degrade_floor:
+                # tighten-admission rung: below-floor traffic is shed at
+                # the door while both burn windows say the engine cannot
+                # keep its promises at current load — the ladder's last
+                # defensive move before the only option is queue growth
+                self._c_tightened.inc()
+                trace.instant("req.shed", rid=request_id,
+                              priority=priority, tightened=True)
+                slog.emit("req.shed", rid=request_id, priority=priority,
+                          tightened=True,
+                          degrade_level=self._degrade_level)
+                raise AdmissionTightenedError(
+                    "admission tightened: the degradation ladder is at "
+                    "level %d (SLO burn alert active) and priority %d "
+                    "is below the floor %d; retry when the alert "
+                    "clears, or submit at/above the floor"
+                    % (self._degrade_level, priority,
+                       self._degrade_floor))
             depth = self._pool.queue_depth
             if depth >= self.max_queue:
                 self._c_rejected.inc()
@@ -380,18 +541,22 @@ class ServingEngine:
                            max(0.001, est - float(deadline_s))),
                         retry_after_s=max(0.001, est - float(deadline_s)))
             now = self._clock()
+            deadline_abs = None if deadline_s is None \
+                else now + float(deadline_s)
             rid = self._pool.submit(ids, max_new_tokens,
-                                    request_id=request_id)
+                                    request_id=request_id,
+                                    priority=priority, tenant=tenant,
+                                    deadline=deadline_abs)
             stream = ResponseStream(self, rid, int(max_new_tokens))
             self._live[rid] = _Record(
                 rid, stream, ids.astype(np.int32), int(max_new_tokens),
-                None if deadline_s is None else now + float(deadline_s),
-                now)
+                deadline_abs, now, priority=priority, tenant=tenant)
             self._c_submitted.inc()
             trace.instant("req.queued", rid=rid,
                           prompt_tokens=int(ids.shape[0]),
                           max_new_tokens=int(max_new_tokens),
-                          deadline_s=deadline_s)
+                          deadline_s=deadline_s,
+                          priority=priority or None, tenant=tenant)
             # the req.admitted log line is emitted at POOL admission
             # (_on_admit, when the request takes a slot): only there is
             # the prefix-hit outcome known, and the line must carry it
@@ -461,6 +626,209 @@ class ServingEngine:
         # while rec.tokens carries the request's full committed output
         # (identical to `tokens` when no recovery happened)
         self._finalize(rec, RequestState.DONE, reason, rec.tokens)
+
+    def _on_resume(self, rid, info):
+        """Pool hook: a preempted request's K/V were restored and its
+        slot re-activated (fires inside ``pool.step``'s refill, under
+        the engine lock).  The decision is logged at the moment it
+        happened, joined to the current trace tick."""
+        rec = self._live.get(rid)
+        if rec is None:
+            return
+        rec.state = RequestState.DECODING
+        self._c_resumes.inc()
+        now = self._clock()
+        wait_s = None if rec.preempted_at is None \
+            else round(now - rec.preempted_at, 6)
+        rec.preempted_at = None
+        # restart the inter-token clock at the RESUME moment: the
+        # parked wait is scheduler time, not decode cadence — without
+        # this, the first post-resume token would observe the whole
+        # park as one inter_token latency, and a ladder that preempts
+        # would feed its own SLO alert the violation that keeps it
+        # preempting (self-sustaining degradation)
+        if rec.last_t is not None:
+            rec.last_t = now
+        trace.instant("sched.resume", rid=rid, slot=info.get("slot"),
+                      blocks_remapped=info.get("blocks_remapped"),
+                      blocks_uploaded=info.get("blocks_uploaded"),
+                      wait_s=wait_s)
+        slog.emit("sched.resume", rid=rid, slot=info.get("slot"),
+                  blocks_remapped=info.get("blocks_remapped"),
+                  blocks_uploaded=info.get("blocks_uploaded"),
+                  committed_tokens=info.get("committed_tokens"),
+                  wait_s=wait_s)
+
+    # -- preemption + the degradation ladder (docs §5j) ------------------
+    def preempt(self, request_id=None, reason: str = "manual"):
+        """Evict one actively-decoding request into the host-RAM spill
+        tier; it resumes automatically (byte-identically) when the
+        scheduler next has capacity for it.
+
+        With ``request_id=None`` the engine auto-selects the victim —
+        the LOWEST-priority decoding request, youngest first (the least
+        important, least-invested work parks) — and returns its id, or
+        None when nothing is preemptable (no decoding request passes
+        ``pool.can_preempt``).  With an explicit id, typed errors
+        propagate: ``NotFoundError`` for unknown/non-decoding requests,
+        the pool's preconditions otherwise."""
+        with self._lock:
+            if request_id is None:
+                victims = [r for r in self._live.values()
+                           if r.state == RequestState.DECODING
+                           and self._pool.can_preempt(r.rid)]
+                if not victims:
+                    return None
+                rec = min(victims,
+                          key=lambda r: (r.priority, -r.submit_t))
+            else:
+                rec = self._live.get(request_id)
+                if rec is None:
+                    raise NotFoundError(
+                        "request_id %r is not live on this engine"
+                        % (request_id,))
+            return self._do_preempt(rec, reason)
+
+    def _do_preempt(self, rec: _Record, reason: str):
+        """Preempt ``rec`` (caller holds the lock): spill via the pool,
+        flip the record to PREEMPTED, and make the decision auditable —
+        one flight-recorder event and one structured-log line, both
+        carrying the tick join key."""
+        info = self._pool.preempt(rec.rid)
+        rec.state = RequestState.PREEMPTED
+        rec.preempts += 1
+        rec.preempted_at = self._clock()
+        self._c_preempts.inc()
+        self._c_spill_bytes.inc(info["spill_bytes"])
+        trace.instant("sched.preempt", rid=rec.rid, reason=reason,
+                      priority=rec.priority,
+                      committed_tokens=info["committed_tokens"],
+                      blocks_spilled=info["blocks_spilled"],
+                      spill_bytes=info["spill_bytes"])
+        slog.emit("sched.preempt", rid=rec.rid, reason=reason,
+                  priority=rec.priority, tenant=rec.tenant,
+                  committed_tokens=info["committed_tokens"],
+                  blocks_spilled=info["blocks_spilled"],
+                  blocks_freed=info["blocks_freed"],
+                  spill_bytes=info["spill_bytes"],
+                  degrade_level=self._degrade_level or None)
+        return rec.rid
+
+    def _degrade_eval(self) -> None:
+        """One ladder evaluation per tick (caller holds the lock; runs
+        BEFORE the pool step so a preemption frees capacity the same
+        tick's refill can hand to waiting high-priority work).
+
+        Step DOWN one level per alerting tick once ``dwell`` ticks have
+        passed since the last change; step back UP one level after
+        ``clear`` consecutive alert-free ticks.  Rungs are cumulative:
+        1 preempt-for-priority, 2 +reduce spec-K to 1 (speculative
+        pools), 3 +tighten admission below the priority floor.  Every
+        transition emits ``sched.degrade``/``sched.restore`` to the
+        flight recorder and the structured log."""
+        if not self._degrade_on:
+            return
+        alerting = self._slo.alerting_names()
+        self._degrade_ticks_since_change += 1
+        if alerting:
+            self._degrade_clean_ticks = 0
+            if self._degrade_level < self._degrade_max and \
+                    self._degrade_ticks_since_change >= self._degrade_dwell:
+                self._set_degrade_level(self._degrade_level + 1, alerting)
+        else:
+            self._degrade_clean_ticks += 1
+            if self._degrade_level > 0 and \
+                    self._degrade_clean_ticks >= self._degrade_clear:
+                self._set_degrade_level(self._degrade_level - 1, alerting)
+                self._degrade_clean_ticks = 0
+        if self._degrade_level >= 1:
+            self._preempt_for_priority()
+
+    def _set_degrade_level(self, level: int, alerting) -> None:
+        prev, self._degrade_level = self._degrade_level, level
+        self._degrade_ticks_since_change = 0
+        self._degrade_transitions += 1
+        actions = []
+        if level >= 1:
+            actions.append("preempt-low-priority")
+        spec = getattr(self._pool, "set_spec_k", None)
+        if spec is not None and self._spec_k_full is not None \
+                and self._spec_k_full > 1:
+            if level >= 2 and prev < 2:
+                # engage the rung: remember the OPERATOR's runtime
+                # setting (which may itself be a manual set_spec_k
+                # tune) and drop to 1 — restore must return there, not
+                # to the construction-time ceiling
+                self._spec_k_saved = self._pool.spec_k_active
+                if self._spec_k_saved != 1:
+                    spec(1)
+                    actions.append("spec_k->1")
+            elif level < 2 and prev >= 2 \
+                    and self._spec_k_saved is not None:
+                if self._pool.spec_k_active == 1 \
+                        and self._spec_k_saved != 1:
+                    # only undo the LADDER's own setting: an operator
+                    # who re-tuned mid-degradation wins
+                    spec(self._spec_k_saved)
+                    actions.append("spec_k->%d" % self._spec_k_saved)
+                self._spec_k_saved = None
+        if level >= 3:
+            actions.append("admission-floor>=%d" % self._degrade_floor)
+        if self._g_degrade is not None:
+            self._g_degrade.set(level)
+        event = "sched.degrade" if level > prev else "sched.restore"
+        trace.instant(event, level=level, prev=prev,
+                      alerting=list(alerting) or None)
+        slog.emit(event, level=level, prev=prev,
+                  alerting=list(alerting) or None,
+                  actions=actions or None)
+
+    def _preempt_for_priority(self) -> None:
+        """The preempt rung: evict ONE low-priority decoding request
+        per tick, and only when it actually buys something — a
+        STRICTLY-higher-priority request is waiting AND the pool is out
+        of slots (or its chosen candidate is block-starved).  Bounded
+        and purposeful, so the ladder cannot thrash the spill tier."""
+        pool = self._pool
+        # only requests the refill could actually ADMIT justify a
+        # victim: a tenant at its fairness cap is deferred by
+        # _pick_candidate, and preempting for it would just thrash the
+        # spill tier (preempt, then resume the victim into the slot
+        # the capped request cannot take)
+        queued = [r for r in self._live.values()
+                  if r.state == RequestState.QUEUED
+                  and not pool.tenant_at_cap(r.tenant)]
+        if not queued:
+            return
+        if pool.active_count + pool.prefilling_count < pool.slots \
+                and not pool.admission_blocked:
+            return
+        pmax = max(r.priority for r in queued)
+        victims = [r for r in self._live.values()
+                   if r.state == RequestState.DECODING
+                   and r.priority < pmax
+                   and pool.can_preempt(r.rid)]
+        if not victims:
+            return
+        rec = min(victims, key=lambda r: (r.priority, -r.submit_t))
+        self._do_preempt(rec, "degrade")
+
+    def degradation_snapshot(self) -> dict:
+        """The ladder's state — folded into ``GET /slo`` and readable
+        directly; ``enabled=False`` with zeros when no ladder was
+        configured."""
+        out = {"enabled": self._degrade_on,
+               "level": self._degrade_level,
+               "max_level": self._degrade_max,
+               "admit_floor": self._degrade_floor,
+               "transitions": self._degrade_transitions,
+               "preempted_requests": sum(
+                   1 for r in self._live.values()
+                   if r.state == RequestState.PREEMPTED)}
+        if self._spec_k_full is not None:
+            out["spec_k_active"] = self._pool.spec_k_active
+            out["spec_k_full"] = self._spec_k_full
+        return out
 
     # -- lifecycle transitions -------------------------------------------
     def _finalize(self, rec: _Record, state: str, reason: str, tokens,
@@ -569,12 +937,20 @@ class ServingEngine:
             try:
                 ids = rec.prompt if not rec.tokens else np.concatenate(
                     [rec.prompt, np.asarray(rec.tokens, np.int32)])
+                # scheduling metadata survives recovery: a resubmitted
+                # victim keeps its class/tenant/deadline — including
+                # PREEMPTED victims, whose spill-tier copies died with
+                # the pool (prompt+committed is the recovery source)
                 self._pool.submit(ids, rec.max_new - len(rec.tokens),
-                                  request_id=rec.rid)
+                                  request_id=rec.rid,
+                                  priority=rec.priority,
+                                  tenant=rec.tenant,
+                                  deadline=rec.deadline_abs)
             except Exception as sub_exc:  # noqa: BLE001 - per-victim
                 self._fail_record(rec, sub_exc, "resubmit failed")
                 continue
             rec.state = RequestState.QUEUED
+            rec.preempted_at = None
             self._live[rec.rid] = rec
             self._c_recovered.inc()
             trace.instant("recovery.resubmit", rid=rec.rid,
@@ -629,6 +1005,12 @@ class ServingEngine:
         self._health.note_tick_start(self._clock())
         try:
             self._expire()
+            # ladder BEFORE the pool step: it reads the alert state the
+            # previous tick's window roll produced, and a preemption it
+            # performs frees capacity THIS tick's refill can hand to
+            # waiting high-priority work — and it must also run on idle
+            # ticks, or a drained engine could never step back up
+            self._degrade_eval()
             if not self._live:
                 self._observe_gauges()
                 return False
@@ -661,6 +1043,9 @@ class ServingEngine:
         self._g_kv_resident.set(stats["pool_bytes"])
         if self._g_kv_free is not None:
             self._g_kv_free.set(stats["free_blocks"])
+        self._g_preempted.set(pool.preempted_count)
+        if self._g_spilled_blocks is not None:
+            self._g_spilled_blocks.set(stats["spilled_blocks"])
         if self._g_accept is not None:
             self._g_accept.set(
                 pool.acceptance_stats()["acceptance_rate"])
@@ -829,6 +1214,13 @@ class ServingEngine:
                "queue_depth": self._pool.queue_depth,
                "loop_alive": loop_alive,
                "draining": self._draining,
+               # degradation is the system WORKING, not wedging: a
+               # degraded-but-serving engine stays healthy/200 — the
+               # probe reads the level and the parked-victim count
+               # here, while 503 stays reserved for wedged/loop-dead/
+               # stopped (test-pinned)
+               "degraded": self._degrade_level,
+               "preempted_requests": self._pool.preempted_count,
                # birth + age on the engine's monotonic clock: a probe
                # distinguishes "just restarted" from "long-lived" at a
                # glance, and uptime_s is injected-clock-deterministic
@@ -850,11 +1242,16 @@ class ServingEngine:
         slot one token, so the backlog drains at ``slots`` tokens per
         tick and the new request then needs ``max_new_tokens`` ticks of
         its own.  Under chunked prefill, prompt work is ALSO tick work
-        the token backlog cannot see: each not-yet-decoding prompt
-        (plus this request's own) consumes ``ceil(len/C)`` serialized
-        chunk ticks, so they are added — a long-prompt burst must shed,
-        not admit-then-expire.  Deliberately simple and stated here so
-        the shed decision is auditable from the error message."""
+        the token backlog cannot see: chunks run ONE SLOT PER TICK
+        (``_chunk_work`` is FIFO-serialized), so each not-yet-decoding
+        prompt (plus this request's own) contributes its OWN
+        ``ceil(len/C)`` ticks — per-request ceils, never one ceil over
+        the summed lengths: ten queued 5-token prompts at C=16 cost
+        ten serialized chunk ticks where the summed form would claim
+        one, and exactly that under-estimate let bursty long-prompt
+        arrivals admit-then-expire instead of shedding at admission.
+        Deliberately simple and stated here so the shed decision is
+        auditable from the error message."""
         if not self._timer.total:
             return None
         step_s = self._timer.step_time
@@ -867,12 +1264,12 @@ class ServingEngine:
             # first_t-is-None: a recovery-resubmitted victim already
             # streamed tokens (first_t set) but still owes a FULL
             # re-prefill of prompt + committed through the chunk path
-            pending = prompt_len + sum(
+            pending = [prompt_len] + [
                 r.prompt_len + len(r.tokens)
                 for r in self._live.values()
                 if r.state in (RequestState.QUEUED,
-                               RequestState.PREFILLING))
-            ticks += -(-pending // chunk)
+                               RequestState.PREFILLING)]
+            ticks += sum(-(-p // chunk) for p in pending if p)
         return step_s * ticks
 
     # -- graceful teardown ----------------------------------------------
@@ -1067,7 +1464,11 @@ class ServingEngine:
                 "no SLO tracker is configured on this engine: pass "
                 "slo=serving.slo.SLOTracker([...objectives...]) at "
                 "construction to declare objectives")
-        return self._slo.snapshot()
+        snap = self._slo.snapshot()
+        # the closed loop rides the same body: what the alert is
+        # currently MAKING the engine do (docs §5j)
+        snap["degradation"] = self.degradation_snapshot()
+        return snap
 
     @property
     def slo(self):
@@ -1092,6 +1493,14 @@ class ServingEngine:
             # count: left at its old high-water mark, the next chunks
             # up to it would never reach serving_prefill_chunks_total
             self._chunks_seen = 0
+
+    def spill_stats(self) -> dict:
+        """Host-RAM spill-tier accounting
+        (``GenerationPool.spill_stats``): preempt/resume totals, parked
+        requests, device-resident spilled blocks vs host-only copies,
+        spill/upload byte totals — what the ``serving_spilled_*``
+        gauges and the overload bench leg stamp."""
+        return self._pool.spill_stats()
 
     def acceptance_stats(self) -> Optional[dict]:
         """Speculative acceptance accounting

@@ -5,7 +5,8 @@ the engine already does all the serving work — this module only maps
 HTTP onto ``ServingEngine.submit`` and ``metrics.render_prometheus``:
 
 - ``POST /generate`` — JSON body ``{"prompt": [ids...],
-  "max_new_tokens": n, "request_id"?: any, "deadline_s"?: s}``; the
+  "max_new_tokens": n, "request_id"?: any, "deadline_s"?: s,
+  "priority"?: int | "low" | "normal" | "high", "tenant"?: str}``; the
   response STREAMS one JSON line per token (``{"token": id}``,
   ``application/x-ndjson``) the moment the batched decode step emits
   it, then one terminal line carrying the ``StreamStatus`` record
@@ -34,10 +35,14 @@ HTTP onto ``ServingEngine.submit`` and ``metrics.render_prometheus``:
 Error mapping is the engine's typed-error vocabulary, not guesswork:
 ``InvalidArgumentError`` → 400, ``DuplicateRequestError`` → 409,
 ``QueueFullError`` → 503 with ``Retry-After`` (the engine's retryable
-backpressure signal, verbatim), ``DeadlineUnattainableError`` → 503
-with its own ``retry_after_s`` hint rounded up into ``Retry-After``,
-draining → 503 without one (a drained engine never reopens), anything
-else → 404/405.
+backpressure signal, verbatim), ``DeadlineUnattainableError`` and
+``AdmissionTightenedError`` (the degradation ladder shedding
+below-floor priorities) → 503 with ``Retry-After``, draining → 503
+without one (a drained engine never reopens), anything else →
+404/405.  A DEGRADED engine is a working engine: ``GET /healthz``
+stays 200 while the ladder is active and carries the ``degraded``
+level + ``preempted_requests`` in the snapshot; 503 remains reserved
+for wedged/loop-dead/stopped (docs/DESIGN.md §5j).
 
 Drive modes: with ``engine.start()`` (the owned step loop) handler
 threads just block on their streams — real serving.  Without it, the
@@ -60,8 +65,8 @@ from ..core.errors import (InvalidArgumentError, NotFoundError,
                            PreconditionNotMetError)
 from ..inference.generation import DuplicateRequestError
 from . import faults
-from .engine import (DeadlineUnattainableError, QueueFullError,
-                     ServingEngine)
+from .engine import (AdmissionTightenedError, DeadlineUnattainableError,
+                     QueueFullError, ServingEngine, _normalize_priority)
 
 __all__ = ["ServingHTTPFrontend", "parse_generate_request"]
 
@@ -73,14 +78,20 @@ _MAX_BODY_BYTES = 8 << 20
 
 
 def parse_generate_request(body: bytes) -> Tuple[np.ndarray, int,
-                                                 object, Optional[float]]:
+                                                 object, Optional[float],
+                                                 int, Optional[str]]:
     """Validate a ``POST /generate`` body into
-    ``(ids int32[L], max_new_tokens, request_id, deadline_s)``.
+    ``(ids int32[L], max_new_tokens, request_id, deadline_s, priority,
+    tenant)``.
 
-    Raises :class:`InvalidArgumentError` with an actionable message for
-    every malformed shape — the handler maps it to a 400 whose body the
-    caller can fix from.  Value-range checks (budget vs max_len, bucket
-    coverage, queue depth) stay with the engine, which owns them."""
+    ``priority`` accepts an int or a named class
+    (``PRIORITY_CLASSES``: "low"/"normal"/"high") and normalizes to the
+    int the scheduler orders by; ``tenant`` is an optional string
+    fairness-cap key.  Raises :class:`InvalidArgumentError` with an
+    actionable message for every malformed shape — the handler maps it
+    to a 400 whose body the caller can fix from.  Value-range checks
+    (budget vs max_len, bucket coverage, queue depth) stay with the
+    engine, which owns them."""
     try:
         payload = json.loads(body.decode("utf-8"))
     except (ValueError, UnicodeDecodeError) as e:
@@ -124,8 +135,19 @@ def parse_generate_request(body: bytes) -> Tuple[np.ndarray, int,
         raise InvalidArgumentError(
             "'request_id' must be a JSON string or number (or absent), "
             "got %s" % type(rid).__name__)
+    # one normalization rule for the HTTP boundary and the Python API:
+    # _normalize_priority already rejects unknown classes, bools (an
+    # int subclass — `true` would silently jump the queue) and floats
+    # with a 400-ready InvalidArgumentError naming the classes
+    priority = _normalize_priority(payload.get("priority", 0))
+    tenant = payload.get("tenant")
+    if tenant is not None and not isinstance(tenant, str):
+        raise InvalidArgumentError(
+            "'tenant' must be a JSON string fairness-cap key (or "
+            "absent), got %s" % type(tenant).__name__)
     return (np.asarray(prompt, np.int32), max_new, rid,
-            None if deadline is None else float(deadline))
+            None if deadline is None else float(deadline),
+            priority, tenant)
 
 
 def _make_handler(engine: ServingEngine, quiet: bool = True):
@@ -240,13 +262,16 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
                                                   _MAX_BODY_BYTES)})
                 return
             try:
-                ids, max_new, rid, deadline = parse_generate_request(
-                    self.rfile.read(length))
+                ids, max_new, rid, deadline, priority, tenant = \
+                    parse_generate_request(self.rfile.read(length))
                 stream = engine.submit(ids, max_new, request_id=rid,
-                                       deadline_s=deadline)
-            except DeadlineUnattainableError as e:
-                # deadline-aware load shedding: retryable, with the
-                # engine's own feasibility estimate as the hint
+                                       deadline_s=deadline,
+                                       priority=priority, tenant=tenant)
+            except (DeadlineUnattainableError,
+                    AdmissionTightenedError) as e:
+                # deadline-aware load shedding AND the degradation
+                # ladder's tighten-admission rung: both retryable, with
+                # the engine's own hint as Retry-After
                 self._send_json(
                     503, {"error": str(e), "retryable": True},
                     headers=(("Retry-After",

@@ -8,7 +8,10 @@ surface: each event is one JSON object per line carrying
 
 - ``ts`` (wall clock), ``event`` (dotted name: ``req.admitted``,
   ``req.terminal``, ``engine.recovery``, ``req.shed``,
-  ``engine.restart``, ``slo.alert``, ...);
+  ``engine.restart``, ``slo.alert``, and the scheduler's decision
+  records ``sched.preempt`` / ``sched.resume`` / ``sched.degrade`` /
+  ``sched.restore`` — every overload move the degradation ladder
+  makes is one greppable line, docs/DESIGN.md §5j);
 - ``rid`` when the event belongs to a request, plus the event's own
   fields (``state``/``finish_reason`` on terminals, counts on
   recoveries);

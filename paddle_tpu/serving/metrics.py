@@ -8,8 +8,14 @@ emits a request's first token, the robustness counters
 (``serving_requests_recovered_total``, ``serving_recoveries_total``,
 ``serving_requests_shed_total``, ``serving_engine_restarts_total``,
 ``serving_ticks_stalled_total``) increment inside the recovery /
-shedding / watchdog paths themselves (docs/DESIGN.md §5f), and KV-cache
-gauges read
+shedding / watchdog paths themselves (docs/DESIGN.md §5f), the
+scheduling surface (``serving_preemptions_total``,
+``serving_resumes_total``, ``serving_spill_bytes_total``,
+``serving_admission_tightened_total``, plus the
+``serving_preempted_requests`` / ``serving_spilled_blocks`` /
+``serving_degrade_level`` gauges) increments inside the preempt /
+resume / degradation-ladder decisions (docs/DESIGN.md §5j), and
+KV-cache gauges read
 ``cache_stats()`` (the allocator's own accounting) after every step —
 ``serving_kv_reachable_bytes`` (what a step can READ right now) and
 ``serving_kv_resident_bytes`` (the whole pool allocation), both

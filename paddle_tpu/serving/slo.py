@@ -351,6 +351,15 @@ class SLOTracker:
     def alerts_active(self) -> int:
         return sum(1 for st in self._states.values() if st.alert_active)
 
+    def alerting_names(self) -> List[str]:
+        """Names of objectives whose multi-window alert is ACTIVE right
+        now (both burn windows at/over the threshold) — the control
+        signal the serving engine's degradation ladder steps on.  Plain
+        attribute reads, safe from the tick path (one tuple walk per
+        tick when a ladder is configured)."""
+        return [name for name, st in self._states.items()
+                if st.alert_active]
+
     def health_summary(self) -> dict:
         """The compact record ``ServingEngine.health()`` folds in —
         plain-attribute reads only, safe lock-free during a wedge."""

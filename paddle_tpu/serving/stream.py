@@ -34,11 +34,17 @@ __all__ = ["RequestState", "ResponseStream", "StreamStatus"]
 
 
 class RequestState:
-    """Request lifecycle: QUEUED → PREFILLING → DECODING → terminal."""
+    """Request lifecycle: QUEUED → PREFILLING → DECODING → terminal.
+
+    ``PREEMPTED`` is a NON-terminal detour off DECODING: the scheduler
+    evicted the request mid-decode (its K/V spilled to the host tier)
+    and will resume it — the stream stays open, tokens already
+    delivered stand, and the request returns to DECODING at resume."""
 
     QUEUED = "QUEUED"
     PREFILLING = "PREFILLING"
     DECODING = "DECODING"
+    PREEMPTED = "PREEMPTED"
     DONE = "DONE"
     CANCELLED = "CANCELLED"
     EXPIRED = "EXPIRED"

@@ -13,9 +13,12 @@ tick, and WHAT happened in the moments before a wedge.  Two pieces:
 - :class:`Tracer` — the emitter the instrumented code paths talk to:
   ``span(name)`` context managers for the tick phases (admit / prefill
   / decode step / sample / deliver) and ``instant(name)`` marks for the
-  request lifecycle (QUEUED→PREFILLING→DECODING→terminal), compile
-  events, fault injections, recoveries, shed decisions, and supervisor
-  stall/restart actions.
+  request lifecycle (QUEUED→PREFILLING→DECODING→terminal, plus the
+  PREEMPTED detour), compile events, fault injections, recoveries,
+  shed decisions, supervisor stall/restart actions, and the
+  degradation ladder's scheduler decisions (``sched.preempt`` /
+  ``sched.resume`` / ``sched.degrade`` / ``sched.restore`` — every
+  overload move lands in the ring with its tick, docs/DESIGN.md §5j).
 
 Tracing OFF is a module-level no-op on the hot path — the same pattern
 as the fault plane (``serving.faults``): call sites check one module

@@ -338,6 +338,69 @@ def test_serving_prefix_leg_gate():
     assert not ok and "cache_layout" in why
 
 
+def test_serving_overload_leg_gate():
+    """The overload leg's structural gate: the degraded sub-leg must
+    say what the ladder DID (preempt/resume/spill stamps), both
+    sub-legs must carry the SLO burn stamp, and the usual cache
+    provenance applies — a closed-loop claim without the loop's own
+    evidence must never promote."""
+    sub = {"cache_layout": "paged", "cache_dtype": "float32",
+           "ttft_p99_high_s": 0.02, "slo_ttft_burn_slow_max": 4.0}
+    good = {"input_staged": False, "transfer_note": "same traffic",
+            "degrade_on": dict(sub, preemptions=2, resumes=2,
+                               spill_bytes_total=4096),
+            "degrade_off": dict(sub)}
+    ok, why = bench._leg_promotable("serving_overload", good)
+    assert ok, why
+    # degraded sub-leg without the ladder's own evidence: rejected
+    unproven = {"input_staged": False, "transfer_note": "x",
+                "degrade_on": dict(sub),
+                "degrade_off": dict(sub)}
+    ok, why = bench._leg_promotable("serving_overload", unproven)
+    assert not ok and "preempt" in why and "degrade_on" in why
+    # either sub-leg missing the burn stamp: rejected
+    unburned = {"input_staged": False, "transfer_note": "x",
+                "degrade_on": dict(good["degrade_on"]),
+                "degrade_off": {"cache_layout": "paged",
+                                "cache_dtype": "float32",
+                                "ttft_p99_high_s": 0.03}}
+    ok, why = bench._leg_promotable("serving_overload", unburned)
+    assert not ok and "slo_ttft_burn_slow_max" in why
+    # missing cache provenance rejects like every serving leg
+    nostamp = {"input_staged": False, "transfer_note": "x",
+               "degrade_on": {"ttft_p99_high_s": 0.02,
+                              "preemptions": 1, "resumes": 1,
+                              "spill_bytes_total": 1,
+                              "slo_ttft_burn_slow_max": 1.0}}
+    ok, why = bench._leg_promotable("serving_overload", nostamp)
+    assert not ok and "cache_layout" in why
+
+
+@pytest.mark.slow
+def test_live_serving_overload_leg_passes_its_own_gate():
+    """The leg bench.py actually emits must satisfy its own gate AND
+    the §5j acceptance contract: high-priority p99 TTFT strictly
+    better with degradation on, on identical traffic, with the ladder
+    provably engaged — slow-marked (calibration + both modes)."""
+    import jax
+
+    import paddle_tpu as pt
+
+    leg = bench.bench_serving_overload(pt, jax, False)
+    ok, why = bench._leg_promotable("serving_overload", leg)
+    assert ok, why
+    on, off = leg["degrade_on"], leg["degrade_off"]
+    # the ladder ENGAGED on: preemptions happened, and off did nothing
+    assert on["preemptions"] >= 1
+    assert off["preemptions"] == 0
+    # the acceptance headline: strictly better high-priority p99 TTFT
+    assert on["ttft_p99_high_s"] < off["ttft_p99_high_s"]
+    assert leg["ttft_p99_high_improvement_pct"] > 0
+    # the burn drop is stamped (the SLO plane saw the same story)
+    assert "slo_burn_drop" in leg
+    assert on["slo_ttft_burn_slow_max"] <= off["slo_ttft_burn_slow_max"]
+
+
 @pytest.mark.slow
 def test_live_serving_prefix_leg_passes_its_own_gate():
     """The leg bench.py actually emits must satisfy its own gate (a

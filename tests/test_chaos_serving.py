@@ -124,6 +124,126 @@ def test_chaos_invariants_hold(model, seed):
     assert eng.compile_counts() == clean_counts
 
 
+def _bursty_schedule(seed):
+    """ON/OFF arrival phases (the bursty traffic shape §5j is for):
+    per tick, either a burst of low-priority arrivals (ON) or silence
+    (OFF), with sporadic high-priority arrivals riding on top.
+    Returns [(tick, rid, prompt, budget, priority), ...] — identical
+    for the clean and chaotic runs by construction."""
+    rng = np.random.RandomState(1000 + seed)
+    plan, rid = [], 0
+    tick = 0
+    for phase in range(3):
+        on_len = 2 + rng.randint(2)
+        for t in range(on_len):  # ON: low-priority burst
+            for _ in range(1 + rng.randint(2)):
+                plan.append((tick + t, "b%d" % rid,
+                             rng.randint(0, 128, (4 + rng.randint(6),))
+                             .astype("int32"),
+                             3 + rng.randint(4), -1))
+                rid += 1
+        if rng.rand() < 0.8:  # a high-priority request mid-burst
+            plan.append((tick + rng.randint(on_len), "h%d" % rid,
+                         rng.randint(0, 128, (5,)).astype("int32"),
+                         3 + rng.randint(3), 1))
+            rid += 1
+        tick += on_len + 2 + rng.randint(3)  # OFF gap
+    return plan
+
+
+def _drive_bursty(eng, plan, preempt_every=None):
+    """Pump tick-by-tick, submitting arrivals on schedule; optionally
+    preempt the auto-selected victim every N ticks (the §5j scripted-
+    preemption axis).  Bounded — a wedge fails, never hangs."""
+    streams = {}
+    horizon = max(t for t, *_ in plan)
+    tick = 0
+    work = True
+    while work or tick <= horizon:
+        for (t, rid, prompt, budget, prio) in plan:
+            if t == tick:
+                streams[rid] = eng.submit(prompt, budget,
+                                          request_id=rid, priority=prio)
+        if preempt_every and tick and tick % preempt_every == 0:
+            eng.preempt()  # None when nothing is preemptable
+        work = eng.pump(1)
+        tick += 1
+        assert tick < 700, "bursty chaos run failed to drain: wedged"
+        # invariant: the allocator partition is exact EVERY tick, not
+        # just at drain — free + resident + spilled + scratch
+        stats = eng.cache_stats()
+        assert stats["free_blocks"] + stats["mapped_blocks"] \
+            + stats["spilled_blocks"] + 1 == stats["num_blocks"]
+    return streams
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_bursty_chaos_with_preemption(model, seed):
+    """The §5j capstone: bursty ON/OFF mixed-priority traffic, seeded
+    chaos faults AND scripted preemptions — survivors (including
+    preempted-then-resumed and preempted-then-recovered ones) finish
+    byte-identical to a calm run, the spill tier reconciles with the
+    allocator every tick, nothing hangs, and the counters close."""
+    plan = _bursty_schedule(seed)
+
+    clean = _engine(model)
+    baseline = clean.cache_stats()
+    clean_streams = _drive_bursty(clean, plan)
+    want = {rid: s.result(timeout_s=0).tokens
+            for rid, s in clean_streams.items()}
+    clean_counts = clean.compile_counts()
+
+    eng = _engine(model)
+    plane = FaultPlane(chaos_seed=seed, chaos_p=0.05,
+                       chaos_points=CHAOS_POINTS, max_faults=MAX_FAULTS)
+    with faults.injected(plane):
+        streams = _drive_bursty(eng, plan, preempt_every=3)
+
+    for rid, s in streams.items():
+        st = s.result(timeout_s=0)
+        assert st.state == RequestState.DONE, (seed, rid, st.state,
+                                               st.error)
+        np.testing.assert_array_equal(st.tokens, want[rid])
+
+    stats = eng.cache_stats()
+    assert stats["mapped_blocks"] == 0 and stats["spilled_blocks"] == 0
+    assert stats["free_blocks"] == baseline["free_blocks"]
+    assert eng.live_requests == 0 and eng.queue_depth == 0
+
+    snap = eng.metrics.snapshot()
+    assert snap["serving_requests_submitted_total"] == len(plan)
+    assert snap["serving_requests_completed_total"] == len(plan)
+    assert snap["serving_requests_failed_total"] == 0
+    # recovery re-emits nothing and resume re-emits nothing: emitted
+    # tokens == the sum of terminal outputs
+    assert snap["serving_tokens_emitted_total"] == \
+        sum(len(w) for w in want.values())
+    # preemptions park and resumes un-park: every parked request came
+    # back (or was resubmitted by recovery) — none left behind
+    assert snap["serving_preemptions_total"] >= \
+        snap["serving_resumes_total"]
+    assert eng.spill_stats()["spilled_requests"] == 0
+
+    # preemption + spill/resume is host-side only: compile counts match
+    # the calm run even with chaos recovery in the mix
+    assert eng.compile_counts() == clean_counts
+
+
+def test_bursty_sweep_actually_preempts_and_resumes(model):
+    # the 5-seed bursty sweep must exercise the §5j machinery, not
+    # vacuously pass: across seeds, at least one preemption AND one
+    # zero-copy-or-upload resume actually happened (deterministic —
+    # the schedule and the preempt cadence are seeded)
+    preempts = resumes = 0
+    for seed in (0, 1, 2, 3, 4):
+        eng = _engine(model)
+        _drive_bursty(eng, _bursty_schedule(seed), preempt_every=3)
+        snap = eng.metrics.snapshot()
+        preempts += snap["serving_preemptions_total"]
+        resumes += snap["serving_resumes_total"]
+    assert preempts >= 1 and resumes >= 1
+
+
 def test_chaos_across_seeds_actually_injects(model):
     # the 5-seed sweep must EXERCISE the machinery, not vacuously pass:
     # at least one seed's plane fires at least one mid-flight fault.

@@ -27,6 +27,7 @@ SCRIPTS = [
     ("12_tracing.py", ["--tokens", "8"]),
     ("13_observatory.py", ["--tokens", "8"]),
     ("14_prefix_serving.py", ["--tokens", "8"]),
+    ("15_overload_serving.py", ["--tokens", "8"]),
 ]
 
 

@@ -36,15 +36,21 @@ def model():
 # -- request parsing (pure, no engine) -----------------------------------
 
 def test_parse_generate_request_valid():
-    ids, max_new, rid, deadline = parse_generate_request(
+    ids, max_new, rid, deadline, prio, tenant = parse_generate_request(
         json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 4,
-                    "request_id": "job-1", "deadline_s": 2.5}).encode())
+                    "request_id": "job-1", "deadline_s": 2.5,
+                    "priority": "high", "tenant": "acme"}).encode())
     np.testing.assert_array_equal(ids, [1, 2, 3])
     assert ids.dtype == np.int32
     assert max_new == 4 and rid == "job-1" and deadline == 2.5
-    ids, max_new, rid, deadline = parse_generate_request(
+    assert prio == 1 and tenant == "acme"  # named class normalized
+    ids, max_new, rid, deadline, prio, tenant = parse_generate_request(
         b'{"prompt": [7], "max_new_tokens": 1}')
     assert rid is None and deadline is None
+    assert prio == 0 and tenant is None
+    # raw integer priorities pass through unmapped
+    assert parse_generate_request(
+        b'{"prompt": [7], "max_new_tokens": 1, "priority": -3}')[4] == -3
 
 
 def test_parse_generate_request_malformed():
@@ -70,7 +76,15 @@ def test_parse_generate_request_malformed():
                       (b'{"prompt": [1], "max_new_tokens": 2, '
                        b'"request_id": {"a": 1}}', "request_id"),
                       (b'{"prompt": [1], "max_new_tokens": 2, '
-                       b'"request_id": [1]}', "request_id")):
+                       b'"request_id": [1]}', "request_id"),
+                      (b'{"prompt": [1], "max_new_tokens": 2, '
+                       b'"priority": "urgent"}', "priority"),
+                      (b'{"prompt": [1], "max_new_tokens": 2, '
+                       b'"priority": true}', "priority"),
+                      (b'{"prompt": [1], "max_new_tokens": 2, '
+                       b'"priority": 1.5}', "priority"),
+                      (b'{"prompt": [1], "max_new_tokens": 2, '
+                       b'"tenant": 7}', "tenant")):
         with pytest.raises(InvalidArgumentError, match=why):
             parse_generate_request(body)
 
@@ -292,6 +306,52 @@ def test_slo_endpoint(model):
     health = json.loads(_http(eng, "GET", "/healthz")[2])
     assert health["slo"] == {"alerts_active": 0, "alerting": [],
                              "ticks": tracker.ticks}
+
+
+def test_healthz_stays_200_while_degraded_and_carries_the_level(model):
+    # degradation is the system WORKING, not wedging: a degraded-but-
+    # serving engine answers 200, with the ladder level and the parked-
+    # victim count in the snapshot; 503 stays reserved for wedged/
+    # loop-dead/stopped (§5j satellite contract)
+    from paddle_tpu.serving import Objective, SLOTracker
+
+    eng = ServingEngine(
+        model, max_len=64, slots=1, buckets=[16],
+        slo=SLOTracker([Objective("ttft_p95", "ttft", 0.95,
+                                  threshold_s=0.5)],
+                       fast_window=2, slow_window=4),
+        degrade=True)
+    body = json.loads(_http(eng, "GET", "/healthz")[2])
+    assert body["degraded"] == 0 and body["preempted_requests"] == 0
+    # force the ladder to its deepest rung (the closed-loop path is
+    # pinned in tests/test_scheduling.py; this test pins the SURFACE)
+    eng._set_degrade_level(3, ["ttft_p95"])
+    stream = eng.submit(np.zeros(4, np.int32), 2, priority="high")
+    code, _, payload = _http(eng, "GET", "/healthz")
+    body = json.loads(payload)
+    assert code == 200 and body["healthy"] is True
+    assert body["state"] == "serving"
+    assert body["degraded"] == 3
+    # the /slo body carries what the alert is MAKING the engine do
+    slo_body = json.loads(_http(eng, "GET", "/slo")[2])
+    assert slo_body["degradation"]["level"] == 3
+    assert slo_body["degradation"]["enabled"] is True
+    # tighten-admission rung at the HTTP boundary: a below-floor
+    # submit is shed 503 + Retry-After, retryable, while the floor
+    # and above admit normally
+    code, headers, payload = _http(
+        eng, "POST", "/generate",
+        json.dumps({"prompt": [1, 2], "max_new_tokens": 2,
+                    "priority": "low"}).encode())
+    assert code == 503
+    assert "Retry-After" in headers
+    assert json.loads(payload)["retryable"] is True
+    assert b"tightened" in payload or b"ladder" in payload
+    assert eng.metrics.snapshot()[
+        "serving_admission_tightened_total"] == 1
+    while eng.pump(8):
+        pass
+    assert stream.result(timeout_s=0).state == "DONE"
 
 
 def test_debug_trace_and_flightrec_endpoints(model):

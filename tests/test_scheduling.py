@@ -1,0 +1,614 @@
+"""Traffic-grade scheduling: priorities, preemption + host-RAM KV
+spill, and the SLO-closed-loop degradation ladder (docs/DESIGN.md §5j).
+
+The contracts pinned here:
+
+1. admission order is (priority desc, deadline asc, arrival), with
+   per-tenant fairness caps — never strict FIFO once classes differ;
+2. preempt/spill/resume is BYTE-IDENTICAL for greedy requests, paged ×
+   fp32/int8, through both resume paths (zero-copy re-map of
+   still-resident spilled blocks AND host upload after reclaim), and
+   never compiles (``compile_counts()`` unchanged);
+3. the allocator partition is exact at every step:
+   ``free + resident + spilled + scratch == num_blocks``;
+4. the degradation ladder steps down while the SLO burn alert is
+   active (preempt low-priority → reduce spec-K → tighten admission)
+   and back up when it clears, and every decision is auditable from
+   the structured log and the flight recorder, joined by trace tick.
+"""
+import io
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import (InvalidArgumentError, NotFoundError,
+                                    PreconditionNotMetError)
+from paddle_tpu.inference import GenerationPool, SpeculativePool
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (AdmissionTightenedError, RequestState,
+                                ServingEngine, faults)
+from paddle_tpu.serving import log as slog
+from paddle_tpu.serving import trace as serving_trace
+from paddle_tpu.serving.slo import Objective, SLOTracker
+
+
+def _tiny_model(seed=0, **over):
+    pt.seed(seed)
+    cfg = dict(vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+               intermediate_size=64, max_position=256, causal=True,
+               dropout=0.0)
+    cfg.update(over)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 128, (n,)).astype("int32") for n in lens]
+
+
+def _partition_ok(stats):
+    return stats["free_blocks"] + stats["mapped_blocks"] \
+        + stats["spilled_blocks"] + 1 == stats["num_blocks"]
+
+
+# -- admission ordering --------------------------------------------------
+
+def test_priority_orders_admission(model):
+    pool = GenerationPool(model, max_len=64, slots=1, buckets=[32],
+                          cache_layout="paged", block_size=8)
+    p = _prompts(0, (5, 6, 7))
+    pool.submit(p[0], 4, request_id="first")
+    pool.step()  # "first" takes the only slot
+    pool.submit(p[1], 4, request_id="low", priority=-1)
+    pool.submit(p[2], 4, request_id="high", priority=2)
+    order = []
+    pool.on_admit = lambda rid, slot, n: order.append(rid)
+    while pool.step():
+        pass
+    # "high" submitted AFTER "low" but admitted before it
+    assert order == ["high", "low"]
+
+
+def test_deadline_breaks_priority_ties(model):
+    pool = GenerationPool(model, max_len=64, slots=1, buckets=[32],
+                          cache_layout="paged", block_size=8)
+    p = _prompts(1, (5, 6, 7))
+    pool.submit(p[0], 4, request_id="first")
+    pool.step()
+    pool.submit(p[1], 4, request_id="lax", deadline=50.0)
+    pool.submit(p[2], 4, request_id="tight", deadline=10.0)
+    order = []
+    pool.on_admit = lambda rid, slot, n: order.append(rid)
+    while pool.step():
+        pass
+    # same class: the earlier deadline wins the freed slot; a request
+    # with NO deadline sorts last (infinitely lax)
+    assert order == ["tight", "lax"]
+
+
+def test_tenant_slot_cap_bounds_one_tenant(model):
+    pool = GenerationPool(model, max_len=64, slots=2, buckets=[32],
+                          cache_layout="paged", block_size=8,
+                          tenant_slot_cap=1)
+    p = _prompts(2, (5, 5, 5, 6))
+    for i in range(3):
+        pool.submit(p[i], 6, request_id="a%d" % i, tenant="acme")
+    pool.submit(p[3], 6, request_id="b0", tenant="beta")
+    admitted = []
+    pool.on_admit = lambda rid, slot, n: admitted.append(rid)
+    pool.step()
+    # acme holds ONE slot despite arriving first with three requests;
+    # the second slot goes to beta past them
+    assert admitted == ["a0", "b0"]
+    while pool.step():
+        pass
+    assert sorted(admitted) == ["a0", "a1", "a2", "b0"]
+
+
+def test_tenant_cap_validation(model):
+    with pytest.raises(InvalidArgumentError, match="tenant_slot_cap"):
+        GenerationPool(model, max_len=64, slots=2, tenant_slot_cap=0)
+
+
+# -- preempt / spill / resume byte-identity ------------------------------
+
+@pytest.mark.parametrize("cache_dtype", ["float32", "int8"])
+def test_preempt_resume_byte_identity(model, cache_dtype):
+    p = _prompts(3, (5, 9, 7))
+
+    def mk():
+        return GenerationPool(model, max_len=64, slots=2, buckets=[32],
+                              cache_layout="paged", block_size=8,
+                              cache_dtype=cache_dtype)
+
+    ref = mk()
+    for i, ids in enumerate(p):
+        ref.submit(ids, 8, request_id=i)
+    want = ref.run()
+    counts = ref.compile_counts()
+
+    pool = mk()
+    for i, ids in enumerate(p):
+        pool.submit(ids, 8, request_id=i)
+    pool.step()
+    pool.step()
+    assert pool.can_preempt(0)
+    info = pool.preempt(0)
+    assert info["blocks_spilled"] >= 1 and info["spill_bytes"] > 0
+    assert _partition_ok(pool.cache_stats())
+    got = pool.run()
+    for i in want:
+        np.testing.assert_array_equal(got[i], want[i])
+    # preemption is host-side only: no executable was (re)compiled
+    assert pool.compile_counts() == counts
+    stats = pool.cache_stats()
+    assert stats["mapped_blocks"] == 0 and stats["spilled_blocks"] == 0
+    assert _partition_ok(stats)
+    sstats = pool.spill_stats()
+    assert sstats["preempts_total"] == 1
+    assert sstats["resumes_total"] == 1
+    assert sstats["spilled_requests"] == 0
+
+
+@pytest.mark.parametrize("cache_dtype", ["float32", "int8"])
+def test_reclaim_forces_upload_resume(model, cache_dtype):
+    # a block-hungry high-priority competitor RECLAIMS the victim's
+    # spilled device copies, so resume must page the K/V back in from
+    # host RAM — the upload path — still byte-identical
+    p = {"victim": _prompts(4, (9,))[0], "big": _prompts(5, (48,))[0]}
+
+    def mk():
+        return GenerationPool(model, max_len=64, slots=2,
+                              buckets=[32, 64], cache_layout="paged",
+                              block_size=8, num_blocks=9,
+                              cache_dtype=cache_dtype)
+
+    ref = mk()
+    ref.submit(p["victim"], 8, request_id="victim")
+    ref.submit(p["big"], 8, request_id="big")
+    want = ref.run()
+
+    pool = mk()
+    pool.submit(p["victim"], 8, request_id="victim")
+    pool.step()
+    pool.step()
+    pool.step()
+    pool.preempt("victim")
+    pool.submit(p["big"], 8, request_id="big", priority=5)
+    got = pool.run()
+    sstats = pool.spill_stats()
+    assert sstats["reclaims_total"] >= 1, "reclaim path not exercised"
+    assert sstats["upload_bytes_total"] > 0, "upload path not exercised"
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k])
+    assert _partition_ok(pool.cache_stats())
+
+
+def test_preempt_with_prefix_sharing(model):
+    # the victim maps SHARED prefix blocks: preempt decrefs them (the
+    # co-owner keeps them resident), resume restores the victim from
+    # its host copy — byte-identical, refcounts reconciled
+    rng = np.random.RandomState(6)
+    prefix = rng.randint(0, 128, (16,)).astype("int32")
+    prompts = [np.concatenate([prefix,
+                               rng.randint(0, 128, (4,)).astype("int32")])
+               for _ in range(2)]
+
+    def mk():
+        return GenerationPool(model, max_len=64, slots=2,
+                              cache_layout="paged", block_size=8,
+                              prefill_chunk_tokens=8, prefix_sharing=True)
+
+    ref = mk()
+    for i, ids in enumerate(prompts):
+        ref.submit(ids, 6, request_id=i)
+    want = ref.run()
+
+    pool = mk()
+    pool.submit(prompts[0], 6, request_id=0)
+    for _ in range(4):  # prefill r0 far enough to index the prefix
+        pool.step()
+    pool.submit(prompts[1], 6, request_id=1)  # admission matches it
+    for _ in range(6):
+        pool.step()
+        if pool.active_count == 2:
+            break
+    assert pool.cache_stats()["shared_blocks"] >= 1
+    victim = next(iter(pool._active.values())).rid
+    pool.preempt(victim)
+    assert _partition_ok(pool.cache_stats())
+    got = pool.run()
+    for i in want:
+        np.testing.assert_array_equal(got[i], want[i])
+    stats = pool.cache_stats()
+    assert stats["mapped_blocks"] == 0 and stats["shared_blocks"] == 0
+    assert _partition_ok(stats)
+
+
+def test_speculative_preempt_resume_and_runtime_spec_k(model):
+    def mk():
+        return SpeculativePool(model, model, max_len=64, spec_k=4,
+                               slots=2, buckets=[32, 64],
+                               cache_layout="paged", block_size=8)
+
+    p = _prompts(7, (5, 9))
+    ref = mk()
+    for i, ids in enumerate(p):
+        ref.submit(ids, 16, request_id=i)
+    want = ref.run()
+
+    pool = mk()
+    for i, ids in enumerate(p):
+        pool.submit(ids, 16, request_id=i)
+    pool.step()
+    pool.set_spec_k(2)  # the ladder's reduce-spec-K rung, mid-flight
+    assert pool.spec_k_active == 2
+    pool.preempt(0)
+    pool.step()
+    pool.set_spec_k(4)  # restore
+    got = pool.run()
+    for i in want:
+        np.testing.assert_array_equal(got[i], want[i])
+    assert _partition_ok(pool.cache_stats())
+    # self-draft acceptance stays perfect across preempt/resume: the
+    # draft twin was re-prefilled to the target's exact position
+    assert pool.acceptance_stats()["acceptance_rate"] == 1.0
+    with pytest.raises(InvalidArgumentError, match="ceiling"):
+        pool.set_spec_k(5)
+    with pytest.raises(InvalidArgumentError, match="ceiling"):
+        pool.set_spec_k(0)
+
+
+def test_preempt_typed_errors(model):
+    dense = GenerationPool(model, max_len=64, slots=1, buckets=[32])
+    dense.submit(np.zeros(4, np.int32), 4, request_id="r")
+    dense.step()
+    with pytest.raises(PreconditionNotMetError, match="paged"):
+        dense.preempt("r")
+    assert not dense.can_preempt("r")
+
+    paged = GenerationPool(model, max_len=64, slots=1, buckets=[32],
+                           cache_layout="paged", block_size=8)
+    paged.submit(np.zeros(4, np.int32), 4, request_id="q")
+    with pytest.raises(NotFoundError, match="not actively decoding"):
+        paged.preempt("q")  # still queued
+    with pytest.raises(NotFoundError, match="not actively decoding"):
+        paged.preempt("ghost")
+
+
+def test_cancel_and_expire_free_the_spill_tier(model):
+    clock = FakeClock()
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[32],
+                        cache_layout="paged", block_size=8, clock=clock)
+    baseline = eng.cache_stats()["free_blocks"]
+    a = eng.submit(_prompts(8, (6,))[0], 10, deadline_s=5.0)
+    eng.pump(2)
+    assert eng.preempt(a.request_id) == a.request_id
+    assert eng.request_state(a.request_id) == RequestState.PREEMPTED
+    stats = eng.cache_stats()
+    assert stats["spilled_blocks"] >= 1 and _partition_ok(stats)
+    # expiry reaches a PARKED request too: the deadline sweep cancels
+    # through the pool's "preempted" path, freeing the tier in place
+    clock.advance(6.0)
+    eng.pump(1)
+    assert a.result(timeout_s=0).state == RequestState.EXPIRED
+    stats = eng.cache_stats()
+    assert stats["spilled_blocks"] == 0
+    assert stats["free_blocks"] == baseline
+    assert _partition_ok(stats)
+
+    b = eng.submit(_prompts(9, (6,))[0], 10)
+    eng.pump(2)
+    eng.preempt(b.request_id)
+    assert eng.cancel(b.request_id) is True
+    assert b.result(timeout_s=0).state == RequestState.CANCELLED
+    stats = eng.cache_stats()
+    assert stats["spilled_blocks"] == 0 and _partition_ok(stats)
+
+
+def test_engine_auto_victim_is_lowest_priority_youngest(model):
+    eng = ServingEngine(model, max_len=64, slots=3, buckets=[32],
+                        cache_layout="paged", block_size=8)
+    streams = {
+        "hi": eng.submit(_prompts(10, (5,))[0], 12, request_id="hi",
+                         priority=1),
+        "old-low": eng.submit(_prompts(11, (5,))[0], 12,
+                              request_id="old-low", priority=-1),
+        "new-low": eng.submit(_prompts(12, (5,))[0], 12,
+                              request_id="new-low", priority=-1),
+    }
+    eng.pump(2)
+    assert eng.preempt() == "new-low"  # lowest class, youngest first
+    assert eng.request_state("new-low") == RequestState.PREEMPTED
+    ms = eng.metrics.snapshot()
+    assert ms["serving_preemptions_total"] == 1
+    assert ms["serving_spill_bytes_total"] > 0
+    while eng.pump(16):
+        pass
+    assert all(s.result(timeout_s=0).state == RequestState.DONE
+               for s in streams.values())
+    assert eng.metrics.snapshot()["serving_resumes_total"] == 1
+
+
+def test_engine_preempt_on_dense_pool_returns_none(model):
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[32])
+    eng.submit(np.zeros(4, np.int32), 8)
+    eng.pump(2)
+    assert eng.preempt() is None  # nothing preemptable: dense pool
+
+
+# -- the degradation ladder (SLO-closed loop) ----------------------------
+
+def _ladder_engine(model, clock, draft=None, **over):
+    slo = SLOTracker([Objective("ttft_p95", "ttft", 0.5,
+                                threshold_s=0.05)],
+                     fast_window=2, slow_window=4)
+    kw = dict(max_len=64, slots=2, buckets=[32, 64], clock=clock,
+              cache_layout="paged", block_size=8, slo=slo, degrade=True,
+              degrade_dwell_ticks=1, degrade_clear_ticks=2)
+    kw.update(over)
+    if draft is not None:
+        kw.update(draft_model=draft, spec_k=4)
+    return ServingEngine(model, **kw)
+
+
+def test_ladder_steps_down_preempts_and_restores(model):
+    clock = FakeClock()
+    eng = _ladder_engine(model, clock)
+    buf = io.StringIO()
+    tracer = eng.start_trace()
+    try:
+        with slog.logging_to(buf):
+            for i in range(3):
+                eng.submit(_prompts(13 + i, (6,))[0], 20, priority=-1,
+                           request_id="low%d" % i)
+            for _ in range(3):  # every TTFT observation is "bad"
+                clock.advance(0.2)
+                eng.pump(1)
+            hi = eng.submit(_prompts(20, (6,))[0], 4, priority="high",
+                            request_id="hi")
+            for _ in range(6):
+                clock.advance(0.2)
+                eng.pump(1)
+            snap = eng.slo_snapshot()["degradation"]
+            assert snap["level"] >= 1
+            ms = eng.metrics.snapshot()
+            assert ms["serving_preemptions_total"] >= 1
+            assert ms["serving_degrade_level"] == snap["level"]
+            # degraded is HEALTHY (the §5j satellite): /healthz-backing
+            # snapshot stays healthy and carries the level
+            h = eng.health()
+            assert h["healthy"] is True and h["degraded"] == snap["level"]
+            # drain clean: the alert clears, the ladder steps back to 0
+            while eng.pump(8):
+                clock.advance(0.001)
+            for _ in range(12):
+                clock.advance(0.001)
+                eng.pump(1)
+            assert eng.slo_snapshot()["degradation"]["level"] == 0
+            assert hi.result(timeout_s=0).state == RequestState.DONE
+    finally:
+        eng.stop_trace()
+    # every decision is in the structured log, joined to a trace tick,
+    # and mirrored in the flight recorder
+    events = [json.loads(line) for line in buf.getvalue().splitlines()]
+    sched = [e for e in events if e["event"].startswith("sched.")]
+    kinds = {e["event"] for e in sched}
+    assert {"sched.degrade", "sched.preempt", "sched.resume",
+            "sched.restore"} <= kinds
+    assert all("tick" in e for e in sched), "log↔trace join key missing"
+    rec_kinds = {e.name for e in tracer.recorder.snapshot()}
+    assert {"sched.degrade", "sched.preempt", "sched.resume",
+            "sched.restore"} <= rec_kinds
+    # the ladder came all the way back: last transition restores to 0
+    restores = [e for e in sched if e["event"] == "sched.restore"]
+    assert restores and restores[-1]["level"] == 0
+
+
+def test_ladder_reduces_and_restores_spec_k(model):
+    draft = _tiny_model(seed=1, num_layers=1, hidden_size=32)
+    clock = FakeClock()
+    eng = _ladder_engine(model, clock, draft=draft,
+                         degrade_dwell_ticks=1)
+    pool = eng._pool
+    for i in range(3):
+        eng.submit(_prompts(30 + i, (6,))[0], 24, priority=-1)
+    # burn TTFT until the ladder reaches the spec-K rung
+    for _ in range(4):
+        clock.advance(0.2)
+        eng.pump(1)
+    assert eng.slo_snapshot()["degradation"]["level"] >= 2
+    assert pool.spec_k_active == 1
+    assert eng.slo_snapshot()["degradation"]["spec_k_active"] == 1
+    # clean traffic clears the alert; the rung restores the full K
+    while eng.pump(8):
+        clock.advance(0.001)
+    for _ in range(12):
+        clock.advance(0.001)
+        eng.pump(1)
+    assert eng.slo_snapshot()["degradation"]["level"] == 0
+    assert pool.spec_k_active == 4
+
+
+def test_tightened_admission_sheds_below_floor_only(model):
+    clock = FakeClock()
+    eng = _ladder_engine(model, clock)
+    eng._set_degrade_level(3, ["ttft_p95"])
+    with pytest.raises(AdmissionTightenedError, match="floor"):
+        eng.submit(np.zeros(4, np.int32), 2, priority=0)
+    assert eng.metrics.snapshot()[
+        "serving_admission_tightened_total"] == 1
+    s = eng.submit(np.zeros(4, np.int32), 2, priority="high")
+    while eng.pump(8):
+        pass
+    assert s.result(timeout_s=0).state == RequestState.DONE
+
+
+def test_degrade_requires_slo(model):
+    with pytest.raises(InvalidArgumentError, match="degrade"):
+        ServingEngine(model, max_len=32, slots=1, buckets=[8],
+                      degrade=True)
+
+
+def test_priority_validation(model):
+    eng = ServingEngine(model, max_len=32, slots=1, buckets=[8])
+    with pytest.raises(InvalidArgumentError, match="priority"):
+        eng.submit(np.zeros(4, np.int32), 2, priority="urgent")
+    with pytest.raises(InvalidArgumentError, match="priority"):
+        eng.submit(np.zeros(4, np.int32), 2, priority=1.5)
+
+
+def test_resume_restarts_the_inter_token_clock(model):
+    # the parked wait is scheduler time, not decode cadence: without
+    # the resume-time last_t reset, the first post-resume token would
+    # observe the whole park as one inter_token latency — feeding a
+    # preempting ladder the very violation that keeps it preempting
+    clock = FakeClock()
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[32],
+                        cache_layout="paged", block_size=8, clock=clock)
+    s = eng.submit(_prompts(60, (6,))[0], 10, request_id="r")
+    for _ in range(3):
+        clock.advance(0.01)
+        eng.pump(1)
+    eng.preempt("r")
+    clock.advance(100.0)  # a LONG park
+    while eng.pump(1):
+        clock.advance(0.01)
+    assert s.result(timeout_s=0).state == RequestState.DONE
+    itl = eng.metrics.histogram("serving_inter_token_seconds")
+    assert itl.count > 0
+    assert itl.sum < 1.0, "park time leaked into inter-token latency"
+
+
+def test_manual_spec_k_survives_a_ladder_excursion(model):
+    # restore returns to the OPERATOR's runtime setting, not blindly
+    # to the construction ceiling: a manual set_spec_k(2) must survive
+    # the ladder engaging and releasing the reduce-spec-K rung
+    draft = _tiny_model(seed=2, num_layers=1, hidden_size=32)
+    clock = FakeClock()
+    eng = _ladder_engine(model, clock, draft=draft)
+    pool = eng._pool
+    pool.set_spec_k(2)  # operator tune
+    eng._set_degrade_level(1, ["ttft_p95"])
+    assert pool.spec_k_active == 2  # L1 never touches spec-K
+    eng._set_degrade_level(2, ["ttft_p95"])
+    assert pool.spec_k_active == 1
+    eng._set_degrade_level(1, ["ttft_p95"])
+    assert pool.spec_k_active == 2, "restore clobbered the manual tune"
+    eng._set_degrade_level(0, [])
+    assert pool.spec_k_active == 2
+
+
+def test_preempt_rung_skips_tenant_capped_requests(model):
+    # a queued request its tenant cap would defer cannot justify a
+    # victim: preempting for it would thrash (preempt, then resume the
+    # victim into the slot the capped request still cannot take)
+    clock = FakeClock()
+    eng = _ladder_engine(model, clock, tenant_slot_cap=1, slots=2)
+    eng.submit(_prompts(61, (6,))[0], 20, request_id="t-active",
+               tenant="T", priority=0)
+    eng.submit(_prompts(62, (6,))[0], 20, request_id="u-low",
+               tenant="U", priority=-1)
+    eng.pump(2)  # both decoding; T at its cap
+    eng.submit(_prompts(63, (6,))[0], 4, request_id="t-high",
+               tenant="T", priority=1)
+    eng._set_degrade_level(1, ["ttft_p95"])
+    eng.pump(3)
+    assert eng.metrics.snapshot()["serving_preemptions_total"] == 0
+    while eng.pump(16):
+        pass
+
+
+def test_pool_rejects_non_numeric_deadline(model):
+    pool = GenerationPool(model, max_len=64, slots=1, buckets=[32])
+    with pytest.raises(InvalidArgumentError, match="deadline"):
+        pool.submit(np.zeros(4, np.int32), 2, deadline="soon")
+    with pytest.raises(InvalidArgumentError, match="deadline"):
+        pool.submit(np.zeros(4, np.int32), 2, deadline=True)
+
+
+# -- recovery × preemption ----------------------------------------------
+
+def test_recovery_resubmits_preempted_victims_byte_identically(model):
+    p = _prompts(40, (5, 9))
+
+    def mk():
+        return ServingEngine(model, max_len=64, slots=2,
+                             buckets=[32, 64], cache_layout="paged",
+                             block_size=8, max_retries=4)
+
+    ref = mk()
+    want = [ref.submit(ids, 8, request_id="r%d" % i)
+            for i, ids in enumerate(p)]
+    while ref.pump(8):
+        pass
+    want = [s.result(timeout_s=0).tokens for s in want]
+    counts = ref.compile_counts()
+
+    eng = mk()
+    streams = [eng.submit(ids, 8, request_id="r%d" % i, priority=i)
+               for i, ids in enumerate(p)]
+    eng.pump(2)
+    eng.preempt("r0")
+    # a step fault lands while r0 is PARKED: its spill-tier copies die
+    # with the pool, and recovery resubmits it from prompt+committed
+    # like any other survivor
+    plane = faults.FaultPlane([faults.FaultSpec(
+        "pool.step", error=faults.TransientInjectedFault, times=1)])
+    with faults.injected(plane):
+        while eng.pump(8):
+            pass
+    for s, w in zip(streams, want):
+        st = s.result(timeout_s=0)
+        assert st.state == RequestState.DONE
+        np.testing.assert_array_equal(st.tokens, w)
+    assert eng.compile_counts() == counts
+    stats = eng.cache_stats()
+    assert stats["mapped_blocks"] == 0 and stats["spilled_blocks"] == 0
+    assert _partition_ok(stats)
+
+
+# -- the deadline-shed estimator fix -------------------------------------
+
+def test_deadline_estimate_counts_per_request_chunk_ticks(model):
+    # many SHORT queued prompts: each costs its own serialized chunk
+    # tick.  The old `ceil(sum/C)` formula collapsed ten 5-token
+    # prompts at C=16 into "one tick of prompt work" and admitted
+    # bursts it should shed; the per-request form must count >= one
+    # tick each
+    eng = ServingEngine(model, max_len=64, slots=2,
+                        cache_layout="paged", block_size=8,
+                        prefill_chunk_tokens=16, max_queue=64)
+    for i in range(10):
+        eng.submit(_prompts(50 + i, (5,))[0], 2, request_id="q%d" % i)
+    eng.pump(1)  # measure a tick so the estimator engages
+    est = eng._deadline_estimate_s(2, prompt_len=5)
+    step_s = eng._timer.step_time
+    live = eng.live_requests
+    # prompt-chunk ticks alone: one per not-yet-decoding live request
+    # plus the candidate's own — strictly more than the old collapsed
+    # estimate could ever produce for this shape
+    pending = sum(1 for rid in ("q%d" % i for i in range(10))
+                  if eng.request_state(rid) in ("QUEUED", "PREFILLING"))
+    assert est is not None and live > 0
+    old_style = step_s * ((sum(5 for _ in range(pending)) + 5 + 15) // 16)
+    assert est >= step_s * (pending + 1), (est, step_s, pending)
+    assert est > old_style

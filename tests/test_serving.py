@@ -107,34 +107,39 @@ def test_deadline_expiry_frees_slot_and_blocks(model):
     clock = FakeClock()
     # ONE slot so the engine exercises BOTH expiry paths in one run: a
     # decoding request whose deadline passes mid-generation, and a
-    # queued request whose deadline passes before it ever gets a slot
+    # queued request whose deadline passes before it ever gets a slot.
+    # Slot selection is deadline-aware (§5j: earliest deadline wins the
+    # free slot within a priority class), so `b` — submitted SECOND but
+    # with the tighter deadline — takes the slot and `a` waits
     eng = ServingEngine(model, max_len=64, slots=1, buckets=[16],
                         cache_layout="paged", block_size=8, clock=clock)
     baseline = eng.cache_stats()
     a = eng.submit(np.zeros(5, np.int32), 40, deadline_s=1.0)
     b = eng.submit(np.zeros(7, np.int32), 20, deadline_s=0.5)
-    eng.pump(3)  # `a` admitted + a few decode steps; `b` waits
-    assert eng.request_state(a.request_id) == RequestState.DECODING
-    assert eng.request_state(b.request_id) == RequestState.QUEUED
+    eng.pump(3)  # `b` admitted (earliest deadline) + decode; `a` waits
+    assert eng.request_state(b.request_id) == RequestState.DECODING
+    assert eng.request_state(a.request_id) == RequestState.QUEUED
     assert eng.cache_stats()["mapped_blocks"] > 0
-    clock.advance(0.6)  # past b's deadline only
-    eng.pump(1)
+    clock.advance(0.6)  # past b's deadline, mid-decode
+    eng.pump(2)  # expiry sweep fires, then `a` takes the freed slot
     stb = b.result(timeout_s=0)
     assert stb.state == RequestState.EXPIRED
-    assert stb.new_tokens == 0 and stb.ttft_s is None
-    clock.advance(1.0)  # past a's deadline, mid-decode
+    assert stb.finish_reason == "deadline"
+    assert 0 < stb.new_tokens < 20  # partial output rides in the status
+    assert eng.request_state(a.request_id) == RequestState.DECODING
+    clock.advance(0.5)  # past a's deadline too
     assert eng.pump(1) is False  # expiry sweep fires before the step
     st = a.result(timeout_s=0)
     assert st.state == RequestState.EXPIRED
     assert st.finish_reason == "deadline"
-    assert 0 < st.new_tokens < 40  # partial output rides in the status
+    assert 0 < st.new_tokens < 40
     # the slot and every paged block came back: no leak
     stats = eng.cache_stats()
     assert stats["mapped_blocks"] == 0
     assert stats["free_blocks"] == baseline["free_blocks"]
     snap = eng.metrics.snapshot()
     assert snap["serving_requests_expired_total"] == 2
-    assert snap["serving_ttft_seconds"]["count"] == 1  # b never started
+    assert snap["serving_ttft_seconds"]["count"] == 2
 
 
 def test_submit_rejects_nonpositive_deadline(model):

@@ -49,6 +49,7 @@ EXTRA_EDGES = {
     "GenerationPool._refill": ("ServingEngine._on_admit",
                                "ServingEngine._on_token",
                                "ServingEngine._on_finish",
+                               "GenerationPool._resume",
                                "Tracer.span"),
     # prefix-sharing admission + chunked prefill (docs §5i): the
     # admission match and the chunk dispatch are new hot-path seams —
@@ -63,6 +64,22 @@ EXTRA_EDGES = {
     "GenerationPool._activate": ("ServingEngine._on_token",
                                  "ServingEngine._on_finish",
                                  "SpeculativePool._on_activated"),
+    # traffic-grade scheduling (docs §5j): the degradation ladder's
+    # preempt decision dispatches into the pool's spill path (victim
+    # K/V → host pool, the one deliberate spill-boundary device_get),
+    # and the refill's resume re-pages blocks in and re-activates the
+    # slot — the serving-layer on_resume hook and the speculative
+    # pool's draft re-prefill are attribute-assigned/overridden seams
+    # the AST cannot see, so the whole ladder→preempt→spill and
+    # resume→page-in→re-activate chain is declared hot and audited
+    "ServingEngine._degrade_eval": ("ServingEngine._preempt_for_priority",
+                                    "SLOTracker.alerting_names"),
+    "ServingEngine._preempt_for_priority": ("ServingEngine._do_preempt",),
+    "ServingEngine._do_preempt": ("GenerationPool.preempt",),
+    "GenerationPool.preempt": ("SpeculativePool._preempt_guard",),
+    "GenerationPool._resume": ("ServingEngine._on_resume",
+                               "SpeculativePool._on_resumed",
+                               "GenerationPool._reclaim_one_spilled"),
     "SpeculativePool.step": ("ServingEngine._on_token",
                              "ServingEngine._on_finish",
                              "Tracer.span"),

@@ -55,6 +55,17 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "prefix_hit_rate": ("higher", 0.10),
     "prefix_blocks_saved_bytes": ("higher", 0.10),
     "ttft_p95_improvement_pct": ("higher_abs", 10.0),
+    # traffic-grade scheduling (serving_overload): the closed-loop
+    # headline must not silently decay — high-priority p99 TTFT
+    # improvement and the burn the ladder buys back are gated on
+    # absolute points (both are already relative quantities); the
+    # per-class latency columns ride the usual wall-clock thresholds
+    "ttft_p99_high_improvement_pct": ("higher_abs", 15.0),
+    "slo_burn_drop": ("higher_abs", 3.0),
+    "ttft_p95_high_s": ("lower", 0.40),
+    "ttft_p99_high_s": ("lower", 0.40),
+    "ttft_p95_low_s": ("lower", 0.40),
+    "ttft_p99_low_s": ("lower", 0.40),
     # latency family: lower is better
     "step_time_s": ("lower", 0.15),
     "per_token_s": ("lower", 0.15),
@@ -83,6 +94,11 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
 PER_LEG_THRESHOLDS: Dict[Tuple[str, str], Tuple[str, float]] = {
     ("speculative", "tokens_per_sec"): ("higher", 0.25),
     ("serving_faults", "tokens_per_sec"): ("higher", 0.25),
+    # the overload leg's per-class p50s sit at one-tick granularity on
+    # CPU smoke runs — scheduler noise owns them; leave them untracked
+    # rather than false-alarming (the p95/p99 columns are gated above)
+    ("serving_overload", "ttft_p50_high_s"): ("lower", 1.00),
+    ("serving_overload", "ttft_p50_low_s"): ("lower", 1.00),
 }
 
 
