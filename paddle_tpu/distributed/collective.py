@@ -42,17 +42,43 @@ except ImportError:  # pragma: no cover
     from jax.experimental.shard_map import shard_map as _raw_shard_map  # type: ignore
 
 
-def shard_map(f, mesh, in_specs, out_specs):
+def shard_map(f, mesh, in_specs, out_specs, axis_names=None):
     """Version-compat shard_map with replication checking off (collectives
-    intentionally change replication across the mapped axis)."""
-    try:
-        return _raw_shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=False)
-    except TypeError:  # pragma: no cover - older jax kwarg
-        return _raw_shard_map(
-            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_rep=False)
+    intentionally change replication across the mapped axis).
+
+    ``axis_names`` requests PARTIAL-manual mode: only those axes are
+    manual inside the body, the rest stay GSPMD-managed (jax>=0.8
+    spells this ``axis_names=``; older jax spells it ``auto=`` with the
+    complement set)."""
+    variants = [{"check_vma": False}, {"check_rep": False}]
+    if axis_names is not None:
+        manual = frozenset(axis_names)
+        auto = frozenset(mesh.axis_names) - manual
+        variants = [{"check_vma": False, "axis_names": manual},
+                    {"check_rep": False, "auto": auto}]
+    err = None
+    for kw in variants:
+        try:
+            return _raw_shard_map(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw)
+        except TypeError as e:  # pragma: no cover - version-dependent kwarg
+            err = e
+    raise err
+
+
+def axis_size(axis_name: str):
+    """Version-compat ``lax.axis_size``: the (static) size of a bound
+    mapped axis.  Newer jax has ``lax.axis_size``; older releases spell
+    it ``lax.psum(1, axis_name)``, which constant-folds to a python int
+    for a literal operand.  Raises the axis-binding error either way
+    when the name is unbound (``_axis_bound`` relies on that)."""
+    fn = getattr(lax, "axis_size", None)
+    if fn is not None:
+        return fn(axis_name)
+    return lax.psum(1, axis_name)
 
 __all__ = [
+    "axis_size", "shard_map",
     "ReduceOp", "Group", "new_group", "get_group", "destroy_process_group",
     "is_initialized", "init_parallel_env", "get_rank", "get_world_size",
     "broadcast", "all_reduce", "reduce", "all_gather", "scatter", "alltoall",
@@ -260,7 +286,7 @@ def _in_trace(x) -> bool:
 def _axis_bound(axis_name: str) -> bool:
     """True when ``axis_name`` is a bound shard_map/pmap axis."""
     try:
-        lax.axis_size(axis_name)
+        axis_size(axis_name)
         return True
     except (NameError, KeyError, ValueError):
         return False

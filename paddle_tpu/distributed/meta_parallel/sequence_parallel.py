@@ -30,6 +30,7 @@ import numpy as np
 from jax import lax
 
 from ...core.errors import InvalidArgumentError
+from ..collective import axis_size
 
 __all__ = ["ring_attention", "ulysses_attention", "split_sequence",
            "gather_sequence"]
@@ -38,7 +39,7 @@ __all__ = ["ring_attention", "ulysses_attention", "split_sequence",
 def split_sequence(x, axis_name: str, seq_axis: int = 1):
     """Slice this rank's sequence block out of a replicated tensor (the
     scatter half of the reference's missing SP; inside shard_map)."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     l = x.shape[seq_axis]
     if l % n != 0:
@@ -76,7 +77,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False,
     if q.ndim != 4:
         raise InvalidArgumentError(
             "ring_attention expects [B, H, Lblk, D], got %s" % (q.shape,))
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     my = lax.axis_index(axis_name)
     d = q.shape[-1]
     scale = jnp.asarray(sm_scale if sm_scale is not None else 1.0 / np.sqrt(d),
@@ -129,7 +130,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = False,
     pallas-routed flash attention) runs unchanged; the second alltoall
     restores sequence sharding.
     """
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     h = q.shape[1]
     if h % n != 0:
         raise InvalidArgumentError(

@@ -551,12 +551,14 @@ class PipelineTrainStep:
             # every other axis (mp, ...) remains GSPMD-managed inside the
             # region so stage math gets its TP collectives from the
             # parameter shardings — the pp×mp hybrid
-            sharded_core = jax.shard_map(
+            # version-compat wrapper (axis_names= on jax>=0.8, auto=
+            # complement on older) — same helper the collectives use
+            from ..collective import shard_map as _compat_shard_map
+
+            sharded_core = _compat_shard_map(
                 pipe_core, mesh=mesh, in_specs=in_specs, out_specs=P(),
-                axis_names=frozenset(manual), check_vma=False)
+                axis_names=frozenset(manual))
         else:
-            # version-compat wrapper (check_vma on jax>=0.8, check_rep on
-            # older) — same helper the collectives use
             from ..collective import shard_map as _compat_shard_map
 
             sharded_core = _compat_shard_map(

@@ -97,6 +97,7 @@ from ..core.errors import (AlreadyExistsError, InvalidArgumentError,
                            NotFoundError, PreconditionNotMetError)
 from ..jit import aot
 from ..jit.decode import DecodeSession, classify_finish
+from ..jit.mesh import DecodeMesh
 
 __all__ = ["GenerationPool", "kv_reachable_bytes",
            "DuplicateRequestError"]
@@ -270,10 +271,10 @@ class _SpillState:
 
     __slots__ = ("rid", "ids", "tokens", "remaining", "priority",
                  "tenant", "deadline", "seq", "total_blocks", "written",
-                 "dev_blocks", "host", "host_bytes", "preempts")
+                 "dev_blocks", "host", "host_bytes", "preempts", "shard")
 
     def __init__(self, st: "_SlotState", total_blocks: int,
-                 written: int, host, host_bytes: int):
+                 written: int, host, host_bytes: int, shard: int = 0):
         self.rid = st.rid
         self.ids = st.ids
         self.tokens = st.tokens
@@ -288,6 +289,11 @@ class _SpillState:
         self.host = host
         self.host_bytes = host_bytes
         self.preempts = 1
+        # the dp shard the victim decoded in: its spilled device blocks
+        # live in that shard's partition, and resume is shard-pinned —
+        # a re-mapped block must stay in the partition the slot's table
+        # row is sharded with (0 when dp == 1)
+        self.shard = shard
 
 
 class _PrefixEntry:
@@ -328,9 +334,24 @@ class GenerationPool:
                  block_size: int = 32, num_blocks: Optional[int] = None,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_sharing: bool = False,
-                 tenant_slot_cap: Optional[int] = None):
+                 tenant_slot_cap: Optional[int] = None,
+                 mesh: Optional[DecodeMesh] = None):
         if slots < 1:
             raise InvalidArgumentError("GenerationPool needs slots >= 1")
+        if mesh is not None and not isinstance(mesh, DecodeMesh):
+            raise InvalidArgumentError(
+                "mesh must be a jit.mesh.DecodeMesh (or None for the "
+                "unsharded pool), got %r" % (type(mesh).__name__,))
+        self._mesh = mesh
+        self._dp = 1 if mesh is None else mesh.dp
+        if slots % self._dp != 0:
+            raise InvalidArgumentError(
+                "dp=%d must divide slots=%d: the slot axis is sharded "
+                "in equal contiguous chunks over the dp mesh axis, and "
+                "the allocator maps logical slot g to (shard g // "
+                "(slots/dp), local slot g %% (slots/dp))"
+                % (self._dp, slots))
+        self._slots_per_shard = int(slots) // self._dp
         if tenant_slot_cap is not None and int(tenant_slot_cap) < 1:
             raise InvalidArgumentError(
                 "tenant_slot_cap must be >= 1 slots per tenant (or None "
@@ -375,7 +396,7 @@ class GenerationPool:
             model, max_len, buckets=buckets, temperature=temperature,
             top_k=top_k, top_p=top_p, cache_dtype=cache_dtype,
             donate=donate, cache_layout=cache_layout,
-            block_size=block_size)
+            block_size=block_size, mesh=mesh)
         self._model = model
         self._cache_dtype = cache_dtype
         from ..jit.speculative import model_vocab_size
@@ -388,17 +409,40 @@ class GenerationPool:
         # paged: ceil so a ragged final block still holds max_len
         self._max_blocks = -(-self.max_len // self._block_size)
         if cache_layout == "paged":
-            # physical block 0 is the reserved scratch block — unmapped
-            # table entries point at it, inactive-slot writes land in it;
-            # default pool size is FULL capacity (every slot at max_len);
-            # a smaller num_blocks is the point of paging: HBM scales
-            # with the token budget, and admission control (below) defers
-            # refills that couldn't finish within the remaining blocks
+            # physical block s*(num_blocks/dp) is shard s's reserved
+            # SCRATCH block — that shard's unmapped table entries point
+            # at it, its inactive-slot writes land in it (with dp=1
+            # this is the familiar global block 0); default pool size
+            # is FULL capacity (every slot at max_len); a smaller
+            # num_blocks is the point of paging: HBM scales with the
+            # token budget, and admission control (below) defers
+            # refills that couldn't finish within the remaining blocks.
+            # Under a mesh the block pool's leading axis is sharded
+            # over dp in equal contiguous chunks, so the allocator runs
+            # ONE FREE LIST PER SHARD — a slot's blocks always live in
+            # its own shard's partition of the pool array, and the
+            # decode step never gathers K/V across the dp axis
             if num_blocks is None:
-                num_blocks = 1 + self.slots * self._max_blocks
+                num_blocks = self._dp * (
+                    1 + self._slots_per_shard * self._max_blocks)
             num_blocks = int(num_blocks)
+            if num_blocks % self._dp != 0:
+                raise InvalidArgumentError(
+                    "dp=%d must divide num_blocks=%d: the block pool is "
+                    "partitioned into equal per-shard spans (each with "
+                    "its own scratch block and free list)"
+                    % (self._dp, num_blocks))
+            if num_blocks // self._dp < 2:
+                raise InvalidArgumentError(
+                    "paged pool needs >= 2 blocks per dp shard (one "
+                    "scratch + one allocatable), got num_blocks=%d at "
+                    "dp=%d" % (num_blocks, self._dp))
             self._num_blocks = num_blocks
-            self._free_blocks: List[int] = list(range(1, num_blocks))
+            self._blocks_per_shard = num_blocks // self._dp
+            self._free_by_shard: List[List[int]] = [
+                list(range(s * self._blocks_per_shard + 1,
+                           (s + 1) * self._blocks_per_shard))
+                for s in range(self._dp)]
             self._slot_blocks: Dict[int, List[int]] = {}
             # refcount per RESIDENT physical block (absent = free).  A
             # freshly allocated block starts at 1; prefix sharing bumps
@@ -411,11 +455,16 @@ class GenerationPool:
             raise InvalidArgumentError(
                 "num_blocks is a paged-cache knob; pass "
                 "cache_layout='paged' (got %r)" % (cache_layout,))
-        self._cache = model.gen_decode_cache(
-            self.slots, self.max_len, cache_dtype, per_slot=True,
-            layout=cache_layout, block_size=block_size,
-            num_blocks=(self._num_blocks if cache_layout == "paged"
-                        else None))
+        # per-slot scratch routing: slot g's masked/ unmapped table
+        # entries point at ITS shard's scratch block (all zeros when
+        # dp == 1 — exactly the legacy global scratch).  A plain numpy
+        # constant: the traced step closes over it, and it never
+        # changes after construction
+        self._scratch_row = np.asarray(
+            [self._shard_scratch(self._shard_of_slot(g))
+             for g in range(self.slots)], np.int32) \
+            if cache_layout == "paged" else None
+        self._cache = self._new_cache()
         if donate is None:
             donate = jax.default_backend() != "cpu"
         self._decode_jit = jax.jit(self._pool_decode,
@@ -603,9 +652,7 @@ class GenerationPool:
         tables = None
         if self.cache_layout == "paged":
             tables = [c.table for c in cache]
-            cache = [c._replace(table=jnp.where(active[:, None],
-                                                c.table, 0))
-                     for c in cache]
+            cache = self._masked_tables(cache, active)
         logits, new_cache = sess._run_model(param_vals, buf_vals,
                                             toks[:, None], cache)
         tok, key = sess._sample(logits[:, 0], key)
@@ -615,6 +662,18 @@ class GenerationPool:
             new_cache = [c._replace(table=t)
                          for c, t in zip(new_cache, tables)]
         return new_cache, jnp.where(active, tok, 0), key
+
+    def _masked_tables(self, cache, active):
+        """Inactive slots' table rows routed to their OWN shard's
+        scratch block for the step (all zeros when dp == 1 — the
+        legacy global scratch): a stale write may not land in blocks a
+        refilled request now owns, and under a mesh it may not cross
+        into another shard's partition either.  Traced helper, shared
+        with the speculative verify step."""
+        scratch = jnp.asarray(self._scratch_row)[:, None]
+        return [c._replace(table=jnp.where(active[:, None], c.table,
+                                           scratch))
+                for c in cache]
 
     def _admit(self, cache, slot, row, index):
         """Map an admitted request's table row (shared prefix blocks +
@@ -719,18 +778,22 @@ class GenerationPool:
         if self._chunk_tokens is None:
             self._session._bucket_for(len(ids))
         if self.cache_layout == "paged":
-            # a request must fit an EMPTY pool, else _refill could never
-            # admit it and the pool would stall forever on a full queue
+            # a request must fit an EMPTY pool — one SHARD's partition,
+            # since a slot's blocks never span shards — else _refill
+            # could never admit it and the pool would stall forever on
+            # a full queue
             need = self._blocks_needed(len(ids), max_new_tokens)
-            if need > self._num_blocks - 1:
+            if need > self._blocks_per_shard - 1:
                 raise InvalidArgumentError(
                     "request needs %d KV blocks (prompt %d + "
-                    "max_new_tokens %d at block_size %d) but the pool "
-                    "has only %d allocatable blocks (num_blocks=%d "
-                    "minus the reserved scratch block); raise "
-                    "num_blocks or lower max_new_tokens"
+                    "max_new_tokens %d at block_size %d) but one dp "
+                    "shard has only %d allocatable blocks "
+                    "(num_blocks=%d / dp=%d minus the reserved scratch "
+                    "block; a request's blocks never span shards); "
+                    "raise num_blocks or lower max_new_tokens"
                     % (need, len(ids), max_new_tokens, self._block_size,
-                       self._num_blocks - 1, self._num_blocks))
+                       self._blocks_per_shard - 1, self._num_blocks,
+                       self._dp))
         # one id namespace for explicit and auto ids: explicit duplicates
         # are rejected, auto-assignment skips ids a caller already took
         # (a collision would silently overwrite the earlier results);
@@ -754,6 +817,84 @@ class GenerationPool:
                                     tenant, deadline, self._seq))
         return rid
 
+    # -- mesh / shard mapping (docs §5k) ---------------------------------
+    @property
+    def mesh(self) -> Optional[DecodeMesh]:
+        """The decode mesh (None for an unsharded pool)."""
+        return self._mesh
+
+    @property
+    def dp_shards(self) -> int:
+        """dp shards the slot axis is partitioned into (1 unsharded)."""
+        return self._dp
+
+    def _shard_of_slot(self, slot: int) -> int:
+        """Logical slot -> dp shard: NamedSharding partitions the slot
+        axis into equal CONTIGUOUS chunks in mesh order, so shard =
+        slot // slots_per_shard and local slot = slot % slots_per_shard
+        — the logical→(shard, local-slot) mapping the scheduler above
+        never sees."""
+        return slot // self._slots_per_shard
+
+    def _shard_of_block(self, b: int) -> int:
+        """Physical block -> dp shard (the block pool's leading axis is
+        partitioned like the slot axis)."""
+        return b // self._blocks_per_shard
+
+    def _shard_scratch(self, shard: int) -> int:
+        """Shard ``shard``'s reserved scratch block (its partition's
+        first physical block; 0 when dp == 1 — the legacy scratch)."""
+        return shard * self._blocks_per_shard
+
+    def _spilled_dev_count(self, shard: int) -> int:
+        """Device-resident spilled blocks reclaimable from ``shard``'s
+        partition (they sit on top of its free list for admission
+        math)."""
+        if self._dp == 1:
+            return len(self._spill_owner)
+        return sum(1 for b in self._spill_owner
+                   if self._shard_of_block(b) == shard)
+
+    def _pop_free_slot(self, shard: Optional[int] = None) -> int:
+        """Take a free slot — the LAST free one (matching the legacy
+        ``self._free.pop()`` order), restricted to ``shard`` when the
+        paged allocator needs the slot's blocks in a specific
+        partition.  Callers check availability first."""
+        if shard is None or self._dp == 1:
+            return self._free.pop()
+        for i in range(len(self._free) - 1, -1, -1):
+            if self._shard_of_slot(self._free[i]) == shard:
+                return self._free.pop(i)
+        raise PreconditionNotMetError(
+            "no free slot in dp shard %d (free slots: %s) — callers "
+            "must check shard availability before popping"
+            % (shard, sorted(self._free)))
+
+    @property
+    def _free_blocks(self) -> List[int]:
+        """Free-list view: with dp == 1 this IS the live shard-0 list
+        (the legacy attribute tests and tools read); sharded pools get
+        a flattened read-only copy — mutate through the per-shard
+        lists."""
+        if self._dp == 1:
+            return self._free_by_shard[0]
+        return [b for fl in self._free_by_shard for b in fl]
+
+    def _new_cache(self):
+        """Allocate the pool cache and (under a mesh) place every leaf
+        by the §5k axis rules — K/V and scales sharded ('dp', 'mp'),
+        table/index sharded ('dp') — so XLA compiles the decode step as
+        per-shard programs with collectives only where mp requires
+        them."""
+        cache = self._model.gen_decode_cache(
+            self.slots, self.max_len, self._cache_dtype, per_slot=True,
+            layout=self.cache_layout, block_size=self._block_size,
+            num_blocks=(self._num_blocks if self.cache_layout == "paged"
+                        else None))
+        if self._mesh is not None:
+            cache = self._mesh.place_cache(cache)
+        return cache
+
     def _blocks_needed(self, prompt_len: int, max_new_tokens: int) -> int:
         """Blocks a request reserves at ADMISSION: its worst-case token
         span (prompt + generated; submit caps it at max_len).  Reserving
@@ -762,40 +903,44 @@ class GenerationPool:
         span = min(prompt_len + max_new_tokens, self.max_len)
         return -(-span // self._block_size)
 
-    def _alloc_blocks(self, n: int) -> List[int]:
-        """Pop ``n`` fresh blocks at refcount 1: the free list first,
-        then — under pressure — RECLAIM spilled device copies (lowest-
-        priority victim first; its host copy is the survivor, so the
-        preempted request stays resumable, just via the upload path)."""
+    def _alloc_blocks(self, n: int, shard: int = 0) -> List[int]:
+        """Pop ``n`` fresh blocks at refcount 1 from ``shard``'s
+        partition: its free list first, then — under pressure —
+        RECLAIM spilled device copies (lowest-priority victim first;
+        its host copy is the survivor, so the preempted request stays
+        resumable, just via the upload path)."""
         self._prefix_epoch += 1
+        fl = self._free_by_shard[shard]
         blocks = []
         for _ in range(n):
-            if not self._free_blocks:
-                self._reclaim_one_spilled()
-            blocks.append(self._free_blocks.pop())
+            if not fl:
+                self._reclaim_one_spilled(shard)
+            blocks.append(fl.pop())
         for b in blocks:
             self._block_refs[b] = 1
         return blocks
 
-    def _reclaim_one_spilled(self) -> None:
-        """Drop ONE spilled block's device copy back to the free list
-        (its owner's ``dev_blocks`` entry goes None — resume for that
-        block becomes a host upload).  Victim order: lowest priority,
-        then oldest arrival — the least important parked request loses
-        its zero-copy resume first."""
+    def _reclaim_one_spilled(self, shard: int = 0) -> None:
+        """Drop ONE spilled block's device copy (from ``shard``'s
+        partition) back to its free list (its owner's ``dev_blocks``
+        entry goes None — resume for that block becomes a host
+        upload).  Victim order: lowest priority, then oldest arrival —
+        the least important parked request loses its zero-copy resume
+        first."""
         owners = [sp for sp in self._spilled.values()
-                  if any(b is not None for b in sp.dev_blocks)]
+                  if sp.shard == shard
+                  and any(b is not None for b in sp.dev_blocks)]
         if not owners:
             raise PreconditionNotMetError(
                 "allocator invariant broken: no free block and no "
-                "reclaimable spilled block (callers must check "
-                "availability before allocating)")
+                "reclaimable spilled block in dp shard %d (callers "
+                "must check availability before allocating)" % (shard,))
         sp = min(owners, key=lambda s: (s.priority, s.seq))
         j = next(i for i, b in enumerate(sp.dev_blocks) if b is not None)
         b = sp.dev_blocks[j]
         sp.dev_blocks[j] = None
         self._spill_owner.pop(b, None)
-        self._free_blocks.append(b)
+        self._free_by_shard[shard].append(b)
         self._spill_reclaims_total += 1
 
     def _forget_block_key(self, b: int) -> None:
@@ -826,7 +971,7 @@ class GenerationPool:
                 self._block_refs[b] = left
                 continue
             self._block_refs.pop(b, None)
-            self._free_blocks.append(b)
+            self._free_by_shard[self._shard_of_block(b)].append(b)
             self._forget_block_key(b)
 
     def _finish(self, slot: int):
@@ -892,7 +1037,7 @@ class GenerationPool:
             for b in sp.dev_blocks:
                 if b is not None:
                     self._spill_owner.pop(b, None)
-                    self._free_blocks.append(b)
+                    self._free_by_shard[self._shard_of_block(b)].append(b)
             self._used_rids.discard(request_id)
             return "preempted"
         if request_id in self._results:
@@ -1001,6 +1146,7 @@ class GenerationPool:
         st = self._active[slot]
         self._preempt_guard(slot, st)
         bs = self._block_size
+        shard = self._shard_of_slot(slot)
         # K/V are written for positions [0, pos): the last committed
         # token's K/V is NOT yet written (it is the next step's input)
         pos = len(st.ids) + len(st.tokens) - 1
@@ -1009,9 +1155,10 @@ class GenerationPool:
         # the gather index is padded to a power-of-two bucket so the
         # eager gather compiles O(log max_blocks) distinct shapes over
         # the pool's lifetime, not one per victim length — padding rows
-        # read the scratch block (block 0), harmless and never restored
+        # read the slot's shard's scratch block, harmless and never
+        # restored
         padded_n = _pow2_at_least(written)
-        gidx = np.zeros(padded_n, np.int32)
+        gidx = np.full(padded_n, self._shard_scratch(shard), np.int32)
         gidx[:written] = blocks[:written]
         gather = jnp.asarray(gidx)
         # ONE batched download of everything resume must be able to
@@ -1028,7 +1175,8 @@ class GenerationPool:
         self._free.append(slot)
         self._membership_dirty = True
         self._prefix_epoch += 1
-        sp = _SpillState(st, len(blocks), written, host, host_bytes)
+        sp = _SpillState(st, len(blocks), written, host, host_bytes,
+                         shard=shard)
         freed = 0
         for j, b in enumerate(blocks):
             left = self._block_refs.get(b, 1) - 1
@@ -1043,7 +1191,7 @@ class GenerationPool:
                 self._spill_owner[b] = (st.rid, j)
                 sp.dev_blocks[j] = b
             else:
-                self._free_blocks.append(b)
+                self._free_by_shard[shard].append(b)
                 freed += 1
         self._spilled[st.rid] = sp
         self._preempts_total += 1
@@ -1060,7 +1208,7 @@ class GenerationPool:
         restore the table row, cache index and last-token input.  The
         restored K/V are bit-exact, so greedy decode continues
         byte-identically (eager array ops only — no tracked compile)."""
-        slot = self._free.pop()
+        slot = self._pop_free_slot(sp.shard)
         blocks: List[int] = []
         upload: List[tuple] = []  # (logical j, physical block)
         for j in range(sp.total_blocks):
@@ -1071,25 +1219,27 @@ class GenerationPool:
                 self._block_refs[b] = 1
                 blocks.append(b)
             else:
-                nb = self._alloc_blocks(1)[0]
+                nb = self._alloc_blocks(1, sp.shard)[0]
                 blocks.append(nb)
                 if j < sp.written:
                     upload.append((j, nb))
         self._slot_blocks[slot] = blocks
         pos = len(sp.ids) + len(sp.tokens) - 1
-        padded = np.zeros(self._max_blocks, np.int32)
+        scratch = self._shard_scratch(sp.shard)
+        padded = np.full(self._max_blocks, scratch, np.int32)
         padded[:len(blocks)] = blocks
         row = jnp.asarray(padded)
         pos_dev = jnp.asarray(pos, jnp.int32)
         if upload:
             # same power-of-two padding discipline as the spill gather:
-            # pad target ids with block 0, whose write lands in the
-            # scratch block — garbage there is the §5b masking contract
+            # pad target ids with the shard's scratch block, whose
+            # write lands there — garbage in scratch is the §5b masking
+            # contract
             n_up = len(upload)
             padded_n = _pow2_at_least(n_up)
             sel = np.zeros(padded_n, np.intp)
             sel[:n_up] = [j for j, _ in upload]
-            ids = np.zeros(padded_n, np.int32)
+            ids = np.full(padded_n, scratch, np.int32)
             ids[:n_up] = [b for _, b in upload]
             ids_dev = jnp.asarray(ids)
         new_cache = []
@@ -1224,7 +1374,7 @@ class GenerationPool:
         if finishes:
             self._finish(slot)
 
-    def _match_prefix(self, ids):
+    def _match_prefix(self, ids, shard: int = 0):
         """Longest resident block-aligned prefix of ``ids`` in the
         prefix index: ``(physical_blocks, matched_tokens,
         last_matched_chain_key)``.
@@ -1238,7 +1388,13 @@ class GenerationPool:
         entry, so a hash collision cannot splice another prompt's K/V.
         The FINAL prompt position is never matched — the request's
         first output token is sampled from the logits there, so at
-        least one suffix token always runs through the chunk path."""
+        least one suffix token always runs through the chunk path.
+
+        ``shard`` restricts the match to physical blocks in that dp
+        shard's partition (a slot's table row may only name blocks of
+        its own shard); an entry whose copies all live elsewhere ends
+        the chain — with dp == 1 every block qualifies, the legacy
+        behavior."""
         bs = self._block_size
         limit = (len(ids) - 1) // bs
         blocks: List[int] = []
@@ -1251,7 +1407,14 @@ class GenerationPool:
             if entry is None or entry.tokens != toks \
                     or entry.parent_key != parent:
                 break
-            blocks.append(entry.blocks[-1])
+            if self._dp == 1:
+                cand = entry.blocks[-1]
+            else:
+                cand = next((b for b in reversed(entry.blocks)
+                             if self._shard_of_block(b) == shard), None)
+                if cand is None:
+                    break
+            blocks.append(cand)
             last_matched = key
         return blocks, len(blocks) * bs, last_matched
 
@@ -1301,22 +1464,26 @@ class GenerationPool:
             st.indexed += 1
 
     def _admit_chunked(self, req: _Request, need: int, matched_blocks,
-                       matched_len: int, chain_key) -> None:
+                       matched_len: int, chain_key,
+                       shard: int = 0) -> None:
         """Chunked-prefill admission: map the matched prefix blocks
         READ-ONLY (refcounts bumped), allocate fresh blocks for
         everything from ``matched_len`` on (suffix + generation — every
         position this request will WRITE), point the slot's table row
         at them and set its index to ``matched_len``.  No prompt
         forward runs here: ``_chunk_work`` processes the unmatched
-        suffix at most ``prefill_chunk_tokens`` per tick."""
+        suffix at most ``prefill_chunk_tokens`` per tick.  ``shard``
+        (chosen by ``_choose_shard``) pins the slot and every block to
+        one dp partition."""
         _fire("pool.alloc_blocks")
-        slot = self._free.pop()
+        slot = self._pop_free_slot(shard)
         for b in matched_blocks:
             self._block_refs[b] += 1
         blocks = list(matched_blocks) + \
-            self._alloc_blocks(need - len(matched_blocks))
+            self._alloc_blocks(need - len(matched_blocks), shard)
         self._slot_blocks[slot] = blocks
-        padded = np.zeros(self._max_blocks, np.int32)
+        padded = np.full(self._max_blocks, self._shard_scratch(shard),
+                         np.int32)
         padded[:len(blocks)] = blocks
         self._cache = self._admit_jit(
             self._cache, jnp.asarray(slot, jnp.int32),
@@ -1394,6 +1561,47 @@ class GenerationPool:
                 best, best_key = ("resume", sp), key
         return best
 
+    def _match_prefix_memo(self, req: _Request, shard: int):
+        """Per-(candidate, epoch, shard) memo over ``_match_prefix``:
+        a blocked head would otherwise re-walk its whole chain (tuple-
+        build + hash per block) every tick per shard until blocks
+        free.  The epoch bumps on any allocator/index mutation, so a
+        memoized match is exactly as fresh as a recomputed one."""
+        sig = (req.rid, self._prefix_epoch)
+        if self._head_match is None or self._head_match[0] != sig:
+            self._head_match = (sig, {})
+        per_shard = self._head_match[1]
+        if shard not in per_shard:
+            per_shard[shard] = self._match_prefix(req.ids, shard)
+        return per_shard[shard]
+
+    def _choose_shard(self, req: _Request, need: int):
+        """Pick the dp shard a queued paged admission should land in:
+        among shards with a free slot, the one whose partition can
+        hold the reservation (free + reclaimable-spilled, minus any
+        prefix hit), preferring the LONGEST prefix match and then the
+        most headroom.  Returns ``(shard, matched_blocks, matched_len,
+        chain_key)`` — ``(None, [], 0, None)`` when no shard with a
+        free slot can hold it right now (the caller block-waits).
+        With dp == 1 this reduces exactly to the legacy single-list
+        admission check."""
+        shards = sorted({self._shard_of_slot(s) for s in self._free})
+        best = best_key = None
+        for s in shards:
+            matched: tuple = ([], 0, None)
+            if self.prefix_sharing:
+                matched = self._match_prefix_memo(req, s)
+            avail = len(self._free_by_shard[s]) \
+                + self._spilled_dev_count(s)
+            if need - len(matched[0]) > avail:
+                continue
+            key = (matched[1], avail)
+            if best_key is None or key > best_key:
+                best, best_key = (s,) + matched, key
+        if best is None:
+            return None, [], 0, None
+        return best
+
     def _refill(self):
         tr = _trace_active()
         self.admission_blocked = False
@@ -1403,13 +1611,22 @@ class GenerationPool:
                 break  # every candidate is tenant-capped right now
             kind, item = pick
             if kind == "resume":
+                # a resume is SHARD-PINNED: its zero-copy device blocks
+                # and its table row's partition live in the shard it
+                # was preempted from — block-wait for a slot there
+                if self._dp > 1 and not any(
+                        self._shard_of_slot(s) == item.shard
+                        for s in self._free):
+                    self.admission_blocked = True
+                    break
                 # re-acquire the fresh blocks the resume needs (blocks
                 # still in the spill tier re-map for free; the tier's
-                # OTHER entries are reclaimable on top of the free list)
+                # OTHER entries in the same shard are reclaimable on
+                # top of its free list)
                 own = sum(1 for b in item.dev_blocks if b is not None)
                 need_fresh = item.total_blocks - own
-                avail = len(self._free_blocks) \
-                    + len(self._spill_owner) - own
+                avail = len(self._free_by_shard[item.shard]) \
+                    + self._spilled_dev_count(item.shard) - own
                 if need_fresh > avail:
                     self.admission_blocked = True
                     break  # block-wait on the CHOSEN candidate
@@ -1418,30 +1635,21 @@ class GenerationPool:
                 continue
             req = item
             matched_blocks, matched_len, chain_key = [], 0, None
+            shard = None
             if self.cache_layout == "paged":
                 # admission control: the chosen candidate waits until
                 # enough blocks are free (+reclaimable from the spill
-                # tier) for its whole reservation — skipping ahead to a
-                # smaller request would starve long prompts within the
-                # declared priority ordering.  With sharing, matched
-                # blocks come off the requirement: a hit admits under
-                # block pressure a cold prompt could not
+                # tier) for its whole reservation IN SOME SHARD with a
+                # free slot — skipping ahead to a smaller request would
+                # starve long prompts within the declared priority
+                # ordering.  With sharing, matched blocks come off the
+                # requirement: a hit admits under block pressure a cold
+                # prompt could not
                 need = self._blocks_needed(len(req.ids),
                                            req.max_new_tokens)
-                if self.prefix_sharing:
-                    sig = (req.rid, self._prefix_epoch)
-                    if self._head_match is not None \
-                            and self._head_match[0] == sig:
-                        matched_blocks, matched_len, chain_key = \
-                            self._head_match[1]
-                    else:
-                        matched_blocks, matched_len, chain_key = \
-                            self._match_prefix(req.ids)
-                        self._head_match = (
-                            sig, (matched_blocks, matched_len,
-                                  chain_key))
-                if need - len(matched_blocks) > \
-                        len(self._free_blocks) + len(self._spill_owner):
+                shard, matched_blocks, matched_len, chain_key = \
+                    self._choose_shard(req, need)
+                if shard is None:
                     self.admission_blocked = True
                     break
             # remove by IDENTITY: _Request is a namedtuple holding a
@@ -1453,7 +1661,7 @@ class GenerationPool:
                     break
             if self._chunk_tokens is not None:
                 self._admit_chunked(req, need, matched_blocks,
-                                    matched_len, chain_key)
+                                    matched_len, chain_key, shard)
                 continue
             # bucketed batch-1 prefill (compiled per bucket, shared with
             # DecodeSession.generate) emits the request's FIRST token;
@@ -1472,16 +1680,18 @@ class GenerationPool:
                         # deep-timing honesty: the prefill span ends at
                         # the fusion boundary, not at dispatch return
                         jax.block_until_ready(row_cache)
-            slot = self._free.pop()
+            slot = self._pop_free_slot(shard)
             first = int(np.asarray(tok)[0])
             if self.cache_layout == "paged":
                 _fire("pool.alloc_blocks")
-                blocks = self._alloc_blocks(need)
+                blocks = self._alloc_blocks(need, shard)
                 self._slot_blocks[slot] = blocks
-                # pad the table row to max_blocks with the scratch block:
-                # unreserved logical blocks are never read (masked past
-                # the request's span) and their splice writes are trash
-                padded = np.zeros(self._max_blocks, np.int32)
+                # pad the table row to max_blocks with the shard's
+                # scratch block: unreserved logical blocks are never
+                # read (masked past the request's span) and their
+                # splice writes are trash
+                padded = np.full(self._max_blocks,
+                                 self._shard_scratch(shard), np.int32)
                 padded[:need] = blocks
                 self._cache = self._insert_jit(
                     self._cache, row_cache, jnp.asarray(slot, jnp.int32),
@@ -1561,8 +1771,15 @@ class GenerationPool:
         if self._membership_dirty:
             active = np.zeros(self.slots, bool)
             active[list(self._active)] = True
-            self._tok_dev = jnp.asarray(self._last_tok)
-            self._active_dev = jnp.asarray(active)
+            if self._mesh is not None:
+                # commit the step vectors to their dp sharding up
+                # front: uncommitted inputs would let the compiled
+                # executable pick (and pay a reshard per call)
+                self._tok_dev = self._mesh.place(self._last_tok, "dp")
+                self._active_dev = self._mesh.place(active, "dp")
+            else:
+                self._tok_dev = jnp.asarray(self._last_tok)
+                self._active_dev = jnp.asarray(active)
             self._membership_dirty = False
         if self._state_cache is None:
             self._state_cache = self._session._state_vals()
@@ -1679,7 +1896,10 @@ class GenerationPool:
         self._spill_owner.clear()
         self.admission_blocked = False
         if self.cache_layout == "paged":
-            self._free_blocks = list(range(1, self._num_blocks))
+            self._free_by_shard = [
+                list(range(s * self._blocks_per_shard + 1,
+                           (s + 1) * self._blocks_per_shard))
+                for s in range(self._dp)]
             self._slot_blocks = {}
             self._block_refs = {}
             # the prefix index names physical blocks in the cache being
@@ -1691,11 +1911,7 @@ class GenerationPool:
             self._block_keys.clear()
             self._prefix_epoch += 1
             self._head_match = None
-        self._cache = self._model.gen_decode_cache(
-            self.slots, self.max_len, self._cache_dtype, per_slot=True,
-            layout=self.cache_layout, block_size=self._block_size,
-            num_blocks=(self._num_blocks
-                        if self.cache_layout == "paged" else None))
+        self._cache = self._new_cache()
 
     def run(self) -> Dict[object, np.ndarray]:
         """Drain queue + slots; {request_id: np.int32 token array}."""
@@ -1747,7 +1963,7 @@ class GenerationPool:
         if not step_entry or "flops" not in step_entry:
             return {}
         tokens = self.slots * float(tokens_per_step_per_slot)
-        return {
+        out = {
             "step_flops": step_entry["flops"],
             "step_bytes_accessed": step_entry["bytes_accessed"],
             "hbm_reserved_bytes": step_entry.get("hbm_reserved_bytes"),
@@ -1757,6 +1973,16 @@ class GenerationPool:
             "tokens_per_step": tokens,
             "basis": basis,
         }
+        if self._mesh is not None:
+            # under SPMD the compiled artifact is the PER-DEVICE
+            # partitioned module, so the analyses above are per-shard
+            # figures; say so, and stamp the mesh so a record reader
+            # can reconstruct mesh totals (devices × per-device)
+            out["mesh"] = self._mesh.describe()
+            out["basis"] += ("; SPMD executable — compiler analyses "
+                             "are per-device over dp×mp=%d devices"
+                             % self._mesh.devices_n)
+        return out
 
     def cost_report(self) -> dict:
         """Cost/memory attribution of every executable this pool runs,
@@ -1800,6 +2026,8 @@ class GenerationPool:
         stats = {"cache_layout": self.cache_layout,
                  "cache_dtype": str(np.dtype(first.k.dtype)),
                  "dense_equiv_bytes": dense_bytes}
+        if self._mesh is not None:
+            stats["mesh"] = self._mesh.describe()
         if self.cache_layout == "paged":
             bs = self._block_size
             # resident = unique blocks some live slot's table row maps
@@ -1825,19 +2053,62 @@ class GenerationPool:
             reachable = per_token * sum(
                 max(0, min((j + 1) * bs, self.max_len) - j * bs)
                 for j in seen.values())
+            pool_bytes = self._num_blocks * bs * per_token
             stats.update(
                 block_size=bs,
                 num_blocks=self._num_blocks,
-                free_blocks=len(self._free_blocks),
+                free_blocks=sum(len(fl) for fl in self._free_by_shard),
                 mapped_blocks=mapped,
                 spilled_blocks=len(self._spill_owner),
                 reachable_bytes=reachable,
                 # blocks referenced beyond their first owner — the live
                 # HBM the prefix index is currently saving
                 shared_blocks=self._shared_block_count(),
-                pool_bytes=self._num_blocks * bs *
-                dense_bytes // (self.slots * self.max_len))
+                pool_bytes=pool_bytes)
+            # PER-SHARD accounting beside the mesh totals: the figure a
+            # per-chip capacity decision (the scheduler's spill
+            # thresholds, an HBM headroom alarm) must read — a
+            # mesh-total-only gauge would overstate per-chip headroom
+            # by dp×.  With dp == 1 this is a one-entry restatement of
+            # the totals, so consumers need no mesh special-case.
+            if self._dp == 1:
+                # restate the totals (no rescans: cache_stats runs on
+                # the per-tick gauge path)
+                mapped_by = [mapped]
+                spilled_by = [len(self._spill_owner)]
+                reach_by = [reachable]
+            else:
+                # one pass per collection, bucketing by owning shard
+                mapped_by = [0] * self._dp
+                for b in self._block_refs:
+                    mapped_by[self._shard_of_block(b)] += 1
+                spilled_by = [0] * self._dp
+                for b in self._spill_owner:
+                    spilled_by[self._shard_of_block(b)] += 1
+                reach_by = [0] * self._dp
+                for b, j in seen.items():
+                    reach_by[self._shard_of_block(b)] += per_token * \
+                        max(0, min((j + 1) * bs, self.max_len) - j * bs)
+            stats["per_shard"] = [{
+                "shard": s,
+                "num_blocks": self._blocks_per_shard,
+                "scratch_block": self._shard_scratch(s),
+                "free_blocks": len(self._free_by_shard[s]),
+                "mapped_blocks": mapped_by[s],
+                "spilled_blocks": spilled_by[s],
+                "reachable_bytes": reach_by[s],
+                "pool_bytes": pool_bytes // self._dp,
+            } for s in range(self._dp)]
         else:
             stats.update(reachable_bytes=dense_bytes,
                          pool_bytes=dense_bytes)
+            stats["per_shard"] = [
+                {"shard": s, "reachable_bytes": dense_bytes // self._dp,
+                 "pool_bytes": dense_bytes // self._dp}
+                for s in range(self._dp)]
+        if self._mesh is not None:
+            # bytes one DEVICE holds: dp splits the slot/block axis,
+            # mp splits the head axis of every K/V (and scale) leaf
+            stats["pool_bytes_per_device"] = \
+                stats["pool_bytes"] // self._mesh.devices_n
         return stats

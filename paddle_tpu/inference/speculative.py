@@ -79,7 +79,7 @@ class SpeculativePool(GenerationPool):
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, time_split: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
-                 prefix_sharing: bool = False):
+                 prefix_sharing: bool = False, mesh=None):
         if float(temperature) != 0.0:
             raise InvalidArgumentError(
                 "speculative decoding is greedy-only (temperature=0): "
@@ -105,16 +105,18 @@ class SpeculativePool(GenerationPool):
                          cache_layout=cache_layout, block_size=block_size,
                          num_blocks=num_blocks,
                          prefill_chunk_tokens=prefill_chunk_tokens,
-                         prefix_sharing=prefix_sharing)
+                         prefix_sharing=prefix_sharing, mesh=mesh)
         self.spec_k = int(spec_k)
         # the draft session owns the draft binding and its bucketed
         # batch-1 prefill (compiled once per bucket); its decode step is
-        # unused — the pool's slot-batched draft step below replaces it
+        # unused — the pool's slot-batched draft step below replaces it.
+        # Under a mesh the draft shares it: draft weights place by the
+        # same mp axis rules, the draft slot cache shards over dp like
+        # the target's
         self._draft_session = DecodeSession(
             draft_model, max_len, buckets=buckets, temperature=0.0,
-            donate=donate)
-        self._draft_cache = draft_model.gen_decode_cache(
-            self.slots, self.max_len, "float32", per_slot=True)
+            donate=donate, mesh=mesh)
+        self._draft_cache = self._new_draft_cache()
         if donate is None:
             donate = jax.default_backend() != "cpu"
         dn = (2,) if donate else ()
@@ -227,14 +229,13 @@ class SpeculativePool(GenerationPool):
         idx0 = cache[0].index                                # [slots]
         tables = None
         if self.cache_layout == "paged":
-            # inactive rows' tables are zeroed FOR the step (scratch-
-            # routed writes) but restored in the returned cache: under
-            # chunked prefill an inactive slot can be mid-prompt, and
-            # persisting the zeroed row would wipe its mapping
+            # inactive rows' tables are scratch-routed FOR the step
+            # (each slot to ITS shard's scratch block) but restored in
+            # the returned cache: under chunked prefill an inactive
+            # slot can be mid-prompt, and persisting the masked row
+            # would wipe its mapping
             tables = [c.table for c in cache]
-            cache = [c._replace(table=jnp.where(active[:, None],
-                                                c.table, 0))
-                     for c in cache]
+            cache = self._masked_tables(cache, active)
         logits, new_cache = sess._run_model(param_vals, buf_vals, chunk,
                                             cache)
         m, emitted = greedy_accept(logits, chunk, active)    # [S], [S,K+1]
@@ -452,14 +453,22 @@ class SpeculativePool(GenerationPool):
         super().refresh_weights()
         self._draft_state_cache = None
 
+    def _new_draft_cache(self):
+        """Allocate the dense fp32 draft slot cache (placed over the
+        mesh — slot axis 'dp', head axis 'mp' — when one is set)."""
+        cache = self._draft_session._model.gen_decode_cache(
+            self.slots, self.max_len, "float32", per_slot=True)
+        if self._mesh is not None:
+            cache = self._mesh.place_cache(cache)
+        return cache
+
     def reset(self):
         """Base reset (queue/slots/target cache/allocator) plus a fresh
         draft slot cache — the draft's state is as untrusted as the
         target's after a failed round, and it rebuilds the same way:
         re-allocation only, every compiled executable kept."""
         super().reset()
-        self._draft_cache = self._draft_session._model.gen_decode_cache(
-            self.slots, self.max_len, "float32", per_slot=True)
+        self._draft_cache = self._new_draft_cache()
 
     def acceptance_stats(self) -> dict:
         """{'spec_k', 'rounds', 'drafted', 'accepted',

@@ -43,7 +43,7 @@ from ..nn.layer.layers import Layer
 
 __all__ = [
     "to_static", "not_to_static", "StaticFunction", "InputSpec", "TrainStep",
-    "MultiStepTrainStep", "DecodeSession", "sample_logits",
+    "MultiStepTrainStep", "DecodeSession", "DecodeMesh", "sample_logits",
     "FINISH_EOS", "FINISH_LENGTH", "classify_finish", "truncate_at_eos",
     "SpeculativeDecodeSession", "check_draft_compatible",
     "save", "load", "TranslatedLayer", "ProgramTranslator", "TracedLayer",
@@ -871,6 +871,7 @@ class TracedLayer:
 
 # the decode engine imports _StateBinding back from this module, so it
 # loads after everything above is defined
+from .mesh import DecodeMesh  # noqa: E402,F401
 from .decode import (  # noqa: E402,F401
     FINISH_EOS, FINISH_LENGTH, DecodeSession, classify_finish,
     sample_logits, truncate_at_eos)

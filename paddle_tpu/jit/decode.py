@@ -162,9 +162,27 @@ class DecodeSession:
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, cache_dtype="float32",
                  donate: Optional[bool] = None,
-                 cache_layout: str = "dense", block_size: int = 32):
+                 cache_layout: str = "dense", block_size: int = 32,
+                 mesh=None):
         from . import _StateBinding
 
+        if mesh is not None:
+            # GSPMD serving (docs/DESIGN.md §5k): place every weight on
+            # the mesh by the decode axis rules — attention heads / MLP
+            # hidden sharded over 'mp', the rest replicated — BEFORE
+            # the binding snapshots parameter identities.  The traced
+            # bodies are untouched; XLA partitions them from the
+            # operand shardings (the pool shards the cache/slot axis
+            # over 'dp' on its side)
+            from .mesh import DecodeMesh
+
+            if not isinstance(mesh, DecodeMesh):
+                raise InvalidArgumentError(
+                    "mesh must be a jit.mesh.DecodeMesh (or None for "
+                    "single-device decode), got %r"
+                    % (type(mesh).__name__,))
+            mesh.place_weights(model)
+        self.mesh = mesh
         if not hasattr(model, "gen_decode_cache"):
             raise InvalidArgumentError(
                 "DecodeSession needs a model with gen_decode_cache() and "

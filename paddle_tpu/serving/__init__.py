@@ -71,6 +71,15 @@ decision emitted as ``sched.preempt``/``sched.resume``/
 structured-log lines.  A degraded engine is HEALTHY: ``GET /healthz``
 stays 200 and carries the level.
 
+Sharded serving (docs/DESIGN.md §5k): ``ServingEngine(...,
+mesh=jit.mesh.DecodeMesh(dp, mp))`` runs the SAME scheduler over a
+GSPMD decode pool — the slot axis and paged block pool sharded over
+``dp`` (per-shard scratch/free-list partition), attention heads and
+MLP hidden over ``mp`` — byte-identical to the unsharded engine with
+unchanged compile counts.  The engine sees logical slots only; mesh
+engines additionally export ``serving_mesh_devices`` and the per-shard
+KV byte gauges (per-chip headroom, not mesh-total optimism).
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —

@@ -397,6 +397,27 @@ class ServingEngine:
             "serving_kv_free_blocks",
             "paged allocator free blocks") \
             if self._pool.cache_layout == "paged" else None
+        # sharded-serving surface (docs §5k): gauges exist only when
+        # the pool runs over a DecodeMesh, like the paged-only gauges.
+        # The per-shard resident gauge is the satellite fix: a
+        # mesh-total-only byte gauge would overstate per-chip headroom
+        # by dp× exactly where the scheduler's spill decisions need
+        # the per-chip number
+        _mesh = getattr(self._pool, "mesh", None)
+        self._g_mesh_devices = m.gauge(
+            "serving_mesh_devices",
+            "devices the decode mesh spans (dp * mp)") \
+            if _mesh is not None else None
+        self._g_kv_resident_shard = m.gauge(
+            "serving_kv_resident_bytes_per_shard",
+            "KV cache bytes resident in ONE dp shard's partition "
+            "(mesh-total / dp; the per-chip-headroom figure along the "
+            "slot/block axis)") if _mesh is not None else None
+        self._g_kv_reachable_shard = m.gauge(
+            "serving_kv_reachable_bytes_max_shard",
+            "largest per-dp-shard reachable KV bytes right now (the "
+            "most loaded shard's occupancy)") \
+            if _mesh is not None else None
         # prefix-sharing / chunked-prefill surface (docs §5i): gauges
         # exist only when the feature is on, like the paged free-block
         # gauge — a dense engine's /metrics is unchanged
@@ -1043,6 +1064,12 @@ class ServingEngine:
         self._g_kv_resident.set(stats["pool_bytes"])
         if self._g_kv_free is not None:
             self._g_kv_free.set(stats["free_blocks"])
+        if self._g_kv_resident_shard is not None:
+            self._g_mesh_devices.set(stats["mesh"]["devices"])
+            per_shard = stats["per_shard"]
+            self._g_kv_resident_shard.set(per_shard[0]["pool_bytes"])
+            self._g_kv_reachable_shard.set(
+                max(s["reachable_bytes"] for s in per_shard))
         self._g_preempted.set(pool.preempted_count)
         if self._g_spilled_blocks is not None:
             self._g_spilled_blocks.set(stats["spilled_blocks"])

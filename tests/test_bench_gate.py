@@ -422,3 +422,68 @@ def test_live_serving_prefix_leg_passes_its_own_gate():
     # both modes ran under the same calibrated TTFT promise
     assert leg["slo_ttft_threshold_s"] > 0
     assert "slo_ttft_burn_slow" in on and "slo_ttft_burn_slow" in off
+
+
+def test_serving_sharded_leg_gate():
+    """The sharded leg's structural gate: every mesh sub-leg must
+    carry scaling_efficiency AND the per-shard compiler cost / HBM
+    stamps (the mesh_1x1 baseline is exempt — its scaling is
+    definitionally 1.0), and the usual cache provenance applies."""
+    base = {"cache_layout": "paged", "cache_dtype": "float32",
+            "tokens_per_sec": 1000.0}
+    mesh = dict(base, scaling_efficiency=0.8,
+                cost_flops_per_shard=1e6, cost_bytes_per_shard=1e6,
+                cost_hbm_reserved_per_shard=1e6,
+                kv_resident_bytes_per_shard=4096)
+    good = {"input_staged": False, "transfer_note": "same loop per mesh",
+            "mesh_1x1": dict(base), "mesh_2x1": dict(mesh)}
+    ok, why = bench._leg_promotable("serving_sharded", good)
+    assert ok, why
+    # a mesh sub-leg without its scaling stamp: rejected
+    unscaled = {"input_staged": False, "transfer_note": "x",
+                "mesh_1x1": dict(base),
+                "mesh_2x1": dict(mesh, scaling_efficiency=None)}
+    ok, why = bench._leg_promotable("serving_sharded", unscaled)
+    assert not ok and "scaling" in why and "mesh_2x1" in why
+    # a mesh sub-leg without per-shard cost attribution: rejected
+    uncosted = {"input_staged": False, "transfer_note": "x",
+                "mesh_1x1": dict(base),
+                "mesh_2x1": dict(mesh, cost_hbm_reserved_per_shard=None)}
+    ok, why = bench._leg_promotable("serving_sharded", uncosted)
+    assert not ok and "per-shard" in why
+    # missing cache provenance rejects like every serving leg
+    nostamp = {"input_staged": False, "transfer_note": "x",
+               "mesh_2x1": {k: v for k, v in mesh.items()
+                            if k != "cache_layout"}}
+    ok, why = bench._leg_promotable("serving_sharded", nostamp)
+    assert not ok and "cache_layout" in why
+    # a baseline-only leg (1-device run skipped every real mesh)
+    # measured no sharding at all: rejected, never a hollow record
+    baseline_only = {"input_staged": False, "transfer_note": "x",
+                     "mesh_1x1": dict(base)}
+    ok, why = bench._leg_promotable("serving_sharded", baseline_only)
+    assert not ok and "no sharded mesh sub-leg" in why
+
+
+@pytest.mark.slow
+def test_live_serving_sharded_leg_passes_its_own_gate():
+    """The leg bench.py actually emits must satisfy its own gate — a
+    real subprocess run under 8 forced host devices; slow-marked (it
+    compiles four pools in a cold child process)."""
+    import jax
+
+    import paddle_tpu as pt
+
+    leg = bench.bench_serving_sharded(pt, jax, False)
+    ok, why = bench._leg_promotable("serving_sharded", leg)
+    assert ok, why
+    # the child saw the forced devices and measured real meshes
+    assert leg["devices_available"] >= 4
+    assert "mesh_1x1" in leg and "mesh_2x2" in leg
+    for name in ("mesh_2x1", "mesh_1x2", "mesh_2x2"):
+        sub = leg[name]
+        assert sub["scaling_efficiency"] is not None
+        # per-shard HBM shrinks under dp (the block pool is split)
+        if sub["mesh_dp"] > 1:
+            assert sub["kv_resident_bytes_per_shard"] < \
+                leg["mesh_1x1"]["kv_resident_bytes"]

@@ -28,6 +28,7 @@ SCRIPTS = [
     ("13_observatory.py", ["--tokens", "8"]),
     ("14_prefix_serving.py", ["--tokens", "8"]),
     ("15_overload_serving.py", ["--tokens", "8"]),
+    ("16_sharded_serving.py", ["--tokens", "8"]),
 ]
 
 

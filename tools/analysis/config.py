@@ -83,6 +83,21 @@ EXTRA_EDGES = {
     "SpeculativePool.step": ("ServingEngine._on_token",
                              "ServingEngine._on_finish",
                              "Tracer.span"),
+    # sharded serving (docs §5k): the mesh placement helpers are
+    # reached through ``self._mesh`` — assigned from a constructor
+    # ARGUMENT, so the AST's local-constructor type inference cannot
+    # see DecodeMesh behind it.  Declaring the seams keeps the
+    # step-input re-placement (fires on membership changes inside the
+    # tick), the shard-mapped admission chain (_choose_shard →
+    # per-shard prefix match), and the cache re-placement inside
+    # recovery/reset hot-path-audited like every other dynamic seam
+    # (the _refill → _choose_shard → per-shard match chain is direct
+    # self-calls the AST already resolves — no edge needed there)
+    "GenerationPool._sync_step_inputs": ("DecodeMesh.place",),
+    "GenerationPool._new_cache": ("DecodeMesh.place_cache",),
+    "SpeculativePool._new_draft_cache": ("DecodeMesh.place_cache",),
+    "DecodeMesh.place_cache": ("DecodeMesh.place",),
+    "DecodeMesh.place": ("DecodeMesh.sharding",),
     # fault plane: the hot path's module-level no-op check fans into the
     # installed plane, so the plane's own fire() is hot-path-audited
     "_fire": ("fire",),

@@ -262,6 +262,82 @@ def prefix_reuse_sweep(pt, cfg, batches, buckets, gen, reuse_fracs):
     return legs
 
 
+def mesh_sweep(pt, cfg, batches, buckets, gen, meshes, block_size):
+    """Sharded (GSPMD, docs §5k) pool tok/s per (bucket, batch, dp×mp
+    mesh) against the in-run unsharded baseline, with PER-SHARD HBM
+    columns from the allocator and a scaling-efficiency column
+    (measured tok/s ÷ baseline × devices).  Meshes that don't fit the
+    device set or the model's head count are skipped out loud."""
+    import jax
+
+    from paddle_tpu.inference import GenerationPool
+    from paddle_tpu.jit.mesh import DecodeMesh
+    from paddle_tpu.models import TransformerLM
+
+    rng = np.random.RandomState(0)
+    n_dev = len(jax.devices())
+    legs = []
+    for bucket in buckets:
+        max_len = bucket + gen
+        for batch in batches:
+            prompts = [rng.randint(0, cfg["vocab_size"],
+                                   (bucket,)).astype("int32")
+                       for _ in range(batch)]
+            base_tps = None
+            for dp, mp in [(1, 1)] + meshes:
+                if dp * mp > n_dev:
+                    print("mesh %dx%d skipped: needs %d devices, "
+                          "have %d" % (dp, mp, dp * mp, n_dev))
+                    continue
+                if cfg["num_heads"] % mp:
+                    print("mesh %dx%d skipped: mp must divide "
+                          "num_heads=%d" % (dp, mp, cfg["num_heads"]))
+                    continue
+                slots = batch if batch % dp == 0 \
+                    else dp * (-(-batch // dp))
+                # fresh model per pool: weight placement MUTATES params
+                pt.seed(0)
+                model = TransformerLM(**cfg, dropout=0.0)
+                pool = GenerationPool(
+                    model, max_len, slots=slots, buckets=[bucket],
+                    cache_layout="paged", block_size=block_size,
+                    mesh=None if dp == mp == 1 else DecodeMesh(dp, mp))
+                pool.generate(prompts[:1], 2)  # compile + warm
+                walls, toks = [], 0
+                for _ in range(REPEATS):
+                    t0 = time.perf_counter()
+                    outs = pool.generate(prompts, gen)
+                    walls.append(time.perf_counter() - t0)
+                    toks = sum(len(o) for o in outs)
+                tps = toks / float(np.median(walls))
+                if dp == mp == 1:
+                    base_tps = tps
+                    scaling = None
+                else:
+                    scaling = round(tps / (base_tps * dp * mp), 4) \
+                        if base_tps else None
+                stats = pool.cache_stats()
+                legs.append(dict(
+                    batch=batch, prefill=bucket, generated=gen,
+                    mesh_dp=dp, mesh_mp=mp, slots=slots,
+                    cache_layout="paged", cache_dtype="float32",
+                    block_size=block_size,
+                    kv_resident_bytes=stats["pool_bytes"],
+                    kv_resident_bytes_per_shard=stats["per_shard"][0]
+                    ["pool_bytes"],
+                    kv_resident_bytes_per_device=stats.get(
+                        "pool_bytes_per_device", stats["pool_bytes"]),
+                    decode_tokens_per_sec=round(tps, 1),
+                    scaling_efficiency=scaling))
+                print("bucket %-5d batch %-3d  mesh %dx%d  %8.1f tok/s"
+                      "  shard-HBM %6.2f MiB%s"
+                      % (bucket, batch, dp, mp, tps,
+                         legs[-1]["kv_resident_bytes_per_shard"] / 2**20,
+                         ("  eff %.3f" % scaling)
+                         if scaling is not None else ""), flush=True)
+    return legs
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batches", type=int, nargs="+", default=[1, 2, 4, 8])
@@ -288,6 +364,13 @@ def main():
                          "at K draft tokens per round (0 = off); every "
                          "speculative row records tok/s AND its "
                          "measured acceptance rate")
+    ap.add_argument("--mesh", nargs="*", default=[], metavar="DP,MP",
+                    help="also sweep the GSPMD sharded pool at these "
+                         "dp,mp meshes (e.g. --mesh 2,1 2,2); every row "
+                         "records tok/s, per-shard HBM, and scaling "
+                         "efficiency vs the in-run unsharded baseline. "
+                         "With --cpu-smoke, 8 virtual host devices are "
+                         "forced so the meshes fit")
     ap.add_argument("--cpu-smoke", action="store_true",
                     help="tiny model on CPU to exercise the harness")
     ap.add_argument("--out",
@@ -297,12 +380,28 @@ def main():
                          "the CWD; never written into tools/)")
     args = ap.parse_args()
 
-    from bench import _acquire_chip_lock, _peak_flops
+    meshes = []
+    for spec in args.mesh:
+        try:
+            dp, mp = (int(x) for x in spec.split(","))
+        except ValueError:
+            sys.exit("--mesh entries must be DP,MP (e.g. 2,1), got %r"
+                     % spec)
+        if dp < 1 or mp < 1:
+            sys.exit("--mesh needs dp >= 1 and mp >= 1, got %r" % spec)
+        meshes.append((dp, mp))
+
+    from bench import _acquire_chip_lock, _peak_flops, force_host_devices
 
     if not args.cpu_smoke and _acquire_chip_lock(timeout_s=600.0) is None:
         sys.exit("another process holds the chip lock; not contending")
     if args.cpu_smoke:
         os.environ["JAX_PLATFORMS"] = "cpu"
+        if meshes:
+            # must land before jax initializes its backends (below):
+            # the dp×mp meshes need multiple devices, and on CPU those
+            # are the forced host devices
+            force_host_devices(os.environ)
 
     import jax
 
@@ -337,6 +436,11 @@ def main():
         spec_legs = speculative_sweep(pt, cfg, args.batches,
                                       args.buckets, args.gen,
                                       args.speculate)
+    mesh_legs = None
+    if meshes:
+        mesh_legs = mesh_sweep(pt, cfg, args.batches, args.buckets,
+                               args.gen, meshes,
+                               block_size=(args.block_sizes or [16])[0])
     reuse_legs = None
     if args.prompt_reuse:
         bad = [f for f in args.prompt_reuse if not 0.0 <= f <= 1.0]
@@ -358,10 +462,12 @@ def main():
               "cache_dtypes": args.cache_dtypes,
               "spec_k": args.speculate or None,
               "prompt_reuse": args.prompt_reuse or None,
+              "mesh": [list(m) for m in meshes] or None,
               "compile_counts": compiles,
               "legs": legs,
               "speculative_legs": spec_legs,
-              "prompt_reuse_legs": reuse_legs}
+              "prompt_reuse_legs": reuse_legs,
+              "mesh_legs": mesh_legs}
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print("report:", args.out)
