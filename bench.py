@@ -582,13 +582,20 @@ def bench_decode(pt, jax, on_tpu: bool):
                 cost = sess._decode_jit.last_cost() or {}
                 flops = cost.get("flops")
                 nbytes = cost.get("bytes_accessed")
+                bpt = None if nbytes is None else nbytes / batch
                 legs["%s_%s_batch%d" % (layout, tag, batch)] = dict(
                     m, cache_layout=layout, cache_dtype=cache_dtype,
+                    decode_route=sess.route,
                     decode_tokens_per_sec=round(tps, 1),
                     cost_flops_per_token=(None if flops is None
                                           else flops / batch),
-                    cost_bytes_per_token=(None if nbytes is None
-                                          else nbytes / batch),
+                    cost_bytes_per_token=bpt,
+                    # measured tok/s x compiler-stated bytes/token: the
+                    # HBM bandwidth the decode step actually sustains —
+                    # the roofline column the fused kernel (§5l) exists
+                    # to move, stamped so bench_report can gate it
+                    bandwidth_util_bytes_per_sec=(
+                        None if bpt is None else round(tps * bpt, 1)),
                     cost_hbm_reserved_bytes=cost.get(
                         "hbm_reserved_bytes"),
                     cost_kv_cache_bytes=cost.get("kv_cache_bytes"),
@@ -598,6 +605,40 @@ def bench_decode(pt, jax, on_tpu: bool):
                         **dims))
                 best_tps = max(best_tps, tps)
             compile_counts["%s_%s" % (layout, tag)] = sess.compile_counts()
+    if on_tpu:
+        # kernel-routed sub-legs (compiled pallas, TPU only — off-TPU
+        # the forced route runs the INTERPRETER, whose wall time
+        # measures the interpreter): the paged fused kernel against the
+        # composition legs above at the big-batch point, both dtypes.
+        # _leg_promotable refuses these without the bandwidth stamp.
+        for cache_dtype in ("float32", "int8"):
+            sess = DecodeSession(model, max_len=max_len,
+                                 buckets=[prefill],
+                                 cache_layout="paged",
+                                 block_size=DECODE_BLOCK_SIZE,
+                                 cache_dtype=cache_dtype, route="pallas")
+            tag = "fp32" if cache_dtype == "float32" else cache_dtype
+            ids = rng.randint(0, cfg["vocab_size"],
+                              (8, prefill)).astype("int32")
+            m = measure_decode_marginal(sess, ids, gen)
+            tps = 8 / m["per_token_s"]
+            cost = sess._decode_jit.last_cost() or {}
+            nbytes = cost.get("bytes_accessed")
+            bpt = None if nbytes is None else nbytes / 8
+            legs["paged_%s_batch8_pallas" % tag] = dict(
+                m, cache_layout="paged", cache_dtype=cache_dtype,
+                decode_route="pallas",
+                decode_tokens_per_sec=round(tps, 1),
+                cost_bytes_per_token=bpt,
+                bandwidth_util_bytes_per_sec=(
+                    None if bpt is None else round(tps * bpt, 1)),
+                kv_reachable_bytes=kv_reachable_bytes(
+                    [max_len] * 8, layout="paged",
+                    block_size=DECODE_BLOCK_SIZE, dtype=cache_dtype,
+                    **dims))
+            best_tps = max(best_tps, tps)
+            compile_counts["paged_%s_pallas" % tag] = \
+                sess.compile_counts()
     # the paged win AND the int8 byte reduction quantified across fill
     # levels: reachable KV bytes at batch-8 occupancy fractions of
     # max_len (dense pins the full slab whatever the occupancy; paged
@@ -741,14 +782,20 @@ def bench_serving(pt, jax, on_tpu: bool):
         # figures: per-token FLOPs/bytes and the step executable's HBM
         # reservation, from the artifact this leg actually ran
         cost = engine.cost_report().get("derived") or {}
+        bpt = cost.get("bytes_per_token")
         out["batch%d" % slots] = {
             "slots": slots,
             "requests": len(prompts),
             "cache_layout": stats["cache_layout"],
             "cache_dtype": stats["cache_dtype"],
+            "decode_route": stats.get("decode_route", "auto"),
             "kv_resident_bytes": stats["pool_bytes"],
             "cost_flops_per_token": cost.get("flops_per_token"),
-            "cost_bytes_per_token": cost.get("bytes_per_token"),
+            "cost_bytes_per_token": bpt,
+            # sustained HBM bandwidth (tok/s x compiler bytes/token) —
+            # the §5l roofline column; gated for kernel-routed legs
+            "bandwidth_util_bytes_per_sec": (
+                None if bpt is None else round(tps * bpt, 1)),
             "cost_hbm_reserved_bytes": cost.get("hbm_reserved_bytes"),
             "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 5),
             "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 5),
@@ -1349,12 +1396,17 @@ def bench_speculative(pt, jax, on_tpu: bool):
                            buckets=[prefill])
     plain_tps, plain_wall = timed_run(plain)
     plain_cost = plain.cost_report().get("derived") or {}
+    plain_bpt = plain_cost.get("bytes_per_token")
     out["plain_batch%d" % slots] = {
         "cache_layout": "dense", "cache_dtype": "float32",
+        "decode_route": "auto",
         "tokens_per_sec": round(plain_tps, 1),
         "wall_s": round(plain_wall, 4),
         "cost_flops_per_token": plain_cost.get("flops_per_token"),
-        "cost_bytes_per_token": plain_cost.get("bytes_per_token"),
+        "cost_bytes_per_token": plain_bpt,
+        "bandwidth_util_bytes_per_sec": (
+            None if plain_bpt is None
+            else round(plain_tps * plain_bpt, 1)),
     }
     # only plain_tps is needed past this point: drop the plain pool's
     # slots x max_len KV cache before building the speculative pools
@@ -1370,15 +1422,19 @@ def bench_speculative(pt, jax, on_tpu: bool):
         tps, wall = timed_run(pool)
         st = pool.acceptance_stats()  # timed region only (post-reset)
         spec_cost = pool.cost_report().get("derived") or {}
+        spec_bpt = spec_cost.get("bytes_per_token")
         sub = {
             "cache_layout": "dense", "cache_dtype": "float32",
+            "decode_route": "auto",
             "tokens_per_sec": round(tps, 1),
             "wall_s": round(wall, 4),
             # compiler-reported round cost at the MEASURED acceptance
             # rate (the derivation's basis field says so) — the cost
             # model the speedup_vs_plain stamp can be checked against
             "cost_flops_per_token": spec_cost.get("flops_per_token"),
-            "cost_bytes_per_token": spec_cost.get("bytes_per_token"),
+            "cost_bytes_per_token": spec_bpt,
+            "bandwidth_util_bytes_per_sec": (
+                None if spec_bpt is None else round(tps * spec_bpt, 1)),
             "speedup_vs_plain": round(tps / plain_tps, 4),
             "acceptance_rate": round(st["acceptance_rate"], 4),
             "rounds": st["rounds"],
@@ -1705,6 +1761,22 @@ def _leg_promotable(name: str, leg: dict):
                            "%s: dense-vs-paged / fp32-vs-int8 "
                            "provenance unknown"
                            % (name, missing or "every timed sub-leg"))
+        # a KERNEL-ROUTED number (decode_route == "pallas", the fused
+        # §5l kernel) without its bandwidth-utilization stamp (tok/s x
+        # compiler-stated bytes/token) cannot say what fraction of the
+        # streamed HBM bytes the kernel sustained — the roofline figure
+        # the kernel exists to move, so it is the number's provenance
+        unstamped = sorted(
+            k for k, v in timed.items()
+            if v.get("decode_route") == "pallas"
+            and not isinstance(v.get("bandwidth_util_bytes_per_sec"),
+                               (int, float)))
+        if unstamped:
+            return False, ("%s leg kernel-routed (decode_route=pallas) "
+                           "but missing bandwidth_util_bytes_per_sec "
+                           "on %s: a fused-kernel number must carry "
+                           "the sustained-bandwidth stamp it exists "
+                           "to improve" % (name, unstamped))
         if name == "serving_faults":
             # a recovery wall time whose survivors LOST tokens measured
             # a broken recovery, not a working one: greedy survivors are

@@ -15,6 +15,11 @@ The serving path (docs/DESIGN.md "The prefill/decode split"):
 4. ``cache_dtype="int8"`` — the quantized KV cache (docs/DESIGN.md
    §5d): K/V stored int8 with per-head fp32 scales, dequantized inside
    the attention, ~4x fewer cache bytes streamed per decode step.
+5. ``route="pallas"`` — the fused pallas decode kernel (docs/DESIGN.md
+   §5l) forced against the XLA composition: same greedy tokens, byte
+   for byte, same compile counts (off-TPU the kernel runs under the
+   pallas interpreter — the identity is the point here, the speed
+   belongs to on-chip sweeps).
 
 Run: python examples/08_generate_serving.py [--tokens 16]
 """
@@ -122,6 +127,26 @@ def main():
           "(%.2fx; int8 K/V + riding fp32 scales)"
           % (pool_fp["pool_bytes"], s8["pool_bytes"],
              s8["pool_bytes"] / pool_fp["pool_bytes"]))
+
+    # -- fused pallas decode kernel: forced-route identity ---------------
+    # the same paged+int8 session down both routes: the composition
+    # gathers (and dequantizes) the cache in HBM, the kernel streams
+    # blocks through VMEM with an online softmax — and the tokens must
+    # not care.  route="auto" keeps the measured-crossover gate (the
+    # kernel engages on TPU past DECODE_FLASH_MIN_CACHE); forcing is
+    # the test/sweep knob used here
+    routes = {}
+    for route in ("composition", "pallas"):
+        s = DecodeSession(model, max_len=96, buckets=[64],
+                          cache_layout="paged", block_size=16,
+                          cache_dtype="int8", route=route)
+        routes[route] = (s.generate(prompt, 8), s.compile_counts())
+    toks_c, counts_c = routes["composition"]
+    toks_p, counts_p = routes["pallas"]
+    assert np.array_equal(toks_c, toks_p), "route must not change tokens"
+    assert counts_c == counts_p, "route must not change compile counts"
+    print("fused-kernel route matches composition byte-for-byte "
+          "(paged int8, compiles %s)" % (counts_p,))
 
 
 if __name__ == "__main__":

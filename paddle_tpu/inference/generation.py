@@ -335,7 +335,8 @@ class GenerationPool:
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_sharing: bool = False,
                  tenant_slot_cap: Optional[int] = None,
-                 mesh: Optional[DecodeMesh] = None):
+                 mesh: Optional[DecodeMesh] = None,
+                 route: str = "auto"):
         if slots < 1:
             raise InvalidArgumentError("GenerationPool needs slots >= 1")
         if mesh is not None and not isinstance(mesh, DecodeMesh):
@@ -392,11 +393,15 @@ class GenerationPool:
         # The session shares the pool's cache layout so a paged pool gets
         # paged (identity-tabled, batch-1) row caches from prefill whose
         # blocks splice straight into the pool's global block pool.
+        # the route rides the session (validated there) and is ambient
+        # for every traced body that goes through _run_model — the
+        # pool's batched decode step, the chunk prefill, and the
+        # speculative subclass's draft/verify included (§5l)
         self._session = DecodeSession(
             model, max_len, buckets=buckets, temperature=temperature,
             top_k=top_k, top_p=top_p, cache_dtype=cache_dtype,
             donate=donate, cache_layout=cache_layout,
-            block_size=block_size, mesh=mesh)
+            block_size=block_size, mesh=mesh, route=route)
         self._model = model
         self._cache_dtype = cache_dtype
         from ..jit.speculative import model_vocab_size
@@ -2025,6 +2030,11 @@ class GenerationPool:
         # an int8 byte count as an fp32 one
         stats = {"cache_layout": self.cache_layout,
                  "cache_dtype": str(np.dtype(first.k.dtype)),
+                 # the decode-attention route (§5l) is provenance the
+                 # same way layout/dtype are: a tok/s or byte figure
+                 # from the fused kernel must never be presented as a
+                 # composition number (bench legs stamp this)
+                 "decode_route": self._session.route,
                  "dense_equiv_bytes": dense_bytes}
         if self._mesh is not None:
             stats["mesh"] = self._mesh.describe()

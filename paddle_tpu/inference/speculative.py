@@ -79,7 +79,8 @@ class SpeculativePool(GenerationPool):
                  temperature: float = 0.0, top_k: int = 0,
                  top_p: float = 1.0, time_split: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
-                 prefix_sharing: bool = False, mesh=None):
+                 prefix_sharing: bool = False, mesh=None,
+                 route: str = "auto"):
         if float(temperature) != 0.0:
             raise InvalidArgumentError(
                 "speculative decoding is greedy-only (temperature=0): "
@@ -105,7 +106,8 @@ class SpeculativePool(GenerationPool):
                          cache_layout=cache_layout, block_size=block_size,
                          num_blocks=num_blocks,
                          prefill_chunk_tokens=prefill_chunk_tokens,
-                         prefix_sharing=prefix_sharing, mesh=mesh)
+                         prefix_sharing=prefix_sharing, mesh=mesh,
+                         route=route)
         self.spec_k = int(spec_k)
         # the draft session owns the draft binding and its bucketed
         # batch-1 prefill (compiled once per bucket); its decode step is
@@ -113,9 +115,12 @@ class SpeculativePool(GenerationPool):
         # Under a mesh the draft shares it: draft weights place by the
         # same mp axis rules, the draft slot cache shards over dp like
         # the target's
+        # the draft shares the route: its batched decode step is a
+        # decode-family executable like the target's (Lq=1, so the
+        # fused kernel applies to it the same way)
         self._draft_session = DecodeSession(
             draft_model, max_len, buckets=buckets, temperature=0.0,
-            donate=donate, mesh=mesh)
+            donate=donate, mesh=mesh, route=route)
         self._draft_cache = self._new_draft_cache()
         if donate is None:
             donate = jax.default_backend() != "cpu"

@@ -163,8 +163,18 @@ class DecodeSession:
                  top_p: float = 1.0, cache_dtype="float32",
                  donate: Optional[bool] = None,
                  cache_layout: str = "dense", block_size: int = 32,
-                 mesh=None):
+                 mesh=None, route: str = "auto"):
         from . import _StateBinding
+        from ..ops.flash_attention import normalize_decode_route
+
+        # decode-attention routing (docs/DESIGN.md §5l): "auto" keeps
+        # the measured-crossover discipline (the fused pallas kernel
+        # engages only where the ops-layer gates say it wins);
+        # "composition"/"pallas" force a path for tests and sweeps.
+        # PYTHON-static: the route picks which ops the session's
+        # executables trace, so the exactly-two-compiles contract and
+        # the executable cache keys are untouched.
+        self.route = normalize_decode_route(route)
 
         if mesh is not None:
             # GSPMD serving (docs/DESIGN.md §5k): place every weight on
@@ -284,14 +294,21 @@ class DecodeSession:
         owned by a training loop neither samples with dropout nor — the
         nastier failure — silently flips the shared model to eval mode
         as a constructor side effect."""
+        from ..ops.flash_attention import decode_route
+
         binding = self._binding
         saved = binding.swap_in(param_vals, buf_vals)
         modes = [l.training for l in binding.sublayers]
         for l in binding.sublayers:
             l.training = False
         try:
-            logits, new_cache = self._model(
-                Tensor(ids, stop_gradient=True), cache=cache)
+            # the session's route is ambient for the trace: every
+            # decode-attention call under the layer stack (this
+            # session's steps AND the pool/speculative bodies that call
+            # _run_model) routes by it without a kwarg through forward
+            with decode_route(self.route):
+                logits, new_cache = self._model(
+                    Tensor(ids, stop_gradient=True), cache=cache)
             raw = logits.value if isinstance(logits, Tensor) else logits
         finally:
             for l, t in zip(binding.sublayers, modes):

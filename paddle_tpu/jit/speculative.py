@@ -145,7 +145,7 @@ class SpeculativeDecodeSession:
                  spec_k: int = 4, buckets: Optional[Sequence[int]] = None,
                  temperature: float = 0.0, cache_dtype="float32",
                  cache_layout: str = "dense", block_size: int = 32,
-                 donate: Optional[bool] = None):
+                 donate: Optional[bool] = None, route: str = "auto"):
         if float(temperature) != 0.0:
             raise InvalidArgumentError(
                 "speculative decoding is greedy-only (temperature=0): "
@@ -159,13 +159,17 @@ class SpeculativeDecodeSession:
                 % (spec_k,))
         check_draft_compatible(draft_model, target_model)
         self.spec_k = int(spec_k)
+        # the route reaches the verify chunk through the target
+        # session's _run_model (§5l): Lq = spec_k+1 <= 8 keeps the
+        # verify inside the fused kernel's chunk window
         self._target = DecodeSession(
             target_model, max_len, buckets=buckets, temperature=0.0,
             cache_dtype=cache_dtype, donate=donate,
-            cache_layout=cache_layout, block_size=block_size)
+            cache_layout=cache_layout, block_size=block_size,
+            route=route)
         self._draft = DecodeSession(
             draft_model, max_len, buckets=buckets, temperature=0.0,
-            donate=donate)
+            donate=donate, route=route)
         self.max_len = self._target.max_len
         self.cache_layout = cache_layout
         if donate is None:

@@ -298,8 +298,6 @@ class MultiHeadAttention(Layer):
             v_new, v_s = quantize_kv(v_new)
         idx = jnp.asarray(cache.index, jnp.int32)
         b, _, length, _ = q_.shape
-        max_len = k_buf.shape[2]
-        neg = jnp.asarray(jnp.finfo(jnp.float32).min, q_.dtype)
         if idx.ndim == 0:
             # aligned batch (DecodeSession): one slice write for the chunk
             k_buf = jax.lax.dynamic_update_slice(
@@ -312,8 +310,6 @@ class MultiHeadAttention(Layer):
                 vs_buf = jax.lax.dynamic_update_slice(vs_buf, v_s,
                                                       (0, 0, idx))
             q_pos = idx + jnp.arange(length)                    # [L]
-            allow = jnp.arange(max_len)[None, :] <= q_pos[:, None]
-            bias = jnp.where(allow, 0.0, neg)[None, None]       # [1,1,L,S]
         else:
             # slot-batched decode/verify: each row writes its L-token
             # chunk at its OWN position — a scatter over [B, L]
@@ -334,9 +330,7 @@ class MultiHeadAttention(Layer):
                     k_s.transpose(0, 2, 1), mode="drop")
                 vs_buf = vs_buf.at[rows, :, pos].set(
                     v_s.transpose(0, 2, 1), mode="drop")
-            allow = (jnp.arange(max_len)[None, None, :]
-                     <= pos[:, :, None])                        # [B,L,S]
-            bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,L,S]
+            q_pos = pos                                         # [B,L]
         if attn_mask is not None:
             # a caller's mask is keyed to the CHUNK length while the
             # score axis here is the cache length max_len — combining
@@ -347,7 +341,11 @@ class MultiHeadAttention(Layer):
                 "index (causal over the valid prefix); additive "
                 "attn_mask is not supported with a DecodeCache — pass "
                 "attn_mask=None, or use the uncached forward")
-        out = decode_attention(q_, k_buf, v_buf, bias=bias,
+        # masking travels in index form (q_pos = each query's last
+        # visible key): the composition route rebuilds the exact
+        # additive causal-prefix mask this code used to build inline,
+        # while the fused pallas route masks in-register (§5l)
+        out = decode_attention(q_, k_buf, v_buf, q_pos=q_pos,
                                k_scale=ks_buf, v_scale=vs_buf)
         return out, self.DecodeCache(k_buf, v_buf, idx + length,
                                      ks_buf, vs_buf)
@@ -391,7 +389,6 @@ class MultiHeadAttention(Layer):
         b, _, length, _ = q_.shape
         bs = k_pool.shape[2]
         s = table.shape[1] * bs
-        neg = jnp.asarray(jnp.finfo(jnp.float32).min, q_.dtype)
         if idx.ndim == 0:
             # aligned batch (DecodeSession): every row writes the same
             # chunk positions; one scatter over [B, L] (pos, block) pairs
@@ -407,8 +404,7 @@ class MultiHeadAttention(Layer):
                     k_s.transpose(0, 2, 1))
                 vs_pool = vs_pool.at[phys, :, off].set(
                     v_s.transpose(0, 2, 1))
-            allow = jnp.arange(s)[None, :] <= pos[:, None]
-            bias = jnp.where(allow, 0.0, neg)[None, None]       # [1,1,L,S]
+            q_pos = pos                                         # [L]
         else:
             # slot-batched decode/verify: each row writes its L-token
             # chunk at its OWN position, addressed through ITS table row
@@ -430,10 +426,12 @@ class MultiHeadAttention(Layer):
                     k_s.transpose(0, 2, 1))
                 vs_pool = vs_pool.at[phys, :, off].set(
                     v_s.transpose(0, 2, 1))
-            allow = (jnp.arange(s)[None, None, :]
-                     <= pos[:, :, None])                        # [B,L,S]
-            bias = jnp.where(allow, 0.0, neg)[:, None]          # [B,1,L,S]
-        out = paged_decode_attention(q_, k_pool, v_pool, table, bias=bias,
+            q_pos = pos                                         # [B,L]
+        # masking travels in index form (see _decode_forward): the
+        # composition rebuilds the inline additive mask op-for-op; the
+        # fused route walks the table in-kernel and masks in-register
+        out = paged_decode_attention(q_, k_pool, v_pool, table,
+                                     q_pos=q_pos,
                                      k_scale=ks_pool, v_scale=vs_pool)
         return out, cache._replace(
             k=k_pool, v=v_pool, k_scale=ks_pool, v_scale=vs_pool,

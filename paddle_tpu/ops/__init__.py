@@ -8,17 +8,28 @@ matrix out of HBM entirely, which is the bandwidth win that decides MFU at
 long sequence length.
 """
 from .flash_attention import (  # noqa: F401
+    DECODE_ROUTES,
     decode_attention,
     decode_attention_supported,
+    decode_route,
     dequantize_kv,
     flash_attention,
     flash_attention_supported,
+    normalize_decode_route,
     paged_decode_attention,
     paged_decode_attention_supported,
     quantize_kv,
+    reset_backend_memo,
+)
+from .pallas_decode import (  # noqa: F401
+    decode_attention_kernel,
+    paged_decode_attention_kernel,
 )
 
 __all__ = ["flash_attention", "flash_attention_supported",
            "decode_attention", "decode_attention_supported",
            "paged_decode_attention", "paged_decode_attention_supported",
-           "quantize_kv", "dequantize_kv"]
+           "quantize_kv", "dequantize_kv",
+           "decode_attention_kernel", "paged_decode_attention_kernel",
+           "decode_route", "normalize_decode_route", "DECODE_ROUTES",
+           "reset_backend_memo"]

@@ -14,16 +14,22 @@ call works on CPU test meshes and odd shapes.
 """
 from __future__ import annotations
 
+import contextlib
+import threading
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.errors import InvalidArgumentError
+
 __all__ = ["flash_attention", "flash_attention_supported",
            "decode_attention", "decode_attention_supported",
            "paged_decode_attention", "paged_decode_attention_supported",
-           "quantize_kv", "dequantize_kv"]
+           "quantize_kv", "dequantize_kv",
+           "decode_route", "normalize_decode_route", "DECODE_ROUTES",
+           "reset_backend_memo"]
 
 _SUPPORTED_DTYPES = (jnp.float32, jnp.bfloat16)
 
@@ -182,22 +188,177 @@ def dequantize_kv(q, scale, dtype=jnp.float32):
 # pallas flash kernel is shape-gated to Lq % 128 == 0, so a single-query
 # decode step can NEVER take it; the decode-step composition below is a
 # batched GEMV + softmax + GEMV that XLA fuses into one HBM pass over the
-# cache, and no shipped kernel has beaten that below this cache length.
-# When a paged/splash single-query kernel lands, its measured crossover
-# replaces this constant the same way FLASH_MIN_SEQ was established.
+# cache, and below this cache length no measurement has shown the fused
+# pallas decode kernel (ops/pallas_decode.py) beating it.  Above it the
+# "auto" route engages the kernel on TPU; ``tools/decode_sweep.py
+# --route`` measures both paths so this constant is replaceable by a
+# sweep, not a guess (the same way FLASH_MIN_SEQ was established).
 DECODE_FLASH_MIN_CACHE = 16384
+
+# -- decode routing ----------------------------------------------------
+# "auto": the measured-crossover discipline — the fused pallas kernel
+#   engages exactly where the ``*_supported`` gates say it wins (TPU
+#   backend, short chunk, MXU-tileable head_dim, cache past the
+#   crossover); everything else takes the XLA composition.
+# "composition": force the gather+dequant+attention composition.
+# "pallas": force the fused kernel wherever it structurally applies
+#   (Lq <= 8, float queries) — off-TPU it runs under the pallas
+#   INTERPRETER, which is how tier-1 tests pin numeric identity on CPU;
+#   shapes the kernel cannot take (the bucketed prefill's long chunk)
+#   silently keep the composition, so a forced session still prefills.
+DECODE_ROUTES = ("auto", "composition", "pallas")
+
+# The ambient route is THREAD-LOCAL (the repo's convention for ambient
+# trace state — core/amp_state.py, core/random.py): the serving
+# engine's loop thread traces its executables under its own route
+# while the main thread may be warming another session, and a shared
+# stack would let one thread pop the other's entry mid-trace.
+_route_state = threading.local()
+
+
+def _route_stack() -> list:
+    stack = getattr(_route_state, "stack", None)
+    if stack is None:
+        stack = _route_state.stack = ["auto"]
+    return stack
+
+
+def normalize_decode_route(route) -> str:
+    """Validated route name, or a typed error naming the choices —
+    checked at session/pool construction AND at every explicit
+    ``route=`` call site, so a typo'd route fails loudly instead of
+    silently decoding on the wrong path."""
+    if route not in DECODE_ROUTES:
+        raise InvalidArgumentError(
+            "decode route must be one of %s, got %r"
+            % (list(DECODE_ROUTES), route))
+    return route
+
+
+@contextlib.contextmanager
+def decode_route(route):
+    """Ambient decode-attention routing for a trace region: the decode
+    sessions wrap their model forwards in this so the ``route=`` knob
+    reaches the attention ops buried under the layer stack without
+    threading a kwarg through every ``forward``.  The route is
+    PYTHON-static — it selects which ops get traced, so a session's
+    executables are compiled for exactly one path and the compile-count
+    contract is untouched."""
+    stack = _route_stack()
+    stack.append(normalize_decode_route(route))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+# jax.default_backend() walks the backend registry on every call; the
+# decode gates run on EVERY trace of every decode-family executable, so
+# the lookup is memoized at module level (the backend cannot change
+# within a process once jax initializes).  ``reset_backend_memo`` is
+# the test hook for monkeypatched backends.
+_backend_memo: Optional[str] = None
+
+
+def _cached_backend() -> str:
+    global _backend_memo
+    if _backend_memo is None:
+        _backend_memo = jax.default_backend()
+    return _backend_memo
+
+
+def reset_backend_memo() -> None:
+    global _backend_memo
+    _backend_memo = None
+
+
+def _kernel_feasible(q_shape, dtype) -> bool:
+    """Structural floor for the fused kernel (what ``route='pallas'``
+    may force): 4-D queries, a decode/verify-sized chunk, float query
+    dtype.  The MXU/crossover conditions live in the ``*_supported``
+    gates — they decide WINNING, this decides EXISTING."""
+    from .pallas_decode import MAX_KERNEL_QUERY_CHUNK
+
+    return (len(q_shape) == 4 and q_shape[2] <= MAX_KERNEL_QUERY_CHUNK
+            and jnp.dtype(dtype) in _SUPPORTED_DTYPES)
+
+
+def _bias_kernel_compatible(bias, b, h, lq, s) -> bool:
+    """The kernel streams bias block-wise and needs the materialized
+    4-D [B|1, H|1, Lq, S] layout; other broadcastable shapes keep the
+    composition (the transformer decode paths pass ``q_pos`` instead of
+    a bias, so this only ever gates external callers).  The shape rule
+    itself lives with the kernel (``bias_streamable``) so routing and
+    kernel validation cannot diverge."""
+    if bias is None:
+        return True
+    from .pallas_decode import bias_streamable
+
+    return bias_streamable(getattr(bias, "shape", ()), b, h, lq, s)
+
+
+def _resolve_route(route, supported: bool, feasible: bool) -> bool:
+    """True when this call takes the fused pallas kernel."""
+    r = _route_stack()[-1] if route is None \
+        else normalize_decode_route(route)
+    if r == "composition":
+        return False
+    if r == "pallas":
+        return feasible
+    return supported
+
+
+def _qpos_bias(q_pos, s_len: int, dtype):
+    """The composition's additive mask from last-visible-key positions:
+    [L] q_pos -> [1, 1, L, S] (aligned batch), [B, L] -> [B, 1, L, S]
+    (slot-batched) — op-for-op the mask the transformer decode paths
+    built inline before the routing seam existed, so the composition's
+    jaxpr (and its compiled output) is unchanged."""
+    qp = jnp.asarray(q_pos, jnp.int32)
+    neg = jnp.asarray(jnp.finfo(jnp.float32).min, dtype)
+    if qp.ndim == 1:
+        allow = jnp.arange(s_len)[None, :] <= qp[:, None]
+        return jnp.where(allow, 0.0, neg)[None, None]
+    allow = jnp.arange(s_len)[None, None, :] <= qp[:, :, None]
+    return jnp.where(allow, 0.0, neg)[:, None]
+
+
+def _effective_qpos(q_pos, lengths, b: int, lq: int, s: int):
+    """The kernel's [B, Lq] mask-index form of whatever masking the
+    caller expressed: ``q_pos`` (per-query last visible key) and/or
+    ``lengths`` (valid-token counts; key s is visible iff s < lengths,
+    i.e. last visible = lengths - 1), combined by min.  With neither,
+    every key is visible."""
+    qp = None
+    if q_pos is not None:
+        qp = jnp.asarray(q_pos, jnp.int32)
+        if qp.ndim == 1:
+            qp = jnp.broadcast_to(qp[None, :], (b, lq))
+        else:
+            qp = jnp.broadcast_to(qp, (b, lq))
+    if lengths is not None:
+        ln = jnp.asarray(lengths, jnp.int32)
+        if ln.ndim == 0:
+            ln = jnp.broadcast_to(ln[None], (b,))
+        lim = jnp.broadcast_to((ln - 1)[:, None], (b, lq))
+        qp = lim if qp is None else jnp.minimum(qp, lim)
+    if qp is None:
+        qp = jnp.full((b, lq), s - 1, jnp.int32)
+    return qp
 
 
 def decode_attention_supported(q_shape, kv_len: int, dtype) -> bool:
-    """Gate for a future single-query pallas decode kernel: TPU backend,
-    4-D [B, H, Lq, D] with a short query chunk, MXU-tileable head_dim and
-    a cache long enough to beat the fused XLA composition.  Currently no
-    such kernel ships, so the gate's callers always take the composition
-    path below the crossover — the gate exists so the routing discipline
-    (and its tests) are already in place when one lands."""
-    if jax.default_backend() != "tpu":
+    """Gate for the fused single-query/short-chunk pallas decode kernel
+    (``ops.pallas_decode.decode_attention_kernel``): TPU backend, 4-D
+    [B, H, Lq, D] with a short query chunk, MXU-tileable head_dim and a
+    cache long enough to beat the fused XLA composition.  This is the
+    "auto" route's decision; ``route="pallas"``/``"composition"``
+    override it for tests and sweeps."""
+    from .pallas_decode import MAX_KERNEL_QUERY_CHUNK
+
+    if _cached_backend() != "tpu":
         return False
-    if len(q_shape) != 4 or q_shape[2] > 8:
+    if len(q_shape) != 4 or q_shape[2] > MAX_KERNEL_QUERY_CHUNK:
         return False
     if q_shape[3] not in (64, 128, 256):
         return False
@@ -207,7 +368,7 @@ def decode_attention_supported(q_shape, kv_len: int, dtype) -> bool:
 
 
 def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None,
-                     k_scale=None, v_scale=None):
+                     k_scale=None, v_scale=None, q_pos=None, route=None):
     """Decode-step attention: [B, H, Lq, D] queries against a FULL
     preallocated cache [B, H, S, D] (S = max_len), with ``bias`` masking
     the invalid tail (positions at or beyond the cache index) to -inf.
@@ -229,19 +390,43 @@ def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None,
     cache: K/V arrive as int8 and are dequantized per head IN the
     composition (the HBM read is int8; the up-cast fuses into the score
     matmul).  The sm_scale default keys off the QUERY's head_dim, so the
-    int8 path scores identically to fp32 up to quantization error."""
+    int8 path scores identically to fp32 up to quantization error.
+
+    ``q_pos`` ([Lq] or [B, Lq] int32) expresses the causal-prefix mask
+    as the last key position each query may attend — the structured
+    form the decode-cache forwards pass so the fused kernel route can
+    mask in-register instead of streaming a materialized bias; the
+    composition builds the exact additive mask the callers used to
+    build inline.  ``route`` overrides the ambient :func:`decode_route`
+    ("auto" | "composition" | "pallas")."""
     d = q.shape[-1]
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(d))
+    s = k.shape[2]
+    if _resolve_route(
+            route,
+            decode_attention_supported(q.shape, s, q.dtype)
+            and _bias_kernel_compatible(bias, q.shape[0], q.shape[1],
+                                        q.shape[2], s),
+            _kernel_feasible(q.shape, q.dtype)):
+        # fused pallas route (docs/DESIGN.md §5l): stream cache tiles
+        # through VMEM with an online softmax — int8 tiles dequantize
+        # in VMEM, so the HBM read stays int8 and the gathered fp32
+        # cache is never materialized
+        from .pallas_decode import decode_attention_kernel
+
+        qp = _effective_qpos(q_pos, None, q.shape[0], q.shape[2], s)
+        return decode_attention_kernel(
+            q, k, v, qp, float(sm_scale), k_scale=k_scale,
+            v_scale=v_scale, bias=bias,
+            interpret=_cached_backend() != "tpu")
     if k_scale is not None:
         k = dequantize_kv(k, k_scale, q.dtype)
     if v_scale is not None:
         v = dequantize_kv(v, v_scale, q.dtype)
-    if decode_attention_supported(q.shape, k.shape[2], q.dtype):
-        # reserved routing slot: a paged/splash single-query kernel lands
-        # here once a measured crossover justifies it; until then even a
-        # gate-passing shape falls through to the fused composition
-        pass
+    if q_pos is not None:
+        pos_bias = _qpos_bias(q_pos, s, q.dtype)
+        bias = pos_bias if bias is None else bias + pos_bias
     scores = jnp.einsum("...qd,...kd->...qk", q, k) * jnp.asarray(
         sm_scale, q.dtype)
     if bias is not None:
@@ -257,16 +442,18 @@ def decode_attention(q, k, v, bias=None, sm_scale: Optional[float] = None,
 
 def paged_decode_attention_supported(q_shape, block_size: int,
                                      num_blocks: int, dtype) -> bool:
-    """Gate for a future single-query pallas PAGED decode kernel, mirroring
+    """Gate for the fused pallas PAGED decode kernel
+    (``ops.pallas_decode.paged_decode_attention_kernel``), mirroring
     ``decode_attention_supported``: TPU backend, short query chunk,
     MXU-tileable head_dim, sublane-aligned block_size, and a pool big
-    enough that a hand-tiled gather kernel could beat the XLA
-    gather+composition.  No such kernel ships yet — callers always fall
-    through to the composition — but the routing discipline (and its
-    tests) are in place for when one measures in."""
-    if jax.default_backend() != "tpu":
+    enough that the hand-tiled gather kernel beats the XLA
+    gather+composition.  The "auto" route's decision;
+    ``route="pallas"``/``"composition"`` override it."""
+    from .pallas_decode import MAX_KERNEL_QUERY_CHUNK
+
+    if _cached_backend() != "tpu":
         return False
-    if len(q_shape) != 4 or q_shape[2] > 8:
+    if len(q_shape) != 4 or q_shape[2] > MAX_KERNEL_QUERY_CHUNK:
         return False
     if q_shape[3] not in (64, 128, 256):
         return False
@@ -279,7 +466,8 @@ def paged_decode_attention_supported(q_shape, block_size: int,
 
 def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
                            sm_scale: Optional[float] = None,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None, q_pos=None,
+                           route=None):
     """Decode-step attention against a BLOCK-TABLE KV cache.
 
     ``q``: [B, H, Lq, D] queries (Lq = 1 for autoregressive decode,
@@ -309,10 +497,33 @@ def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
     and dense logits agree to float-reduction noise).  The pool rows a
     step can READ are exactly the mapped blocks, so cache HBM scales
     with allocated tokens, not max_len × rows.
+
+    ``q_pos``/``route`` as in :func:`decode_attention`.  On the fused
+    pallas route the gather below never happens: the kernel's grid
+    walks the table itself (scalar-prefetched block indices feed the
+    DMA), streams pool blocks into VMEM, dequantizes int8 rows there,
+    and runs the online softmax — so the composition's HBM-materialized
+    [B, H, S, D] gathered (and, for int8, fp32-up-cast) K/V is exactly
+    the traffic the kernel deletes.
     """
     b, mb = table.shape
     nb, h, bs, d = k_pool.shape
     s = mb * bs
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if _resolve_route(
+            route,
+            paged_decode_attention_supported(q.shape, bs, nb, q.dtype)
+            and _bias_kernel_compatible(bias, b, q.shape[1], q.shape[2],
+                                        s),
+            _kernel_feasible(q.shape, q.dtype)):
+        from .pallas_decode import paged_decode_attention_kernel
+
+        qp = _effective_qpos(q_pos, lengths, b, q.shape[2], s)
+        return paged_decode_attention_kernel(
+            q, k_pool, v_pool, jnp.asarray(table, jnp.int32), qp,
+            float(sm_scale), k_scale=k_scale, v_scale=v_scale,
+            bias=bias, interpret=_cached_backend() != "tpu")
     # gather the row's blocks: [B, MB, H, bs, D] -> [B, H, MB*bs, D];
     # XLA lowers the fancy-index to one gather over the pool's leading
     # axis, the only data-dependent op in the step
@@ -334,12 +545,15 @@ def paged_decode_attention(q, k_pool, v_pool, table, lengths=None, bias=None,
         neg = jnp.asarray(jnp.finfo(jnp.float32).min, q.dtype)
         len_bias = jnp.where(allow, 0.0, neg)
         bias = len_bias if bias is None else bias + len_bias
-    if paged_decode_attention_supported(q.shape, bs, nb, q.dtype):
-        # reserved routing slot: a pallas paged/splash kernel that tiles
-        # the gather lands here once a measured crossover justifies it
-        pass
+    if q_pos is not None:
+        pos_bias = _qpos_bias(q_pos, s, q.dtype)
+        bias = pos_bias if bias is None else bias + pos_bias
+    # route pinned to the composition: the kernel decision was made
+    # above on the PAGED shapes — re-routing the gathered dense arrays
+    # would run the dense kernel on K/V already materialized in HBM,
+    # the exact traffic the kernel exists to avoid
     return decode_attention(q, k, v, bias=bias, sm_scale=sm_scale,
-                            k_scale=ks, v_scale=vs)
+                            k_scale=ks, v_scale=vs, route="composition")
 
 
 # id(mask) → (weakref(mask), verdict); masks are immutable jax arrays built

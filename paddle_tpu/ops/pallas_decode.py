@@ -1,0 +1,338 @@
+"""Fused pallas paged/dense decode-attention kernel (docs/DESIGN.md §5l).
+
+The decode-family steps are cache-bandwidth-bound: one (or a short
+chunk of) query positions attend a long KV cache, and the XLA
+composition in ``flash_attention.py`` pays for that in HBM round trips
+the compiler cannot fuse away ("Operator Fusion in XLA", PAPERS.md):
+the paged path's data-dependent table gather MATERIALIZES the gathered
+``[B, H, S, D]`` K/V in HBM before attention, and the int8 path's
+dequantize up-casts the whole gathered cache to fp32 there too — 4-8x
+the bytes the cache actually holds.
+
+This kernel crosses both boundaries by hand.  Per ``(batch row, head,
+logical block)`` grid step it
+
+- reads the row's block table (a scalar-prefetch operand, so the block
+  index feeds the DMA descriptor *before* the body runs) and streams
+  that ONE physical K/V block from the pool in HBM into VMEM;
+- dequantizes int8 rows in VMEM — the per-head scales are gathered
+  through the SAME table row, so a remapped block always carries its
+  own scales;
+- applies the lengths/bias masking in-register (``q_pos`` names each
+  query's last visible key position; an optional additive bias streams
+  block-by-block alongside K/V);
+- accumulates attention with an ONLINE softmax across the block axis
+  (running max / normalizer / weighted-V in VMEM scratch that persists
+  over the sequential grid), so neither the gathered fp32 K/V nor the
+  ``[Lq, S]`` score row ever exists in HBM.
+
+``decode_attention_kernel`` is the dense-cache variant on the same
+inner loop: the "table" is the identity walk of the ``[B, H, S, D]``
+buffer, chunked into sequence tiles.
+
+Shapes are static; query chunks are short (``Lq <= 8`` — single-token
+decode and the speculative verify chunk).  ``interpret=True`` runs the
+kernel under the pallas interpreter so the SAME body is tier-1-testable
+on CPU: numeric identity against the composition is pinned without a
+TPU (tests/test_pallas_decode.py), while the routing gates in
+``flash_attention.py`` keep compiled-mode engagement TPU-only and
+measured-crossover honest.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["decode_attention_kernel", "paged_decode_attention_kernel",
+           "MAX_KERNEL_QUERY_CHUNK", "bias_streamable"]
+
+# The longest query chunk the kernel accepts: 1 for autoregressive
+# decode, spec_k+1 for a speculative verify chunk.  Longer chunks are
+# prefill-shaped work — the flash_attention kernel's territory — and
+# the routing layer never sends them here.
+MAX_KERNEL_QUERY_CHUNK = 8
+
+# Finite floor for the running max: masked scores are -inf, so with an
+# all-masked prefix the running max stays at this floor and
+# exp(-inf - floor) == 0 keeps masked positions out of the normalizer
+# (a raw -inf running max would turn exp(-inf - -inf) into NaN).
+_M_FLOOR = -1e30
+
+
+def _dense_seq_block(s: int) -> int:
+    """Sequence tile for the dense variant: the largest sublane-friendly
+    power of two dividing ``s`` (falling back to one whole-sequence tile
+    when nothing divides — correctness never depends on the tile)."""
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if s % cand == 0:
+            return cand
+    return s
+
+
+def bias_streamable(bias_shape, b: int, h: int, lq: int, s: int) -> bool:
+    """Whether an additive bias can stream block-wise through the
+    kernel: 4-D [B|1, H|1, Lq, S].  THE shape rule — the routing layer
+    (flash_attention._bias_kernel_compatible) and the kernel's own
+    validation both read it, so they cannot diverge."""
+    return (len(bias_shape) == 4 and bias_shape[0] in (1, b)
+            and bias_shape[1] in (1, h) and bias_shape[2] == lq
+            and bias_shape[3] == s)
+
+
+def _check_common(q, q_pos, bias, s: int):
+    if q.ndim != 4:
+        raise InvalidArgumentError(
+            "pallas decode kernel needs 4-D [B, H, Lq, D] queries, got "
+            "shape %r" % (tuple(q.shape),))
+    b, h, lq, _ = q.shape
+    if lq > MAX_KERNEL_QUERY_CHUNK:
+        raise InvalidArgumentError(
+            "pallas decode kernel takes query chunks of at most %d "
+            "positions (decode steps and speculative verify chunks), "
+            "got Lq=%d — long chunks are prefill work"
+            % (MAX_KERNEL_QUERY_CHUNK, lq))
+    if q_pos.ndim != 2 or q_pos.shape[0] != b or q_pos.shape[1] != lq:
+        raise InvalidArgumentError(
+            "q_pos must be [B, Lq] int32 last-visible-key positions "
+            "(got %r for q %r)" % (tuple(q_pos.shape), tuple(q.shape)))
+    if bias is not None:
+        bs_ = getattr(bias, "shape", ())
+        if not bias_streamable(bs_, b, h, lq, s):
+            raise InvalidArgumentError(
+                "kernel bias must be 4-D broadcastable to [B, H, Lq, S]"
+                " = %r (got %r); other shapes take the composition path"
+                % ((b, h, lq, s), tuple(bs_)))
+
+
+def _make_body(n_scalar: int, lq: int, bs: int, sm_scale: float,
+               quant: bool, has_bias: bool):
+    """The shared inner loop.  Ref order after the ``n_scalar``
+    scalar-prefetch refs (q_pos always last among them): q, k, v,
+    [k_scale, v_scale,] [bias,] out, then m/l/acc VMEM scratch."""
+
+    def body(*refs):
+        qpos_ref = refs[n_scalar - 1]
+        q_ref, k_ref, v_ref = refs[n_scalar:n_scalar + 3]
+        i = n_scalar + 3
+        ks_ref = vs_ref = bias_ref = None
+        if quant:
+            ks_ref, vs_ref = refs[i:i + 2]
+            i += 2
+        if has_bias:
+            bias_ref = refs[i]
+            i += 1
+        o_ref, m_ref, l_ref, acc_ref = refs[i:i + 4]
+
+        bi = pl.program_id(0)
+        j = pl.program_id(2)
+
+        @pl.when(j == 0)
+        def _():
+            m_ref[...] = jnp.full_like(m_ref, _M_FLOOR)
+            l_ref[...] = jnp.zeros_like(l_ref)
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        qb = q_ref[0, 0].astype(jnp.float32)            # [Lq, D]
+        kb = k_ref[0, 0]                                # [bs, D]
+        vb = v_ref[0, 0]
+        if quant:
+            # VMEM dequant: the HBM read above was int8 — the up-cast
+            # happens here, on one block, never on the gathered cache
+            kb = kb.astype(jnp.float32) * ks_ref[0, 0][:, None]
+            vb = vb.astype(jnp.float32) * vs_ref[0, 0][:, None]
+        else:
+            kb = kb.astype(jnp.float32)
+            vb = vb.astype(jnp.float32)
+        s = jax.lax.dot_general(
+            qb, kb, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * sm_scale  # [Lq, bs]
+        if has_bias:
+            s = s + bias_ref[0, 0].astype(jnp.float32)
+        # mask keys past each query's position (lengths masking, stale
+        # table rows, the scratch block's garbage — all arrive as q_pos)
+        pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (lq, bs), 1)
+        allow = pos <= qpos_ref[bi][:, None]
+        s = jnp.where(allow, s, -jnp.inf)
+        # online softmax: rescale the running sums by exp(m_old - m_new)
+        m_prev = m_ref[...]                             # [Lq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                          # masked -> 0
+        alpha = jnp.exp(m_prev - m_new)
+        l_ref[...] = alpha * l_ref[...] + jnp.sum(p, axis=1,
+                                                  keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vb, dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+
+        @pl.when(j == pl.num_programs(2) - 1)
+        def _():
+            l = l_ref[...]
+            # a row with no visible key (q_pos < 0 everywhere) emits 0
+            # rather than NaN; real decode rows always see position 0
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, 0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+    return body
+
+
+def _scratch(lq: int, d: int):
+    return [pltpu.VMEM((lq, 1), jnp.float32),   # running max
+            pltpu.VMEM((lq, 1), jnp.float32),   # running normalizer
+            pltpu.VMEM((lq, d), jnp.float32)]   # weighted-V accumulator
+
+
+def _bias_index_map(bias_shape, paged: bool):
+    bb, hb = bias_shape[0] > 1, bias_shape[1] > 1
+    if paged:
+        return lambda b, h, j, tbl, qp: (b if bb else 0,
+                                         h if hb else 0, 0, j)
+    return lambda b, h, j, qp: (b if bb else 0, h if hb else 0, 0, j)
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _paged_call(q, k_pool, v_pool, table, q_pos, k_scale, v_scale, bias,
+                sm_scale, interpret):
+    b, h, lq, d = q.shape
+    _, _, bs, _ = k_pool.shape
+    mb = table.shape[1]
+    quant = k_scale is not None
+    has_bias = bias is not None
+
+    def pool_map(bb, hh, j, tbl, qp):
+        return (tbl[bb, j], hh, 0, 0)
+
+    def scale_map(bb, hh, j, tbl, qp):
+        return (tbl[bb, j], hh, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, lq, d), lambda bb, hh, j, tbl, qp:
+                     (bb, hh, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), pool_map),
+        pl.BlockSpec((1, 1, bs, d), pool_map),
+    ]
+    args = [q, k_pool, v_pool]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, bs), scale_map)] * 2
+        args += [k_scale, v_scale]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, lq, bs),
+                                     _bias_index_map(bias.shape, True)))
+        args.append(bias)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h, mb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, lq, d), lambda bb, hh, j, tbl, qp:
+                               (bb, hh, 0, 0)),
+        scratch_shapes=_scratch(lq, d))
+    return pl.pallas_call(
+        _make_body(2, lq, bs, sm_scale, quant, has_bias),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+        interpret=interpret,
+    )(table, q_pos, *args)
+
+
+def paged_decode_attention_kernel(q, k_pool, v_pool, table, q_pos,
+                                  sm_scale: float,
+                                  k_scale=None, v_scale=None, bias=None,
+                                  interpret: bool = False):
+    """Fused paged decode attention: ``q`` [B, H, Lq, D] against a
+    block-table pool [num_blocks, H, bs, D], never materializing the
+    gathered K/V.
+
+    ``table``: [B, max_blocks] int32 — fed as a scalar-prefetch operand
+    so each grid step's DMA streams pool row ``table[b, j]`` directly.
+    ``q_pos``: [B, Lq] int32, the last key position each query may
+    attend (the causal-prefix / lengths mask in index form; stale table
+    rows and the scratch block sit past it and are never read into the
+    softmax).  ``k_scale``/``v_scale`` ([num_blocks, H, bs] fp32) mark
+    an int8 pool; dequantization happens in VMEM on the streamed block.
+    ``bias``: optional additive [B|1, H|1, Lq, S] streamed block-wise.
+    """
+    nb, h, bs, d = k_pool.shape
+    s = table.shape[1] * bs
+    _check_common(q, q_pos, bias, s)
+    if table.ndim != 2 or table.shape[0] != q.shape[0]:
+        raise InvalidArgumentError(
+            "table must be [B, max_blocks] int32 (got %r for q %r)"
+            % (tuple(table.shape), tuple(q.shape)))
+    if (k_scale is None) != (v_scale is None):
+        raise InvalidArgumentError(
+            "int8 pools carry BOTH k_scale and v_scale (got one)")
+    return _paged_call(q, k_pool, v_pool,
+                       jnp.asarray(table, jnp.int32),
+                       jnp.asarray(q_pos, jnp.int32),
+                       k_scale, v_scale, bias,
+                       float(sm_scale), bool(interpret))
+
+
+@functools.partial(jax.jit, static_argnames=("sm_scale", "interpret"))
+def _dense_call(q, k, v, q_pos, k_scale, v_scale, bias, sm_scale,
+                interpret):
+    b, h, lq, d = q.shape
+    s = k.shape[2]
+    bs = _dense_seq_block(s)
+    mb = s // bs
+    quant = k_scale is not None
+    has_bias = bias is not None
+
+    def seq_map(bb, hh, j, qp):
+        return (bb, hh, j, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, lq, d), lambda bb, hh, j, qp:
+                     (bb, hh, 0, 0)),
+        pl.BlockSpec((1, 1, bs, d), seq_map),
+        pl.BlockSpec((1, 1, bs, d), seq_map),
+    ]
+    args = [q, k, v]
+    if quant:
+        in_specs += [pl.BlockSpec((1, 1, bs), lambda bb, hh, j, qp:
+                                  (bb, hh, j))] * 2
+        args += [k_scale, v_scale]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, 1, lq, bs),
+                                     _bias_index_map(bias.shape, False)))
+        args.append(bias)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, h, mb),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, lq, d), lambda bb, hh, j, qp:
+                               (bb, hh, 0, 0)),
+        scratch_shapes=_scratch(lq, d))
+    return pl.pallas_call(
+        _make_body(1, lq, bs, sm_scale, quant, has_bias),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h, lq, d), q.dtype),
+        interpret=interpret,
+    )(q_pos, *args)
+
+
+def decode_attention_kernel(q, k, v, q_pos, sm_scale: float,
+                            k_scale=None, v_scale=None, bias=None,
+                            interpret: bool = False):
+    """Dense-cache variant of the fused decode kernel: the same online
+    softmax inner loop over sequence tiles of a preallocated
+    [B, H, S, D] cache (``k_scale``/``v_scale`` [B, H, S] mark the int8
+    cache; dequant in VMEM).  ``q_pos``/``bias`` as in the paged
+    variant with S = the cache length."""
+    if k.ndim != 4:
+        raise InvalidArgumentError(
+            "dense kernel cache must be [B, H, S, D], got %r"
+            % (tuple(k.shape),))
+    _check_common(q, q_pos, bias, k.shape[2])
+    if (k_scale is None) != (v_scale is None):
+        raise InvalidArgumentError(
+            "int8 caches carry BOTH k_scale and v_scale (got one)")
+    return _dense_call(q, k, v, jnp.asarray(q_pos, jnp.int32),
+                       k_scale, v_scale, bias,
+                       float(sm_scale), bool(interpret))

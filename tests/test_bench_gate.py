@@ -135,6 +135,64 @@ def test_decode_leg_no_timed_subleg_rejected():
     assert not ok and "cache_layout" in why
 
 
+def test_kernel_routed_leg_without_bandwidth_stamp_rejected():
+    # a fused-kernel (§5l) number without its sustained-bandwidth stamp
+    # (tok/s x compiler bytes/token) cannot say what the kernel bought
+    # — the roofline figure it exists to move is its provenance
+    leg = {"tokens_per_sec": 500.0, "transfer_note": "negligible",
+           "paged_fp32_batch8_pallas": {"per_token_s": 0.002,
+                                        "cache_layout": "paged",
+                                        "cache_dtype": "float32",
+                                        "decode_route": "pallas"}}
+    ok, why = bench._leg_promotable("decode", leg)
+    assert not ok and "bandwidth_util_bytes_per_sec" in why
+    # a None stamp (cost analysis unavailable) is just as unpromotable
+    leg["paged_fp32_batch8_pallas"][
+        "bandwidth_util_bytes_per_sec"] = None
+    ok, why = bench._leg_promotable("decode", leg)
+    assert not ok and "bandwidth_util_bytes_per_sec" in why
+
+
+def test_kernel_routed_leg_with_bandwidth_stamp_promotes():
+    leg = {"tokens_per_sec": 500.0, "transfer_note": "negligible",
+           "paged_fp32_batch8_pallas": {
+               "per_token_s": 0.002, "cache_layout": "paged",
+               "cache_dtype": "float32", "decode_route": "pallas",
+               "cost_bytes_per_token": 1.0e6,
+               "bandwidth_util_bytes_per_sec": 5.0e8}}
+    ok, why = bench._leg_promotable("decode", leg)
+    assert ok, why
+
+
+def test_composition_routed_leg_needs_no_bandwidth_stamp():
+    # the gate bites KERNEL-routed legs only: composition/auto legs
+    # (and legacy records predating the stamp) promote as before
+    leg = {"tokens_per_sec": 500.0, "transfer_note": "negligible",
+           "dense_fp32_batch1": {"per_token_s": 0.002,
+                                 "cache_layout": "dense",
+                                 "cache_dtype": "float32",
+                                 "decode_route": "auto"}}
+    assert bench._leg_promotable("decode", leg)[0]
+
+
+def test_kernel_routed_serving_and_speculative_gated_too():
+    # the same stamp rule on the serving and speculative leg families
+    serving = {"tokens_per_sec": 100.0, "transfer_note": "negligible",
+               "batch8": {"ttft_p50_s": 0.01, "cache_layout": "dense",
+                          "cache_dtype": "float32",
+                          "decode_route": "pallas"}}
+    ok, why = bench._leg_promotable("serving", serving)
+    assert not ok and "bandwidth_util_bytes_per_sec" in why
+    spec = {"tokens_per_sec": 100.0, "transfer_note": "negligible",
+            "selfdraft_batch4": {"tokens_per_sec": 100.0,
+                                 "cache_layout": "dense",
+                                 "cache_dtype": "float32",
+                                 "decode_route": "pallas",
+                                 "acceptance_rate": 0.9}}
+    ok, why = bench._leg_promotable("speculative", spec)
+    assert not ok and "bandwidth_util_bytes_per_sec" in why
+
+
 def test_serving_leg_without_cache_layout_rejected():
     # a serving TTFT/tokens-per-sec number inherits the decode leg's
     # provenance rule: no cache_layout stamp, no promotion

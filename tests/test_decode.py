@@ -340,22 +340,31 @@ def test_decode_attention_gate_conditions(monkeypatch):
     import importlib
     fa = importlib.import_module("paddle_tpu.ops.flash_attention")
 
-    # CPU backend: never supported (the fused composition is the kernel)
-    assert not fa.decode_attention_supported((1, 8, 1, 64), 32768,
-                                             jnp.float32)
-    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    ok = (1, 8, 1, 64)
-    assert fa.decode_attention_supported(ok, fa.DECODE_FLASH_MIN_CACHE,
-                                         jnp.bfloat16)
-    # below the measured-crossover cache length: composition wins
-    assert not fa.decode_attention_supported(
-        ok, fa.DECODE_FLASH_MIN_CACHE - 1, jnp.bfloat16)
-    # long query chunks belong to the prefill kernel path
-    assert not fa.decode_attention_supported((1, 8, 9, 64), 32768,
+    # the gate memoizes the backend lookup (it runs on every trace);
+    # clear the memo around the monkeypatch so the fake backend is seen
+    # and cannot leak into later tests
+    fa.reset_backend_memo()
+    try:
+        # CPU backend: never supported (the fused composition wins)
+        assert not fa.decode_attention_supported((1, 8, 1, 64), 32768,
+                                                 jnp.float32)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        fa.reset_backend_memo()
+        ok = (1, 8, 1, 64)
+        assert fa.decode_attention_supported(ok,
+                                             fa.DECODE_FLASH_MIN_CACHE,
                                              jnp.bfloat16)
-    # MXU-hostile head_dim
-    assert not fa.decode_attention_supported((1, 8, 1, 48), 32768,
-                                             jnp.bfloat16)
+        # below the measured-crossover cache length: composition wins
+        assert not fa.decode_attention_supported(
+            ok, fa.DECODE_FLASH_MIN_CACHE - 1, jnp.bfloat16)
+        # long query chunks belong to the prefill kernel path
+        assert not fa.decode_attention_supported((1, 8, 9, 64), 32768,
+                                                 jnp.bfloat16)
+        # MXU-hostile head_dim
+        assert not fa.decode_attention_supported((1, 8, 1, 48), 32768,
+                                                 jnp.bfloat16)
+    finally:
+        fa.reset_backend_memo()
 
 
 def test_default_buckets_cover_max_len():

@@ -243,20 +243,29 @@ def test_paged_decode_attention_gate_conditions(monkeypatch):
     fa = importlib.import_module("paddle_tpu.ops.flash_attention")
     ok_q, bs = (1, 8, 1, 64), 128
     nb = fa.DECODE_FLASH_MIN_CACHE // bs
-    # CPU backend: the composition IS the kernel
-    assert not fa.paged_decode_attention_supported(ok_q, bs, nb,
-                                                  jnp.float32)
-    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
-    assert fa.paged_decode_attention_supported(ok_q, bs, nb, jnp.bfloat16)
-    # below the measured-crossover pool size: composition wins
-    assert not fa.paged_decode_attention_supported(ok_q, bs, nb - 1,
+    # the gate memoizes the backend lookup; clear it around the
+    # monkeypatch so the fake backend is seen and cannot leak
+    fa.reset_backend_memo()
+    try:
+        # CPU backend: the "auto" route never engages the kernel
+        assert not fa.paged_decode_attention_supported(ok_q, bs, nb,
+                                                       jnp.float32)
+        monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+        fa.reset_backend_memo()
+        assert fa.paged_decode_attention_supported(ok_q, bs, nb,
                                                    jnp.bfloat16)
-    # sublane-hostile block size
-    assert not fa.paged_decode_attention_supported(ok_q, 12, nb,
-                                                   jnp.bfloat16)
-    # long query chunks belong to the prefill kernel path
-    assert not fa.paged_decode_attention_supported((1, 8, 9, 64), bs, nb,
-                                                   jnp.bfloat16)
+        # below the measured-crossover pool size: composition wins
+        assert not fa.paged_decode_attention_supported(ok_q, bs, nb - 1,
+                                                       jnp.bfloat16)
+        # sublane-hostile block size
+        assert not fa.paged_decode_attention_supported(ok_q, 12, nb,
+                                                       jnp.bfloat16)
+        # long query chunks belong to the prefill kernel path
+        assert not fa.paged_decode_attention_supported((1, 8, 9, 64),
+                                                       bs, nb,
+                                                       jnp.bfloat16)
+    finally:
+        fa.reset_backend_memo()
 
 
 def test_gen_decode_cache_paged_validation(model):

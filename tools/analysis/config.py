@@ -41,6 +41,14 @@ HOT_ROOTS = (
 # review can see.
 EXTRA_EDGES = {
     "DecodeSession._run_model": ("TransformerLM.forward",),
+    # fused pallas decode kernel (docs §5l): the ops-layer routing seam
+    # dispatches to the pallas entry points behind function-local
+    # imports (invisible to the AST), and both kernels sit on the
+    # decode hot path through the traced decode-cache forwards — the
+    # whole route (gate -> kernel wrapper -> pallas_call) is declared
+    # so the hot-path rules audit it like every other dynamic seam
+    "decode_attention": ("decode_attention_kernel",),
+    "paged_decode_attention": ("paged_decode_attention_kernel",),
     "TransformerEncoder.forward": ("TransformerEncoderLayer.forward",),
     "TransformerDecoder.forward": ("TransformerDecoderLayer.forward",),
     "GenerationPool.step": ("ServingEngine._on_token",

@@ -46,6 +46,12 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "decode_tokens_per_sec": ("higher", 0.10),
     "imgs_per_sec": ("higher", 0.10),
     "mfu": ("higher", 0.10),
+    # sustained HBM bandwidth (tok/s x compiler bytes/token, §5l): the
+    # roofline column the fused decode kernel is gated on — falling
+    # means either tok/s fell (caught above too) or the executable
+    # started streaming fewer accounted bytes per token at the same
+    # speed, and both deserve a look
+    "bandwidth_util_bytes_per_sec": ("higher", 0.10),
     "acceptance_rate": ("higher", 0.20),
     "speedup_vs_plain": ("higher", 0.20),
     # prefix sharing: a hit-rate drop means the index stopped firing on

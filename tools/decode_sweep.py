@@ -31,6 +31,14 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
   the "shared system prompts make serving cheaper" claim carries its
   own evidence of how often the index actually fired.
 
+- a ROUTE axis (``--route auto composition pallas-interpret``): the
+  same sessions forced down the XLA composition vs the fused pallas
+  decode kernel (docs/DESIGN.md §5l), with compiler bytes/token and
+  bandwidth-utilization columns per row — the measurement that
+  replaces the DECODE_FLASH_MIN_CACHE crossover guess (on TPU the
+  forced route runs the compiled kernel; off-TPU it runs the pallas
+  interpreter, which the route name says out loud).
+
 - plain-vs-SPECULATIVE tokens/s with a ``--speculate K`` axis: the
   draft/verify pool (``inference.SpeculativePool``, K draft tokens per
   round against a 1-layer draft twin) timed against the plain pool at
@@ -41,6 +49,7 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
 Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
      [--gen 64] [--block-sizes 16 32 64 128]
      [--cache-dtypes float32 int8] [--speculate K]
+     [--route auto composition pallas-interpret]
      [--prompt-reuse f ...] [--cpu-smoke]
      [--out decode_sweep.json]
 Writes the JSON report to --out (default: decode_sweep.json in the
@@ -63,7 +72,8 @@ import numpy as np
 REPEATS = 3  # median-of-N, same noise discipline as ceiling_probe.py
 
 
-def sweep(pt, cfg, batches, buckets, gen, block_sizes, cache_dtypes):
+def sweep(pt, cfg, batches, buckets, gen, block_sizes, cache_dtypes,
+          routes):
     from bench import measure_decode_marginal  # THE shared timing recipe
     from paddle_tpu.inference.generation import kv_reachable_bytes
     from paddle_tpu.jit import DecodeSession
@@ -83,48 +93,74 @@ def sweep(pt, cfg, batches, buckets, gen, block_sizes, cache_dtypes):
         # (same cache length, different gather/scatter granularity) and
         # the CACHE-DTYPE axis multiplies both: fp32 vs quantized int8,
         # same math up to quantization error, ~4x fewer bytes per step.
+        # The ROUTE axis multiplies again: composition vs the fused §5l
+        # pallas kernel (forced both ways), so the crossover constant
+        # DECODE_FLASH_MIN_CACHE can be replaced by a measurement —
+        # find the cache length where the pallas rows' tok/s pass the
+        # composition rows' and set the constant there.
         max_len = bucket + gen
         dims = dict(max_len=max_len, num_layers=cfg["num_layers"],
                     num_heads=cfg["num_heads"],
                     head_dim=cfg["hidden_size"] // cfg["num_heads"])
         sessions = []
-        for dtype in cache_dtypes:
-            sessions.append(("dense", 0, dtype, DecodeSession(
-                model, max_len=max_len, buckets=[bucket],
-                cache_dtype=dtype)))
-            for bs in block_sizes:
-                sessions.append(("paged", bs, dtype, DecodeSession(
-                    model, max_len=max_len, buckets=[bucket],
-                    cache_layout="paged", block_size=bs,
-                    cache_dtype=dtype)))
+        for route_name in routes:
+            # "pallas-interpret" names the off-TPU truth honestly: the
+            # forced kernel route runs the pallas INTERPRETER off-TPU,
+            # so its wall time measures the interpreter, not the chip
+            route = ("pallas" if route_name == "pallas-interpret"
+                     else route_name)
+            for dtype in cache_dtypes:
+                sessions.append(("dense", 0, dtype, route_name,
+                                 DecodeSession(
+                                     model, max_len=max_len,
+                                     buckets=[bucket],
+                                     cache_dtype=dtype, route=route)))
+                for bs in block_sizes:
+                    sessions.append(("paged", bs, dtype, route_name,
+                                     DecodeSession(
+                                         model, max_len=max_len,
+                                         buckets=[bucket],
+                                         cache_layout="paged",
+                                         block_size=bs,
+                                         cache_dtype=dtype,
+                                         route=route)))
         for batch in batches:
             ids = rng.randint(0, cfg["vocab_size"],
                               (batch, bucket)).astype("int32")
-            for layout, bs, dtype, sess in sessions:
+            for layout, bs, dtype, route_name, sess in sessions:
                 m = measure_decode_marginal(sess, ids, gen,
                                             repeats=REPEATS)
                 kv_bytes = kv_reachable_bytes(
                     [max_len] * batch, layout=layout,
                     block_size=(bs or 32), dtype=dtype, **dims)
+                tps = batch / m["per_token_s"]
+                cost = sess._decode_jit.last_cost() or {}
+                nbytes = cost.get("bytes_accessed")
+                bpt = None if nbytes is None else nbytes / batch
                 leg = dict(m, batch=batch, prefill=bucket, generated=gen,
                            cache_len=max_len, cache_layout=layout,
                            cache_dtype=dtype,
                            block_size=bs or None,
+                           route=route_name,
                            kv_reachable_bytes=kv_bytes,
-                           decode_tokens_per_sec=round(
-                               batch / m["per_token_s"], 1))
+                           cost_bytes_per_token=bpt,
+                           bandwidth_util_bytes_per_sec=(
+                               None if bpt is None
+                               else round(tps * bpt, 1)),
+                           decode_tokens_per_sec=round(tps, 1))
                 legs.append(leg)
-                print("bucket %-5d batch %-3d  %-5s bs %-4s %-8s  "
-                      "prefill %.4fs  %.3f ms/tok  %8.1f tok/s  "
-                      "%6.2f KV-MiB"
+                print("bucket %-5d batch %-3d  %-5s bs %-4s %-8s "
+                      "%-16s  prefill %.4fs  %.3f ms/tok  %8.1f tok/s"
+                      "  %6.2f KV-MiB"
                       % (bucket, batch, layout, bs or "-", dtype,
-                         m["prefill_s"], m["per_token_s"] * 1e3,
+                         route_name, m["prefill_s"],
+                         m["per_token_s"] * 1e3,
                          leg["decode_tokens_per_sec"],
                          kv_bytes / 2**20), flush=True)
         compiles["bucket_%d" % bucket] = {
-            ("%s_bs%d_%s" % (layout, bs, dtype) if bs
-             else "%s_%s" % (layout, dtype)): sess.compile_counts()
-            for layout, bs, dtype, sess in sessions}
+            "%s%s_%s_%s" % (layout, "_bs%d" % bs if bs else "", dtype,
+                            route_name): sess.compile_counts()
+            for layout, bs, dtype, route_name, sess in sessions}
     return legs, compiles
 
 
@@ -353,6 +389,18 @@ def main():
                     default=["float32", "int8"],
                     help="KV cache storage dtypes to sweep (int8 = "
                          "quantized cache with per-head fp32 scales)")
+    ap.add_argument("--route", nargs="+", default=["auto"],
+                    choices=["auto", "composition", "pallas-interpret"],
+                    metavar="R",
+                    help="decode-attention routes to sweep (auto / "
+                         "composition / pallas-interpret): rows record "
+                         "tok/s, compiler bytes/token and the "
+                         "bandwidth-utilization column per route, so "
+                         "the kernel-vs-composition crossover "
+                         "(DECODE_FLASH_MIN_CACHE) is a measurement. "
+                         "On TPU, pallas-interpret still forces the "
+                         "COMPILED kernel; the name flags that off-TPU "
+                         "it times the pallas interpreter")
     ap.add_argument("--prompt-reuse", type=float, nargs="*", default=[],
                     metavar="F",
                     help="also sweep prefix sharing at these reuse "
@@ -430,7 +478,8 @@ def main():
     args.gen = max(args.gen, 2)
 
     legs, compiles = sweep(pt, cfg, args.batches, args.buckets, args.gen,
-                           args.block_sizes, args.cache_dtypes)
+                           args.block_sizes, args.cache_dtypes,
+                           args.route)
     spec_legs = None
     if args.speculate > 0:
         spec_legs = speculative_sweep(pt, cfg, args.batches,
@@ -460,6 +509,7 @@ def main():
               "repeats": REPEATS,
               "block_sizes": args.block_sizes,
               "cache_dtypes": args.cache_dtypes,
+              "routes": args.route,
               "spec_k": args.speculate or None,
               "prompt_reuse": args.prompt_reuse or None,
               "mesh": [list(m) for m in meshes] or None,
