@@ -965,6 +965,188 @@ def bench_serving_faults(pt, jax, on_tpu: bool):
     return out
 
 
+def bench_serving_restart(pt, jax, on_tpu: bool):
+    """L7 durability leg: the recovery-time objective of crash-durable
+    serving (docs/DESIGN.md §5m) — what a kill-and-adopt restart COSTS
+    and what it preserves.
+
+    The same traffic runs three ways: a clean reference (also the warm
+    pass), a journaled engine A that is hard-ABANDONED mid-decode with
+    one victim parked in the disk spill tier (the in-process stand-in
+    for SIGKILL — the real subprocess kill is the slow-marked test in
+    tests/test_durable_serving.py), and a fresh engine B that adopts
+    A's journal + spill directory.  Stamps:
+
+    - ``restore_rto_s``: restore() (journal read, fingerprint check,
+      replay, resubmit/adopt, compaction) PLUS pumping until every
+      replayed survivor has decoded a post-restore token — the honest
+      restore-time-to-first-recovered-token, synced by the pool's own
+      per-tick host download;
+    - ``requests_replayed`` / ``adopted_from_spill`` /
+      ``tokens_replayed``: what the RTO covered (``_leg_promotable``
+      refuses a leg that replayed nothing — an RTO over an empty
+      journal measured file I/O, not recovery);
+    - ``tokens_lost``: mismatched-or-missing tokens of restored greedy
+      requests vs the uninterrupted run.  MUST be 0 — byte-identical
+      replay is the §5m contract, and the gate structurally refuses a
+      lossy leg."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.models import TransformerLM, gpt_1p3b_config
+    from paddle_tpu.serving import ServingEngine
+
+    prefill, gen = (512, 32) if on_tpu else (16, 8)
+    slots = 4
+    cfg = gpt_1p3b_config()
+    if on_tpu:
+        cfg.update(num_layers=6)
+    else:
+        _cpu_smoke_shrink(cfg, max_position=1024)
+    pt.seed(0)
+    model = TransformerLM(**cfg, dropout=0.0)
+    rng = np.random.RandomState(0)
+    max_len = prefill + gen
+    prompts = [rng.randint(0, cfg["vocab_size"],
+                           (prefill,)).astype("int32")
+               for _ in range(2 * slots)]
+    workdir = tempfile.mkdtemp(prefix="bench-restart-")
+    jpath = os.path.join(workdir, "requests.journal")
+    spill_dir = os.path.join(workdir, "spill")
+
+    def fresh_engine(journal=None):
+        # TWO prefill buckets, same §5f bucket-coverage reasoning as
+        # the faults leg: `prefill` serves admission, `max_len` serves
+        # the restore resubmits (prompt+committed outgrows admission)
+        return ServingEngine(model, max_len=max_len, slots=slots,
+                             buckets=[prefill, max_len],
+                             max_queue=4 * slots,
+                             cache_layout="paged", block_size=32,
+                             spill_tier="disk", spill_dir=spill_dir,
+                             journal_path=journal)
+
+    def submit_all(engine):
+        # mixed-priority traffic, lows FIRST and already decoding when
+        # the highs arrive: the preempted low victim then stays PARKED
+        # behind the high-priority queue at crash time, so the restore
+        # prices the spill-adoption path, not just resubmits
+        streams = [engine.submit(p, gen, request_id="req-%d" % i,
+                                 priority="low")
+                   for i, p in enumerate(prompts[:2])]
+        engine.pump(2)
+        streams += [engine.submit(p, gen, request_id="req-%d" % (i + 2),
+                                  priority="high")
+                    for i, p in enumerate(prompts[2:])]
+        return streams
+
+    try:
+        # clean reference on identical traffic (warms every executable)
+        engine = fresh_engine()
+        streams = submit_all(engine)
+        while engine.pump(16):
+            pass
+        want = {s.request_id: s.result(timeout_s=0).tokens
+                for s in streams}
+
+        # engine A: journaled, one low victim spilled to disk, then
+        # hard-abandoned mid-decode (no drain, no shutdown, no flush
+        # beyond the per-tick WAL discipline)
+        engine_a = fresh_engine(journal=jpath)
+        streams = submit_all(engine_a)
+        engine_a.preempt()   # the low victim, parked behind the highs
+        engine_a.pump(2)
+        live_at_crash = engine_a.live_requests
+        del engine_a, streams
+
+        # engine B: fresh engine, same weights; its OWN warm traffic
+        # compiles both buckets OUTSIDE the timed region (the RTO must
+        # price replay, never XLA)
+        engine = fresh_engine(journal=jpath)
+        for warm_len in (max_len - 2, 4):
+            engine.submit(rng.randint(0, cfg["vocab_size"],
+                                      (warm_len,)).astype("int32"), 2)
+            while engine.pump(8):
+                pass
+        counts_before = engine.compile_counts()
+        t0 = time.perf_counter()
+        summary = engine.restore(jpath)
+        # this traffic cannot legitimately finish AT restore (no EOS
+        # id, budgets unexhausted at crash): anything finalized there
+        # escaped the tokens_lost loop below, so it must be zero or
+        # the leg is invalid
+        if summary["finished_at_restore"]:
+            raise RuntimeError(
+                "serving_restart: %d requests finalized during "
+                "restore on traffic that cannot finish there — "
+                "loss accounting would be blind to them"
+                % (summary["finished_at_restore"],))
+        restored = {rid: rec.stream
+                    for rid, rec in engine._live.items()}
+        # ...pump until EVERY replayed survivor decoded a POST-restore
+        # token — per-request progress, not an aggregate count: the
+        # active slots would satisfy an aggregate threshold ticks
+        # before the parked disk-spill victim resumes, and its page-in
+        # is exactly the adopted-path cost this RTO must price
+        base = {rid: len(rec.tokens)
+                for rid, rec in engine._live.items()}
+        while any(rid in engine._live
+                  and len(engine._live[rid].tokens) <= n
+                  for rid, n in base.items()):
+            if not engine.pump(1):
+                break
+        restore_rto = time.perf_counter() - t0
+        while engine.pump(16):
+            pass
+        tokens_lost = 0
+        survivors = 0
+        for rid, s in restored.items():
+            st = s.result(timeout_s=0)
+            # EVERY restored request is accounted, whatever its state:
+            # a survivor that finalizes FAILED after restore lost its
+            # whole remaining reference stream — excluding it would
+            # let a broken resubmit path stamp tokens_lost == 0
+            if st.state == "DONE":
+                survivors += 1
+            ref = want[rid]
+            got = np.asarray(st.tokens)
+            tokens_lost += max(0, len(ref) - len(got)) + int(
+                (got[:len(ref)] != ref[:len(got)]).sum())
+        snap = engine.metrics.snapshot()
+        stats = engine.cache_stats()
+        return {
+            "prefill": prefill,
+            "generated": gen,
+            "slots": slots,
+            "input_staged": False,
+            "transfer_note": (
+                "restore RTO is host-side journal replay + re-prefill "
+                "(plus spill-file page-in for the adopted victim); the "
+                "re-prefill's prompt re-upload IS the recovery cost "
+                "being measured, synced by the pool's per-tick token "
+                "download"),
+            "restart": {
+                "cache_layout": stats["cache_layout"],
+                "cache_dtype": stats["cache_dtype"],
+                "requests": len(prompts),
+                "live_at_crash": live_at_crash,
+                "restore_rto_s": round(restore_rto, 4),
+                "restore_call_s": round(summary["restore_s"], 4),
+                "requests_replayed": int(
+                    snap["serving_journal_replayed_total"]),
+                "adopted_from_spill": summary["adopted_from_spill"],
+                "finished_at_restore": summary["finished_at_restore"],
+                "tokens_replayed": summary["tokens_replayed"],
+                "journal_records": summary["records"],
+                "tokens_lost": tokens_lost,
+                "survivors": survivors,
+                "no_new_compiles": engine.compile_counts()
+                == counts_before,
+            },
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def bench_serving_prefix(pt, jax, on_tpu: bool):
     """L7 prefix-sharing leg: zipf-distributed prompts over a small
     prefix corpus — the real traffic shape (shared system prompts /
@@ -1738,6 +1920,7 @@ def _leg_promotable(name: str, leg: dict):
     cache_stamp_keys = {"decode": "per_token_s",
                         "serving": "ttft_p50_s",
                         "serving_faults": "recovery_wall_s",
+                        "serving_restart": "restore_rto_s",
                         "serving_prefix": "ttft_p50_s",
                         "serving_overload": "ttft_p99_high_s",
                         "serving_sharded": "tokens_per_sec",
@@ -1788,6 +1971,26 @@ def _leg_promotable(name: str, leg: dict):
                 return False, ("serving_faults leg lost tokens on %s: "
                                "greedy survivors must be byte-identical "
                                "to the fault-free run" % (lossy,))
+        if name == "serving_restart":
+            # a restore RTO whose survivors LOST tokens measured a
+            # broken journal replay (byte-identity is the §5m
+            # contract), and one that replayed NO requests measured
+            # file I/O over an empty journal — both structurally
+            # unpromotable
+            lossy = sorted(k for k, v in timed.items()
+                           if v.get("tokens_lost", 1) != 0)
+            if lossy:
+                return False, ("serving_restart leg lost tokens on "
+                               "%s: restored greedy requests must be "
+                               "byte-identical to the uninterrupted "
+                               "run" % (lossy,))
+            unreplayed = sorted(k for k, v in timed.items()
+                                if not v.get("requests_replayed"))
+            if unreplayed:
+                return False, ("serving_restart leg replayed no "
+                               "requests on %s: an RTO over an empty "
+                               "journal measured file I/O, not "
+                               "recovery" % (unreplayed,))
         if name == "speculative":
             # a speculative tokens/s additionally needs its
             # acceptance_rate stamp: without it the number cannot say
@@ -2047,6 +2250,7 @@ def _measure_and_print():
                      ("decode", bench_decode),
                      ("serving", bench_serving),
                      ("serving_faults", bench_serving_faults),
+                     ("serving_restart", bench_serving_restart),
                      ("serving_prefix", bench_serving_prefix),
                      ("serving_overload", bench_serving_overload),
                      ("serving_sharded", bench_serving_sharded),

@@ -87,6 +87,8 @@ Traffic-grade scheduling rides the same allocator (docs/DESIGN.md §5j):
 from __future__ import annotations
 
 import collections
+import json
+import os
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -271,7 +273,8 @@ class _SpillState:
 
     __slots__ = ("rid", "ids", "tokens", "remaining", "priority",
                  "tenant", "deadline", "seq", "total_blocks", "written",
-                 "dev_blocks", "host", "host_bytes", "preempts", "shard")
+                 "dev_blocks", "host", "host_bytes", "preempts", "shard",
+                 "host_path")
 
     def __init__(self, st: "_SlotState", total_blocks: int,
                  written: int, host, host_bytes: int, shard: int = 0):
@@ -288,6 +291,11 @@ class _SpillState:
         self.dev_blocks = [None] * written
         self.host = host
         self.host_bytes = host_bytes
+        # the disk tier (spill_tier="disk", docs §5m): ``host`` is None
+        # and ``host_path`` names the .npz holding the written blocks'
+        # K/V — re-read at resume (or by a SECOND engine's restore,
+        # which is the cross-engine-migration point of the tier)
+        self.host_path = None
         self.preempts = 1
         # the dp shard the victim decoded in: its spilled device blocks
         # live in that shard's partition, and resume is shard-pinned —
@@ -336,7 +344,8 @@ class GenerationPool:
                  prefix_sharing: bool = False,
                  tenant_slot_cap: Optional[int] = None,
                  mesh: Optional[DecodeMesh] = None,
-                 route: str = "auto"):
+                 route: str = "auto", spill_tier: str = "host",
+                 spill_dir: Optional[str] = None):
         if slots < 1:
             raise InvalidArgumentError("GenerationPool needs slots >= 1")
         if mesh is not None and not isinstance(mesh, DecodeMesh):
@@ -541,6 +550,11 @@ class GenerationPool:
         # of the LAST admission, None when sharing is off
         self.last_admit_prefix_tokens: Optional[int] = None
         self._key = jax.random.PRNGKey(seed)
+        # retained for config_fingerprint(): the checkpoint header must
+        # name the sampling config (incl. the seed behind the key) so a
+        # restoring engine can refuse a journal it could not replay
+        # byte-identically (docs §5m)
+        self._sampling_seed = int(seed)
         self._queue: collections.deque = collections.deque()
         self._active: Dict[int, _SlotState] = {}
         self._free: List[int] = list(range(self.slots))
@@ -555,6 +569,36 @@ class GenerationPool:
         # degradation ladder reads it to decide preemption is worth it
         self._tenant_cap = (None if tenant_slot_cap is None
                             else int(tenant_slot_cap))
+        # spill tier backend (docs §5m): "host" parks preempted K/V in
+        # process RAM (the §5j tier — dies with the process); "disk"
+        # writes each victim's blocks to <spill_dir>/<rid>.npz so the
+        # parked state survives a crash and a SECOND engine can adopt
+        # it at restore.  The allocator partition and the resume paths
+        # are identical either way — only where the host copy lives
+        # changes.
+        if spill_tier not in ("host", "disk"):
+            raise InvalidArgumentError(
+                "spill_tier must be 'host' (process-RAM, dies with the "
+                "engine) or 'disk' (crash-durable .npz files under "
+                "spill_dir), got %r" % (spill_tier,))
+        if spill_tier == "disk":
+            if cache_layout != "paged":
+                raise InvalidArgumentError(
+                    "spill_tier='disk' spills paged K/V blocks; a dense "
+                    "pool has no block granularity to spill — pass "
+                    "cache_layout='paged'")
+            if spill_dir is None:
+                raise InvalidArgumentError(
+                    "spill_tier='disk' needs spill_dir= (the directory "
+                    "the per-request .npz spill files live in; a second "
+                    "engine restores from the same directory)")
+            os.makedirs(spill_dir, exist_ok=True)
+        elif spill_dir is not None:
+            raise InvalidArgumentError(
+                "spill_dir is a spill_tier='disk' knob (got spill_dir "
+                "with spill_tier=%r)" % (spill_tier,))
+        self.spill_tier = spill_tier
+        self._spill_dir = None if spill_dir is None else str(spill_dir)
         self._seq = 0
         self._spilled: Dict[object, _SpillState] = {}
         self._spill_owner: Dict[int, tuple] = {}
@@ -1044,6 +1088,7 @@ class GenerationPool:
                     self._spill_owner.pop(b, None)
                     self._free_by_shard[self._shard_of_block(b)].append(b)
             self._used_rids.discard(request_id)
+            self._spill_drop(sp)
             return "preempted"
         if request_id in self._results:
             del self._results[request_id]
@@ -1066,6 +1111,14 @@ class GenerationPool:
         tokens = self._results.pop(request_id)
         self._used_rids.discard(request_id)
         return tokens, self._finish_reasons.pop(request_id, None)
+
+    def advance_auto_rids(self, floor: int) -> None:
+        """Never auto-assign a request id below ``floor``.  The serving
+        engine calls this when it opens a pre-existing journal: the
+        crashed engine's auto int rids are TAKEN (their identities must
+        replay untouched), and this pool's own pre-restore traffic must
+        not reuse them in the shared file."""
+        self._next_rid = max(self._next_rid, int(floor))
 
     @property
     def queue_depth(self) -> int:
@@ -1176,12 +1229,25 @@ class GenerationPool:
         # honest byte accounting: the pad rows are not spilled content
         host_bytes = sum(arr[:written].nbytes
                          for layer in host for arr in layer)
+        host_path = None
+        if self.spill_tier == "disk":
+            # the disk write happens BEFORE any allocator mutation, so
+            # a failed write (the `spill.write` injection seam, or a
+            # real EIO/full disk) leaves the pool exactly as it was —
+            # the victim keeps decoding, nothing to unwind
+            try:
+                host_path = self._spill_write(st, host, written)
+            except BaseException:
+                self._slot_blocks[slot] = blocks
+                raise
+            host = None  # the file is the survivor, not process RAM
         self._active.pop(slot)
         self._free.append(slot)
         self._membership_dirty = True
         self._prefix_epoch += 1
         sp = _SpillState(st, len(blocks), written, host, host_bytes,
                          shard=shard)
+        sp.host_path = host_path
         freed = 0
         for j, b in enumerate(blocks):
             left = self._block_refs.get(b, 1) - 1
@@ -1213,6 +1279,34 @@ class GenerationPool:
         restore the table row, cache index and last-token input.  The
         restored K/V are bit-exact, so greedy decode continues
         byte-identically (eager array ops only — no tracked compile)."""
+        # page the host copy in BEFORE any allocator mutation: the
+        # disk-tier file can vanish or corrupt between park and resume
+        # (operator cleanup, a shared-dir consumer, EIO), and failing
+        # AFTER the slot/blocks were assigned would escalate one bad
+        # file into a whole-pool recovery.  adopt_spill's own rule
+        # applies — resubmit is always available and always correct —
+        # so the loss is contained to THIS victim: its device copies
+        # free, and prompt+committed re-queues under its identity.
+        host_src = sp.host
+        if host_src is None and any(
+                sp.dev_blocks[j] is None for j in range(sp.written)):
+            try:
+                host_src = self._spill_read(sp)
+            except Exception:  # noqa: BLE001 - per-victim fallback
+                self._prefix_epoch += 1
+                for b in sp.dev_blocks:
+                    if b is not None:
+                        self._spill_owner.pop(b, None)
+                        self._free_by_shard[
+                            self._shard_of_block(b)].append(b)
+                self._spill_drop(sp)
+                self._used_rids.discard(sp.rid)
+                ids = np.concatenate(
+                    [sp.ids, np.asarray(sp.tokens, np.int32)])
+                self.submit(ids, sp.remaining, request_id=sp.rid,
+                            priority=sp.priority, tenant=sp.tenant,
+                            deadline=sp.deadline)
+                return
         slot = self._pop_free_slot(sp.shard)
         blocks: List[int] = []
         upload: List[tuple] = []  # (logical j, physical block)
@@ -1252,7 +1346,7 @@ class GenerationPool:
             upd = dict(table=c.table.at[slot].set(row),
                        index=c.index.at[slot].set(pos_dev))
             if upload:
-                fields = sp.host[layer]
+                fields = host_src[layer]
                 upd["k"] = c.k.at[ids_dev].set(jnp.asarray(fields[0][sel]))
                 upd["v"] = c.v.at[ids_dev].set(jnp.asarray(fields[1][sel]))
                 if c.k_scale is not None:
@@ -1273,8 +1367,12 @@ class GenerationPool:
         if upload:
             # honest byte accounting: pad rows are not paged-in content
             self._upload_bytes_total += sum(
-                fields[i][sel[:n_up]].nbytes for fields in sp.host
+                fields[i][sel[:n_up]].nbytes for fields in host_src
                 for i in range(len(fields)))
+        # the parked copy is consumed: a disk-tier file is deleted the
+        # moment its request decodes again (a crash after this point
+        # restores via the journal's prompt+committed replay instead)
+        self._spill_drop(sp)
         self._on_resumed(slot, sp)
         if self.on_resume is not None:
             self.on_resume(sp.rid, {
@@ -1298,6 +1396,7 @@ class GenerationPool:
         request's written span, device-resident or not)."""
         return {
             "enabled": self.cache_layout == "paged",
+            "spill_tier": self.spill_tier,
             "preempts_total": self._preempts_total,
             "resumes_total": self._resumes_total,
             "spilled_requests": len(self._spilled),
@@ -1308,6 +1407,217 @@ class GenerationPool:
             "upload_bytes_total": self._upload_bytes_total,
             "reclaims_total": self._spill_reclaims_total,
         }
+
+    # -- disk spill backend (docs §5m) -----------------------------------
+    def _spill_path(self, rid) -> str:
+        """The .npz a request's spilled K/V lives in — a pure function
+        of the rid, so a SECOND engine pointed at the same directory
+        finds a crashed engine's files.  The type tag keeps int 1 and
+        str "1" from colliding on one file."""
+        tag = "i" if isinstance(rid, (int, np.integer)) else "s"
+        safe = "".join(c if c.isalnum() or c in "-_" else "~%02x" % ord(c)
+                       for c in str(rid))
+        return os.path.join(self._spill_dir,
+                            "spill-%s%s.npz" % (tag, safe))
+
+    def _spill_write(self, st: _SlotState, host, written: int) -> str:
+        """Write one victim's gathered K/V (+ int8 scales — they ride
+        their blocks) to its spill file: tmp file + fsync + atomic
+        rename, so a crash mid-write can never leave a half file a
+        restoring engine would adopt.  Fires the ``spill.write`` seam;
+        a transient failure is retried ONCE (each caught fault emits a
+        ``spill.error`` trace event, so the chaos harness reconciles
+        injections against the recorder), then propagates — the caller
+        leaves the pool untouched."""
+        path = self._spill_path(st.rid)
+        arrays = {}
+        for i, layer in enumerate(host):
+            for j, arr in enumerate(layer):
+                arrays["l%d_f%d" % (i, j)] = arr[:written]
+        meta = {"rid": str(st.rid), "prompt_len": int(len(st.ids)),
+                "committed": len(st.tokens), "written": int(written),
+                "block_size": self._block_size,
+                "layers": len(host), "fields": len(host[0]),
+                "cache_dtype": str(np.dtype(self._cache[0].k.dtype))}
+        arrays["meta"] = np.asarray(json.dumps(meta))
+        tmp = path + ".tmp"
+        for attempt in (0, 1):
+            try:
+                _fire("spill.write")
+                with open(tmp, "wb") as f:
+                    np.savez(f, **arrays)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, path)
+                return path
+            except BaseException as e:  # noqa: BLE001 - classify + retry
+                retry = attempt == 0 \
+                    and _faults.classify_error(e) == "transient"
+                tr = _trace_active()
+                if tr is not None:
+                    tr.instant("spill.error", rid=st.rid,
+                               error=type(e).__name__, retried=retry)
+                if not retry:
+                    # a persistently failed write must not leave its
+                    # half-written .tmp littering the spill dir
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
+                    raise
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _spill_read(self, sp: _SpillState):
+        """Page a disk-tier spill file back into the per-layer tuple
+        shape ``_resume``'s upload path consumes (the resume-boundary
+        file read — only when resume actually needs content)."""
+        with np.load(sp.host_path) as z:
+            meta = json.loads(str(z["meta"]))
+            return [tuple(z["l%d_f%d" % (i, j)]
+                          for j in range(meta["fields"]))
+                    for i in range(meta["layers"])]
+
+    def _spill_drop(self, sp: _SpillState) -> None:
+        """Delete a spill record's disk file, if it has one (resume /
+        cancel / reset all consume the parked copy; no-op on the host
+        tier)."""
+        path = sp.host_path
+        if path is not None:
+            sp.host_path = None
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def _adopt_guard(self, ids, tokens) -> None:
+        """Subclass veto for :meth:`adopt_spill` — the speculative pool
+        requires draft bucket coverage for the resume-time re-prefill,
+        the same constraint ``_preempt_guard`` imposes at preempt
+        time."""
+
+    def adopt_spill(self, request_id, input_ids, tokens,
+                    max_new_tokens: int, priority: int = 0, tenant=None,
+                    deadline=None) -> bool:
+        """Adopt a crashed engine's disk-spilled K/V for ``request_id``:
+        park the request in this pool's spill tier with its ``.npz`` as
+        the restorable source, so the next refill resumes it through
+        the normal upload path — no re-prefill, byte-identical (the
+        file holds bit-exact K/V for positions ``[0, prompt+committed-1)``,
+        the exact resume state).
+
+        Returns False — the caller falls back to prompt+committed
+        resubmit — whenever adoption cannot be exact: tier off, no
+        file, a file whose meta disagrees with the journal's committed
+        count (the victim decoded past its last spill before crashing —
+        the file is STALE), shape/dtype/block-size mismatch against
+        this pool's cache, or a subclass veto.  Never raises for a bad
+        file: resubmit is always available and always correct."""
+        if self.spill_tier != "disk" or self.cache_layout != "paged":
+            return False
+        if request_id in self._used_rids:
+            return False
+        ids = np.asarray(getattr(input_ids, "value",
+                                 input_ids)).astype(np.int32)
+        tokens = [int(t) for t in tokens]
+        # a parked request by construction has >= 1 committed token and
+        # >= 1 remaining (otherwise it would have finished, and replay
+        # finalizes it instead of resubmitting)
+        if len(tokens) < 1 or int(max_new_tokens) - len(tokens) < 1:
+            return False
+        path = self._spill_path(request_id)
+        if not os.path.exists(path):
+            return False
+        bs = self._block_size
+        pos = int(len(ids)) + len(tokens) - 1
+        written = -(-pos // bs)
+        total = self._blocks_needed(len(ids), int(max_new_tokens))
+        if total > self._blocks_per_shard - 1:
+            return False
+        first = self._cache[0]
+        nf = 4 if first.k_scale is not None else 2
+        try:
+            with np.load(path) as z:
+                meta = json.loads(str(z["meta"]))
+                if (meta.get("committed") != len(tokens)
+                        or meta.get("prompt_len") != len(ids)
+                        or meta.get("written") != written):
+                    # STALE: the journal is ground truth, and a file
+                    # whose resume point disagrees with it can never
+                    # be adopted again — delete it, or crash/restore
+                    # cycles accumulate dead .npz litter (and stale
+                    # K/V under a recurring rid is worse than no file,
+                    # the reset() rule)
+                    try:
+                        os.remove(path)
+                    except OSError:
+                        pass
+                    return False
+                if (meta.get("block_size") != bs
+                        or meta.get("layers") != len(self._cache)
+                        or meta.get("fields") != nf
+                        or meta.get("cache_dtype")
+                        != str(np.dtype(first.k.dtype))):
+                    # structural mismatch against THIS pool's cache:
+                    # possibly another config's pool sharing the dir —
+                    # fall back without deleting what is not ours to
+                    # judge
+                    return False
+                if tuple(z["l0_f0"].shape) \
+                        != (written,) + tuple(first.k.shape[1:]):
+                    return False
+                host_bytes = sum(int(z[k].nbytes) for k in z.files
+                                 if k != "meta")
+        except Exception:  # noqa: BLE001 - a bad file falls back, always
+            return False
+        try:
+            self._adopt_guard(ids, tokens)
+        except Exception:  # noqa: BLE001 - subclass veto -> resubmit
+            return False
+        self._seq += 1
+        st = _SlotState(request_id, ids, tokens,
+                        int(max_new_tokens) - len(tokens),
+                        priority=int(priority), tenant=tenant,
+                        deadline=deadline, seq=self._seq)
+        # no device-resident copies to pin the shard: park where the
+        # most blocks are free (dp == 1: shard 0, the common case)
+        shard = max(range(self._dp),
+                    key=lambda s: len(self._free_by_shard[s]))
+        sp = _SpillState(st, total, written, None, host_bytes,
+                         shard=shard)
+        sp.host_path = path
+        self._spilled[request_id] = sp
+        self._used_rids.add(request_id)
+        return True
+
+    def config_fingerprint(self) -> dict:
+        """The JSON-stable identity of everything byte-identical replay
+        depends on: the sampling config (temperature/top-k/top-p and
+        the seed behind the PRNG key), the cache layout/dtype/geometry,
+        and the mesh shape.  Written into every journal's header;
+        ``ServingEngine.restore`` refuses a journal whose fingerprint
+        differs, naming both sides (docs §5m)."""
+        sess = self._session
+        fp = {
+            "pool_type": type(self).__name__,
+            "temperature": sess.temperature,
+            "top_k": sess.top_k,
+            "top_p": sess.top_p,
+            "sampling_seed": self._sampling_seed,
+            "eos_id": None if self.eos_id is None else int(self.eos_id),
+            "max_len": self.max_len,
+            "slots": self.slots,
+            "vocab_size": (None if self._vocab is None
+                           else int(self._vocab)),
+            "cache_layout": self.cache_layout,
+            "cache_dtype": str(np.dtype(self._cache[0].k.dtype)),
+            "mesh": (None if self._mesh is None
+                     else {"dp": int(self._mesh.dp),
+                           "mp": int(self._mesh.mp)}),
+        }
+        if self.cache_layout == "paged":
+            fp["block_size"] = self._block_size
+            fp["num_blocks"] = self._num_blocks
+        return fp
 
     def _shared_block_count(self) -> int:
         """Blocks currently referenced beyond their first owner — the
@@ -1896,7 +2206,11 @@ class GenerationPool:
         # discarded AND host copies of state the engine will resubmit
         # from its own records: both die with the pool (the engine's
         # recovery resubmits a preempted victim's prompt+committed like
-        # any other survivor — byte-identical either way)
+        # any other survivor — byte-identical either way).  Disk-tier
+        # files die too: stale K/V under a recurring rid would be worse
+        # than no file (restore falls back to resubmit without one)
+        for sp in self._spilled.values():
+            self._spill_drop(sp)
         self._spilled.clear()
         self._spill_owner.clear()
         self.admission_blocked = False

@@ -80,7 +80,8 @@ class SpeculativePool(GenerationPool):
                  top_p: float = 1.0, time_split: bool = False,
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_sharing: bool = False, mesh=None,
-                 route: str = "auto"):
+                 route: str = "auto", spill_tier: str = "host",
+                 spill_dir: Optional[str] = None):
         if float(temperature) != 0.0:
             raise InvalidArgumentError(
                 "speculative decoding is greedy-only (temperature=0): "
@@ -107,7 +108,8 @@ class SpeculativePool(GenerationPool):
                          num_blocks=num_blocks,
                          prefill_chunk_tokens=prefill_chunk_tokens,
                          prefix_sharing=prefix_sharing, mesh=mesh,
-                         route=route)
+                         route=route, spill_tier=spill_tier,
+                         spill_dir=spill_dir)
         self.spec_k = int(spec_k)
         # the draft session owns the draft binding and its bucketed
         # batch-1 prefill (compiled once per bucket); its decode step is
@@ -315,6 +317,26 @@ class SpeculativePool(GenerationPool):
         decision point, never a mid-refill surprise at resume."""
         self._draft_session._bucket_for(
             len(st.ids) + max(0, len(st.tokens) - 1))
+
+    def _adopt_guard(self, ids, tokens) -> None:
+        """Adopting a crashed engine's disk-spilled state (docs §5m)
+        ends in a resume, which re-prefills the draft twin — the same
+        bucket-coverage constraint as ``_preempt_guard``, checked at
+        the adoption decision so an uncoverable request falls back to
+        the prompt+committed resubmit path instead of dying mid-refill."""
+        self._draft_session._bucket_for(
+            len(ids) + max(0, len(tokens) - 1))
+
+    def config_fingerprint(self) -> dict:
+        """The base fingerprint plus the draft geometry: a journal
+        written by a speculative engine replays byte-identically on a
+        plain engine too (greedy acceptance emits the target's own
+        argmax), but the fingerprint is an equality contract — adopting
+        across pool variants is a config change the operator must make
+        deliberately, not a silent fallback."""
+        fp = super().config_fingerprint()
+        fp["spec_k"] = self.spec_k
+        return fp
 
     def _on_resumed(self, slot, sp) -> None:
         """Restore the draft twin for a resumed slot: re-prefill it

@@ -80,6 +80,21 @@ unchanged compile counts.  The engine sees logical slots only; mesh
 engines additionally export ``serving_mesh_devices`` and the per-shard
 KV byte gauges (per-chip headroom, not mesh-total optimism).
 
+Crash-durable serving (docs/DESIGN.md §5m): ``journal`` is the
+append-only, CRC-framed write-ahead request journal —
+``ServingEngine(journal_path=...)`` records admissions (with the
+pool's sampling/cache config fingerprint in the header) and per-tick
+committed-token batches, ``checkpoint()`` compacts, and
+``restore(path)`` lets a FRESH process (or a second engine with the
+same weights) adopt the journal plus the ``spill_tier="disk"``
+directory and finish every greedy survivor byte-identically with zero
+new compiles — torn tails truncate (never crash), fingerprint
+mismatches raise typed errors naming both sides, and the RESTORING
+state answers ``/healthz`` 503 + Retry-After while deferring (never
+dropping) admissions.  ``journal.append``/``spill.write`` are fault
+seams, and the ``serving_restart`` bench leg stamps the measured RTO
+with ``tokens_lost == 0`` required for promotion.
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —
@@ -87,10 +102,12 @@ serving-oriented systems work (PAPERS.md, arXiv:2603.09555) treats the
 cached decode step as a component inside a request scheduler; this
 package is that scheduler.
 """
-from . import faults, log, slo, trace
+from . import faults, journal, log, slo, trace
 from .engine import (PRIORITY_CLASSES, AdmissionTightenedError,
                      DeadlineUnattainableError, QueueFullError,
                      ServingEngine)
+from .journal import (FingerprintMismatchError, JournalCorruptError,
+                      JournalWriteError, JournalWriter)
 from .http import ServingHTTPFrontend, parse_generate_request
 from .log import JsonLinesLogger
 from .metrics import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
@@ -111,4 +128,6 @@ __all__ = [
     "trace", "Tracer", "FlightRecorder", "TraceEvent",
     "slo", "Objective", "SLOTracker",
     "log", "JsonLinesLogger",
+    "journal", "JournalWriter", "JournalWriteError",
+    "JournalCorruptError", "FingerprintMismatchError",
 ]

@@ -65,6 +65,20 @@ Design points (docs/DESIGN.md §5c):
   decision emitted as a ``sched.*`` flight-recorder event and
   structured-log line so overload behavior is post-hoc auditable.
   Degraded is healthy: ``/healthz`` stays 200 and carries the level.
+- **Crash durability.** With ``journal_path=`` every admission and
+  each tick's committed-token batch land in an append-only CRC-framed
+  write-ahead journal (``serving/journal.py``) whose header carries
+  the pool's config fingerprint; ``checkpoint()`` compacts it to one
+  snapshot record and ``restore(path)`` lets a FRESH process (or a
+  second engine with the same weights) adopt it — spilled victims
+  re-parked straight from the ``spill_tier="disk"`` directory, every
+  other survivor resubmitted prompt+committed through the SAME
+  ``_recover`` machinery — finishing every greedy survivor
+  byte-identically with zero new compiles on warmed executables.
+  While replaying the engine is RESTORING: ``/healthz`` 503 +
+  Retry-After, submits deferred (never dropped).  The journal falls
+  BEHIND under write faults (records stay pending), never wrong: a
+  lost tail only re-decodes at restore (docs/DESIGN.md §5m).
 - **Request-scoped tracing.** With a tracer installed
   (``start_trace()`` / ``serving.trace``) every tick runs inside a
   numbered span, lifecycle transitions / recoveries / sheds / compiles
@@ -75,18 +89,21 @@ Design points (docs/DESIGN.md §5c):
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..core.errors import (InvalidArgumentError, NotFoundError,
                            PreconditionNotMetError, UnavailableError)
-from ..inference.generation import GenerationPool
+from ..inference.generation import DuplicateRequestError, GenerationPool
 from ..profiler import StepTimer
 from . import faults, trace
 from . import log as slog
+from .journal import (FingerprintMismatchError, JournalWriteError,
+                      JournalWriter, read_journal, replay)
 from .metrics import MetricsRegistry
 from .stream import RequestState, ResponseStream, StreamStatus
 from .supervisor import EngineHealth
@@ -98,6 +115,15 @@ __all__ = ["ServingEngine", "QueueFullError", "DeadlineUnattainableError",
 # accept; priorities are plain ints underneath — higher admits first,
 # ties broken by deadline then arrival (docs/DESIGN.md §5j)
 PRIORITY_CLASSES = {"low": -1, "normal": 0, "high": 1}
+
+
+def _jsonable_rid(rid):
+    """Request ids round-trip the journal as JSON values: ints and
+    strings survive verbatim (numpy ints normalized) — everything else
+    is rejected at the submit edge by ``_check_journal_rid``."""
+    if isinstance(rid, np.integer):
+        return int(rid)
+    return rid
 
 
 def _normalize_priority(priority) -> int:
@@ -206,7 +232,9 @@ class ServingEngine:
                  degrade_max_level: int = 3,
                  degrade_dwell_ticks: int = 2,
                  degrade_clear_ticks: int = 3,
-                 degrade_admit_floor=1, **pool_kwargs):
+                 degrade_admit_floor=1,
+                 journal_path: Optional[str] = None,
+                 journal_fsync: str = "tick", **pool_kwargs):
         if int(max_queue) < 1:
             raise InvalidArgumentError(
                 "max_queue must be >= 1, got %r" % (max_queue,))
@@ -289,6 +317,35 @@ class ServingEngine:
         # ceiling — a manual set_spec_k survives a ladder excursion
         self._spec_k_saved = None
         self._live: Dict[object, _Record] = {}
+        # crash-durability plane (docs §5m): the write-ahead journal —
+        # admissions are durable BEFORE they can commit tokens, token
+        # batches ride one `commit` record per tick, terminals close
+        # them; checkpoint() compacts, restore() replays.  The writer's
+        # constructor validates an existing file's fingerprint (typed
+        # mismatch error naming both sides) and truncates a torn tail.
+        self._journal = None if journal_path is None else JournalWriter(
+            journal_path, self._pool.config_fingerprint(),
+            fsync=journal_fsync)
+        if self._journal is not None \
+                and self._journal.max_int_rid is not None:
+            # same-path restart: the adopted journal's auto int rids
+            # are taken — this engine's pre-restore traffic (warm-up,
+            # canaries) must not reuse them, or its own admit/terminal
+            # records would stomp the crashed engine's live entries in
+            # the shared file before restore() can replay them
+            self._pool.advance_auto_rids(self._journal.max_int_rid + 1)
+        # this tick's committed-token deltas (rid -> [tok...]) and the
+        # record backlog a failed append leaves behind: the journal
+        # falls BEHIND under write faults, never wrong — replay just
+        # regenerates more decode work (greedy is byte-identical)
+        self._jl_tick_toks: Dict[object, List[int]] = {}
+        self._jl_pending: List[dict] = []
+        # RESTORING state (docs §5m): /healthz answers 503+Retry-After,
+        # submits are DEFERRED (parked with a live stream, admitted the
+        # moment replay finishes) — never dropped
+        self._restoring = False
+        self._restore_retry_after_s = 1.0
+        self._deferred_submits: List[tuple] = []
         # one reentrant lock serializes every pool mutation: submit and
         # cancel may race the background step loop; in pump mode it is
         # uncontended and costs nothing
@@ -373,6 +430,32 @@ class ServingEngine:
             "degradation ladder level (0 normal, 1 preempt, "
             "2 +reduce-spec-K, 3 +tighten-admission)") \
             if self._degrade_on else None
+        # crash-durability surface (docs §5m): journal write accounting
+        # plus the restore-side reconciliation counter the acceptance
+        # contract names (`serving_journal_replayed_total` must equal
+        # the journal's admitted-minus-terminal record count exactly)
+        self._c_journal_records = m.counter(
+            "serving_journal_records_total",
+            "records appended to the write-ahead request journal")
+        self._c_journal_bytes = m.counter(
+            "serving_journal_bytes_total",
+            "framed bytes appended to the request journal")
+        self._c_journal_errors = m.counter(
+            "serving_journal_errors_total",
+            "journal append/sync failures caught (each is retried or "
+            "left pending — the journal falls behind, never lies)")
+        self._c_journal_truncated = m.counter(
+            "serving_journal_truncated_records_total",
+            "records dropped by torn-tail truncation during replay")
+        self._c_checkpoints = m.counter(
+            "serving_checkpoints_total",
+            "checkpoint snapshots written (journal compactions)")
+        self._c_replayed = m.counter(
+            "serving_journal_replayed_total",
+            "live requests reconstructed from a journal by restore()")
+        self._c_restores = m.counter(
+            "serving_restores_total",
+            "journal restore operations completed on this engine")
         self._c_trace_dropped = m.counter(
             "serving_trace_events_dropped_total",
             "flight-recorder ring overflow: trace events evicted "
@@ -478,6 +561,24 @@ class ServingEngine:
         self._pool.on_finish = self._on_finish
         self._pool.on_resume = self._on_resume
 
+        # the JournalWriter truncated a torn tail when it re-opened an
+        # existing file (a crash mid-write on the SAME path — the
+        # standard restart flow): surface the count now that the
+        # metric/log planes exist, so the post-mortem never reads 0
+        # for damage that actually happened
+        if self._journal is not None and self._journal.truncated_bytes:
+            self._c_journal_truncated.inc(
+                self._journal.truncated_records)
+            trace.instant(
+                "journal.truncated",
+                dropped_records=self._journal.truncated_records,
+                dropped_bytes=self._journal.truncated_bytes)
+            slog.emit(
+                "journal.truncated", path=self._journal.path,
+                dropped_records=self._journal.truncated_records,
+                dropped_bytes=self._journal.truncated_bytes,
+                at="open")
+
     # -- admission -------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int, request_id=None,
                deadline_s: Optional[float] = None, priority=0,
@@ -515,6 +616,64 @@ class ServingEngine:
                 raise PreconditionNotMetError(
                     "engine is draining/shut down: admissions are "
                     "stopped (drain()/shutdown() was called)")
+            if self._restoring:
+                # RESTORING defers admission, never drops it: the
+                # journal replay owns the pool right now, so the
+                # request is parked with a LIVE stream and admitted
+                # through the normal path the moment replay finishes
+                # (_end_restore).  An auto request's id is assigned AT
+                # that admission, not now — a provisional id handed
+                # out here could collide with a journaled request's
+                # identity (both engines allocate auto ints from 0),
+                # so ``stream.request_id`` is None until the engine
+                # leaves RESTORING, which is honest rather than a
+                # value that might have to change.  /healthz says
+                # 503 + Retry-After meanwhile, so well-behaved HTTP
+                # callers back off instead of parking.
+                if len(self._deferred_submits) >= self.max_queue:
+                    # the deferral parks requests in engine memory:
+                    # the SAME backpressure bound as the wait queue
+                    # applies, or a caller ignoring the 503 could park
+                    # unbounded prompts during a long replay
+                    self._c_rejected.inc()
+                    raise QueueFullError(
+                        "restore in progress and the deferred-submit "
+                        "queue is full (%d waiting >= max_queue=%d); "
+                        "back off and retry after the restore"
+                        % (len(self._deferred_submits), self.max_queue))
+                if request_id is not None and (
+                        request_id in self._live or any(
+                            e[0] == request_id
+                            for e in self._deferred_submits)):
+                    # detectable NOW, so the caller gets the same
+                    # typed 409-mapped error the normal path raises —
+                    # a 200 + FAILED stream would make an idempotency-
+                    # keyed retry look like a hard generation failure.
+                    # (A collision with a not-yet-replayed journaled
+                    # rid cannot be known here; that one does surface
+                    # on the stream.)
+                    raise DuplicateRequestError(
+                        "request_id %r is already live or deferred on "
+                        "this restoring engine" % (request_id,))
+                ids = np.asarray(getattr(input_ids, "value", input_ids))
+                if self._journal is not None:
+                    self._check_journal_rid(request_id)
+                stream = ResponseStream(self, request_id,
+                                        int(max_new_tokens))
+                # the deadline anchors at SUBMIT time ("a wall-clock
+                # budget from NOW" is the documented contract): the
+                # restore wait counts against it, so a request whose
+                # budget the replay consumed expires honestly instead
+                # of being served long past its SLA
+                self._deferred_submits.append(
+                    (request_id, ids.astype(np.int32),
+                     int(max_new_tokens),
+                     (None if deadline_s is None
+                      else self._clock() + float(deadline_s)),
+                     priority, tenant, stream))
+                trace.instant("req.deferred", rid=request_id,
+                              restoring=True)
+                return stream
             if self._degrade_level >= 3 and priority < self._degrade_floor:
                 # tighten-admission rung: below-floor traffic is shed at
                 # the door while both burn windows say the engine cannot
@@ -564,6 +723,8 @@ class ServingEngine:
             now = self._clock()
             deadline_abs = None if deadline_s is None \
                 else now + float(deadline_s)
+            if self._journal is not None:
+                self._check_journal_rid(request_id)
             rid = self._pool.submit(ids, max_new_tokens,
                                     request_id=request_id,
                                     priority=priority, tenant=tenant,
@@ -572,6 +733,24 @@ class ServingEngine:
             self._live[rid] = _Record(
                 rid, stream, ids.astype(np.int32), int(max_new_tokens),
                 deadline_abs, now, priority=priority, tenant=tenant)
+            if self._journal is not None:
+                # WAL discipline: the admission is durable BEFORE the
+                # request can commit a token.  A failed (retried)
+                # append REJECTS the admission with the typed retryable
+                # error — strictly better than serving a request the
+                # journal could never replay.
+                try:
+                    self._journal_admit(rid, ids, max_new_tokens,
+                                        deadline_s, priority, tenant)
+                except Exception as e:  # noqa: BLE001 - reject, typed
+                    self._pool.cancel(rid)
+                    self._live.pop(rid, None)
+                    raise JournalWriteError(
+                        "admission rejected: the request journal could "
+                        "not record it (%s: %s); retry — an admission "
+                        "the journal cannot replay would be silently "
+                        "non-durable" % (type(e).__name__,
+                                         str(e)[:200])) from e
             self._c_submitted.inc()
             trace.instant("req.queued", rid=rid,
                           prompt_tokens=int(ids.shape[0]),
@@ -633,6 +812,11 @@ class ServingEngine:
                                           now - rec.last_t)
         rec.last_t = now
         rec.tokens.append(int(tok))
+        if self._journal is not None:
+            # buffered, not written: the tick's deltas ride ONE commit
+            # record at flush (journal bandwidth stays O(ticks), not
+            # O(tokens)), and a lost tail only re-decodes at restore
+            self._jl_tick_toks.setdefault(rec.rid, []).append(int(tok))
         self._c_tokens.inc()
         self._tokens_total += 1
 
@@ -858,6 +1042,15 @@ class ServingEngine:
         toks = np.asarray(tokens if tokens is not None else rec.tokens,
                           np.int32)
         rec.state = state
+        if self._journal is not None:
+            # commit-before-terminal ordering: this rid's same-tick
+            # token deltas must hit the journal before the record that
+            # stops replay from tracking it — materialize the buffer
+            # first, then queue the terminal
+            self._materialize_tick_commits()
+            self._jl_pending.append(
+                {"t": "terminal", "rid": _jsonable_rid(rec.rid),
+                 "state": state, "reason": reason})
         # every terminal path (done / cancelled / expired / failed —
         # including drain()/shutdown()'s cancels) funnels through here,
         # so an exported request timeline always closes with a terminal
@@ -891,11 +1084,34 @@ class ServingEngine:
         with self._lock:
             rec = self._live.pop(request_id, None)
             if rec is None:
+                if request_id is not None:
+                    # a submit DEFERRED during RESTORING is cancellable
+                    # too (the HTTP disconnect-reclaim path must not
+                    # leave an orphan to decode its whole budget for
+                    # nobody after the restore); auto-rid deferrals
+                    # have no id yet and cannot be addressed — bounded
+                    # by the deferral's max_queue cap
+                    for i, entry in enumerate(self._deferred_submits):
+                        if entry[0] == request_id:
+                            (rid, ids, max_new, _dl, priority, tenant,
+                             stream) = entry
+                            del self._deferred_submits[i]
+                            rec = _Record(rid, stream, ids, max_new,
+                                          None, self._clock(),
+                                          priority=priority,
+                                          tenant=tenant)
+                            self._c_cancelled.inc()
+                            self._finalize(rec, RequestState.CANCELLED,
+                                           "cancelled", [])
+                            return True
                 return False
             self._pool.cancel(request_id)
             self._c_cancelled.inc()
             self._finalize(rec, RequestState.CANCELLED, "cancelled",
                            rec.tokens)
+            # an out-of-tick terminal must not wait for the next tick's
+            # flush to become durable (there may never be one)
+            self._journal_flush()
             return True
 
     def _expire(self) -> None:
@@ -919,6 +1135,24 @@ class ServingEngine:
             error=("%s (retries=%d/%d): %s"
                    % (why, rec.retries, self.max_retries,
                       str(exc)[:400]))[:500])
+
+    def _resubmit_record(self, rec: _Record) -> None:
+        """THE recovery primitive (docs §5f): resubmit one victim as
+        prompt + committed tokens with its remaining budget and its
+        scheduling metadata — greedy decode regenerates from there
+        byte-identically (the O(1)-cache contract).  Shared by
+        ``_recover`` (in-process step failure) and ``restore``
+        (cross-process journal replay): both are the same operation at
+        different blast radii."""
+        ids = rec.prompt if not rec.tokens else np.concatenate(
+            [rec.prompt, np.asarray(rec.tokens, np.int32)])
+        self._pool.submit(ids, rec.max_new - len(rec.tokens),
+                          request_id=rec.rid,
+                          priority=rec.priority,
+                          tenant=rec.tenant,
+                          deadline=rec.deadline_abs)
+        rec.state = RequestState.QUEUED
+        rec.preempted_at = None
 
     def _recover(self, exc: BaseException) -> None:
         """A pool step blew up mid-flight.  The batched step serves
@@ -956,22 +1190,14 @@ class ServingEngine:
         resubmitted = 0
         for rec in survivors:  # dict order == submit order: FIFO kept
             try:
-                ids = rec.prompt if not rec.tokens else np.concatenate(
-                    [rec.prompt, np.asarray(rec.tokens, np.int32)])
                 # scheduling metadata survives recovery: a resubmitted
                 # victim keeps its class/tenant/deadline — including
                 # PREEMPTED victims, whose spill-tier copies died with
                 # the pool (prompt+committed is the recovery source)
-                self._pool.submit(ids, rec.max_new - len(rec.tokens),
-                                  request_id=rec.rid,
-                                  priority=rec.priority,
-                                  tenant=rec.tenant,
-                                  deadline=rec.deadline_abs)
+                self._resubmit_record(rec)
             except Exception as sub_exc:  # noqa: BLE001 - per-victim
                 self._fail_record(rec, sub_exc, "resubmit failed")
                 continue
-            rec.state = RequestState.QUEUED
-            rec.preempted_at = None
             self._live[rec.rid] = rec
             self._c_recovered.inc()
             trace.instant("recovery.resubmit", rid=rec.rid,
@@ -982,6 +1208,462 @@ class ServingEngine:
         slog.emit("engine.recovery", kind=kind,
                   survivors=len(survivors), resubmitted=resubmitted,
                   error=str(exc)[:200])
+
+    # -- crash durability: journal, checkpoint, restore (docs §5m) -------
+    def _check_journal_rid(self, request_id) -> None:
+        """A journaled engine only accepts JSON-round-trippable request
+        ids (int/str): anything else could not be replayed under the
+        same identity, which is the whole point of recording it."""
+        if request_id is None or isinstance(request_id, str):
+            return
+        if isinstance(request_id, (int, np.integer)) \
+                and not isinstance(request_id, bool):
+            return
+        raise InvalidArgumentError(
+            "a journaled engine needs a JSON-safe request_id (int or "
+            "str, or None for auto-assignment) — got %r; the journal "
+            "must replay the request under the same identity"
+            % (request_id,))
+
+    def _journal_admit(self, rid, ids, max_new, deadline_s, priority,
+                       tenant) -> None:
+        """Make ONE admission durable — the WAL step shared by
+        ``submit()`` and ``_admit_deferred`` so the two admission
+        paths can never diverge.  Drains any backlog FIRST (journal
+        ORDER is replay correctness: a collected-and-reused rid would
+        otherwise see the OLD request's stranded commits replayed onto
+        the NEW admission), then appends + syncs the admit record.  On
+        any failure a closing ghost terminal is queued — if the admit
+        frame landed and only the sync failed, restore would otherwise
+        resurrect a consumer-less request; a ghost terminal for an
+        admit that never landed is replay-tolerated — and the error
+        propagates for the caller to unwind the pool and pick its
+        error channel (typed raise vs stream finalize)."""
+        try:
+            if self._jl_pending or self._jl_tick_toks:
+                self._journal_flush()
+                if self._jl_pending:
+                    raise JournalWriteError(
+                        "the journal has a backlog of %d unflushed "
+                        "records (append failures) that must land "
+                        "before a new admit record can — retry"
+                        % (len(self._jl_pending),))
+            self._journal_append(
+                {"t": "admit", "rid": _jsonable_rid(rid),
+                 "ids": [int(t) for t in ids],
+                 "max_new": int(max_new),
+                 "priority": int(priority), "tenant": tenant,
+                 "deadline_s": (None if deadline_s is None
+                                else float(deadline_s)),
+                 # WALL clock (engine clocks may be injected and do
+                 # not cross processes): restore deducts the elapsed
+                 # time so a replayed deadline keeps its REMAINING
+                 # budget, matching checkpoint's snapshot semantics
+                 "ts": time.time()})
+            self._journal.sync()
+        except Exception:
+            self._jl_pending.append(
+                {"t": "terminal", "rid": _jsonable_rid(rid),
+                 "state": RequestState.FAILED,
+                 "reason": "admit-unjournaled"})
+            # try to land the closing terminal NOW: if the admit frame
+            # reached disk and only its fsync failed, a crash before
+            # the next tick flush would otherwise resurrect a request
+            # whose caller was told it was never admitted (flush is
+            # non-raising — a still-broken disk just leaves it pending)
+            self._journal_flush()
+            raise
+
+    def _materialize_tick_commits(self) -> None:
+        """Fold this tick's buffered token deltas into ONE pending
+        commit record — the single shape both call sites (_finalize's
+        commit-before-terminal ordering, the tick flush) must share,
+        so the record format can never diverge between them."""
+        if self._jl_tick_toks:
+            self._jl_pending.append(
+                {"t": "commit",
+                 "toks": [[_jsonable_rid(r), ts] for r, ts
+                          in self._jl_tick_toks.items()]})
+            self._jl_tick_toks = {}
+
+    def _journal_append(self, rec: dict) -> int:
+        """Append one record, retrying ONCE on a transient failure.
+        Every caught fault emits a ``journal.error`` trace event and a
+        structured-log line and bumps ``serving_journal_errors_total``,
+        so the chaos harness reconciles injected ``journal.append``
+        faults against the recorder exactly.  A second failure
+        propagates — the caller decides (submit rejects the admission;
+        the tick flush leaves the record pending and serves on)."""
+        for attempt in (0, 1):
+            try:
+                n = self._journal.append(rec)
+            except Exception as e:  # noqa: BLE001 - classify + retry
+                retry = attempt == 0 \
+                    and faults.classify_error(e) == "transient"
+                self._c_journal_errors.inc()
+                trace.instant("journal.error", record=rec.get("t"),
+                              error=type(e).__name__, retried=retry)
+                slog.emit("journal.error", record=rec.get("t"),
+                          error=str(e)[:200], retried=retry)
+                if not retry:
+                    raise
+                continue
+            self._c_journal_records.inc()
+            self._c_journal_bytes.inc(n)
+            return n
+        raise AssertionError("unreachable")  # pragma: no cover
+
+    def _journal_flush(self) -> None:
+        """Drain this tick's commit batch plus any backlog into the
+        journal, in order, stopping (NOT raising) at a persistent
+        append failure — the journal falls behind and catches up on a
+        later flush; restore regenerates the gap byte-identically
+        either way.  One fsync per flush under the default
+        ``journal_fsync="tick"`` policy."""
+        j = self._journal
+        if j is None:
+            return
+        self._materialize_tick_commits()
+        if not self._jl_pending:
+            return
+        while self._jl_pending:
+            try:
+                self._journal_append(self._jl_pending[0])
+            except Exception:  # noqa: BLE001 - stays pending, serve on
+                break
+            self._jl_pending.pop(0)
+        try:
+            j.sync()
+        except OSError as e:
+            self._c_journal_errors.inc()
+            trace.instant("journal.error", record="sync",
+                          error=type(e).__name__, retried=False)
+            slog.emit("journal.error", record="sync",
+                      error=str(e)[:200], retried=False)
+
+    def checkpoint(self, path: Optional[str] = None) -> dict:
+        """Snapshot the live request set at a tick boundary and COMPACT
+        the journal to header + one checkpoint record (tmp file +
+        fsync + atomic rename).  With ``path=None`` the engine's own
+        journal is compacted in place (requires ``journal_path=``);
+        with an explicit ``path`` a standalone snapshot journal is
+        written there — the cross-engine hand-off form — and the live
+        journal is left untouched.  The engine lock IS the tick
+        boundary: no step can be mid-flight while the snapshot is
+        taken.  Returns ``{"path", "bytes", "records",
+        "live_requests"}``."""
+        with self._lock:
+            if self._journal is None and path is None:
+                raise PreconditionNotMetError(
+                    "checkpoint() needs either a journaled engine "
+                    "(journal_path= at construction) or an explicit "
+                    "path to write the snapshot journal to")
+            self._journal_flush()
+            now = self._clock()
+            live = []
+            for rec in self._live.values():
+                live.append({
+                    "rid": _jsonable_rid(rec.rid),
+                    "ids": [int(t) for t in rec.prompt],
+                    "tokens": list(rec.tokens),
+                    "max_new": rec.max_new,
+                    "priority": rec.priority,
+                    "tenant": rec.tenant,
+                    # deadlines are re-armed with the REMAINING budget
+                    # at restore time: absolute stamps from this
+                    # engine's clock mean nothing in another process.
+                    # The wall-clock stamp lets restore deduct the
+                    # DOWNTIME too — an hour-long outage must not be
+                    # granted back to a request whose SLA it consumed
+                    "deadline_s": (None if rec.deadline_abs is None
+                                   else max(0.001,
+                                            rec.deadline_abs - now)),
+                    "ts": time.time(),
+                    "retries": rec.retries})
+            ckpt = {"t": "checkpoint", "live": live}
+            if self._journal is not None:
+                info = self._journal.compact([ckpt], path=path)
+                if path is None or os.path.abspath(path) \
+                        == os.path.abspath(self._journal.path):
+                    # the snapshot SUPERSEDES any backlog a failed
+                    # flush stranded: rec.tokens above already include
+                    # those commits, so appending them after the
+                    # checkpoint would double-apply at replay —
+                    # discard them with the history they belong to
+                    self._jl_pending = []
+                    self._jl_tick_toks = {}
+            else:
+                w = JournalWriter(path,
+                                  self._pool.config_fingerprint())
+                try:
+                    info = w.compact([ckpt])
+                finally:
+                    w.close()
+            self._c_checkpoints.inc()
+            trace.instant("journal.checkpoint",
+                          live=len(live), bytes=info["bytes"])
+            slog.emit("journal.checkpoint", path=info["path"],
+                      live_requests=len(live), bytes=info["bytes"])
+            info["live_requests"] = len(live)
+            return info
+
+    def _begin_restore(self, retry_after_s: float = 1.0) -> None:
+        """Flip the engine into RESTORING: ``health()`` reports it
+        (503 + Retry-After on ``GET /healthz``) and submits are
+        deferred until ``_end_restore`` (test seam: the HTTP suite
+        drives the window directly)."""
+        with self._lock:
+            self._restoring = True
+            self._restore_retry_after_s = float(retry_after_s)
+
+    def _end_restore(self) -> None:
+        """Leave RESTORING and admit every deferred submit through the
+        normal path (journal admit record included) — all under ONE
+        lock acquisition, so no foreign submit can interleave between
+        the flag flip and the deferred admissions.  A deferred request
+        whose admission now fails finalizes its stream FAILED — its
+        caller already holds the stream, so the error travels there,
+        not up this stack."""
+        with self._lock:
+            self._restoring = False
+            deferred, self._deferred_submits = self._deferred_submits, []
+            for args in deferred:
+                self._admit_deferred(*args)
+        if deferred:
+            self._wake.set()
+
+    def _admit_deferred(self, rid, ids, max_new, deadline_abs, priority,
+                        tenant, stream) -> None:
+        """``deadline_abs`` was anchored at the original submit (the
+        restore wait already counts against it — an exhausted budget
+        expires at the first tick, never gets served past its SLA)."""
+        with self._lock:
+            now = self._clock()
+            try:
+                if self._draining:
+                    raise PreconditionNotMetError(
+                        "engine drained while the submit was deferred")
+                if self._pool.queue_depth >= self.max_queue:
+                    raise QueueFullError(
+                        "queue filled while the submit was deferred; "
+                        "back off and resubmit")
+                # no deadline-estimate shed here: the estimator is cold
+                # right after a restore — the deadline itself still
+                # expires the request normally once admitted
+                rid = self._pool.submit(ids, int(max_new),
+                                        request_id=rid,
+                                        priority=priority,
+                                        tenant=tenant,
+                                        deadline=deadline_abs)
+            except Exception as e:  # noqa: BLE001 - to the stream
+                rec = _Record(rid, stream, ids, int(max_new),
+                              deadline_abs, now, priority=priority,
+                              tenant=tenant)
+                self._c_failed.inc()
+                self._finalize(rec, RequestState.FAILED, "error", [],
+                               error="deferred admission failed: %s: %s"
+                               % (type(e).__name__, str(e)[:200]))
+                return
+            # a deferred AUTO submit's identity exists from HERE: the
+            # pool just assigned it, and the stream handle learns it
+            # before any token can flow
+            stream.request_id = rid
+            rec = _Record(rid, stream, ids, int(max_new), deadline_abs,
+                          now, priority=priority, tenant=tenant)
+            self._live[rid] = rec
+            if self._journal is not None:
+                try:
+                    # the admit record's deadline_s is the budget
+                    # REMAINING at this admission (the anchor already
+                    # absorbed the restore wait), stamped like any
+                    # other admit so a later restore keeps deducting
+                    self._journal_admit(
+                        rid, ids, max_new,
+                        (None if deadline_abs is None
+                         else max(0.001, deadline_abs - now)),
+                        priority, tenant)
+                except Exception as e:  # noqa: BLE001 - to the stream
+                    self._pool.cancel(rid)
+                    self._live.pop(rid, None)
+                    self._c_failed.inc()
+                    self._finalize(
+                        rec, RequestState.FAILED, "error", [],
+                        error="deferred admission not journalable: %s"
+                        % (str(e)[:200],))
+                    return
+            self._c_submitted.inc()
+            trace.instant("req.queued", rid=rid, deferred=True,
+                          prompt_tokens=int(ids.shape[0]),
+                          max_new_tokens=int(max_new))
+
+    def restore(self, path: str) -> dict:
+        """Adopt the journal at ``path``: validate its fingerprint
+        against this engine (typed mismatch error naming both sides),
+        truncate-tolerantly replay it, and reconstruct every live
+        request — PREEMPTED requests whose disk-spill file is present
+        and exact are re-parked in the spill tier (their K/V page back
+        in at resume, no re-prefill), everything else resubmits
+        prompt + committed through the ``_recover`` machinery, so every
+        greedy survivor finishes byte-identically with ZERO new
+        compiles on warmed executables.  Requests whose journaled
+        history already exhausted their budget (torn tail ate the
+        terminal record) finalize immediately.
+
+        The engine must be fresh (no live requests); while the replay
+        runs the engine is RESTORING — ``/healthz`` 503 + Retry-After,
+        submits deferred.  With a configured journal the live set is
+        checkpoint-compacted into it afterwards, so a second crash
+        replays from HERE, not from the adopted file.  Returns the
+        summary dict (``requests_replayed``, ``tokens_replayed``,
+        ``adopted_from_spill``, ``finished_at_restore``,
+        ``records``, ``records_dropped``, ``restore_s``)."""
+        t0 = time.perf_counter()
+        with self._lock:
+            # precondition check and the RESTORING flip happen under
+            # ONE lock acquisition: a gap between them would let a
+            # concurrent submit admit into the pool mid-restore and
+            # collide with a replayed survivor's rid
+            if self._draining:
+                raise PreconditionNotMetError(
+                    "engine is draining/shut down: build a fresh engine "
+                    "to restore into")
+            if self._restoring:
+                raise PreconditionNotMetError(
+                    "a restore is already in progress on this engine: "
+                    "a second concurrent replay would fail every "
+                    "duplicate resubmit and journal bogus terminals "
+                    "for requests the first replay is serving")
+            if self._live or self._pool.queue_depth \
+                    or self._pool.active_count:
+                raise PreconditionNotMetError(
+                    "restore() needs a fresh engine: %d live requests "
+                    "are already being served (restore rebuilds the "
+                    "live set from the journal, it does not merge)"
+                    % (len(self._live),))
+            self._restoring = True
+            self._restore_retry_after_s = 1.0
+        adopted = finished = replayed = tokens_replayed = 0
+        try:
+            with self._lock:
+                fp, records, stats = read_journal(path)
+                if stats["truncated"]:
+                    self._c_journal_truncated.inc(
+                        stats["records_dropped"])
+                    trace.instant(
+                        "journal.truncated",
+                        dropped_records=stats["records_dropped"],
+                        dropped_bytes=stats["bytes_dropped"])
+                    slog.emit(
+                        "journal.truncated", path=path,
+                        dropped_records=stats["records_dropped"],
+                        dropped_bytes=stats["bytes_dropped"])
+                mine = self._pool.config_fingerprint()
+                if fp != mine:
+                    raise FingerprintMismatchError(fp, mine)
+                live, counts = replay(records)
+                now = self._clock()
+                eos = self._pool.eos_id
+                for entry in live:
+                    rid = entry["rid"]
+                    ids = np.asarray(entry["ids"], np.int32)
+                    toks = entry["tokens"]
+                    max_new = entry["max_new"]
+                    deadline_s = entry["deadline_s"]
+                    if deadline_s is not None and entry.get("ts"):
+                        # REMAINING budget, not a fresh grant: deduct
+                        # the wall-clock time already burned since
+                        # admission (checkpoint entries carry the
+                        # remaining budget directly, ts=None).  An
+                        # exhausted deadline re-arms at epsilon so the
+                        # first tick expires it, same as checkpoint's
+                        # floor
+                        deadline_s = max(
+                            0.001, float(deadline_s)
+                            - max(0.0, time.time() - entry["ts"]))
+                    deadline_abs = None if deadline_s is None \
+                        else now + float(deadline_s)
+                    stream = ResponseStream(self, rid, max_new)
+                    rec = _Record(rid, stream, ids, max_new,
+                                  deadline_abs, now,
+                                  priority=entry["priority"],
+                                  tenant=entry["tenant"])
+                    rec.retries = entry["retries"]
+                    rec.tokens = list(toks)
+                    # the committed history replays into the FRESH
+                    # stream, so a consumer of this engine sees the
+                    # full token stream, not just the post-restore tail
+                    for t in toks:
+                        stream._put_token(int(t))
+                    if toks:
+                        rec.first_t = rec.last_t = now
+                    self._c_replayed.inc()
+                    replayed += 1
+                    tokens_replayed += len(toks)
+                    if len(toks) >= max_new or (
+                            eos is not None and toks
+                            and toks[-1] == eos):
+                        # budget exhausted / EOS committed but the
+                        # terminal record was lost to the torn tail:
+                        # the request is DONE, finish it here instead
+                        # of resubmitting work the contract forbids
+                        self._c_done.inc()
+                        self._finalize(rec, RequestState.DONE,
+                                       ("eos" if eos is not None
+                                        and toks and toks[-1] == eos
+                                        else "length"), rec.tokens)
+                        finished += 1
+                        continue
+                    if self._pool.adopt_spill(
+                            rid, ids, toks, max_new,
+                            priority=entry["priority"],
+                            tenant=entry["tenant"],
+                            deadline=deadline_abs):
+                        # the crashed engine's disk-spilled K/V are
+                        # exact for this committed count: re-park the
+                        # request — it resumes via page-in, skipping
+                        # the re-prefill entirely
+                        rec.state = RequestState.PREEMPTED
+                        rec.preempted_at = now
+                        self._live[rid] = rec
+                        adopted += 1
+                        continue
+                    try:
+                        self._resubmit_record(rec)
+                    except Exception as e:  # noqa: BLE001 - per-victim
+                        self._fail_record(rec, e,
+                                          "restore resubmit failed")
+                        continue
+                    self._live[rid] = rec
+                self._c_restores.inc()
+                restore_s = time.perf_counter() - t0
+                self._health.note_restore(restore_s)
+                if self._journal is not None:
+                    # compact the adopted state into THIS engine's
+                    # journal: a second crash replays from here
+                    self.checkpoint()
+                trace.instant("engine.restore", replayed=replayed,
+                              adopted=adopted, finished=finished,
+                              tokens=tokens_replayed)
+                slog.emit("engine.restore", path=path,
+                          requests_replayed=replayed,
+                          adopted_from_spill=adopted,
+                          finished_at_restore=finished,
+                          tokens_replayed=tokens_replayed,
+                          records=stats["records"],
+                          records_dropped=stats["records_dropped"],
+                          restore_s=round(restore_s, 6))
+        finally:
+            self._end_restore()
+        self._wake.set()
+        return {"requests_replayed": replayed,
+                "adopted_from_spill": adopted,
+                "finished_at_restore": finished,
+                "tokens_replayed": tokens_replayed,
+                "records": stats["records"],
+                "records_dropped": stats["records_dropped"],
+                "truncated": stats["truncated"],
+                "journal_counts": counts,
+                "restore_s": time.perf_counter() - t0}
 
     # -- the scheduling tick (ONE code path for both drive modes) --------
     def _tick(self) -> bool:
@@ -1046,6 +1728,11 @@ class ServingEngine:
             self._observe_gauges()
             return bool(self._live)
         finally:
+            # the tick's journal flush rides the same finally: commits
+            # and terminals from a recovered tick are recorded too, and
+            # a flush failure leaves records PENDING — the journal
+            # falls behind, the engine never dies for it
+            self._journal_flush()
             # the heartbeat closes even when recovery re-raises: the
             # loop thread dying is the DEAD-LOOP signal, not a stall —
             # and the SLO windows roll on EVERY tick (idle included),
@@ -1225,6 +1912,12 @@ class ServingEngine:
         loop_alive = None if t is None else t.is_alive()
         if h.stall_open:
             state = "wedged"
+        elif self._restoring:
+            # RESTORING is unhealthy-but-transient: the probe backs off
+            # (503 + Retry-After on /healthz) instead of killing an
+            # engine that is seconds from adopting its journal —
+            # admissions are deferred meanwhile, never dropped
+            state = "restoring"
         elif loop_alive is False and not self._draining \
                 and not self._stop.is_set():
             state = "loop-dead"
@@ -1252,7 +1945,10 @@ class ServingEngine:
                # distinguishes "just restarted" from "long-lived" at a
                # glance, and uptime_s is injected-clock-deterministic
                "started_at": self._started_at,
-               "uptime_s": max(0.0, now - self._started_at)}
+               "uptime_s": max(0.0, now - self._started_at),
+               "restoring": self._restoring}
+        if self._restoring:
+            out["retry_after_s"] = self._restore_retry_after_s
         if self._slo is not None:
             # SLO state rides the post-mortem: a stall dump says which
             # promises were burning when the engine wedged
@@ -1366,6 +2062,12 @@ class ServingEngine:
             # drain=False cancels them, both through _finalize.
             for rid in list(self._live):
                 trace.instant("req.aborted", rid=rid, reason="shutdown")
+            # final durability point: drain buffered journal records and
+            # close the handle (a clean shutdown's journal replays to an
+            # empty or fully-terminal live set)
+            self._journal_flush()
+            if self._journal is not None:
+                self._journal.close()
 
     # -- tracing / flight recorder ---------------------------------------
     def start_trace(self, capacity: int = 4096,

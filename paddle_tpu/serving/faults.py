@@ -14,6 +14,8 @@ them with scripted schedules or a seeded random chaos mode:
 - ``weights.refresh``  — the hot weight-swap path
 - ``stream.deliver``   — per-token delivery into a ResponseStream
 - ``http.write``       — the per-token ndjson socket write
+- ``journal.append``   — the crash-durability journal's record write
+- ``spill.write``      — the disk spill tier's K/V file write
 
 The plane is OFF by default: ``fire(point)`` is a module-level check of
 one global against ``None`` — no allocation, no lock, no host sync —
@@ -52,6 +54,8 @@ POINTS = (
     "weights.refresh",
     "stream.deliver",
     "http.write",
+    "journal.append",
+    "spill.write",
 )
 _POINT_SET = frozenset(POINTS)
 

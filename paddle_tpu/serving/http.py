@@ -18,7 +18,10 @@ HTTP onto ``ServingEngine.submit`` and ``metrics.render_prometheus``:
 - ``GET /healthz`` — the engine's lock-free ``health()`` snapshot as
   JSON: 200 while healthy (idle/serving/draining), 503 while a tick is
   wedged past the supervisor's stall timeout, the loop thread is dead,
-  or the engine was shut down.  The body is the FULL snapshot — state,
+  the engine was shut down, or a journal RESTORE is replaying (the
+  RESTORING state answers 503 **with Retry-After** — transient by
+  construction, and submits that do arrive meanwhile are DEFERRED with
+  a live stream, never dropped; docs/DESIGN.md §5m).  The body is the FULL snapshot — state,
   the last loop error (what/when/kind), restart/stall/recovery
   counters, and the flight-recorder post-mortem dump when supervision
   attached one — so the probe response IS the post-mortem.  Reading
@@ -189,7 +192,17 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
                 # restart/stall counters, flight-recorder dump), not
                 # just a status code
                 h = engine.health()
-                self._send_json(200 if h["healthy"] else 503, h)
+                headers = ()
+                if h.get("state") == "restoring":
+                    # RESTORING is transient by construction: the probe
+                    # gets the engine's own back-off hint so a rollout
+                    # controller waits out the journal replay instead
+                    # of killing an engine seconds from recovery
+                    ra = h.get("retry_after_s") or 1.0
+                    headers = (("Retry-After",
+                                str(max(1, int(-(-ra // 1))))),)
+                self._send_json(200 if h["healthy"] else 503, h,
+                                headers=headers)
                 return
             if path == "/debug/trace":
                 rid = parse_qs(query).get("rid", [None])[0]

@@ -8,10 +8,13 @@ surface: each event is one JSON object per line carrying
 
 - ``ts`` (wall clock), ``event`` (dotted name: ``req.admitted``,
   ``req.terminal``, ``engine.recovery``, ``req.shed``,
-  ``engine.restart``, ``slo.alert``, and the scheduler's decision
+  ``engine.restart``, ``slo.alert``, the scheduler's decision
   records ``sched.preempt`` / ``sched.resume`` / ``sched.degrade`` /
   ``sched.restore`` — every overload move the degradation ladder
-  makes is one greppable line, docs/DESIGN.md §5j);
+  makes is one greppable line, docs/DESIGN.md §5j — and the
+  crash-durability plane's ``journal.error`` / ``journal.truncated`` /
+  ``journal.checkpoint`` / ``engine.restore`` records, so a restart's
+  post-mortem greps the same stream, docs/DESIGN.md §5m);
 - ``rid`` when the event belongs to a request, plus the event's own
   fields (``state``/``finish_reason`` on terminals, counts on
   recoveries);

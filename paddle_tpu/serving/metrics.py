@@ -14,8 +14,15 @@ scheduling surface (``serving_preemptions_total``,
 ``serving_admission_tightened_total``, plus the
 ``serving_preempted_requests`` / ``serving_spilled_blocks`` /
 ``serving_degrade_level`` gauges) increments inside the preempt /
-resume / degradation-ladder decisions (docs/DESIGN.md §5j), and
-KV-cache gauges read
+resume / degradation-ladder decisions (docs/DESIGN.md §5j), the
+crash-durability surface (``serving_journal_records_total`` /
+``serving_journal_bytes_total`` / ``serving_journal_errors_total`` /
+``serving_journal_truncated_records_total`` /
+``serving_checkpoints_total`` / ``serving_journal_replayed_total`` /
+``serving_restores_total``) increments inside the journal append /
+flush / checkpoint / restore paths themselves — the replayed counter
+reconciles EXACTLY with the journal's admitted-minus-terminal records
+(docs/DESIGN.md §5m) — and KV-cache gauges read
 ``cache_stats()`` (the allocator's own accounting) after every step —
 ``serving_kv_reachable_bytes`` (what a step can READ right now) and
 ``serving_kv_resident_bytes`` (the whole pool allocation), both

@@ -57,6 +57,8 @@ class EngineHealth:
         self.restarts = 0
         self.recoveries = 0
         self.requests_recovered = 0
+        self.restores = 0
+        self.last_restore_s: Optional[float] = None
         self.stalls = 0
         self.stall_open = False
         # post-mortem timeline: the flight recorder's tail, attached by
@@ -86,6 +88,16 @@ class EngineHealth:
     def note_recovery(self, resubmitted: int) -> None:
         self.recoveries += 1
         self.requests_recovered += resubmitted
+
+    def note_restore(self, duration_s: float) -> None:
+        """A journal restore completed on this engine (docs §5m): the
+        count and the last restore's wall time ride every health
+        snapshot, so a probe can tell "slow because it just adopted a
+        journal" from "slow, period" — the RTO figure the
+        serving_restart bench leg stamps is this same quantity measured
+        end-to-end."""
+        self.restores += 1
+        self.last_restore_s = duration_s
 
     def note_restart(self, now: float) -> None:
         self.restarts += 1
@@ -132,6 +144,8 @@ class EngineHealth:
             "restarts": self.restarts,
             "recoveries": self.recoveries,
             "requests_recovered": self.requests_recovered,
+            "restores": self.restores,
+            "last_restore_s": self.last_restore_s,
             "ticks_stalled": self.stalls,
             "flight_dump": self.flight_dump,
         }

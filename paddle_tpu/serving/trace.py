@@ -67,7 +67,11 @@ __all__ = ["TraceEvent", "FlightRecorder", "Tracer", "active", "install",
 # the request-lifecycle event names (engine-emitted): non-terminal marks
 # OPEN a lifecycle phase on the request's export track; terminal marks
 # close it.  Everything else is a tick phase span or a point event
-# (compile / fault.injected / recovery / shed / stall / restart / ...).
+# (compile / fault.injected / recovery / shed / stall / restart, and
+# the §5m durability plane's journal.error / journal.truncated /
+# journal.checkpoint / spill.error / engine.restore / req.deferred
+# marks — the chaos harness reconciles fault injections against the
+# journal.error/spill.error counts exactly).
 LIFECYCLE_EVENTS = {
     "req.queued": "QUEUED",
     "req.prefilling": "PREFILLING",

@@ -545,3 +545,38 @@ def test_live_serving_sharded_leg_passes_its_own_gate():
         if sub["mesh_dp"] > 1:
             assert sub["kv_resident_bytes_per_shard"] < \
                 leg["mesh_1x1"]["kv_resident_bytes"]
+
+
+def test_serving_restart_gate_structural_cases():
+    """The §5m durability leg: an RTO whose survivors lost tokens, or
+    that replayed an empty journal, is structurally unpromotable — and
+    the usual cache-provenance stamps apply."""
+    def leg(**over):
+        sub = {"cache_layout": "paged", "cache_dtype": "float32",
+               "restore_rto_s": 0.02, "requests_replayed": 8,
+               "tokens_lost": 0}
+        sub.update(over)
+        return {"input_staged": False,
+                "transfer_note": "host-side replay", "restart": sub}
+
+    ok, why = bench._leg_promotable("serving_restart", leg())
+    assert ok, why
+    # lossy restore: byte-identity is the contract, never promotable
+    ok, why = bench._leg_promotable("serving_restart",
+                                    leg(tokens_lost=3))
+    assert not ok and "lost tokens" in why
+    # an UNSTAMPED tokens_lost defaults to lossy (absence of evidence
+    # is not evidence of byte-identity)
+    bad = leg()
+    del bad["restart"]["tokens_lost"]
+    ok, why = bench._leg_promotable("serving_restart", bad)
+    assert not ok and "lost tokens" in why
+    # an RTO over an empty journal measured file I/O, not recovery
+    ok, why = bench._leg_promotable("serving_restart",
+                                    leg(requests_replayed=0))
+    assert not ok and "replayed no requests" in why
+    # cache provenance applies like every serving leg
+    bad = leg()
+    del bad["restart"]["cache_dtype"]
+    ok, why = bench._leg_promotable("serving_restart", bad)
+    assert not ok and "cache_layout/cache_dtype" in why

@@ -29,6 +29,7 @@ SCRIPTS = [
     ("14_prefix_serving.py", ["--tokens", "8"]),
     ("15_overload_serving.py", ["--tokens", "8"]),
     ("16_sharded_serving.py", ["--tokens", "8"]),
+    ("17_durable_serving.py", ["--tokens", "8"]),
 ]
 
 

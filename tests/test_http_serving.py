@@ -354,6 +354,34 @@ def test_healthz_stays_200_while_degraded_and_carries_the_level(model):
     assert stream.result(timeout_s=0).state == "DONE"
 
 
+def test_healthz_restoring_503_retry_after_then_200(model):
+    """The §5m RESTORING pin: while a journal replay owns the engine,
+    /healthz answers 503 WITH Retry-After (transient by construction —
+    a rollout controller waits instead of killing the engine), submits
+    are deferred with a live stream, and the flip back to 200 happens
+    the moment replay ends."""
+    eng = ServingEngine(model, max_len=64, slots=1, buckets=[16])
+    eng._begin_restore(retry_after_s=2.5)
+    code, headers, payload = _http(eng, "GET", "/healthz")
+    body = json.loads(payload)
+    assert code == 503
+    assert body["state"] == "restoring" and body["healthy"] is False
+    assert body["restoring"] is True and body["retry_after_s"] == 2.5
+    assert headers.get("Retry-After") == "3"  # ceil of the hint
+    # admission during the window is DEFERRED, not dropped: a live
+    # stream comes back, nothing reaches the pool yet
+    stream = eng.submit(np.zeros(4, np.int32), 3)
+    assert eng.live_requests == 0 and eng.queue_depth == 0
+    eng._end_restore()
+    code, headers, payload = _http(eng, "GET", "/healthz")
+    assert code == 200 and "Retry-After" not in headers
+    assert json.loads(payload)["restoring"] is False
+    assert eng.live_requests == 1
+    while eng.pump(8):
+        pass
+    assert stream.result(timeout_s=0).state == "DONE"
+
+
 def test_debug_trace_and_flightrec_endpoints(model):
     from paddle_tpu.serving import trace
 

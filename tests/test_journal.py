@@ -1,0 +1,296 @@
+"""The crash-durability journal (docs/DESIGN.md §5m): CRC framing,
+torn-tail truncation, replay semantics, compaction.
+
+The contracts pinned here:
+
+1. replay of a damaged journal recovers the LONGEST VALID PREFIX —
+   property-tested over truncation at EVERY byte offset of a valid
+   multi-record journal, plus CRC corruption of every record — and
+   NEVER raises for tail damage (only a destroyed head is an error);
+2. record semantics fold deterministically: admit/commit/terminal
+   reconcile exactly (``admitted - terminals == len(live)``), integer
+   and string rids survive the JSON round trip distinctly, and a
+   checkpoint record REPLACES the folded state (compaction = header +
+   checkpoint);
+3. the writer re-opens an existing journal only under the SAME
+   fingerprint (typed mismatch error naming both sides) and truncates
+   a torn tail before appending — new records must never land behind
+   the reader's stop point.
+"""
+import os
+
+import pytest
+
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.serving.journal import (MAGIC, FingerprintMismatchError,
+                                        JournalCorruptError,
+                                        JournalWriter, frame_record,
+                                        read_journal, replay)
+
+FP = {"temperature": 0.0, "cache_layout": "paged", "block_size": 8}
+
+RECORDS = [
+    {"t": "admit", "rid": "a", "ids": [1, 2, 3], "max_new": 4,
+     "priority": 1, "tenant": None, "deadline_s": None},
+    {"t": "commit", "toks": [["a", [7, 8]]]},
+    {"t": "admit", "rid": 2, "ids": [4], "max_new": 2, "priority": 0,
+     "tenant": "acme", "deadline_s": 5.0},
+    {"t": "commit", "toks": [["a", [9]], [2, [5]]]},
+    {"t": "terminal", "rid": "a", "state": "DONE", "reason": "length"},
+]
+
+
+def _write(tmp_path, records, name="j.journal", fp=FP):
+    path = str(tmp_path / name)
+    w = JournalWriter(path, fp)
+    for r in records:
+        w.append(r)
+    w.sync()
+    w.close()
+    return path
+
+
+def test_roundtrip_and_replay(tmp_path):
+    path = _write(tmp_path, RECORDS)
+    fp, records, stats = read_journal(path)
+    assert fp == FP
+    assert records == RECORDS
+    assert stats["truncated"] is False
+    assert stats["records_dropped"] == 0 and stats["bytes_dropped"] == 0
+    live, counts = replay(records)
+    # "a" terminated; 2 survives with its committed token
+    assert [e["rid"] for e in live] == [2]
+    assert live[0]["tokens"] == [5]
+    assert live[0]["ids"] == [4] and live[0]["max_new"] == 2
+    assert live[0]["tenant"] == "acme" and live[0]["deadline_s"] == 5.0
+    assert counts == {"admitted": 2, "terminals": 1,
+                      "committed_tokens": 4, "checkpoints": 0}
+    # the acceptance reconciliation: admitted - terminals == live
+    assert counts["admitted"] - counts["terminals"] == len(live)
+
+
+def test_int_and_str_rids_survive_distinctly(tmp_path):
+    # int 2 must come back as int 2 (commit records are rid/token
+    # PAIRS, not a JSON object, exactly so keys keep their type)
+    path = _write(tmp_path, RECORDS)
+    _, records, _ = read_journal(path)
+    live, _ = replay(records)
+    assert live[0]["rid"] == 2 and not isinstance(live[0]["rid"], str)
+
+
+def test_checkpoint_record_replaces_state(tmp_path):
+    ckpt = {"t": "checkpoint", "live": [
+        {"rid": "z", "ids": [9, 9], "tokens": [1], "max_new": 6,
+         "priority": 2, "tenant": None, "deadline_s": None,
+         "retries": 1}]}
+    extra = {"t": "commit", "toks": [["z", [3]], ["ghost", [4]]]}
+    path = _write(tmp_path, RECORDS + [ckpt, extra])
+    _, records, _ = read_journal(path)
+    live, counts = replay(records)
+    # the snapshot REPLACED everything folded before it; the later
+    # commit lands on top of it (the ghost rid is ignored)
+    assert [e["rid"] for e in live] == ["z"]
+    assert live[0]["tokens"] == [1, 3] and live[0]["retries"] == 1
+    assert counts["checkpoints"] == 1
+
+
+def test_unknown_record_types_are_skipped(tmp_path):
+    path = _write(tmp_path, [RECORDS[0], {"t": "future", "x": 1},
+                             RECORDS[1]])
+    _, records, _ = read_journal(path)
+    live, _ = replay(records)
+    assert live[0]["tokens"] == [7, 8]
+
+
+def test_truncation_at_every_byte_offset(tmp_path):
+    """The torn-tail property: cut a valid journal at EVERY byte
+    offset — replay never crashes, always recovers the longest valid
+    prefix, and says exactly how much it dropped."""
+    path = _write(tmp_path, RECORDS)
+    with open(path, "rb") as f:
+        full = f.read()
+    # frame boundaries: magic + header + each record
+    header_frame = frame_record({"t": "header", "v": 1,
+                                 "fingerprint": FP})
+    bounds = [len(MAGIC) + len(header_frame)]
+    for rec in RECORDS:
+        bounds.append(bounds[-1] + len(frame_record(rec)))
+    assert bounds[-1] == len(full)
+    cut_path = str(tmp_path / "cut.journal")
+    for cut in range(len(full) + 1):
+        with open(cut_path, "wb") as f:
+            f.write(full[:cut])
+        if cut < bounds[0]:
+            # the HEAD (magic + fingerprint header) is destroyed:
+            # that is the one unrecoverable damage class
+            with pytest.raises(JournalCorruptError):
+                read_journal(cut_path)
+            continue
+        fp, records, stats = read_journal(cut_path)
+        assert fp == FP
+        # longest valid prefix: every complete frame before the cut
+        n_complete = sum(1 for b in bounds[1:] if b <= cut)
+        assert records == RECORDS[:n_complete]
+        assert stats["truncated"] == (cut not in bounds)
+        assert stats["bytes_dropped"] == cut - bounds[n_complete]
+        if cut in bounds:
+            assert stats["records_dropped"] == 0
+        else:
+            assert stats["records_dropped"] >= 1
+        # replay of the prefix never raises
+        replay(records)
+
+
+def test_crc_corruption_drops_exact_suffix(tmp_path):
+    """Corrupt one byte inside each record's payload in turn: replay
+    recovers the records before it, and the dropped-record count is
+    exact (the corrupt record plus every intact one behind it —
+    framing survives, content does not, and prefix-only is the
+    correctness rule)."""
+    path = _write(tmp_path, RECORDS)
+    with open(path, "rb") as f:
+        full = bytearray(f.read())
+    header_frame = frame_record({"t": "header", "v": 1,
+                                 "fingerprint": FP})
+    start = len(MAGIC) + len(header_frame)
+    offs = [start]
+    for rec in RECORDS:
+        offs.append(offs[-1] + len(frame_record(rec)))
+    bad_path = str(tmp_path / "bad.journal")
+    for i in range(len(RECORDS)):
+        corrupt = bytearray(full)
+        payload_byte = offs[i] + 8  # first payload byte of record i
+        corrupt[payload_byte] ^= 0xFF
+        with open(bad_path, "wb") as f:
+            f.write(corrupt)
+        fp, records, stats = read_journal(bad_path)
+        assert records == RECORDS[:i]
+        assert stats["truncated"] is True
+        assert stats["records_dropped"] == len(RECORDS) - i
+        replay(records)  # never raises
+
+
+def test_head_damage_is_typed(tmp_path):
+    missing = str(tmp_path / "nope.journal")
+    with pytest.raises(JournalCorruptError, match="unreadable"):
+        read_journal(missing)
+    empty = str(tmp_path / "empty.journal")
+    open(empty, "wb").close()
+    with pytest.raises(JournalCorruptError, match="magic"):
+        read_journal(empty)
+    garbled = str(tmp_path / "garbled.journal")
+    with open(garbled, "wb") as f:
+        f.write(b"not a journal at all")
+    with pytest.raises(JournalCorruptError, match="magic"):
+        read_journal(garbled)
+
+
+def test_reopen_appends_under_same_fingerprint(tmp_path):
+    path = _write(tmp_path, RECORDS[:2])
+    w = JournalWriter(path, FP)
+    w.append(RECORDS[2])
+    w.sync()
+    w.close()
+    _, records, _ = read_journal(path)
+    assert records == RECORDS[:3]
+
+
+def test_reopen_rejects_fingerprint_mismatch(tmp_path):
+    path = _write(tmp_path, RECORDS[:1])
+    other = dict(FP, temperature=0.7)
+    with pytest.raises(FingerprintMismatchError) as ei:
+        JournalWriter(path, other)
+    msg = str(ei.value)
+    # names the differing key AND both sides' values
+    assert "temperature" in msg and "0.0" in msg and "0.7" in msg
+
+
+def test_reopen_truncates_torn_tail_before_appending(tmp_path):
+    path = _write(tmp_path, RECORDS[:2])
+    size = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x13\x37torn")  # a crash mid-write
+    w = JournalWriter(path, FP)
+    w.append(RECORDS[2])
+    w.sync()
+    w.close()
+    _, records, stats = read_journal(path)
+    # the garbage is GONE (not sitting between old and new records)
+    assert records == RECORDS[:3]
+    assert stats["truncated"] is False
+    assert os.path.getsize(path) == size + len(frame_record(RECORDS[2]))
+
+
+def test_append_rewinds_over_torn_bytes(tmp_path):
+    """Exactly-once framing: an append whose write died mid-frame (or
+    landed but failed its fsync) must be REPLACED by the next append,
+    never stacked behind — a duplicate commit record would
+    double-apply tokens at replay, and a torn frame would strand every
+    later record past the reader's stop point."""
+    path = _write(tmp_path, RECORDS[:1])
+    w = JournalWriter(path, FP)
+    w.append(RECORDS[1])
+    # simulate a torn append: partial frame bytes land at the tail
+    # without the writer's known-good offset advancing
+    with open(path, "ab") as f:
+        f.write(b"\x55torn-partial-frame")
+    w.append(RECORDS[2])  # rewinds over the garbage
+    w.sync()
+    w.close()
+    _, records, stats = read_journal(path)
+    assert records == RECORDS[:3]
+    assert stats["truncated"] is False and stats["bytes_dropped"] == 0
+
+
+def test_compact_in_place_and_to_path(tmp_path):
+    path = _write(tmp_path, RECORDS, name="live.journal")
+    w = JournalWriter(path, FP)
+    ckpt = {"t": "checkpoint", "live": []}
+    # standalone snapshot: the live journal is untouched
+    other = str(tmp_path / "snapshot.journal")
+    info = w.compact([ckpt], path=other)
+    assert info["path"] == other and info["records"] == 1
+    _, records, _ = read_journal(other)
+    assert records == [ckpt]
+    _, records, _ = read_journal(path)
+    assert records == RECORDS
+    # in-place: the journal shrinks to header + checkpoint and the
+    # handle keeps appending onto the COMPACTED file
+    w.compact([ckpt])
+    w.append(RECORDS[0])
+    w.sync()
+    w.close()
+    _, records, _ = read_journal(path)
+    assert records == [ckpt, RECORDS[0]]
+
+
+def test_fsync_policy_validation(tmp_path):
+    with pytest.raises(InvalidArgumentError, match="fsync"):
+        JournalWriter(str(tmp_path / "x.journal"), FP, fsync="sometimes")
+    for mode in ("always", "tick", "never"):
+        p = str(tmp_path / ("m-%s.journal" % mode))
+        w = JournalWriter(p, FP, fsync=mode)
+        w.append(RECORDS[0])
+        w.sync()
+        w.close()
+        assert read_journal(p)[1] == RECORDS[:1]
+
+
+def test_commit_for_unknown_rid_is_ignored(tmp_path):
+    path = _write(tmp_path, [
+        {"t": "commit", "toks": [["ghost", [1, 2]]]}, RECORDS[0]])
+    _, records, _ = read_journal(path)
+    live, counts = replay(records)
+    assert [e["rid"] for e in live] == ["a"]
+    assert counts["committed_tokens"] == 0
+
+
+def test_terminal_for_unknown_rid_not_counted(tmp_path):
+    path = _write(tmp_path, [
+        {"t": "terminal", "rid": "ghost", "state": "DONE",
+         "reason": "length"}] + RECORDS)
+    _, records, _ = read_journal(path)
+    live, counts = replay(records)
+    # the ghost terminal neither crashes nor skews the reconciliation
+    assert counts["terminals"] == 1
+    assert counts["admitted"] - counts["terminals"] == len(live)

@@ -106,6 +106,26 @@ EXTRA_EDGES = {
     "SpeculativePool._new_draft_cache": ("DecodeMesh.place_cache",),
     "DecodeMesh.place_cache": ("DecodeMesh.place",),
     "DecodeMesh.place": ("DecodeMesh.sharding",),
+    # crash-durability plane (docs §5m): the journal handle is a
+    # conditional constructor assignment (`None if ... else
+    # JournalWriter(...)`) the local-constructor inference cannot see
+    # through, and the writer fires the fault seam via a module
+    # attribute call — declaring the engine→journal.append→fsync chain
+    # keeps the per-tick WAL flush hot-path-audited like every other
+    # plane.  restore() reaches the pool's adoption/resubmit machinery
+    # behind self._pool (the same dynamic seam as _recover's), so the
+    # restore→replay→submit chain is declared too: a restore is cold
+    # by definition, but its callees (submit, adopt_spill) are shared
+    # with hot paths and must be audited under both reachabilities.
+    "ServingEngine._journal_append": ("JournalWriter.append",),
+    "ServingEngine._journal_flush": ("JournalWriter.sync",),
+    "JournalWriter.append": ("fire",),
+    "ServingEngine._resubmit_record": ("GenerationPool.submit",),
+    "ServingEngine.restore": ("read_journal", "replay",
+                              "GenerationPool.adopt_spill",
+                              "ServingEngine._resubmit_record",
+                              "ServingEngine.checkpoint"),
+    "ServingEngine.checkpoint": ("JournalWriter.compact",),
     # fault plane: the hot path's module-level no-op check fans into the
     # installed plane, so the plane's own fire() is hot-path-audited
     "_fire": ("fire",),

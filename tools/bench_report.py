@@ -82,6 +82,12 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "itl_p50_s": ("lower", 0.25),
     "itl_p95_s": ("lower", 0.25),
     "recovery_wall_s": ("lower", 0.30),
+    # crash-durable serving (serving_restart, docs §5m): the recovery-
+    # time objective — journal replay + resubmit/adoption through the
+    # first post-restore token.  Host-side work like recovery_wall_s,
+    # gated at the same looseness (CPU smoke jitters with scheduler
+    # noise; the tokens_lost==0 contract is the bench gate's job)
+    "restore_rto_s": ("lower", 0.30),
     # byte accounting: deterministic, so tight
     "kv_resident_bytes": ("lower", 0.01),
     "kv_reachable_bytes": ("lower", 0.01),
