@@ -1790,6 +1790,187 @@ def _sharded_bench_child():
     print(json.dumps(_round_tree(out)))
 
 
+def bench_serving_disagg(pt, jax, on_tpu: bool):
+    """L7 disaggregated-serving leg (docs/DESIGN.md §5n): the SAME
+    zipf-mixed traffic — mostly short interactive prompts, a heavy
+    tail of long prefill jobs, the shape whose chunked prefills the
+    fused engine interleaves into resident decodes — through the fused
+    engine vs the prefill/decode pair behind ``DisaggregatedServing``.
+
+    Stamps the headline the tier split claims and the hand-off's own
+    cost, so neither can silently decay:
+
+    - ``ttft_p95_improvement_pct`` / ``itl_p95_improvement_pct``:
+      disagg vs fused on identical traffic (front-observed, so the
+      disagg numbers INCLUDE the hand-off wait — the honest end-to-end
+      reading; on CPU smoke both tiers timeshare one core, so ~0 or
+      negative is the expected reading there — the columns exist so
+      the on-chip run has a stamped comparison);
+    - ``kv_transfers`` / ``kv_transfer_bytes``: every request must
+      actually cross the contract (``_leg_promotable`` rejects a
+      disagg record whose hand-off never fired — it measured two idle
+      engines), and the bytes are the wire cost of the split;
+    - ``handoff_wait_p95_s``: the export-to-adopt latency the front's
+      deadline estimate folds in;
+    - ``tokens_lost``: disagg greedy output vs the fused reference.
+      MUST be 0 — a hand-off can never change tokens, only where they
+      are computed, and the gate structurally refuses a lossy leg."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.models import TransformerLM, gpt_1p3b_config
+    from paddle_tpu.serving import DisaggregatedServing, ServingEngine
+
+    cfg = gpt_1p3b_config()
+    if on_tpu:
+        cfg.update(num_layers=6)
+        short_len, long_len, gen = 32, 384, 24
+        chunk, block, slots, n_requests = 64, 32, 4, 16
+    else:
+        _cpu_smoke_shrink(cfg, max_position=1024)
+        short_len, long_len, gen = 8, 48, 6
+        chunk, block, slots, n_requests = 16, 8, 2, 8
+    max_len = long_len + gen
+    pt.seed(0)
+    model = TransformerLM(**cfg, dropout=0.0)
+    rng = np.random.RandomState(0)
+    # zipf over prompt-length ranks: rank 1 is the short interactive
+    # prompt (dominates), the tail ranks are the long prefill-heavy
+    # jobs (same normalized 1/rank^a draw as the prefix leg)
+    zipf_a = 1.1
+    ranks = np.linspace(short_len, long_len, 4).astype(int)
+    probs = 1.0 / np.arange(1, len(ranks) + 1) ** zipf_a
+    probs /= probs.sum()
+    choices = rng.choice(len(ranks), size=n_requests, p=probs)
+    prompts = [rng.randint(0, cfg["vocab_size"],
+                           (int(ranks[c]),)).astype("int32")
+               for c in choices]
+    shared = dict(cache_layout="paged", block_size=block,
+                  buckets=[max_len], temperature=0.0)
+    workdir = tempfile.mkdtemp(prefix="bench-disagg-")
+
+    def measure(target, itl_hist, after_warm=None):
+        # warm every executable on BOTH sides of the hand-off outside
+        # the timed region (a long warm prompt crosses the transfer on
+        # the disagg target), then measure the zipf burst
+        target.submit(rng.randint(0, cfg["vocab_size"],
+                                  (long_len,)).astype("int32"), 2)
+        while target.pump(8):
+            pass
+        itl_hist.reset()
+        if after_warm is not None:
+            after_warm()
+        t0 = time.perf_counter()
+        streams = [target.submit(p, gen, request_id="r%d" % i)
+                   for i, p in enumerate(prompts)]
+        while target.pump(4):
+            pass
+        wall = time.perf_counter() - t0
+        return [s.result(timeout_s=0) for s in streams], wall
+
+    def leg(statuses, wall, itl_hist, stats):
+        ttfts = [st.ttft_s for st in statuses]
+        return {
+            "cache_layout": stats["cache_layout"],
+            "cache_dtype": stats["cache_dtype"],
+            "requests": len(statuses),
+            "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 5),
+            "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 5),
+            "itl_p50_s": _histogram_quantile(itl_hist, 0.5),
+            "itl_p95_s": _histogram_quantile(itl_hist, 0.95),
+            "tokens_per_sec": round(
+                sum(st.new_tokens for st in statuses) / wall, 1),
+            "wall_s": round(wall, 4),
+        }
+
+    try:
+        # fused reference: one engine, chunked prefill interleaved with
+        # resident decodes — also the greedy byte-identity reference
+        engine = ServingEngine(model, max_len=max_len, slots=2 * slots,
+                               max_queue=2 * n_requests,
+                               prefill_chunk_tokens=chunk, **shared)
+        itl = engine.metrics.histogram("serving_inter_token_seconds")
+        statuses, wall = measure(engine, itl)
+        fused = leg(statuses, wall, itl, engine.cache_stats())
+        want = {st.request_id: np.asarray(st.tokens) for st in statuses}
+        engine.shutdown()
+
+        # disaggregated pair on the same traffic: prefill tier admits
+        # and chunks, decode tier adopts over the transfer contract;
+        # TTFT/ITL come from the FRONT's registry (end-to-end, the
+        # hand-off wait included)
+        front = DisaggregatedServing(
+            model, max_len, transfer_dir=os.path.join(workdir, "xfer"),
+            prefill_chunk_tokens=chunk, prefill_slots=slots,
+            decode_slots=slots, max_queue=2 * n_requests, **shared)
+        itl = front.metrics.histogram("serving_inter_token_seconds")
+        base = {}
+
+        def snap_after_warm():
+            # the warm request crosses the transfer too: snapshot the
+            # counters at the timed region's edge so the stamped
+            # transfer count/bytes cover exactly the measured traffic
+            base["xfers"] = front._c_transfers.value
+            base["bytes"] = front._c_transfer_bytes.value
+            front.metrics.histogram("serving_ttft_seconds").reset()
+            front.metrics.histogram("serving_handoff_wait_s").reset()
+
+        statuses, wall = measure(front, itl,
+                                 after_warm=snap_after_warm)
+        dleg = leg(statuses, wall, itl, front.decode.cache_stats())
+        tokens_lost = 0
+        for st in statuses:
+            ref = want[st.request_id]
+            got = np.asarray(st.tokens)
+            tokens_lost += max(0, len(ref) - len(got)) + int(
+                (got[:len(ref)] != ref[:len(got)]).sum())
+        dleg.update({
+            "kv_transfers": int(front._c_transfers.value
+                                - base["xfers"]),
+            "kv_transfer_bytes": int(front._c_transfer_bytes.value
+                                     - base["bytes"]),
+            "handoffs_degraded": int(front._c_degraded.value),
+            "handoff_wait_p95_s": _histogram_quantile(
+                front.metrics.histogram("serving_handoff_wait_s"),
+                0.95),
+            "tokens_lost": tokens_lost,
+        })
+        front.shutdown()
+
+        def imp(key):
+            off, on = fused.get(key), dleg.get(key)
+            if not isinstance(off, (int, float)) \
+                    or not isinstance(on, (int, float)):
+                return None
+            return round((off - on) / max(1e-9, off) * 100.0, 2)
+
+        return {
+            "short_len": short_len,
+            "long_len": long_len,
+            "generated": gen,
+            "slots_per_tier": slots,
+            "block_size": block,
+            "prefill_chunk_tokens": chunk,
+            "zipf_a": zipf_a,
+            "input_staged": False,
+            "transfer_note": (
+                "prompt upload rides inside the (chunked) prefill term "
+                "exactly as in the serving leg, identically on both "
+                "sub-legs; the K/V hand-off's own wire cost is stamped "
+                "explicitly (kv_transfer_bytes, handoff_wait_p95_s) "
+                "rather than hidden in the ratio"),
+            "fused": fused,
+            "disagg": dleg,
+            "kv_transfers": dleg["kv_transfers"],
+            "kv_transfer_bytes": dleg["kv_transfer_bytes"],
+            "tokens_lost": tokens_lost,
+            "ttft_p95_improvement_pct": imp("ttft_p95_s"),
+            "itl_p95_improvement_pct": imp("itl_p95_s"),
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _probe_accelerator(timeout_s: int = 180) -> bool:
     """Check from a THROWAWAY subprocess that the accelerator runtime
     answers; a wedged tunnel (the axon transport can hang for hours) must
@@ -1924,6 +2105,7 @@ def _leg_promotable(name: str, leg: dict):
                         "serving_prefix": "ttft_p50_s",
                         "serving_overload": "ttft_p99_high_s",
                         "serving_sharded": "tokens_per_sec",
+                        "serving_disagg": "ttft_p95_s",
                         "speculative": "tokens_per_sec"}
     if name in cache_stamp_keys:
         # a decode/serving/speculative number without its cache-layout
@@ -2075,6 +2257,33 @@ def _leg_promotable(name: str, leg: dict):
                                "carry its measured-vs-ideal scaling "
                                "and what one shard asks of its chip"
                                % (unscaled,))
+        if name == "serving_disagg":
+            # the tier split's headline IS the fused-vs-disagg
+            # comparison: a record missing either improvement column
+            # compared nothing (the sub-leg that failed took the
+            # comparison with it); a lossy hand-off broke the
+            # byte-identity contract (a hand-off may move computation,
+            # never change tokens); and a record whose hand-off never
+            # fired measured two idle engines wearing the tier roles
+            if not isinstance(leg.get("ttft_p95_improvement_pct"),
+                              (int, float)) \
+                    or not isinstance(leg.get("itl_p95_improvement_pct"),
+                                      (int, float)):
+                return False, ("serving_disagg leg missing the "
+                               "ttft/itl p95 improvement stamps: a "
+                               "disaggregation number that cannot "
+                               "compare against the fused engine on "
+                               "the same traffic claims nothing")
+            if leg.get("tokens_lost", 1) != 0:
+                return False, ("serving_disagg leg lost tokens vs the "
+                               "fused reference: a hand-off can move "
+                               "computation between tiers, never "
+                               "change greedy tokens")
+            if not leg.get("kv_transfers"):
+                return False, ("serving_disagg leg recorded no K/V "
+                               "hand-offs: without a transfer the "
+                               "pair measured two idle engines, not "
+                               "disaggregation")
         if name == "serving":
             # the §5g tracing contract is that the flight recorder is
             # effectively free on the tick path; a serving number whose
@@ -2254,6 +2463,7 @@ def _measure_and_print():
                      ("serving_prefix", bench_serving_prefix),
                      ("serving_overload", bench_serving_overload),
                      ("serving_sharded", bench_serving_sharded),
+                     ("serving_disagg", bench_serving_disagg),
                      ("speculative", bench_speculative)):
         try:
             legs[name] = fn(pt, jax, on_tpu)

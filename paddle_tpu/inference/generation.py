@@ -147,6 +147,20 @@ def _trace_active():
     return _trace.active()
 
 
+_transfer = None
+
+
+def _transfer_mod():
+    # same lazy binding as _fire/_trace_active: the K/V transfer
+    # contract lives in the serving layer, and importing it at module
+    # load would cycle (serving.engine imports this module)
+    global _transfer
+    if _transfer is None:
+        from ..serving import transfer as _transfer_module
+        _transfer = _transfer_module
+    return _transfer
+
+
 class DuplicateRequestError(AlreadyExistsError, InvalidArgumentError):
     """``submit()`` reused a request_id that is still queued, active, or
     awaiting collection.  Subclasses ``InvalidArgumentError`` so callers
@@ -345,7 +359,8 @@ class GenerationPool:
                  tenant_slot_cap: Optional[int] = None,
                  mesh: Optional[DecodeMesh] = None,
                  route: str = "auto", spill_tier: str = "host",
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 prefill_only: bool = False):
         if slots < 1:
             raise InvalidArgumentError("GenerationPool needs slots >= 1")
         if mesh is not None and not isinstance(mesh, DecodeMesh):
@@ -599,6 +614,25 @@ class GenerationPool:
                 "with spill_tier=%r)" % (spill_tier,))
         self.spill_tier = spill_tier
         self._spill_dir = None if spill_dir is None else str(spill_dir)
+        # prefill tier mode (docs §5n): the pool runs admission +
+        # prefill as usual, but a request that survives its first token
+        # PARKS instead of decoding — export_kv() then hands its
+        # written blocks + committed state to a decode-tier pool over
+        # the K/V transfer contract.  Requires the disk spill tier (the
+        # export writer IS the spill writer) and therefore paged.
+        if prefill_only and spill_tier != "disk":
+            raise InvalidArgumentError(
+                "prefill_only=True exports finished prefills over the "
+                "K/V transfer contract, which lives in the disk spill "
+                "tier — pass spill_tier='disk' (and spill_dir=)")
+        self._prefill_only = bool(prefill_only)
+        # rid -> (slot, _SlotState) for prefill-complete parked
+        # requests awaiting export_kv()
+        self._prefill_done: Dict[object, tuple] = {}
+        # serving-layer hook: on_prefill_done(rid) the moment a
+        # prefill-only request parks (fires inside step(), after the
+        # first token's on_token)
+        self.on_prefill_done = None
         self._seq = 0
         self._spilled: Dict[object, _SpillState] = {}
         self._spill_owner: Dict[int, tuple] = {}
@@ -1090,6 +1124,17 @@ class GenerationPool:
             self._used_rids.discard(request_id)
             self._spill_drop(sp)
             return "preempted"
+        parked = self._prefill_done.pop(request_id, None)
+        if parked is not None:
+            # a prefill-complete request cancelled before export: its
+            # slot and blocks free like an active cancel (no transfer
+            # file exists yet — export_kv writes it)
+            slot, _st = parked
+            self._free.append(slot)
+            self._release_blocks(slot)
+            self._used_rids.discard(request_id)
+            self._membership_dirty = True
+            return "prefill-done"
         if request_id in self._results:
             del self._results[request_id]
             self._finish_reasons.pop(request_id, None)
@@ -1420,13 +1465,18 @@ class GenerationPool:
         return os.path.join(self._spill_dir,
                             "spill-%s%s.npz" % (tag, safe))
 
-    def _spill_write(self, st: _SlotState, host, written: int) -> str:
-        """Write one victim's gathered K/V (+ int8 scales — they ride
-        their blocks) to its spill file: tmp file + fsync + atomic
-        rename, so a crash mid-write can never leave a half file a
-        restoring engine would adopt.  Fires the ``spill.write`` seam;
-        a transient failure is retried ONCE (each caught fault emits a
-        ``spill.error`` trace event, so the chaos harness reconciles
+    def _spill_write(self, st: _SlotState, host, written: int,
+                     seam: str = "spill.write") -> str:
+        """Write one request's gathered K/V (+ int8 scales — they ride
+        their blocks) to its transfer file under the versioned
+        ``serving.transfer`` contract (PTKV magic + version + this
+        pool's config fingerprint in the header); the writer keeps the
+        tmp file + fsync + atomic rename discipline, so a crash
+        mid-write can never leave a half file an adopting engine would
+        read.  Fires ``seam`` (``spill.write`` for preemption spills,
+        ``xfer.write`` for prefill-tier exports); a transient failure
+        is retried ONCE (each caught fault emits a ``spill.error`` /
+        ``xfer.error`` trace event, so the chaos harness reconciles
         injections against the recorder), then propagates — the caller
         leaves the pool untouched."""
         path = self._spill_path(st.rid)
@@ -1439,43 +1489,21 @@ class GenerationPool:
                 "block_size": self._block_size,
                 "layers": len(host), "fields": len(host[0]),
                 "cache_dtype": str(np.dtype(self._cache[0].k.dtype))}
-        arrays["meta"] = np.asarray(json.dumps(meta))
-        tmp = path + ".tmp"
-        for attempt in (0, 1):
-            try:
-                _fire("spill.write")
-                with open(tmp, "wb") as f:
-                    np.savez(f, **arrays)
-                    f.flush()
-                    os.fsync(f.fileno())
-                os.replace(tmp, path)
-                return path
-            except BaseException as e:  # noqa: BLE001 - classify + retry
-                retry = attempt == 0 \
-                    and _faults.classify_error(e) == "transient"
-                tr = _trace_active()
-                if tr is not None:
-                    tr.instant("spill.error", rid=st.rid,
-                               error=type(e).__name__, retried=retry)
-                if not retry:
-                    # a persistently failed write must not leave its
-                    # half-written .tmp littering the spill dir
-                    try:
-                        os.remove(tmp)
-                    except OSError:
-                        pass
-                    raise
-        raise AssertionError("unreachable")  # pragma: no cover
+        return _transfer_mod().write_transfer(
+            path, self.config_fingerprint(), meta, arrays,
+            seam=seam, rid=st.rid)
 
     def _spill_read(self, sp: _SpillState):
-        """Page a disk-tier spill file back into the per-layer tuple
-        shape ``_resume``'s upload path consumes (the resume-boundary
-        file read — only when resume actually needs content)."""
-        with np.load(sp.host_path) as z:
-            meta = json.loads(str(z["meta"]))
-            return [tuple(z["l%d_f%d" % (i, j)]
-                          for j in range(meta["fields"]))
-                    for i in range(meta["layers"])]
+        """Map a disk-tier transfer file back into the per-layer tuple
+        shape ``_resume``'s upload path consumes.  The reader is
+        mmap-backed: the returned arrays are zero-copy views, so the
+        only copy is the device upload itself (the views keep the
+        mapping alive)."""
+        r = _transfer_mod().TransferReader(sp.host_path)
+        meta = r.meta
+        return [tuple(r.arrays["l%d_f%d" % (i, j)]
+                      for j in range(meta["fields"]))
+                for i in range(meta["layers"])]
 
     def _spill_drop(self, sp: _SpillState) -> None:
         """Delete a spill record's disk file, if it has one (resume /
@@ -1535,38 +1563,76 @@ class GenerationPool:
             return False
         first = self._cache[0]
         nf = 4 if first.k_scale is not None else 2
+        xfer = _transfer_mod()
         try:
-            with np.load(path) as z:
-                meta = json.loads(str(z["meta"]))
-                if (meta.get("committed") != len(tokens)
-                        or meta.get("prompt_len") != len(ids)
-                        or meta.get("written") != written):
-                    # STALE: the journal is ground truth, and a file
-                    # whose resume point disagrees with it can never
-                    # be adopted again — delete it, or crash/restore
-                    # cycles accumulate dead .npz litter (and stale
-                    # K/V under a recurring rid is worse than no file,
-                    # the reset() rule)
-                    try:
-                        os.remove(path)
-                    except OSError:
-                        pass
-                    return False
-                if (meta.get("block_size") != bs
-                        or meta.get("layers") != len(self._cache)
-                        or meta.get("fields") != nf
-                        or meta.get("cache_dtype")
-                        != str(np.dtype(first.k.dtype))):
-                    # structural mismatch against THIS pool's cache:
-                    # possibly another config's pool sharing the dir —
-                    # fall back without deleting what is not ours to
-                    # judge
-                    return False
-                if tuple(z["l0_f0"].shape) \
-                        != (written,) + tuple(first.k.shape[1:]):
-                    return False
-                host_bytes = sum(int(z[k].nbytes) for k in z.files
-                                 if k != "meta")
+            r = xfer.TransferReader(path)
+        except xfer.TransferVersionError as e:
+            # a PTKV file under OUR rid naming in an OLDER format
+            # version can never be adopted again — delete it, the
+            # stale-file litter rule; a NEWER version is a newer
+            # engine's file sharing the dir, not ours to judge
+            if e.found < xfer.VERSION:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+            from ..serving import log as _slog
+            _slog.emit("xfer.reject", rid=str(request_id),
+                       reason="version", found=e.found,
+                       deleted=e.found < xfer.VERSION)
+            return False
+        except xfer.TransferFormatError as e:
+            # pre-upgrade unversioned np.savez spill (or a corrupt
+            # file): detected and rejected with a one-line log, never
+            # a crash — and left on disk, the old engine's to clean up
+            from ..serving import log as _slog
+            _slog.emit("xfer.reject", rid=str(request_id),
+                       reason="legacy_npz" if e.legacy_npz
+                       else "format", detail=str(e))
+            return False
+        except Exception:  # noqa: BLE001 - a bad file falls back, always
+            return False
+        try:
+            try:
+                xfer.check_fingerprint(r.fingerprint,
+                                       self.config_fingerprint())
+            except xfer.TransferFingerprintError as e:
+                # another deployment's file (different sampling/cache
+                # semantics) sharing the dir — fall back without
+                # deleting what is not ours to judge
+                from ..serving import log as _slog
+                _slog.emit("xfer.reject", rid=str(request_id),
+                           reason="fingerprint", keys=list(e.keys))
+                return False
+            meta = r.meta
+            if (meta.get("committed") != len(tokens)
+                    or meta.get("prompt_len") != len(ids)
+                    or meta.get("written") != written):
+                # STALE: the journal is ground truth, and a file
+                # whose resume point disagrees with it can never
+                # be adopted again — delete it, or crash/restore
+                # cycles accumulate dead transfer-file litter (and
+                # stale K/V under a recurring rid is worse than no
+                # file, the reset() rule)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                return False
+            if (meta.get("block_size") != bs
+                    or meta.get("layers") != len(self._cache)
+                    or meta.get("fields") != nf
+                    or meta.get("cache_dtype")
+                    != str(np.dtype(first.k.dtype))):
+                # structural mismatch against THIS pool's cache:
+                # possibly another config's pool sharing the dir —
+                # fall back without deleting what is not ours to
+                # judge
+                return False
+            if tuple(r.arrays["l0_f0"].shape) \
+                    != (written,) + tuple(first.k.shape[1:]):
+                return False
+            host_bytes = int(r.nbytes)
         except Exception:  # noqa: BLE001 - a bad file falls back, always
             return False
         try:
@@ -1588,6 +1654,73 @@ class GenerationPool:
         self._spilled[request_id] = sp
         self._used_rids.add(request_id)
         return True
+
+    @property
+    def prefill_done_count(self) -> int:
+        """Prefill-complete requests parked awaiting export (always 0
+        unless ``prefill_only=True``)."""
+        return len(self._prefill_done)
+
+    def has_prefill_done(self, request_id) -> bool:
+        """True while ``request_id`` is parked prefill-complete (not
+        yet exported or cancelled)."""
+        return request_id in self._prefill_done
+
+    def export_kv(self, request_id) -> dict:
+        """First-class K/V export of a parked prefill-complete request
+        through the transfer contract (docs §5n): gather its written
+        blocks (+ int8 scales) in ONE batched download — the same
+        pow2-padded gather ``preempt`` compiles, so export adds no new
+        eager shapes — write them to the request's transfer file at the
+        ``xfer.write`` seam, then free the slot and blocks.  NO
+        preemption semantics: there is no victim, no resume
+        bookkeeping, no ``_spilled`` entry — the file plus the returned
+        committed state IS the hand-off, and the adopting decode-tier
+        pool re-parks it via :meth:`adopt_spill` (one mechanism for
+        migration, restore, and disaggregation).
+
+        The write happens BEFORE any allocator mutation, so a failed
+        write (the ``xfer.write`` injection seam, or a real EIO) leaves
+        the request parked and the pool untouched — the caller can
+        retry or fall back to prompt+committed hand-off.  Unknown or
+        not-parked ids raise :class:`NotFoundError`."""
+        parked = self._prefill_done.get(request_id)
+        if parked is None:
+            raise NotFoundError(
+                "request_id %r is not parked prefill-complete (not a "
+                "prefill_only pool, not yet prefilled, cancelled, or "
+                "already exported)" % (request_id,))
+        slot, st = parked
+        shard = self._shard_of_slot(slot)
+        blocks = self._slot_blocks[slot]
+        pos = len(st.ids) + len(st.tokens) - 1
+        written = -(-pos // self._block_size)
+        padded_n = _pow2_at_least(written)
+        gidx = np.full(padded_n, self._shard_scratch(shard), np.int32)
+        gidx[:written] = blocks[:written]
+        gather = jnp.asarray(gidx)
+        host = jax.device_get([
+            (c.k[gather], c.v[gather])
+            + ((c.k_scale[gather], c.v_scale[gather])
+               if c.k_scale is not None else ())
+            for c in self._cache])
+        # honest byte accounting: the pad rows are not hand-off content
+        transfer_bytes = sum(arr[:written].nbytes
+                             for layer in host for arr in layer)
+        path = self._spill_write(st, host, written, seam="xfer.write")
+        del self._prefill_done[request_id]
+        self._free.append(slot)
+        self._release_blocks(slot)
+        self._used_rids.discard(request_id)
+        self._membership_dirty = True
+        return {"rid": request_id, "path": path,
+                "transfer_bytes": int(transfer_bytes),
+                "blocks_written": int(written),
+                "committed_tokens": len(st.tokens),
+                "prompt_len": int(len(st.ids)),
+                "max_new_tokens": len(st.tokens) + st.remaining,
+                "priority": st.priority, "tenant": st.tenant,
+                "deadline": st.deadline}
 
     def config_fingerprint(self) -> dict:
         """The JSON-stable identity of everything byte-identical replay
@@ -1679,6 +1812,21 @@ class GenerationPool:
         self._membership_dirty = True
         finishes = max_new_tokens - 1 == 0 or \
             (self.eos_id is not None and first == self.eos_id)
+        if self._prefill_only and not finishes:
+            # prefill tier (docs §5n): the request's prompt is fully
+            # resident and its first token committed — exactly the
+            # state export_kv() hands off — so PARK it instead of
+            # decoding.  A request that finishes on its first token
+            # never hands off: it completes here like any other (the
+            # decode tier has nothing to do for it).
+            st = self._active.pop(slot)
+            self._prefill_done[rid] = (slot, st)
+            self._membership_dirty = True
+            if self.on_token is not None:
+                self.on_token(rid, first)
+            if self.on_prefill_done is not None:
+                self.on_prefill_done(rid)
+            return
         if not finishes:
             # a slot that finishes on its very first token never
             # decodes, so the subclass hook (the speculative pool's
@@ -2124,7 +2272,7 @@ class GenerationPool:
             self._chunk_work(tr)
         if not self._active:
             return bool(self._queue or self._prefilling
-                        or self._spilled)
+                        or self._spilled or self._prefill_done)
         params, bufs = self._sync_step_inputs()
         if tr is None:
             tok_dev = self._dispatch(params, bufs)
@@ -2148,7 +2296,7 @@ class GenerationPool:
             with tr.span("tick.deliver"):
                 self._deliver(tok)
         return bool(self._active or self._queue or self._prefilling
-                    or self._spilled)
+                    or self._spilled or self._prefill_done)
 
     def _dispatch(self, params, bufs):
         """The one batched decode dispatch (cache donated and rebound in
@@ -2213,6 +2361,9 @@ class GenerationPool:
             self._spill_drop(sp)
         self._spilled.clear()
         self._spill_owner.clear()
+        # parked prefill-complete requests name blocks of the cache
+        # being discarded; the engine resubmits them like any survivor
+        self._prefill_done.clear()
         self.admission_blocked = False
         if self.cache_layout == "paged":
             self._free_by_shard = [

@@ -95,6 +95,22 @@ dropping) admissions.  ``journal.append``/``spill.write`` are fault
 seams, and the ``serving_restart`` bench leg stamps the measured RTO
 with ``tokens_lost == 0`` required for promotion.
 
+Disaggregated serving (docs/DESIGN.md §5n): ``transfer`` is the
+versioned K/V hand-off contract — a magic+version+fingerprint-headered,
+64-byte-aligned, fsync'd single file (``write_transfer`` /
+``TransferReader``) that the disk spill tier, crash restore and tier
+hand-off all share — and ``DisaggregatedServing`` runs a prefill-role
+engine (admission + chunked prefill, exports at first token over the
+``xfer.write`` seam) next to a decode-role engine (adopts via the
+PR 15 upload path, never compiles a prefill-chunk executable) behind
+one fused-looking front: one stream per request across the hand-off,
+byte-identical to the fused engine, deadline shed that includes the
+observed mean ``serving_handoff_wait_s``, and
+``serving_kv_transfers_total`` / ``serving_kv_transfer_bytes_total``
+on the front's registry.  Stale-version files are deleted (resubmit
+fallback covers them), alien-fingerprint and pre-upgrade unversioned
+files are left alone and logged — never adopted, never crash.
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —
@@ -102,7 +118,8 @@ serving-oriented systems work (PAPERS.md, arXiv:2603.09555) treats the
 cached decode step as a component inside a request scheduler; this
 package is that scheduler.
 """
-from . import faults, journal, log, slo, trace
+from . import faults, journal, log, slo, trace, transfer
+from .disagg import DisaggregatedServing
 from .engine import (PRIORITY_CLASSES, AdmissionTightenedError,
                      DeadlineUnattainableError, QueueFullError,
                      ServingEngine)
@@ -116,6 +133,9 @@ from .slo import Objective, SLOTracker
 from .stream import RequestState, ResponseStream, StreamStatus
 from .supervisor import EngineHealth, Supervisor
 from .trace import FlightRecorder, TraceEvent, Tracer
+from .transfer import (TransferFingerprintError, TransferFormatError,
+                       TransferReader, TransferVersionError,
+                       check_fingerprint, write_transfer)
 
 __all__ = [
     "ServingEngine", "QueueFullError", "DeadlineUnattainableError",
@@ -130,4 +150,8 @@ __all__ = [
     "log", "JsonLinesLogger",
     "journal", "JournalWriter", "JournalWriteError",
     "JournalCorruptError", "FingerprintMismatchError",
+    "transfer", "write_transfer", "TransferReader", "check_fingerprint",
+    "TransferFormatError", "TransferVersionError",
+    "TransferFingerprintError",
+    "DisaggregatedServing",
 ]

@@ -234,7 +234,8 @@ class ServingEngine:
                  degrade_clear_ticks: int = 3,
                  degrade_admit_floor=1,
                  journal_path: Optional[str] = None,
-                 journal_fsync: str = "tick", **pool_kwargs):
+                 journal_fsync: str = "tick", role: str = "fused",
+                 **pool_kwargs):
         if int(max_queue) < 1:
             raise InvalidArgumentError(
                 "max_queue must be >= 1, got %r" % (max_queue,))
@@ -242,6 +243,46 @@ class ServingEngine:
             raise InvalidArgumentError(
                 "max_retries must be >= 0 (0 = never resubmit after a "
                 "step failure), got %r" % (max_retries,))
+        # disaggregated serving tiers (docs §5n): "fused" is the
+        # default single-engine mode (everything below is unchanged);
+        # "prefill" runs admission + chunked prefill only and exports
+        # completed prefills over the K/V transfer contract; "decode"
+        # adopts exported transfers and goes straight to token 1
+        if role not in ("fused", "prefill", "decode"):
+            raise InvalidArgumentError(
+                "role must be 'fused', 'prefill', or 'decode', got %r"
+                % (role,))
+        if role != "fused":
+            if draft_model is not None:
+                raise InvalidArgumentError(
+                    "disaggregated tiers run the plain pool: the "
+                    "speculative pool's draft state does not cross the "
+                    "K/V hand-off — use role='fused' with draft_model")
+            if pool_kwargs.get("spill_tier") != "disk":
+                raise InvalidArgumentError(
+                    "role=%r hands K/V off through the disk transfer "
+                    "contract — pass spill_tier='disk' and spill_dir= "
+                    "(the directory both tiers share)" % (role,))
+        if role == "prefill":
+            if pool_kwargs.get("prefill_chunk_tokens") is None:
+                # the prefill tier's entire job is the chunk executable
+                # (PR 11, reused verbatim); without it the tier would
+                # run bucketed one-shot prefill and the per-role
+                # compile contract would have nothing to pin
+                raise InvalidArgumentError(
+                    "role='prefill' needs prefill_chunk_tokens= (the "
+                    "tier runs ONLY admission + chunked prefill)")
+            pool_kwargs["prefill_only"] = True
+        if role == "decode" \
+                and pool_kwargs.get("prefill_chunk_tokens") is not None:
+            # the decode tier never compiles a prefill-chunk
+            # executable — that saving is part of the point (its
+            # fallback re-prefill path is the bucketed session prefill)
+            raise InvalidArgumentError(
+                "role='decode' must not set prefill_chunk_tokens: the "
+                "decode tier adopts finished prefills and never "
+                "compiles the chunk executable (docs §5n)")
+        self.role = str(role)
         if degrade and slo is None:
             # the ladder's control signal IS the SLO alert: without
             # objectives there is nothing to step on, and a silently
@@ -561,6 +602,20 @@ class ServingEngine:
         self._pool.on_finish = self._on_finish
         self._pool.on_resume = self._on_resume
 
+        # prefill-tier hand-off plumbing (docs §5n): the pool hook
+        # collects rids whose prefill completed this tick; the export
+        # sweep at the tick edge writes each transfer file and fires
+        # ``on_handoff(rid, info)`` — the disaggregated front's bridge
+        self._export_ready: List = []
+        self.on_handoff = None
+        self._c_handed_off = m.counter(
+            "serving_requests_handed_off_total",
+            "prefill-complete requests exported over the K/V transfer "
+            "contract and handed to a decode tier") \
+            if role == "prefill" else None
+        if role == "prefill":
+            self._pool.on_prefill_done = self._on_prefill_done
+
         # the JournalWriter truncated a torn tail when it re-opened an
         # existing file (a crash mid-write on the SAME path — the
         # standard restart flow): surface the count now that the
@@ -863,6 +918,170 @@ class ServingEngine:
                   blocks_uploaded=info.get("blocks_uploaded"),
                   committed_tokens=info.get("committed_tokens"),
                   wait_s=wait_s)
+
+    # -- disaggregated hand-off (docs §5n) -------------------------------
+    def _on_prefill_done(self, rid) -> None:
+        """Pool hook (prefill role only): ``rid``'s prompt is fully
+        resident and its first token committed — queue it for the
+        export sweep at this tick's edge.  The sweep, not the hook,
+        does the device gather + file write: the hook fires inside
+        ``pool.step`` and must stay cheap."""
+        self._export_ready.append(rid)
+
+    def _export_sweep(self) -> None:
+        """Export every prefill-complete request queued this tick:
+        gather + write its transfer file (the ``xfer.write`` seam),
+        fire ``on_handoff(rid, info)`` with everything the decode tier
+        needs — BEFORE the tier-terminal ``HANDED_OFF`` finalize, so
+        the front's hand-off record exists before the stream closes —
+        and finalize the tier's involvement.  A failed export degrades,
+        never loses: the parked K/V is cancelled and the hand-off
+        carries ``path=None`` — the decode tier falls back to
+        prompt+committed resubmit, byte-identical under greedy decoding
+        (the O(1)-cache contract)."""
+        if not self._export_ready:
+            return
+        ready, self._export_ready = self._export_ready, []
+        for rid in ready:
+            if not self._pool.has_prefill_done(rid):
+                continue  # cancelled / expired / recovered away
+            rec = self._live.get(rid)
+            if rec is None:
+                # engine-side record gone (raced a cancel): drop the
+                # parked pool state too, nothing to hand off
+                try:
+                    self._pool.cancel(rid)
+                except NotFoundError:
+                    pass
+                continue
+            error = None
+            try:
+                info = self._pool.export_kv(rid)
+            except BaseException as e:  # noqa: BLE001 - degrade, not lose
+                error = "%s: %s" % (type(e).__name__, str(e)[:200])
+                try:
+                    self._pool.cancel(rid)
+                except NotFoundError:
+                    pass
+                info = {"rid": rid, "path": None, "transfer_bytes": 0,
+                        "blocks_written": 0,
+                        "committed_tokens": len(rec.tokens)}
+            self._live.pop(rid, None)
+            info = dict(info)
+            info.update(
+                prompt=rec.prompt, tokens=list(rec.tokens),
+                prompt_len=rec.prompt_len, max_new_tokens=rec.max_new,
+                priority=rec.priority, tenant=rec.tenant,
+                deadline_abs=rec.deadline_abs, submit_t=rec.submit_t,
+                exported_at=self._clock(), error=error)
+            if self._c_handed_off is not None:
+                self._c_handed_off.inc()
+            trace.instant("xfer.export", rid=rid,
+                          transfer_bytes=info["transfer_bytes"],
+                          blocks=info["blocks_written"],
+                          committed_tokens=info["committed_tokens"],
+                          degraded=error is not None or None)
+            slog.emit("xfer.export", rid=rid,
+                      transfer_bytes=info["transfer_bytes"],
+                      blocks=info["blocks_written"],
+                      committed_tokens=info["committed_tokens"],
+                      error=error)
+            if self.on_handoff is not None:
+                self.on_handoff(rid, info)
+            self._finalize(rec, RequestState.HANDED_OFF, "handoff",
+                           rec.tokens)
+
+    def adopt_transfer(self, request_id, input_ids, tokens,
+                       max_new_tokens: int, priority=0, tenant=None,
+                       deadline_abs=None) -> dict:
+        """Decode-role admission: adopt one handed-off request —
+        ``input_ids`` + committed ``tokens`` are the journal-grade
+        ground truth, the transfer file (if present and exact) is the
+        K/V fast path.  The request re-parks straight into the spill
+        tier via ``adopt_spill`` and resumes into DECODING at the next
+        refill with NO re-prefill; any adoption miss (stale/alien/
+        missing file) falls back to prompt+committed resubmit —
+        byte-identical either way.  Committed tokens are NOT replayed
+        into the returned stream: the front already delivered them
+        live off the prefill tier's stream.
+
+        Returns ``{"stream": ResponseStream, "adopted_from_file":
+        bool}``.  No queue-depth gate: admission control ran at the
+        prefill tier's door, and refusing a mid-flight hand-off here
+        would drop a request both tiers already invested in."""
+        with self._lock:
+            if self.role != "decode":
+                raise PreconditionNotMetError(
+                    "adopt_transfer is the decode tier's admission "
+                    "path (this engine's role is %r)" % (self.role,))
+            if self._draining:
+                raise PreconditionNotMetError(
+                    "engine is draining/shut down: hand-offs are "
+                    "stopped")
+            if request_id in self._live:
+                raise DuplicateRequestError(
+                    "request_id %r is already live on this decode "
+                    "tier" % (request_id,))
+            priority = _normalize_priority(priority)
+            ids = np.asarray(getattr(input_ids, "value",
+                                     input_ids)).astype(np.int32)
+            toks = [int(t) for t in tokens]
+            now = self._clock()
+            stream = ResponseStream(self, request_id,
+                                    int(max_new_tokens))
+            rec = _Record(request_id, stream, ids,
+                          int(max_new_tokens), deadline_abs, now,
+                          priority=priority, tenant=tenant)
+            rec.tokens = list(toks)
+            if toks:
+                # the decode tier observes ITL only from here on: TTFT
+                # belongs to the prefill tier (and end-to-end to the
+                # front) — the first post-adopt token must not book
+                # the whole prefill+hand-off as one inter-token gap
+                rec.first_t = rec.last_t = now
+            if self._journal is not None:
+                # WAL discipline survives disaggregation: the adoption
+                # is durable (admit + the committed history as one
+                # commit record) BEFORE the request can decode, so a
+                # decode-tier crash mid-adopt replays prompt+committed
+                # — the transfer file, if still exact, is re-adopted
+                # at restore
+                self._check_journal_rid(request_id)
+                try:
+                    self._journal_admit(
+                        request_id, ids, max_new_tokens,
+                        (None if deadline_abs is None
+                         else max(0.001, deadline_abs - now)),
+                        priority, tenant)
+                    if toks:
+                        self._jl_tick_toks.setdefault(
+                            request_id, []).extend(toks)
+                        self._journal_flush()
+                except Exception as e:  # noqa: BLE001 - reject, typed
+                    raise JournalWriteError(
+                        "hand-off rejected: the request journal could "
+                        "not record the adoption (%s: %s); retry"
+                        % (type(e).__name__, str(e)[:200])) from e
+            adopted = self._pool.adopt_spill(
+                request_id, ids, toks, int(max_new_tokens),
+                priority=priority, tenant=tenant,
+                deadline=deadline_abs)
+            if adopted:
+                rec.state = RequestState.PREEMPTED
+                rec.preempted_at = now
+            else:
+                self._resubmit_record(rec)
+            self._live[request_id] = rec
+            self._c_submitted.inc()
+            trace.instant("xfer.adopt", rid=request_id,
+                          from_file=adopted,
+                          committed_tokens=len(toks))
+            slog.emit("xfer.adopt", rid=request_id,
+                      adopted_from_file=adopted,
+                      committed_tokens=len(toks),
+                      prompt_tokens=int(ids.shape[0]))
+        self._wake.set()
+        return {"stream": stream, "adopted_from_file": bool(adopted)}
 
     # -- preemption + the degradation ladder (docs §5j) ------------------
     def preempt(self, request_id=None, reason: str = "manual"):
@@ -1168,6 +1387,10 @@ class ServingEngine:
         compiled executable — recovery costs cache re-allocation plus
         one re-prefill per survivor, never a recompile."""
         kind = faults.classify_error(exc)
+        # sweep entries queued before the failure name parked pool
+        # state pool.reset() is about to discard; the resubmitted
+        # survivors will re-prefill and re-queue themselves
+        self._export_ready = []
         survivors = []
         for rid, rec in list(self._live.items()):
             self._live.pop(rid)
@@ -1725,6 +1948,9 @@ class ServingEngine:
                 self._health.note_error(self._clock(), e,
                                         faults.classify_error(e))
                 self._recover(e)
+            # prefill-role tick edge: export every prefill that
+            # completed this step and hand it off (no-op otherwise)
+            self._export_sweep()
             self._observe_gauges()
             return bool(self._live)
         finally:
@@ -1930,6 +2156,7 @@ class ServingEngine:
         now = self._clock()
         out = {"state": state,
                "healthy": state in ("idle", "serving", "draining"),
+               "role": self.role,
                "live_requests": len(self._live),
                "queue_depth": self._pool.queue_depth,
                "loop_alive": loop_alive,

@@ -16,6 +16,7 @@ them with scripted schedules or a seeded random chaos mode:
 - ``http.write``       — the per-token ndjson socket write
 - ``journal.append``   — the crash-durability journal's record write
 - ``spill.write``      — the disk spill tier's K/V file write
+- ``xfer.write``       — the K/V hand-off contract's transfer-file write
 
 The plane is OFF by default: ``fire(point)`` is a module-level check of
 one global against ``None`` — no allocation, no lock, no host sync —
@@ -56,6 +57,7 @@ POINTS = (
     "http.write",
     "journal.append",
     "spill.write",
+    "xfer.write",
 )
 _POINT_SET = frozenset(POINTS)
 

@@ -39,7 +39,15 @@ class RequestState:
     ``PREEMPTED`` is a NON-terminal detour off DECODING: the scheduler
     evicted the request mid-decode (its K/V spilled to the host tier)
     and will resume it — the stream stays open, tokens already
-    delivered stand, and the request returns to DECODING at resume."""
+    delivered stand, and the request returns to DECODING at resume.
+
+    ``HANDED_OFF`` is terminal FOR THE TIER, not for the request: a
+    prefill-role engine exported the request's K/V over the transfer
+    contract and a decode-role engine now owns it (docs §5n).  The
+    disaggregated front never surfaces it — its bridged stream keeps
+    flowing across the hand-off — but tier-local observers (the
+    journal, per-tier metrics) see the prefill tier's involvement end
+    here."""
 
     QUEUED = "QUEUED"
     PREFILLING = "PREFILLING"
@@ -49,7 +57,8 @@ class RequestState:
     CANCELLED = "CANCELLED"
     EXPIRED = "EXPIRED"
     FAILED = "FAILED"
-    TERMINAL = frozenset({DONE, CANCELLED, EXPIRED, FAILED})
+    HANDED_OFF = "HANDED_OFF"
+    TERMINAL = frozenset({DONE, CANCELLED, EXPIRED, FAILED, HANDED_OFF})
 
 
 # the terminal record delivered once per request: finish_reason is the

@@ -580,3 +580,74 @@ def test_serving_restart_gate_structural_cases():
     del bad["restart"]["cache_dtype"]
     ok, why = bench._leg_promotable("serving_restart", bad)
     assert not ok and "cache_layout/cache_dtype" in why
+
+
+def test_serving_disagg_gate_structural_cases():
+    """The §5n disaggregation leg: a record missing either fused-vs-
+    disagg improvement column, one whose hand-offs lost tokens, or one
+    whose hand-off never fired is structurally unpromotable — and the
+    usual cache-provenance stamps apply to both timed sub-legs."""
+    def leg(**over):
+        sub = {"cache_layout": "paged", "cache_dtype": "float32",
+               "ttft_p95_s": 0.02, "itl_p95_s": 0.005}
+        out = {"input_staged": False,
+               "transfer_note": "identical traffic on both sub-legs",
+               "fused": dict(sub), "disagg": dict(sub),
+               "kv_transfers": 8, "kv_transfer_bytes": 1 << 20,
+               "tokens_lost": 0,
+               "ttft_p95_improvement_pct": 12.0,
+               "itl_p95_improvement_pct": 7.5}
+        out.update(over)
+        return out
+
+    ok, why = bench._leg_promotable("serving_disagg", leg())
+    assert ok, why
+    # a record that cannot compare against the fused engine claims
+    # nothing — EITHER missing improvement column rejects
+    ok, why = bench._leg_promotable(
+        "serving_disagg", leg(ttft_p95_improvement_pct=None))
+    assert not ok and "improvement" in why
+    bad = leg()
+    del bad["itl_p95_improvement_pct"]
+    ok, why = bench._leg_promotable("serving_disagg", bad)
+    assert not ok and "improvement" in why
+    # a lossy hand-off broke the byte-identity contract
+    ok, why = bench._leg_promotable("serving_disagg",
+                                    leg(tokens_lost=2))
+    assert not ok and "lost tokens" in why
+    # an UNSTAMPED tokens_lost defaults to lossy
+    bad = leg()
+    del bad["tokens_lost"]
+    ok, why = bench._leg_promotable("serving_disagg", bad)
+    assert not ok and "lost tokens" in why
+    # zero hand-offs measured two idle engines wearing the tier roles
+    ok, why = bench._leg_promotable("serving_disagg",
+                                    leg(kv_transfers=0))
+    assert not ok and "no K/V hand-offs" in why
+    # cache provenance applies to both timed sub-legs
+    bad = leg()
+    del bad["disagg"]["cache_dtype"]
+    ok, why = bench._leg_promotable("serving_disagg", bad)
+    assert not ok and "cache_layout/cache_dtype" in why
+
+
+@pytest.mark.slow
+def test_live_serving_disagg_leg_passes_its_own_gate():
+    """The leg bench.py actually emits must satisfy its own gate AND
+    the §5n acceptance contract: every request crossed the transfer,
+    zero tokens lost vs the fused reference, both improvement columns
+    stamped — slow-marked (it runs the zipf traffic through the fused
+    engine AND the two-tier pair, compiling both tiers)."""
+    import jax
+
+    import paddle_tpu as pt
+
+    leg = bench.bench_serving_disagg(pt, jax, False)
+    ok, why = bench._leg_promotable("serving_disagg", leg)
+    assert ok, why
+    assert leg["tokens_lost"] == 0
+    assert leg["kv_transfers"] == leg["disagg"]["requests"]
+    assert leg["kv_transfer_bytes"] > 0
+    assert leg["disagg"]["handoffs_degraded"] == 0
+    assert isinstance(leg["ttft_p95_improvement_pct"], float)
+    assert isinstance(leg["itl_p95_improvement_pct"], float)

@@ -1,0 +1,431 @@
+"""Disaggregated prefill/decode serving (docs/DESIGN.md §5n): tier
+roles, the versioned K/V hand-off contract, and the front that bridges
+them.
+
+The contracts pinned here:
+
+1. the disaggregated pair produces BYTE-IDENTICAL greedy output to the
+   fused engine on the same traffic — paged, fp32 AND int8 (the
+   transfer carries the quantized K/V plus scales) — with per-role
+   compile pins: the decode tier never compiles a prefill-chunk
+   executable, the prefill tier never compiles the batched decode
+   step;
+2. scheduling metadata (deadline, priority, tenant) is carried across
+   the hand-off into the decode tier's record — remaining deadline,
+   never a re-grant;
+3. cancel during the hand-off window (exported, not yet adopted)
+   reclaims BOTH tiers: the transfer file dies, neither tier holds a
+   slot or a block, the front stream ends CANCELLED;
+4. seeded chaos at the ``xfer.write`` seam never hangs the front,
+   never loses a token (a failed export degrades to prompt+committed
+   resubmit — same greedy bytes), and the plane's injection count
+   reconciles EXACTLY with the recorded ``xfer.error`` trace events;
+5. the decode tier crashing mid-adopt restores green from its own
+   journal + the shared transfer dir, survivors byte-identical;
+6. version/magic hardening: a stale-VERSION file is deleted (it can
+   never become adoptable; resubmit covers it), a FUTURE version and
+   an alien fingerprint are left alone (another writer/config may own
+   them), and a pre-upgrade unversioned ``np.savez`` file is detected
+   and rejected with a one-line ``xfer.reject`` log — never a crash;
+7. the front's deadline estimate folds in the OBSERVED mean hand-off
+   wait between the tier estimates.
+"""
+import io
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import (InvalidArgumentError,
+                                    PreconditionNotMetError)
+from paddle_tpu.inference import GenerationPool
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (DisaggregatedServing, RequestState,
+                                ServingEngine, faults, transfer)
+from paddle_tpu.serving import log as slog
+from paddle_tpu.serving.faults import FaultPlane
+
+
+def _tiny_model(seed=0, **over):
+    pt.seed(seed)
+    cfg = dict(vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+               intermediate_size=64, max_position=256, causal=True,
+               dropout=0.0)
+    cfg.update(over)
+    return TransformerLM(**cfg)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _prompts(seed, lens):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 128, (n,)).astype("int32") for n in lens]
+
+
+def _drain(target):
+    while target.pump(8):
+        pass
+
+
+def _mk_front(model, tmp_path, tag="x", **over):
+    kw = dict(transfer_dir=str(tmp_path / ("xfer-" + tag)),
+              prefill_chunk_tokens=16, prefill_slots=2, decode_slots=2,
+              buckets=[32, 64], block_size=8)
+    kw.update(over)
+    return DisaggregatedServing(model, 64, **kw)
+
+
+def _fused_want(model, prompts, budgets, **over):
+    kw = dict(max_len=64, slots=2, buckets=[32, 64],
+              cache_layout="paged", block_size=8,
+              prefill_chunk_tokens=16)
+    kw.update(over)
+    eng = ServingEngine(model, **kw)
+    streams = [eng.submit(p, n, request_id="r%d" % i)
+               for i, (p, n) in enumerate(zip(prompts, budgets))]
+    _drain(eng)
+    want = {s.request_id: np.asarray(s.result(timeout_s=0).tokens)
+            for s in streams}
+    eng.shutdown()
+    return want
+
+
+# -- 1. byte-identity + per-role compile pins -----------------------------
+
+@pytest.mark.parametrize("cache_dtype", ["float32", "int8"])
+def test_disagg_byte_identity_and_role_pins(model, tmp_path, cache_dtype):
+    prompts = _prompts(3, (5, 19, 9, 33))
+    budgets = (8, 6, 7, 5)
+    want = _fused_want(model, prompts, budgets, cache_dtype=cache_dtype)
+
+    front = _mk_front(model, tmp_path, tag="ident-" + cache_dtype,
+                      cache_dtype=cache_dtype)
+    streams = [front.submit(p, n, request_id="r%d" % i)
+               for i, (p, n) in enumerate(zip(prompts, budgets))]
+    _drain(front)
+    for s in streams:
+        st = s.result(timeout_s=0)
+        # the front NEVER surfaces the tier-terminal HANDED_OFF
+        assert st.state == RequestState.DONE
+        np.testing.assert_array_equal(np.asarray(st.tokens),
+                                      want[s.request_id])
+    # every request crossed the contract as a real file adoption
+    assert front._c_transfers.value == len(prompts)
+    assert front._c_transfer_bytes.value > 0
+    assert front._c_degraded.value == 0
+    assert front._h_handoff.count == len(prompts)
+    # per-role compile pins: the decode tier NEVER compiled a
+    # prefill-chunk executable, the prefill tier NEVER compiled the
+    # batched decode step
+    cc = front.compile_counts()
+    assert "prefill_chunk" not in cc["decode"], cc["decode"]
+    assert cc["prefill"]["prefill_chunk"] >= 1
+    assert cc["prefill"].get("pool_decode", 0) == 0, cc["prefill"]
+    assert cc["decode"].get("pool_decode", 0) >= 1
+    # transfer files are consumed at adoption/resume: the dir drains
+    assert os.listdir(str(tmp_path / ("xfer-ident-" + cache_dtype))) \
+        == []
+    front.shutdown()
+
+
+# -- 2. metadata across the hand-off --------------------------------------
+
+def test_handoff_carries_scheduling_metadata(model, tmp_path):
+    front = _mk_front(model, tmp_path, tag="meta")
+    p = _prompts(5, (21,))[0]
+    s = front.submit(p, 8, request_id="m", deadline_s=60.0,
+                     priority="high", tenant="acme")
+    fr = front._records["m"]
+    ticks = 0
+    while "m" not in front._handoffs:
+        front.prefill.pump(1)
+        ticks += 1
+        assert ticks < 100, "hand-off never fired"
+    info = front._handoffs["m"]
+    assert info["priority"] is not None
+    assert info["tenant"] == "acme"
+    assert info["deadline_abs"] is not None
+    front._bridge()  # adopt into the decode tier
+    drec = front.decode._live["m"]
+    assert drec.tenant == "acme"
+    assert drec.priority == info["priority"]
+    # the REMAINING deadline crossed, not a fresh 60s grant
+    assert drec.deadline_abs == info["deadline_abs"]
+    assert abs(drec.deadline_abs - fr.deadline_abs) < 1.0
+    _drain(front)
+    assert s.result(timeout_s=0).state == RequestState.DONE
+    front.shutdown()
+
+
+# -- 3. cancel during the hand-off window ---------------------------------
+
+def test_cancel_during_handoff_reclaims_both_tiers(model, tmp_path):
+    front = _mk_front(model, tmp_path, tag="cancel")
+    p = _prompts(6, (21,))[0]
+    s = front.submit(p, 8, request_id="c")
+    ticks = 0
+    while "c" not in front._handoffs:
+        front.prefill.pump(1)
+        ticks += 1
+        assert ticks < 100, "hand-off never fired"
+    path = front._handoffs["c"]["path"]
+    assert path and os.path.exists(path)
+    assert front.cancel("c")
+    # the transfer file died with the request; neither tier holds it
+    assert not os.path.exists(path)
+    assert front.prefill.live_requests == 0
+    assert front.decode.live_requests == 0
+    assert front.prefill.cache_stats()["mapped_blocks"] == 0
+    st = s.result(timeout_s=0)
+    assert st.state == RequestState.CANCELLED
+    assert not front.cancel("c")  # idempotent
+    # cancel on the DECODE tier (post-adoption) reclaims it too
+    s2 = front.submit(p, 8, request_id="c2")
+    ticks = 0
+    while front.decode.live_requests == 0:
+        front.pump(1)
+        ticks += 1
+        assert ticks < 100
+    assert front.cancel("c2")
+    assert front.decode.live_requests == 0
+    assert front.decode.cache_stats()["mapped_blocks"] == 0
+    assert s2.result(timeout_s=0).state == RequestState.CANCELLED
+    front.shutdown()
+
+
+# -- 4. chaos at the xfer.write seam --------------------------------------
+
+@pytest.mark.parametrize("seed", range(5))
+def test_chaos_xfer_write_seam(model, tmp_path, seed):
+    """Seeded faults at the transfer-file write: no hang, survivors
+    byte-identical (a dead export degrades to resubmit — same greedy
+    bytes, different tier does the work), injections == recorded
+    ``xfer.error`` events exactly."""
+    prompts = _prompts(seed, (5, 19, 9, 4))
+    budgets = (6, 5, 7, 4)
+    want = _fused_want(model, prompts, budgets)
+
+    front = _mk_front(model, tmp_path, tag="chaos-%d" % seed)
+    plane = FaultPlane(chaos_seed=seed, chaos_p=0.35,
+                       chaos_points=("xfer.write",), max_faults=8)
+    tracer = front.prefill.start_trace(capacity=4096)
+    with faults.injected(plane):
+        streams = [front.submit(p, n, request_id="r%d" % i)
+                   for i, (p, n) in enumerate(zip(prompts, budgets))]
+        ticks = 0
+        while front.pump(1):
+            ticks += 1
+            assert ticks < 400, "chaos run failed to drain: wedged"
+    front.prefill.stop_trace()
+    for s in streams:
+        st = s.result(timeout_s=0)
+        assert st.state == RequestState.DONE
+        np.testing.assert_array_equal(np.asarray(st.tokens),
+                                      want[s.request_id])
+    events = tracer.recorder.snapshot()
+    xfer_errors = sum(1 for e in events if e.name == "xfer.error")
+    injected = sum(1 for pt_, _, name in plane.injected
+                   if pt_ == "xfer.write" and name != "delay")
+    assert xfer_errors == injected
+    # a double-fault export degrades (resubmit on the decode tier);
+    # the front's counter saw every one of them
+    degraded = sum(1 for e in events
+                   if e.name == "xfer.export"
+                   and (e.meta or {}).get("degraded"))
+    assert front._c_degraded.value == degraded
+    front.shutdown()
+
+
+# -- 5. decode tier crash mid-adopt + journal restore ---------------------
+
+def test_decode_crash_mid_adopt_restores_from_journal(model, tmp_path):
+    prompts = _prompts(11, (9, 17))
+    budgets = (8, 7)
+    want = _fused_want(model, prompts, budgets)
+    jpath = str(tmp_path / "decode.journal")
+    xdir = str(tmp_path / "xfer-crash")
+
+    front = _mk_front(model, tmp_path, tag="crash",
+                      decode_overrides={"journal_path": jpath})
+    streams = [front.submit(p, n, request_id="r%d" % i)
+               for i, (p, n) in enumerate(zip(prompts, budgets))]
+    # drive until BOTH requests are adopted into the decode tier but
+    # never give that tier a tick: the crash lands mid-adopt, journal
+    # admits written, transfer files still parked in the spill tier
+    ticks = 0
+    while front.decode.live_requests < len(prompts):
+        front.prefill.pump(1)
+        front._bridge()
+        ticks += 1
+        assert ticks < 200, "adoption never completed"
+    del front, streams  # the in-process SIGKILL stand-in
+
+    eng = ServingEngine(model, max_len=64, slots=2, buckets=[32, 64],
+                        cache_layout="paged", block_size=8,
+                        role="decode", spill_tier="disk", spill_dir=xdir,
+                        journal_path=str(tmp_path / "decode2.journal"))
+    summary = eng.restore(jpath)
+    restored = {rid: rec.stream for rid, rec in eng._live.items()}
+    assert set(restored) == {"r0", "r1"}
+    assert summary["adopted_from_spill"] >= 1
+    _drain(eng)
+    for rid, s in restored.items():
+        st = s.result(timeout_s=0)
+        assert st.state == RequestState.DONE
+        np.testing.assert_array_equal(np.asarray(st.tokens), want[rid])
+    # the adopted decode tier never compiled a prefill-chunk executable
+    assert "prefill_chunk" not in eng.compile_counts()
+    eng.shutdown()
+
+
+# -- 6. version/magic hardening -------------------------------------------
+
+def test_transfer_version_and_magic_hardening(model, tmp_path):
+    spill = str(tmp_path / "pool-spill")
+
+    def mk(**over):
+        kw = dict(max_len=64, slots=2, buckets=[32],
+                  cache_layout="paged", block_size=8,
+                  spill_tier="disk", spill_dir=spill)
+        kw.update(over)
+        return GenerationPool(model, **kw)
+
+    p = _prompts(4, (9,))[0]
+    pool = mk()
+    pool.submit(p, 8, request_id="v")
+    for _ in range(3):
+        pool.step()
+    pool.preempt("v")
+    path = pool._spilled["v"].host_path
+    committed = list(pool._spilled["v"].tokens)
+    with open(path, "rb") as f:
+        raw = f.read()
+    magic, _ver, hlen = transfer._HEADER_STRUCT.unpack(
+        raw[:transfer._HEADER_STRUCT.size])
+
+    def rejects(body, reason, deleted):
+        with open(path, "wb") as f:
+            f.write(body)
+        buf = io.StringIO()
+        with slog.logging_to(buf):
+            assert not mk().adopt_spill("v", p, committed, 8)
+        assert os.path.exists(path) == (not deleted)
+        rej = [json.loads(l) for l in buf.getvalue().splitlines()
+               if json.loads(l)["event"] == "xfer.reject"]
+        assert len(rej) == 1, "exactly one reject line per attempt"
+        assert rej[0]["reason"] == reason
+        return rej[0]
+
+    # a STALE version can never become adoptable again: deleted, and
+    # the caller's resubmit fallback covers the request
+    line = rejects(
+        transfer._HEADER_STRUCT.pack(magic, 0, hlen) + raw[16:],
+        "version", deleted=True)
+    assert line["found"] == 0
+    # a FUTURE version belongs to a newer writer: left alone
+    line = rejects(
+        transfer._HEADER_STRUCT.pack(magic, transfer.VERSION + 41, hlen)
+        + raw[16:], "version", deleted=False)
+    assert line["found"] == transfer.VERSION + 41
+    # a pre-upgrade unversioned npz (the PK zip magic) is detected and
+    # rejected with its own one-line log — never parsed, never deleted
+    buf = io.BytesIO()
+    np.savez(buf, l0_f0=np.zeros((1, 8, 2, 16), np.float32))
+    rejects(buf.getvalue(), "legacy_npz", deleted=False)
+    # garbage that is neither PTKV nor a zip: format reject, kept
+    rejects(b"\x00" * 64, "format", deleted=False)
+    # an ALIEN fingerprint (int8 pool, fp32 file) is another config's
+    # property: left alone, the mismatched keys named in the log
+    with open(path, "wb") as f:
+        f.write(raw)
+    buf = io.StringIO()
+    with slog.logging_to(buf):
+        assert not mk(cache_dtype="int8").adopt_spill(
+            "v", p, committed, 8)
+    assert os.path.exists(path)
+    rej = [json.loads(l) for l in buf.getvalue().splitlines()
+           if json.loads(l)["event"] == "xfer.reject"]
+    assert len(rej) == 1 and rej[0]["reason"] == "fingerprint"
+    assert "cache_dtype" in rej[0]["keys"]
+    # ...and after every rejection the intact file still adopts,
+    # byte-identically (the hardening never corrupted it)
+    ref = mk()
+    ref.submit(p, 8, request_id="v")
+    want = ref.run()
+    good = mk()
+    assert good.adopt_spill("v", p, committed, 8)
+    got = good.run()
+    np.testing.assert_array_equal(got["v"], want["v"])
+
+
+def test_capacity_keys_tolerated_across_tiers(model, tmp_path):
+    """Tier sizing (slots / num_blocks) is EXCLUDED from the transfer
+    fingerprint check — a bigger decode tier adopts a smaller prefill
+    tier's file; sampling/cache keys still refuse."""
+    fp_a = {"slots": 2, "num_blocks": 16, "temperature": 0.0,
+            "cache_dtype": "float32"}
+    fp_b = {"slots": 8, "num_blocks": 64, "temperature": 0.0,
+            "cache_dtype": "float32"}
+    transfer.check_fingerprint(fp_a, fp_b)  # capacity-only: passes
+    with pytest.raises(transfer.TransferFingerprintError) as ei:
+        transfer.check_fingerprint(
+            dict(fp_a, temperature=1.0), fp_b)
+    assert "temperature" in str(ei.value)
+
+
+# -- 7. roles + the front's deadline estimate -----------------------------
+
+def test_role_validation(model, tmp_path):
+    spill = str(tmp_path / "rv")
+    with pytest.raises(InvalidArgumentError, match="role"):
+        ServingEngine(model, max_len=64, role="hybrid")
+    with pytest.raises(InvalidArgumentError, match="prefill_chunk"):
+        ServingEngine(model, max_len=64, role="prefill",
+                      cache_layout="paged", block_size=8,
+                      spill_tier="disk", spill_dir=spill)
+    with pytest.raises(InvalidArgumentError, match="prefill_chunk"):
+        ServingEngine(model, max_len=64, role="decode",
+                      cache_layout="paged", block_size=8,
+                      prefill_chunk_tokens=16,
+                      spill_tier="disk", spill_dir=spill)
+    with pytest.raises(InvalidArgumentError, match="disk"):
+        ServingEngine(model, max_len=64, role="decode",
+                      cache_layout="paged", block_size=8)
+    eng = ServingEngine(model, max_len=64, slots=2, buckets=[32],
+                        cache_layout="paged", block_size=8, role="decode",
+                        spill_tier="disk", spill_dir=spill)
+    assert eng.health()["role"] == "decode"
+    with pytest.raises(PreconditionNotMetError):
+        # adopt is the DECODE tier's door; a fused engine refuses it
+        fused = ServingEngine(model, max_len=64, slots=2, buckets=[32])
+        fused.adopt_transfer("x", [1, 2], [3], 8)
+    eng.shutdown()
+    fused.shutdown()
+
+
+def test_front_deadline_estimate_includes_handoff_wait(model, tmp_path):
+    front = _mk_front(model, tmp_path, tag="ddl")
+    prompts = _prompts(8, (9, 17))
+    streams = [front.submit(p, 6, request_id="d%d" % i)
+               for i, p in enumerate(prompts)]
+    _drain(front)
+    for s in streams:
+        assert s.result(timeout_s=0).state == RequestState.DONE
+    h = front._h_handoff
+    assert h.count > 0
+    est = front._deadline_estimate_s(4, prompt_len=8)
+    assert est is not None
+    # the composition is exactly prefill + observed mean wait + decode
+    pe = front.prefill._deadline_estimate_s(1, 8)
+    de = front.decode._deadline_estimate_s(3)
+    assert est == pytest.approx(pe + h.sum / h.count + de)
+    # the estimate MOVES with the observed hand-off wait: a slow
+    # transfer path must make the front shed earlier, not admit blind
+    h.observe(100.0)
+    assert front._deadline_estimate_s(4, prompt_len=8) > est + 1.0
+    front.shutdown()

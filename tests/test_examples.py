@@ -30,6 +30,7 @@ SCRIPTS = [
     ("15_overload_serving.py", ["--tokens", "8"]),
     ("16_sharded_serving.py", ["--tokens", "8"]),
     ("17_durable_serving.py", ["--tokens", "8"]),
+    ("18_disagg_serving.py", ["--tokens", "8"]),
 ]
 
 

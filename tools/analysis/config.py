@@ -71,7 +71,8 @@ EXTRA_EDGES = {
                                    "Tracer.span"),
     "GenerationPool._activate": ("ServingEngine._on_token",
                                  "ServingEngine._on_finish",
-                                 "SpeculativePool._on_activated"),
+                                 "SpeculativePool._on_activated",
+                                 "ServingEngine._on_prefill_done"),
     # traffic-grade scheduling (docs §5j): the degradation ladder's
     # preempt decision dispatches into the pool's spill path (victim
     # K/V → host pool, the one deliberate spill-boundary device_get),
@@ -126,6 +127,25 @@ EXTRA_EDGES = {
                               "ServingEngine._resubmit_record",
                               "ServingEngine.checkpoint"),
     "ServingEngine.checkpoint": ("JournalWriter.compact",),
+    # disaggregated serving (docs §5n): the transfer contract is reached
+    # behind a lazy module import (`_transfer_mod()` — invisible to the
+    # AST) from the pool's spill write/read/adopt paths, the prefill
+    # tier's export sweep fires the attribute-assigned on_handoff hook
+    # into the front's bridge, and the front drives both tier engines
+    # through constructor-built attributes — the whole
+    # park→export→transfer-write→adopt hand-off chain is declared so
+    # the hot-path rules audit it like the spill tier it generalizes
+    "GenerationPool._spill_write": ("write_transfer",),
+    "GenerationPool._spill_read": ("TransferReader.__init__",),
+    "GenerationPool.adopt_spill": ("TransferReader.__init__",
+                                   "check_fingerprint"),
+    "write_transfer": ("fire",),
+    "ServingEngine._export_sweep": ("GenerationPool.export_kv",
+                                    "GenerationPool.cancel",
+                                    "DisaggregatedServing._on_handoff"),
+    "ServingEngine.adopt_transfer": ("GenerationPool.adopt_spill",
+                                     "ServingEngine._resubmit_record"),
+    "DisaggregatedServing._bridge": ("ServingEngine.adopt_transfer",),
     # fault plane: the hot path's module-level no-op check fans into the
     # installed plane, so the plane's own fire() is hot-path-audited
     "_fire": ("fire",),
