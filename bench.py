@@ -1971,6 +1971,245 @@ def bench_serving_disagg(pt, jax, on_tpu: bool):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_serving_fleet(pt, jax, on_tpu: bool):
+    """L7 serving-fleet leg (docs/DESIGN.md §5o): IDENTICAL bursty
+    zipf traffic with shared-prefix groups over 1 vs 2 vs 4 engines,
+    plus a chaos sub-leg that hard-abandons one engine mid-burst.
+
+    Stamps the three claims the fleet tier makes and their provenance:
+
+    - ``scaling_efficiency``: tokens/s at 4 engines over 4x the
+      1-engine rate (and ``scaling_efficiency_2`` for the pair) — the
+      data-parallel-replica argument measured, not asserted.  On CPU
+      smoke every engine timeshares ONE core, so ~1/N is the expected
+      reading there (same caveat as the sharded leg) — the column
+      exists so the multi-host run has a stamped comparison;
+    - ``prefix_affinity_hit_rate``: the fraction of routed requests
+      the affinity hash placed (vs least-loaded fallback) on the
+      4-engine sub-leg — a fleet whose router never fires is N
+      independent caches wearing a fleet's name;
+    - ``migration_rto_s``: hard-abandon of a mid-burst engine to
+      every victim decoding again on a survivor — the fleet's
+      recovery-time objective, measured at the front;
+    - ``tokens_lost``: every sub-leg's greedy output (including the
+      chaos one, one engine dead mid-burst) vs the calm 1-engine
+      reference.  MUST be 0 — routing and migration move computation,
+      never change tokens, and the gate refuses a lossy record."""
+    import shutil
+    import tempfile
+
+    from paddle_tpu.models import TransformerLM, gpt_1p3b_config
+    from paddle_tpu.serving import ServingEngine, ServingFleet
+
+    cfg = gpt_1p3b_config()
+    if on_tpu:
+        cfg.update(num_layers=6)
+        head_len, tail_lo, tail_hi, gen = 64, 16, 96, 24
+        chunk, block, slots, n_requests = 64, 32, 4, 24
+    else:
+        _cpu_smoke_shrink(cfg, max_position=1024)
+        head_len, tail_lo, tail_hi, gen = 24, 4, 16, 6
+        chunk, block, slots, n_requests = 16, 8, 2, 8
+    max_len = head_len + tail_hi + gen
+    pt.seed(0)
+    model = TransformerLM(**cfg, dropout=0.0)
+    rng = np.random.RandomState(0)
+    # bursty zipf over PREFIX GROUPS: a few shared heads (system
+    # prompts) dominate by the same 1/rank^a draw the prefix leg uses,
+    # each request appending its own random tail — the traffic shape
+    # affinity routing exists for
+    zipf_a = 1.1
+    n_groups = 4
+    heads = [rng.randint(0, cfg["vocab_size"], (head_len,))
+             .astype("int32") for _ in range(n_groups)]
+    probs = 1.0 / np.arange(1, n_groups + 1) ** zipf_a
+    probs /= probs.sum()
+    groups = rng.choice(n_groups, size=n_requests, p=probs)
+    prompts = [np.concatenate([
+        heads[g], rng.randint(0, cfg["vocab_size"],
+                              (int(rng.randint(tail_lo, tail_hi)),))
+        .astype("int32")]) for g in groups]
+    workdir = tempfile.mkdtemp(prefix="bench-fleet-")
+
+    def make_fleet(engines, tag):
+        # each fleet gets its own spill dir: sub-legs reuse request
+        # ids, and a stale transfer file from a previous fleet must
+        # never be adoptable by the next one
+        spill = os.path.join(workdir, "spill-%s" % tag)
+
+        def factory(engine_id, registry):
+            return ServingEngine(
+                model, max_len=max_len, slots=slots,
+                max_queue=2 * n_requests, cache_layout="paged",
+                block_size=block, prefill_chunk_tokens=chunk,
+                prefix_sharing=True, temperature=0.0,
+                spill_tier="disk", spill_dir=spill,
+                metrics=registry)
+
+        return ServingFleet(factory, engines=engines)
+
+    def warm(fleet):
+        # warm every engine's executables OUTSIDE the timed region by
+        # submitting directly to each (the router would happily pile
+        # warm traffic on one engine and leave another to compile
+        # inside the measurement)
+        for eng in fleet.engines().values():
+            eng.submit(rng.randint(0, cfg["vocab_size"],
+                                   (head_len + tail_hi,))
+                       .astype("int32"), 2)
+        while any(e.live_requests or e.queue_depth
+                  for e in fleet.engines().values()):
+            fleet.pump(1)
+
+    def measure(fleet):
+        warm(fleet)
+        itl = fleet.metrics.histogram("serving_inter_token_seconds")
+        itl.reset()
+        fleet.metrics.histogram("serving_ttft_seconds").reset()
+        routed0 = {k: c.value for k, c in fleet._routed.items()}
+        t0 = time.perf_counter()
+        streams = []
+        for i, p in enumerate(prompts):
+            # bursty-but-ordered arrivals: a tick between submits
+            # lets a later request find an earlier one's shared head
+            # RESIDENT — the condition affinity routing exists for
+            # (greedy output is arrival-order independent, so the
+            # byte-identity reference is unaffected)
+            streams.append(fleet.submit(p, gen, request_id="r%d" % i))
+            fleet.pump(1)
+        while fleet.pump(4):
+            pass
+        wall = time.perf_counter() - t0
+        routed = {k: c.value - routed0[k]
+                  for k, c in fleet._routed.items()}
+        return [s.result(timeout_s=0) for s in streams], wall, \
+            itl, routed
+
+    def lost_vs(want, statuses):
+        lost = 0
+        for st in statuses:
+            ref, got = want[st.request_id], np.asarray(st.tokens)
+            lost += max(0, len(ref) - len(got)) + int(
+                (got[:len(ref)] != ref[:len(got)]).sum())
+        return lost
+
+    def leg(statuses, wall, itl, routed, stats):
+        ttfts = [st.ttft_s for st in statuses]
+        total = max(1.0, routed["affinity"] + routed["load"])
+        return {
+            "cache_layout": stats["cache_layout"],
+            "cache_dtype": stats["cache_dtype"],
+            "requests": len(statuses),
+            "ttft_p50_s": round(float(np.percentile(ttfts, 50)), 5),
+            "ttft_p95_s": round(float(np.percentile(ttfts, 95)), 5),
+            "itl_p95_s": _histogram_quantile(itl, 0.95),
+            "tokens_per_sec": round(
+                sum(st.new_tokens for st in statuses) / wall, 1),
+            "wall_s": round(wall, 4),
+            "routed_affinity": int(routed["affinity"]),
+            "routed_load": int(routed["load"]),
+            "prefix_affinity_hit_rate": round(
+                routed["affinity"] / total, 3),
+        }
+
+    try:
+        subs = {}
+        want = None
+        tokens_lost = 0
+        for n_engines in (1, 2, 4):
+            fleet = make_fleet(n_engines, "n%d" % n_engines)
+            statuses, wall, itl, routed = measure(fleet)
+            sub = leg(statuses, wall, itl, routed,
+                      fleet.engines()["e0"].cache_stats())
+            if want is None:
+                # the calm 1-engine run is the byte-identity reference
+                # for every other sub-leg, chaos included
+                want = {st.request_id: np.asarray(st.tokens)
+                        for st in statuses}
+            else:
+                sub["tokens_lost"] = lost_vs(want, statuses)
+                tokens_lost += sub["tokens_lost"]
+                sub["scaling_efficiency"] = round(
+                    sub["tokens_per_sec"]
+                    / (n_engines * subs["engines_1"]["tokens_per_sec"]),
+                    3)
+            subs["engines_%d" % n_engines] = sub
+            fleet.shutdown(drain=False)
+
+        # chaos sub-leg: same traffic over 2 engines, one hard-
+        # abandoned mid-burst; the RTO clock runs from the abandon
+        # call until EVERY migrated victim has produced a fresh token
+        # on (or finished on) a survivor
+        fleet = make_fleet(2, "chaos")
+        warm(fleet)
+        t0 = time.perf_counter()
+        streams = [fleet.submit(p, gen, request_id="r%d" % i)
+                   for i, p in enumerate(prompts)]
+        fleet.pump(2)
+        victim_eid = next(iter(
+            r.engine_id for r in fleet._records.values()))
+        pre = {r.rid: len(r.tokens)
+               for r in fleet._records.values()
+               if r.engine_id == victim_eid}
+        t_kill = time.perf_counter()
+        migrated = fleet.hard_abandon(victim_eid, error="bench-chaos")
+        while any(rid in fleet._records
+                  and len(fleet._records[rid].tokens) <= pre[rid]
+                  for rid in migrated):
+            fleet.pump(1)
+        rto = time.perf_counter() - t_kill
+        while fleet.pump(4):
+            pass
+        wall = time.perf_counter() - t0
+        statuses = [s.result(timeout_s=0) for s in streams]
+        chaos_lost = lost_vs(want, statuses)
+        tokens_lost += chaos_lost
+        stats = fleet.engines()["e1" if victim_eid == "e0"
+                                else "e0"].cache_stats()
+        subs["chaos"] = {
+            "cache_layout": stats["cache_layout"],
+            "cache_dtype": stats["cache_dtype"],
+            "requests": len(statuses),
+            "tokens_per_sec": round(
+                sum(st.new_tokens for st in statuses) / wall, 1),
+            "wall_s": round(wall, 4),
+            "engine_killed": victim_eid,
+            "requests_migrated": len(migrated),
+            "migration_rto_s": round(rto, 5),
+            "tokens_lost": chaos_lost,
+            "byte_identical": chaos_lost == 0,
+        }
+        fleet.shutdown(drain=False)
+
+        return dict(subs, **{
+            "head_len": head_len,
+            "generated": gen,
+            "slots_per_engine": slots,
+            "block_size": block,
+            "prefill_chunk_tokens": chunk,
+            "zipf_a": zipf_a,
+            "prefix_groups": n_groups,
+            "input_staged": False,
+            "transfer_note": (
+                "prompt upload rides inside the (chunked) prefill "
+                "term identically on every sub-leg; the fleet adds no "
+                "device transfer of its own (routing and migration "
+                "bookkeeping are host-side), and the migrated K/V "
+                "file cost is inside migration_rto_s"),
+            "scaling_efficiency": subs["engines_4"][
+                "scaling_efficiency"],
+            "scaling_efficiency_2": subs["engines_2"][
+                "scaling_efficiency"],
+            "prefix_affinity_hit_rate": subs["engines_4"][
+                "prefix_affinity_hit_rate"],
+            "migration_rto_s": subs["chaos"]["migration_rto_s"],
+            "requests_migrated": subs["chaos"]["requests_migrated"],
+            "tokens_lost": tokens_lost,
+        })
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _probe_accelerator(timeout_s: int = 180) -> bool:
     """Check from a THROWAWAY subprocess that the accelerator runtime
     answers; a wedged tunnel (the axon transport can hang for hours) must
@@ -2106,6 +2345,7 @@ def _leg_promotable(name: str, leg: dict):
                         "serving_overload": "ttft_p99_high_s",
                         "serving_sharded": "tokens_per_sec",
                         "serving_disagg": "ttft_p95_s",
+                        "serving_fleet": "tokens_per_sec",
                         "speculative": "tokens_per_sec"}
     if name in cache_stamp_keys:
         # a decode/serving/speculative number without its cache-layout
@@ -2284,6 +2524,46 @@ def _leg_promotable(name: str, leg: dict):
                                "hand-offs: without a transfer the "
                                "pair measured two idle engines, not "
                                "disaggregation")
+        if name == "serving_fleet":
+            # the fleet's headline IS the multi-engine comparison: a
+            # multi-engine sub-leg without its measured-vs-ideal
+            # scaling stamp compared nothing; a chaos sub-leg without
+            # its migration RTO (or with token loss) measured a fleet
+            # that cannot survive the one event the tier exists to
+            # survive; and ANY lost token breaks the routing/migration
+            # byte-identity contract
+            unscaled = sorted(
+                k for k, v in timed.items()
+                if k.startswith("engines_") and k != "engines_1"
+                and not isinstance(v.get("scaling_efficiency"),
+                                   (int, float)))
+            if unscaled:
+                return False, ("serving_fleet leg missing "
+                               "scaling_efficiency on %s: a "
+                               "multi-engine number must carry its "
+                               "measured-vs-ideal scaling" % (unscaled,))
+            chaos = leg.get("chaos")
+            if not isinstance(chaos, dict) \
+                    or not isinstance(chaos.get("migration_rto_s"),
+                                      (int, float)):
+                return False, ("serving_fleet leg missing the chaos "
+                               "sub-leg's migration_rto_s stamp: a "
+                               "fleet record must measure the "
+                               "engine-death recovery it exists for")
+            if not chaos.get("requests_migrated"):
+                return False, ("serving_fleet chaos sub-leg migrated "
+                               "no requests: killing an idle engine "
+                               "measured nothing")
+            if leg.get("tokens_lost", 1) != 0:
+                return False, ("serving_fleet leg lost tokens vs the "
+                               "1-engine reference: routing and "
+                               "migration move computation between "
+                               "engines, never change greedy tokens")
+            if leg.get("prefix_affinity_hit_rate") is None:
+                return False, ("serving_fleet leg missing "
+                               "prefix_affinity_hit_rate: cannot tell "
+                               "an affinity-routed fleet from N "
+                               "independent caches")
         if name == "serving":
             # the §5g tracing contract is that the flight recorder is
             # effectively free on the tick path; a serving number whose
@@ -2464,6 +2744,7 @@ def _measure_and_print():
                      ("serving_overload", bench_serving_overload),
                      ("serving_sharded", bench_serving_sharded),
                      ("serving_disagg", bench_serving_disagg),
+                     ("serving_fleet", bench_serving_fleet),
                      ("speculative", bench_speculative)):
         try:
             legs[name] = fn(pt, jax, on_tpu)

@@ -1655,6 +1655,41 @@ class GenerationPool:
         self._used_rids.add(request_id)
         return True
 
+    def detach_spilled(self, request_id) -> dict:
+        """Release a disk-parked victim from this pool KEEPING its
+        transfer file — the live-migration donor primitive.  Where
+        ``cancel()`` on a preempted request deletes the spill file with
+        the record (the request is dead), detach forgets the request but
+        leaves the ``.npz`` on disk for a peer engine sharing the spill
+        directory to ``adopt_spill`` under the same rid: still-resident
+        device copies return to the free list (the host file is the
+        only restorable source from here on), the rid leaves
+        ``_used_rids`` so this pool could even re-admit it later.
+        Disk tier only: a host-RAM-parked victim has no file to hand
+        over (``PreconditionNotMetError`` — the caller falls back to
+        prompt+committed resubmit, byte-identical either way)."""
+        sp = self._spilled.get(request_id)
+        if sp is None:
+            raise NotFoundError(
+                "request_id %r is not parked in the spill tier"
+                % (request_id,))
+        if sp.host_path is None:
+            raise PreconditionNotMetError(
+                "request %r is parked on the host tier (no transfer "
+                "file) — only disk-tier victims detach for migration"
+                % (request_id,))
+        del self._spilled[request_id]
+        self._prefix_epoch += 1
+        for b in sp.dev_blocks:
+            if b is not None:
+                self._spill_owner.pop(b, None)
+                self._free_by_shard[self._shard_of_block(b)].append(b)
+        self._used_rids.discard(request_id)
+        path, sp.host_path = sp.host_path, None
+        return {"rid": request_id, "path": path,
+                "committed_tokens": len(sp.tokens),
+                "spill_bytes": sp.host_bytes}
+
     @property
     def prefill_done_count(self) -> int:
         """Prefill-complete requests parked awaiting export (always 0
@@ -1790,6 +1825,29 @@ class GenerationPool:
             "prefill_chunks_total": self._chunks_total,
             "prefill_chunk_tokens_total": self._chunk_tokens_total,
         }
+
+    def prefix_digest(self, since_epoch: Optional[int] = None
+                      ) -> Optional[dict]:
+        """Cheap resident-prefix digest for affinity routing: the
+        chain-hash keys currently in the prefix index, stamped with
+        ``_prefix_epoch`` so a router can cache the key set and refresh
+        only when the allocator/index actually changed.  Pass the
+        epoch of the cached digest as ``since_epoch``: an unchanged
+        index returns the epoch WITHOUT the key set (nothing to
+        recopy); a changed one (or ``since_epoch=None``) includes
+        ``"keys"``.  The keys are the same chained hashes
+        ``_match_prefix`` walks, so a router replaying the chain over a
+        prompt's head blocks predicts exactly which engine would hit.
+        ``None`` when prefix sharing is off (dense layout) — the router
+        then has no affinity signal and falls back to load placement."""
+        if not self.prefix_sharing:
+            return None
+        d = {"epoch": self._prefix_epoch,
+             "block_size": self._block_size,
+             "indexed_blocks": len(self._prefix_index)}
+        if since_epoch is None or since_epoch != self._prefix_epoch:
+            d["keys"] = frozenset(self._prefix_index)
+        return d
 
     def _on_activated(self, slot: int, rid, ids) -> None:
         """Subclass hook: a slot just became ACTIVE with its first

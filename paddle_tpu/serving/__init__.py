@@ -111,6 +111,26 @@ on the front's registry.  Stale-version files are deleted (resubmit
 fallback covers them), alien-fingerprint and pre-upgrade unversioned
 files are left alone and logged — never adopted, never crash.
 
+The serving fleet (docs/DESIGN.md §5o): ``ServingFleet`` fronts N
+fused engines with the single-engine API — prefix-affinity routing
+(the router replays the pool's chain-hash prefix walk against each
+engine's epoch-cached ``resident_prefix_digest()`` so shared-prefix
+traffic lands where its blocks already live, falling back to
+least-loaded placement scored from ``health()`` backpressure), LIVE
+request migration (``retire_engine`` preempts victims to their disk
+transfer files, ``GenerationPool.detach_spilled`` releases the file
+for ``adopt_migration`` on a peer — zero re-prefill, zero new
+compiles, prompt+committed resubmit as the always-correct fallback;
+engine DEATH replays from the fleet's own forwarded-token record), and
+SLO-driven autoscaling (a fleet-level tracker + the §5j dwell/clear
+discipline spawning on sustained multiwindow burn and retiring on
+sustained clear).  ``FleetSupervisor`` fans per-engine watchdogs in
+and escalates unkillable wedges to ``hard_abandon``; the aggregated
+``render_prometheus()`` namespaces per-engine series under an
+``engine`` label (never double-counting N registries into one scrape)
+and adds ``fleet_migrations_total`` /
+``fleet_requests_routed_total{reason=affinity|load}``.
+
 Reference parity: the framework-level analog of the reference's
 ``paddle/fluid/inference/`` serving layer (SURVEY §1), rebuilt
 TPU-native over the compiled decode step instead of an executor —
@@ -123,6 +143,7 @@ from .disagg import DisaggregatedServing
 from .engine import (PRIORITY_CLASSES, AdmissionTightenedError,
                      DeadlineUnattainableError, QueueFullError,
                      ServingEngine)
+from .fleet import ServingFleet
 from .journal import (FingerprintMismatchError, JournalCorruptError,
                       JournalWriteError, JournalWriter)
 from .http import ServingHTTPFrontend, parse_generate_request
@@ -131,7 +152,7 @@ from .metrics import (DEFAULT_TIME_BUCKETS, Counter, Gauge, Histogram,
                       MetricsRegistry)
 from .slo import Objective, SLOTracker
 from .stream import RequestState, ResponseStream, StreamStatus
-from .supervisor import EngineHealth, Supervisor
+from .supervisor import EngineHealth, FleetSupervisor, Supervisor
 from .trace import FlightRecorder, TraceEvent, Tracer
 from .transfer import (TransferFingerprintError, TransferFormatError,
                        TransferReader, TransferVersionError,
@@ -144,7 +165,7 @@ __all__ = [
     "MetricsRegistry", "Counter", "Gauge", "Histogram",
     "DEFAULT_TIME_BUCKETS",
     "ServingHTTPFrontend", "parse_generate_request",
-    "faults", "Supervisor", "EngineHealth",
+    "faults", "Supervisor", "EngineHealth", "FleetSupervisor",
     "trace", "Tracer", "FlightRecorder", "TraceEvent",
     "slo", "Objective", "SLOTracker",
     "log", "JsonLinesLogger",
@@ -154,4 +175,5 @@ __all__ = [
     "TransferFormatError", "TransferVersionError",
     "TransferFingerprintError",
     "DisaggregatedServing",
+    "ServingFleet",
 ]

@@ -1009,19 +1009,49 @@ class ServingEngine:
         bool}``.  No queue-depth gate: admission control ran at the
         prefill tier's door, and refusing a mid-flight hand-off here
         would drop a request both tiers already invested in."""
+        if self.role != "decode":
+            raise PreconditionNotMetError(
+                "adopt_transfer is the decode tier's admission "
+                "path (this engine's role is %r)" % (self.role,))
+        return self._adopt_live(request_id, input_ids, tokens,
+                                max_new_tokens, priority, tenant,
+                                deadline_abs)
+
+    def adopt_migration(self, request_id, input_ids, tokens,
+                        max_new_tokens: int, priority=0, tenant=None,
+                        deadline_abs=None) -> dict:
+        """Fleet live-migration admission (docs/DESIGN.md §5o): the
+        same adoption mechanics as :meth:`adopt_transfer` — transfer
+        file as the K/V fast path, prompt+committed resubmit as the
+        byte-identical fallback — but for FUSED engines behind a
+        :class:`~paddle_tpu.serving.fleet.ServingFleet`, which migrate
+        live requests among peers rather than across tier roles.  A
+        prefill-role engine cannot adopt (it has no decode executable
+        to finish the request with)."""
+        if self.role == "prefill":
+            raise PreconditionNotMetError(
+                "a prefill-role engine cannot adopt a migrated "
+                "request: it has no decode step to finish it with")
+        return self._adopt_live(request_id, input_ids, tokens,
+                                max_new_tokens, priority, tenant,
+                                deadline_abs)
+
+    def _adopt_live(self, request_id, input_ids, tokens,
+                    max_new_tokens: int, priority=0, tenant=None,
+                    deadline_abs=None) -> dict:
+        """Shared adoption body behind :meth:`adopt_transfer` (tier
+        hand-off) and :meth:`adopt_migration` (fleet migration): the
+        role gates differ, the mechanics — journal WAL, ``adopt_spill``
+        fast path, resubmit fallback — must not."""
         with self._lock:
-            if self.role != "decode":
-                raise PreconditionNotMetError(
-                    "adopt_transfer is the decode tier's admission "
-                    "path (this engine's role is %r)" % (self.role,))
             if self._draining:
                 raise PreconditionNotMetError(
                     "engine is draining/shut down: hand-offs are "
                     "stopped")
             if request_id in self._live:
                 raise DuplicateRequestError(
-                    "request_id %r is already live on this decode "
-                    "tier" % (request_id,))
+                    "request_id %r is already live on this engine"
+                    % (request_id,))
             priority = _normalize_priority(priority)
             ids = np.asarray(getattr(input_ids, "value",
                                      input_ids)).astype(np.int32)
@@ -1082,6 +1112,73 @@ class ServingEngine:
                       prompt_tokens=int(ids.shape[0]))
         self._wake.set()
         return {"stream": stream, "adopted_from_file": bool(adopted)}
+
+    def migrate_out(self, request_id) -> dict:
+        """Surrender one live request for adoption by a peer engine —
+        the donor half of fleet live migration (docs/DESIGN.md §5o).
+
+        A DECODING victim on the disk spill tier is preempted first
+        (its written K/V lands in a transfer file under the shared
+        spill naming) and then DETACHED — the file survives, the pool
+        forgets the request — so the adopting peer resumes it through
+        ``adopt_spill`` with zero re-prefill.  Anything else (queued,
+        mid-prefill, host-tier parked, preempt-refused) is simply
+        cancelled pool-side: the returned prompt+committed entry is the
+        journal-grade ground truth and the peer's resubmit path
+        regenerates byte-identically under greedy decoding.
+
+        The engine finalizes its side ``HANDED_OFF``/"migrated" (the
+        journal stops tracking the rid, the local stream terminates
+        with the tier-terminal the fleet front never surfaces) and
+        returns the migration entry: ``{"rid", "prompt", "tokens",
+        "max_new", "priority", "tenant", "deadline_abs", "retries",
+        "spill_path"}`` — everything ``adopt_migration`` needs."""
+        with self._lock:
+            rec = self._live.get(request_id)
+            if rec is None:
+                raise NotFoundError(
+                    "request_id %r is not live on this engine"
+                    % (request_id,))
+            pool = self._pool
+            spill_path = None
+            if rec.state == RequestState.DECODING \
+                    and pool.spill_tier == "disk" \
+                    and pool.can_preempt(rec.rid):
+                try:
+                    self._do_preempt(rec, "migrate")
+                except Exception:  # noqa: BLE001 - degrade to resubmit
+                    pass
+            if rec.state == RequestState.PREEMPTED:
+                try:
+                    spill_path = pool.detach_spilled(rec.rid)["path"]
+                except (NotFoundError, PreconditionNotMetError):
+                    # host-tier parked (no file to hand over) or raced
+                    # away: the prompt+committed entry still carries
+                    # the full resume state
+                    pool.cancel(rec.rid)
+            else:
+                pool.cancel(rec.rid)
+            self._live.pop(request_id, None)
+            entry = {"rid": rec.rid,
+                     "prompt": rec.prompt,
+                     "tokens": list(rec.tokens),
+                     "max_new": rec.max_new,
+                     "priority": rec.priority,
+                     "tenant": rec.tenant,
+                     "deadline_abs": rec.deadline_abs,
+                     "retries": rec.retries,
+                     "spill_path": spill_path}
+            trace.instant("sched.migrate_out", rid=rec.rid,
+                          spilled=spill_path is not None,
+                          committed_tokens=len(rec.tokens))
+            slog.emit("sched.migrate_out", rid=rec.rid,
+                      spilled=spill_path is not None,
+                      committed_tokens=len(rec.tokens),
+                      remaining=rec.max_new - len(rec.tokens))
+            self._finalize(rec, RequestState.HANDED_OFF, "migrated",
+                           rec.tokens)
+            self._journal_flush()
+            return entry
 
     # -- preemption + the degradation ladder (docs §5j) ------------------
     def preempt(self, request_id=None, reason: str = "manual"):
@@ -2438,6 +2535,16 @@ class ServingEngine:
         blocks, live shared blocks, chunk totals — what the
         ``serving_prefix_*`` gauges and the bench leg stamp."""
         return self._pool.prefix_stats()
+
+    def resident_prefix_digest(self, since_epoch=None):
+        """Chain-hash digest of the K/V blocks resident in this
+        engine's prefix index (``GenerationPool.prefix_digest``) — the
+        affinity signal the fleet router hashes prompt heads against.
+        Epoch-cached: pass the previous digest's ``epoch`` and an
+        unchanged index returns without the key set.  None when prefix
+        sharing is off."""
+        with self._lock:
+            return self._pool.prefix_digest(since_epoch)
 
     def reset_prefix_stats(self) -> None:
         """Zero the pool's cumulative prefix/chunk counters — bench

@@ -240,7 +240,12 @@ def _make_handler(engine: ServingEngine, quiet: bool = True):
                                       "GET /debug/flightrec"
                                       % self.path})
                 return
-            body = engine.metrics.render_prometheus().encode()
+            # a fleet front renders its own aggregated exposition
+            # (per-engine series under an `engine` label — §5o); a
+            # single engine's registry renders itself
+            render = getattr(engine, "render_prometheus", None) \
+                or engine.metrics.render_prometheus
+            body = render().encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")

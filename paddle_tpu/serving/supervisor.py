@@ -30,12 +30,12 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 from ..core.errors import InvalidArgumentError
 from . import trace
 
-__all__ = ["EngineHealth", "Supervisor"]
+__all__ = ["EngineHealth", "Supervisor", "FleetSupervisor"]
 
 
 class EngineHealth:
@@ -222,6 +222,113 @@ class Supervisor:
                 self._stop.clear()
                 self._thread = threading.Thread(
                     target=self._run, name="serving-engine-supervisor",
+                    daemon=True)
+                self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.check_once()
+            self._stop.wait(self.poll_interval_s)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop.set()
+            if self._thread is not None:
+                self._thread.join(timeout=10.0)
+                self._thread = None
+
+    def is_running(self) -> bool:
+        return self._thread is not None
+
+
+class FleetSupervisor:
+    """Per-engine supervision fanned in at fleet scope (docs §5o).
+
+    One :class:`Supervisor` per live engine — created as the fleet
+    spawns engines, dropped as they retire or die — plus the one
+    escalation a single-engine watchdog cannot make: an engine whose
+    tick has been wedged past ``escalate_timeout_s`` (a python thread
+    cannot be killed, so the single-engine policy stops at honest
+    visibility) is declared dead TO THE FLEET via
+    ``fleet.hard_abandon``, which migrates its live requests onto
+    survivors.  Detection is the same lock-free health-record read the
+    per-engine watchdog uses; each sub-supervisor keeps its engine's
+    own clock domain, so injected test clocks supervise
+    deterministically.
+
+    ``check_once()`` is again the whole policy: one sweep over every
+    active/draining engine, returning ``{engine_id: [actions...]}``
+    (the per-engine actions plus ``"engine-abandoned"`` on
+    escalation).  ``start()`` runs it from an owned daemon thread for
+    real serving — out-of-band on purpose, since a wedged engine tick
+    wedges the fleet's own pump loop with it."""
+
+    def __init__(self, fleet, stall_timeout_s: float = 5.0,
+                 escalate_timeout_s: Optional[float] = None,
+                 poll_interval_s: Optional[float] = None):
+        if not float(stall_timeout_s) > 0.0:
+            raise InvalidArgumentError(
+                "stall_timeout_s must be > 0, got %r"
+                % (stall_timeout_s,))
+        self.fleet = fleet
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.escalate_timeout_s = (4.0 * self.stall_timeout_s
+                                   if escalate_timeout_s is None
+                                   else float(escalate_timeout_s))
+        if self.escalate_timeout_s < self.stall_timeout_s:
+            raise InvalidArgumentError(
+                "escalate_timeout_s (%r) must be >= stall_timeout_s "
+                "(%r): abandonment is the step AFTER stall detection"
+                % (self.escalate_timeout_s, self.stall_timeout_s))
+        self.poll_interval_s = (max(0.005, self.stall_timeout_s / 4.0)
+                                if poll_interval_s is None
+                                else float(poll_interval_s))
+        self._subs: Dict[object, Supervisor] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def check_once(self) -> Dict[object, List[str]]:
+        """One fan-in sweep: sync the sub-supervisor set with the
+        fleet's live engines, run each engine's own sweep, escalate
+        wedges that outlived ``escalate_timeout_s``."""
+        out: Dict[object, List[str]] = {}
+        states = self.fleet.engine_states()
+        engines = self.fleet.engines()
+        for eid in list(self._subs):
+            if states.get(eid) not in ("active", "draining"):
+                del self._subs[eid]
+        for eid, eng in engines.items():
+            if states.get(eid) not in ("active", "draining"):
+                continue
+            sup = self._subs.get(eid)
+            if sup is None:
+                sup = self._subs[eid] = Supervisor(
+                    eng, stall_timeout_s=self.stall_timeout_s)
+            actions = sup.check_once()
+            h = eng._health
+            now = sup._clock()
+            if h.stall_open and h.tick_busy() \
+                    and now - h.tick_started_at \
+                    >= self.escalate_timeout_s:
+                wedged_s = now - h.tick_started_at
+                self.fleet.hard_abandon(
+                    eid, error="tick wedged %.3fs — supervisor "
+                               "escalation" % wedged_s)
+                actions = list(actions) + ["engine-abandoned"]
+                del self._subs[eid]
+            if actions:
+                out[eid] = actions
+        return out
+
+    # -- owned watchdog thread (same shape as Supervisor) -----------------
+    def start(self) -> "FleetSupervisor":
+        with self._lock:
+            if self._thread is None:
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="serving-fleet-supervisor",
                     daemon=True)
                 self._thread.start()
         return self

@@ -651,3 +651,94 @@ def test_live_serving_disagg_leg_passes_its_own_gate():
     assert leg["disagg"]["handoffs_degraded"] == 0
     assert isinstance(leg["ttft_p95_improvement_pct"], float)
     assert isinstance(leg["itl_p95_improvement_pct"], float)
+
+
+def test_serving_fleet_gate_structural_cases():
+    """The §5o fleet leg: a multi-engine sub-leg without its scaling
+    stamp, a chaos sub-leg without its migration RTO (or that migrated
+    nothing), any lost token, or a missing affinity hit rate is
+    structurally unpromotable — and the cache-provenance stamps apply
+    to every timed sub-leg."""
+    def leg(**over):
+        def sub(**s):
+            d = {"cache_layout": "paged", "cache_dtype": "float32",
+                 "tokens_per_sec": 500.0, "ttft_p95_s": 0.02}
+            d.update(s)
+            return d
+
+        out = {"input_staged": False,
+               "transfer_note": "identical traffic on every sub-leg",
+               "engines_1": sub(),
+               "engines_2": sub(scaling_efficiency=0.5, tokens_lost=0),
+               "engines_4": sub(scaling_efficiency=0.3, tokens_lost=0),
+               "chaos": sub(migration_rto_s=0.05, requests_migrated=3,
+                            tokens_lost=0),
+               "prefix_affinity_hit_rate": 0.6,
+               "migration_rto_s": 0.05,
+               "scaling_efficiency": 0.3,
+               "tokens_lost": 0}
+        out.update(over)
+        return out
+
+    ok, why = bench._leg_promotable("serving_fleet", leg())
+    assert ok, why
+    # a multi-engine sub-leg without measured-vs-ideal scaling
+    # compared nothing (engines_1 is exempt: its scaling is the
+    # definition of 1.0)
+    bad = leg()
+    del bad["engines_4"]["scaling_efficiency"]
+    ok, why = bench._leg_promotable("serving_fleet", bad)
+    assert not ok and "scaling_efficiency" in why
+    # a chaos sub-leg without its RTO measured a fleet that cannot
+    # survive the event the tier exists for
+    bad = leg()
+    del bad["chaos"]["migration_rto_s"]
+    ok, why = bench._leg_promotable("serving_fleet", bad)
+    assert not ok and "migration_rto_s" in why
+    # ...and one that migrated nothing killed an idle engine
+    bad = leg()
+    bad["chaos"]["requests_migrated"] = 0
+    ok, why = bench._leg_promotable("serving_fleet", bad)
+    assert not ok and "migrated no requests" in why
+    # any lost token breaks the routing/migration byte-identity
+    # contract; an UNSTAMPED tokens_lost defaults to lossy
+    ok, why = bench._leg_promotable("serving_fleet",
+                                    leg(tokens_lost=1))
+    assert not ok and "lost tokens" in why
+    bad = leg()
+    del bad["tokens_lost"]
+    ok, why = bench._leg_promotable("serving_fleet", bad)
+    assert not ok and "lost tokens" in why
+    # a fleet that cannot show its router fired is N independent
+    # caches wearing a fleet's name
+    ok, why = bench._leg_promotable("serving_fleet",
+                                    leg(prefix_affinity_hit_rate=None))
+    assert not ok and "prefix_affinity_hit_rate" in why
+    # cache provenance applies to every timed sub-leg, chaos included
+    bad = leg()
+    del bad["chaos"]["cache_dtype"]
+    ok, why = bench._leg_promotable("serving_fleet", bad)
+    assert not ok and "cache_layout/cache_dtype" in why
+
+
+@pytest.mark.slow
+def test_live_serving_fleet_leg_passes_its_own_gate():
+    """The leg bench.py actually emits must satisfy its own gate AND
+    the §5o acceptance contract: zero tokens lost across every
+    sub-leg (chaos included — one engine hard-abandoned mid-burst),
+    the scaling and RTO columns stamped, and the affinity router
+    actually firing on the shared-prefix mix — slow-marked (it runs
+    the zipf burst through four fleet sizes plus the chaos fleet)."""
+    import jax
+
+    import paddle_tpu as pt
+
+    leg = bench.bench_serving_fleet(pt, jax, False)
+    ok, why = bench._leg_promotable("serving_fleet", leg)
+    assert ok, why
+    assert leg["tokens_lost"] == 0
+    assert leg["chaos"]["byte_identical"] is True
+    assert leg["chaos"]["requests_migrated"] >= 1
+    assert isinstance(leg["migration_rto_s"], float)
+    assert isinstance(leg["scaling_efficiency"], float)
+    assert leg["prefix_affinity_hit_rate"] > 0

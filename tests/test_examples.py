@@ -31,6 +31,7 @@ SCRIPTS = [
     ("16_sharded_serving.py", ["--tokens", "8"]),
     ("17_durable_serving.py", ["--tokens", "8"]),
     ("18_disagg_serving.py", ["--tokens", "8"]),
+    ("19_fleet_serving.py", ["--tokens", "8"]),
 ]
 
 

@@ -1,0 +1,470 @@
+"""Multi-engine serving fleet (docs/DESIGN.md §5o): prefix-affinity
+routing, live request migration, SLO-driven autoscaling.
+
+The contracts pinned here:
+
+1. a 2-engine fleet produces BYTE-IDENTICAL greedy output to one
+   engine on the same traffic — routing changes WHERE a token is
+   computed, never WHAT it is;
+2. concurrent shared-prefix traffic affinity-routes to the engine
+   whose blocks are resident (the router's chain-key walk replays the
+   pool's ``_match_prefix`` hashes) and actually HITS that engine's
+   prefix cache; cold traffic falls back to least-loaded placement;
+3. graceful ``retire_engine`` migrates every live request to a peer —
+   the disk transfer file is detached and adopted (zero re-prefill)
+   with prompt+committed resubmit as fallback — and the caller's
+   stream never notices;
+4. CHAOS: hard-abandoning one engine mid-burst (the in-process
+   SIGKILL stand-in) migrates its live requests onto survivors and
+   the whole burst finishes byte-identical to a calm single-engine
+   run, over 5 seeds, with counters reconciling exactly and no new
+   compiles on the survivor;
+5. the autoscaler obeys the §5j dwell/clear discipline: spawn only
+   after ``scale_dwell_ticks`` since the last change under a
+   sustained alert, retire only after ``scale_clear_ticks``
+   consecutive clean ticks under the utilization floor, never
+   outside [min_engines, max_engines];
+6. aggregated exposition never double-counts N registries: one TYPE
+   header per metric name, every per-engine series carries an
+   ``engine`` label, routed counters carry ``reason`` labels, and the
+   body round-trips through a prometheus text parser;
+7. ``FleetSupervisor`` fans per-engine watchdogs in and escalates a
+   wedge that outlives the escalation timeout to ``hard_abandon`` —
+   the fleet-scope action the single-engine policy cannot take.
+"""
+import io
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import (NotFoundError,
+                                    PreconditionNotMetError)
+from paddle_tpu.models import TransformerLM
+from paddle_tpu.serving import (QueueFullError, RequestState,
+                                ServingEngine, ServingFleet)
+from paddle_tpu.serving import log as slog
+from paddle_tpu.serving.engine import DuplicateRequestError
+from paddle_tpu.serving.supervisor import FleetSupervisor
+
+
+def _tiny_model(seed=0):
+    pt.seed(seed)
+    return TransformerLM(vocab_size=128, hidden_size=32, num_layers=1,
+                         num_heads=2, intermediate_size=64,
+                         max_position=256, causal=True, dropout=0.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    return _tiny_model()
+
+
+def _factory(model, spill_dir, **over):
+    cfg = dict(max_len=64, slots=2, buckets=[64], cache_layout="paged",
+               block_size=8, prefill_chunk_tokens=16,
+               spill_tier="disk", spill_dir=spill_dir)
+    cfg.update(over)
+
+    def factory(engine_id, registry):
+        return ServingEngine(model, metrics=registry, **cfg)
+
+    return factory
+
+
+def _prompts(seed, n=6, lo=9, hi=20):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(1, 128, size=rng.randint(lo, hi))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _single_engine_reference(model, spill_dir, prompts, max_new,
+                             rids):
+    eng = _factory(model, spill_dir)(None, None)
+    streams = [eng.submit(p, max_new, request_id=r)
+               for p, r in zip(prompts, rids)]
+    while eng.pump(1):
+        pass
+    want = [list(map(int, s.status.tokens)) for s in streams]
+    eng.shutdown(drain=False)
+    return want
+
+
+class _ScriptedSLO:
+    """Deterministic tracker stand-in: alerts exactly on the scripted
+    ticks, so the dwell/clear pins need no latency choreography."""
+
+    def __init__(self, alert_ticks):
+        self.alert_ticks = set(alert_ticks)
+        self.tick = 0
+
+    def alerting_names(self):
+        return ["ttft"] if self.tick in self.alert_ticks else []
+
+    def note_tick(self):
+        self.tick += 1
+
+    def observe_latency(self, kind, v):
+        pass
+
+    def observe_terminal(self, state):
+        pass
+
+    def bind_metrics(self, registry):
+        pass
+
+    def health_summary(self):
+        return {"alerts_active": 0, "alerting": [], "ticks": self.tick}
+
+    def snapshot(self):
+        return {"ticks": self.tick}
+
+
+# -- 1. byte-identity ----------------------------------------------------
+
+def test_fleet_byte_identical_to_single_engine(model, tmp_path):
+    prompts = _prompts(0)
+    rids = ["f%d" % i for i in range(len(prompts))]
+    want = _single_engine_reference(model, str(tmp_path / "ref"),
+                                    prompts, 10, rids)
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=2)
+    streams = [fleet.submit(p, 10) for p in prompts]
+    # auto-rids are fleet-assigned and collision-free across engines
+    assert [s.request_id for s in streams] == rids
+    while fleet.pump(1):
+        pass
+    got = [list(map(int, s.status.tokens)) for s in streams]
+    assert got == want
+    assert all(s.status.state == RequestState.DONE for s in streams)
+    # both engines actually served (least-loaded spreads a burst)
+    per_engine = fleet.render_prometheus()
+    assert 'serving_requests_submitted_total{engine="e0"}' in per_engine
+    assert 'serving_requests_submitted_total{engine="e1"}' in per_engine
+    fleet.shutdown(drain=False)
+
+
+# -- 2. routing ----------------------------------------------------------
+
+def test_affinity_routes_to_resident_prefix_owner(model, tmp_path):
+    fleet = ServingFleet(
+        _factory(model, str(tmp_path / "s"), slots=4,
+                 prefix_sharing=True), engines=2)
+    rng = np.random.RandomState(1)
+    head = rng.randint(1, 128, size=24).astype(np.int32)
+    first = fleet.submit(
+        np.concatenate([head, rng.randint(1, 128, size=6)
+                        .astype(np.int32)]), 20)
+    fleet.pump(6)  # head blocks indexed; request still decoding
+    owner = fleet._records[first.request_id].engine_id
+    buf = io.StringIO()
+    with slog.logging_to(buf):
+        peers = [fleet.submit(
+            np.concatenate([head, rng.randint(1, 128, size=4)
+                            .astype(np.int32)]), 4)
+            for _ in range(3)]
+    # every shared-head peer landed on the owner, for the affinity
+    # reason, and the decision is a structured log line
+    assert all(fleet._records[p.request_id].engine_id == owner
+               for p in peers)
+    assert fleet._routed["affinity"].value == 3
+    routed = [json.loads(l) for l in buf.getvalue().splitlines()
+              if '"fleet.route"' in l]
+    assert [r["reason"] for r in routed] == ["affinity"] * 3
+    assert all(r["engine"] == owner and r["matched_blocks"] >= 3
+               for r in routed)
+    while fleet.pump(1):
+        pass
+    # the routing hint cashed out as REAL prefix-cache hits
+    stats = fleet.engines()[owner].prefix_stats()
+    assert stats["hits"] >= 3
+    fleet.shutdown(drain=False)
+
+
+def test_cold_traffic_load_balances_and_duplicates_refused(model,
+                                                           tmp_path):
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=2)
+    prompts = _prompts(3, n=4)
+    streams = [fleet.submit(p, 6, request_id="r%d" % i)
+               for i, p in enumerate(prompts)]
+    assert fleet._routed["load"].value == 4
+    assert fleet._routed["affinity"].value == 0
+    # cold burst spread over both engines, not piled on one
+    owners = {fleet._records[s.request_id].engine_id for s in streams}
+    assert owners == {"e0", "e1"}
+    with pytest.raises(DuplicateRequestError):
+        fleet.submit(prompts[0], 6, request_id="r0")
+    while fleet.pump(1):
+        pass
+    assert all(s.status.state == RequestState.DONE for s in streams)
+    fleet.shutdown(drain=False)
+    # a drained/shut fleet refuses admissions, typed
+    with pytest.raises(PreconditionNotMetError):
+        fleet.submit(prompts[0], 4)
+
+
+# -- 3. graceful migration -----------------------------------------------
+
+def test_retire_engine_migrates_live_requests_byte_identical(
+        model, tmp_path):
+    prompts = _prompts(4)
+    rids = ["g%d" % i for i in range(len(prompts))]
+    want = _single_engine_reference(model, str(tmp_path / "ref"),
+                                    prompts, 10, rids)
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=2)
+    streams = [fleet.submit(p, 10, request_id=r)
+               for p, r in zip(prompts, rids)]
+    fleet.pump(4)  # decode underway on both engines
+    victim_eid = next(r.engine_id for r in fleet._records.values())
+    n_victims = sum(1 for r in fleet._records.values()
+                    if r.engine_id == victim_eid)
+    out = fleet.retire_engine(victim_eid, reason="test-drain")
+    assert out["migrated"] == n_victims
+    # decoding victims rode their detached transfer files (zero
+    # re-prefill); any queued/prefilling one fell back to resubmit
+    assert 0 <= out["adopted_from_file"] <= n_victims
+    assert fleet.engine_states()[victim_eid] == "retired"
+    assert fleet._c_migrations.value == n_victims
+    while fleet.pump(1):
+        pass
+    got = [list(map(int, s.status.tokens)) for s in streams]
+    assert got == want  # tokens_lost == 0, byte-for-byte
+    # the retired engine is out of the active set but its history
+    # stays scrapeable (states dict still names it)
+    assert fleet.health()["active_engines"] == 1
+    with pytest.raises(PreconditionNotMetError):
+        fleet.retire_engine(victim_eid)  # only active engines retire
+    fleet.shutdown(drain=False)
+
+
+def test_retire_last_loaded_engine_refused(model, tmp_path):
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=1)
+    s = fleet.submit(_prompts(5, n=1)[0], 8)
+    fleet.pump(2)
+    with pytest.raises(PreconditionNotMetError):
+        fleet.retire_engine("e0")
+    fleet.cancel(s.request_id)
+    fleet.shutdown(drain=False)
+
+
+# -- 4. chaos: engine death mid-burst ------------------------------------
+
+@pytest.mark.parametrize("seed", range(5))
+def test_chaos_engine_death_mid_burst_byte_identical(model, tmp_path,
+                                                     seed):
+    prompts = _prompts(10 + seed)
+    rids = ["c%d" % i for i in range(len(prompts))]
+    want = _single_engine_reference(model, str(tmp_path / "ref"),
+                                    prompts, 10, rids)
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=2, min_engines=1)
+    streams = [fleet.submit(p, 10, request_id=r)
+               for p, r in zip(prompts, rids)]
+    fleet.pump(3)  # both engines mid-burst
+    victim_eid = next(r.engine_id for r in fleet._records.values())
+    survivor_eid = "e1" if victim_eid == "e0" else "e0"
+    n_victims = sum(1 for r in fleet._records.values()
+                    if r.engine_id == victim_eid)
+    assert n_victims >= 1
+    survivor_compiles = fleet.engines()[survivor_eid].compile_counts()
+    migrated = fleet.hard_abandon(victim_eid, error="chaos")
+    # every one of the dead engine's live requests was adopted
+    assert len(migrated) == n_victims
+    assert fleet.engine_states()[victim_eid] == "dead"
+    while fleet.pump(1):
+        pass
+    got = [list(map(int, s.status.tokens)) for s in streams]
+    assert got == want  # byte-identical to the calm run: 0 tokens lost
+    assert all(s.status.state == RequestState.DONE for s in streams)
+    # counters reconcile EXACTLY: one death, one migration per victim,
+    # and the health surface agrees
+    assert fleet._c_deaths.value == 1
+    assert fleet._c_migrations.value == n_victims
+    h = fleet.health()
+    assert h["healthy"] and h["engine_deaths"] == 1
+    assert h["migrations"] == n_victims
+    assert h["engines"][victim_eid] == {"healthy": False,
+                                        "state": "dead"}
+    # replay cost is decode-only on shapes the survivor already owns
+    assert fleet.engines()[survivor_eid].compile_counts() \
+        == survivor_compiles
+    fleet.shutdown(drain=False)
+
+
+def test_engine_death_with_no_survivor_fails_requests_honestly(
+        model, tmp_path):
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=1, min_engines=1)
+    # make the replacement factory blow up so death leaves NO engine
+    fleet._factory = lambda eid, reg: (_ for _ in ()).throw(
+        RuntimeError("factory down"))
+    s = fleet.submit(_prompts(6, n=1)[0], 8)
+    fleet.pump(2)
+    fleet.hard_abandon("e0", error="chaos")
+    st = s.status
+    assert st.state == RequestState.FAILED
+    assert "no healthy engine" in st.error
+    assert fleet.live_requests == 0
+    fleet.shutdown(drain=False)
+
+
+# -- 5. autoscaling ------------------------------------------------------
+
+def test_autoscale_dwell_and_clear_discipline(model, tmp_path):
+    slo = _ScriptedSLO(alert_ticks=range(0, 10))
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=1, min_engines=1, max_engines=2,
+                         slo=slo, autoscale=True, scale_dwell_ticks=3,
+                         scale_clear_ticks=5, scale_down_util=0.9)
+    history = []
+    for _ in range(25):
+        fleet.pump(1)
+        history.append(len(fleet._active_handles()))
+    # exactly one spawn (after a full dwell from birth, never tick 0)
+    # and exactly one retire (after 5 consecutive clean ticks), with
+    # the count clamped to [min, max] throughout
+    assert history[0] == 1 and max(history) == 2 and history[-1] == 1
+    assert fleet._c_scale_ups.value == 1
+    assert fleet._c_scale_downs.value == 1
+    spawn_tick = history.index(2)
+    assert spawn_tick >= 2  # dwell honored: not on the first alert
+    retire_tick = len(history) - 1 - history[::-1].index(2) + 1
+    # note_tick() rolls before the controller evaluates, so the last
+    # alerting evaluation is pump index max(alert_ticks) - 1; the
+    # retire must wait 5 consecutive clean evaluations after it
+    assert retire_tick - (max(slo.alert_ticks) - 1) >= 5
+    fleet.shutdown(drain=False)
+
+
+def test_autoscale_never_exceeds_max_engines(model, tmp_path):
+    slo = _ScriptedSLO(alert_ticks=range(0, 40))
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=1, min_engines=1, max_engines=3,
+                         slo=slo, autoscale=True, scale_dwell_ticks=2,
+                         scale_clear_ticks=4)
+    for _ in range(30):
+        fleet.pump(1)
+    assert len(fleet._active_handles()) == 3
+    assert fleet._c_scale_ups.value == 2
+    fleet.shutdown(drain=False)
+
+
+# -- 6. aggregated exposition --------------------------------------------
+
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(inf)?$")
+
+
+def test_metrics_exposition_round_trip(model, tmp_path):
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=2)
+    streams = [fleet.submit(p, 6) for p in _prompts(7, n=4)]
+    while fleet.pump(1):
+        pass
+    body = fleet.render_prometheus()
+    lines = body.splitlines()
+    # every line parses: comment, or name{labels} value
+    for line in lines:
+        assert line.startswith("#") or _PROM_LINE.match(line), line
+    # one TYPE header per metric name even though the fleet and both
+    # engines all register e.g. serving_requests_submitted_total
+    types = [l for l in lines if l.startswith("# TYPE ")]
+    assert len(types) == len({l.split()[2] for l in types})
+    # per-engine series are NAMESPACED — no unlabeled duplicate of a
+    # per-engine series can inflate an aggregate
+    sub = [l for l in lines
+           if l.startswith("serving_requests_submitted_total")]
+    unlabeled = [l for l in sub if "{" not in l]
+    assert len(unlabeled) == 1  # the fleet's own front counter
+    assert float(unlabeled[0].split()[-1]) == 4.0
+    per_engine = {l for l in sub if 'engine="' in l}
+    assert len(per_engine) == 2
+    # per-engine admissions sum to the front's count (nothing counted
+    # twice, nothing dropped)
+    assert sum(float(l.split()[-1]) for l in per_engine) == 4.0
+    # routing decisions ride reason labels
+    assert any('fleet_requests_routed_total{reason="load"}' in l
+               for l in lines)
+    assert any('fleet_requests_routed_total{reason="affinity"}' in l
+               for l in lines)
+    # per-engine histograms carry BOTH labels, fleet histograms only le
+    assert any(l.startswith("serving_ttft_seconds_bucket{engine=")
+               and 'le="' in l for l in lines)
+    assert any(l.startswith('serving_ttft_seconds_bucket{le="')
+               for l in lines)
+    assert all(s.status.state == RequestState.DONE for s in streams)
+    fleet.shutdown(drain=False)
+
+
+# -- 7. aggregated health/slo + supervision fan-in -----------------------
+
+def test_fleet_health_and_slo_aggregation(model, tmp_path):
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=2)
+    with pytest.raises(PreconditionNotMetError):
+        fleet.slo_snapshot()  # absence is a configuration fact
+    h = fleet.health()
+    assert h["healthy"] and h["state"] == "idle"
+    assert h["active_engines"] == 2 and h["live_requests"] == 0
+    assert set(h["engines"]) == {"e0", "e1"}
+    assert all(e["healthy"] for e in h["engines"].values())
+    fleet.shutdown(drain=False)
+    assert not fleet.health()["healthy"]
+
+    slo = _ScriptedSLO(alert_ticks=())
+    fleet2 = ServingFleet(_factory(model, str(tmp_path / "s2")),
+                          engines=1, slo=slo)
+    snap = fleet2.slo_snapshot()
+    assert "engines" in snap  # per-engine snapshots nested
+    fleet2.shutdown(drain=False)
+
+
+def test_fleet_supervisor_escalates_wedged_engine(model, tmp_path):
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=2, min_engines=1)
+    s = fleet.submit(_prompts(8, n=1)[0], 10)
+    fleet.pump(2)
+    owner = fleet._records[s.request_id].engine_id
+    sup = FleetSupervisor(fleet, stall_timeout_s=0.01,
+                          escalate_timeout_s=0.02)
+    assert sup.check_once() == {}  # healthy sweep: no action
+    # wedge the owner: a tick that started long ago and never finished
+    # (the lock-free heartbeat is the detection surface, same as the
+    # single-engine watchdog)
+    wedged = fleet.engines()[owner]._health
+    wedged.tick_finished_at = -1.0
+    wedged.note_tick_start(0.0)
+    actions = sup.check_once()
+    assert actions[owner][-1] == "engine-abandoned"
+    assert "stall-detected" in actions[owner]
+    assert fleet.engine_states()[owner] == "dead"
+    # the wedged engine's request moved and still finishes
+    while fleet.pump(1):
+        pass
+    assert s.status.state == RequestState.DONE
+    # a dead engine leaves the supervised set; next sweep is a no-op
+    assert sup.check_once() == {}
+    fleet.shutdown(drain=False)
+
+
+# -- cancel over the fleet ----------------------------------------------
+
+def test_cancel_frees_engine_and_front(model, tmp_path):
+    fleet = ServingFleet(_factory(model, str(tmp_path / "s")),
+                         engines=2)
+    s = fleet.submit(_prompts(9, n=1)[0], 30)
+    fleet.pump(3)
+    owner = fleet._records[s.request_id].engine_id
+    assert fleet.cancel(s.request_id) is True
+    assert s.status.state == RequestState.CANCELLED
+    assert fleet.cancel(s.request_id) is False  # idempotent
+    fleet.pump(2)
+    assert fleet.engines()[owner].live_requests == 0
+    assert fleet.live_requests == 0
+    fleet.shutdown(drain=False)

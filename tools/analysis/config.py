@@ -143,9 +143,32 @@ EXTRA_EDGES = {
     "ServingEngine._export_sweep": ("GenerationPool.export_kv",
                                     "GenerationPool.cancel",
                                     "DisaggregatedServing._on_handoff"),
-    "ServingEngine.adopt_transfer": ("GenerationPool.adopt_spill",
-                                     "ServingEngine._resubmit_record"),
+    "ServingEngine._adopt_live": ("GenerationPool.adopt_spill",),
     "DisaggregatedServing._bridge": ("ServingEngine.adopt_transfer",),
+    # serving fleet (docs §5o): the router, migration and autoscale
+    # paths all reach member engines behind ``_EngineHandle.engine``
+    # attributes (plain object slots — invisible to the AST's
+    # local-constructor inference), and the fleet supervisor reaches
+    # the fleet behind a constructor ARGUMENT.  Declaring the seams
+    # keeps the route→submit fan-out, the digest refresh the router
+    # hashes against (engine → pool behind self._pool), the
+    # drain→checkpoint→migrate_out→adopt_migration hand-off chain and
+    # the watchdog escalation hot-path-audited like the single-engine
+    # planes they compose
+    "ServingFleet.submit": ("ServingEngine.submit",),
+    "ServingFleet._refresh_digest":
+        ("ServingEngine.resident_prefix_digest",),
+    "ServingEngine.resident_prefix_digest":
+        ("GenerationPool.prefix_digest",),
+    "ServingFleet.pump": ("ServingEngine.pump",),
+    "ServingFleet.retire_engine": ("ServingEngine.checkpoint",
+                                   "ServingEngine.shutdown"),
+    "ServingFleet._migrate_record": ("ServingEngine.migrate_out",),
+    "ServingFleet._adopt_onto": ("ServingEngine.adopt_migration",),
+    "ServingEngine.migrate_out": ("GenerationPool.detach_spilled",
+                                  "GenerationPool.cancel"),
+    "FleetSupervisor.check_once": ("Supervisor.check_once",
+                                   "ServingFleet.hard_abandon"),
     # fault plane: the hot path's module-level no-op check fans into the
     # installed plane, so the plane's own fire() is hot-path-audited
     "_fire": ("fire",),

@@ -113,6 +113,16 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
     # 8 virtual devices on one physical CPU); the per-shard cost/HBM
     # columns are compiler-reported and deterministic per config
     "scaling_efficiency": ("higher", 0.20),
+    # serving fleet (serving_fleet, docs §5o): the engine-death
+    # recovery objective — hard-abandon through every migrated
+    # victim's first post-migration token on a survivor.  Host+replay
+    # work like the other RTOs, gated at the same looseness
+    "migration_rto_s": ("lower", 0.30),
+    # the router's affinity share on the shared-prefix zipf mix: a
+    # ratio, but CPU smoke placement jitters with arrival timing —
+    # gate loosely; a silent fall to ~0 (router stopped firing) is
+    # what this catches
+    "prefix_affinity_hit_rate": ("higher", 0.30),
     "cost_flops_per_shard": ("lower", 0.01),
     "cost_bytes_per_shard": ("lower", 0.01),
     "cost_hbm_reserved_per_shard": ("lower", 0.01),
@@ -135,6 +145,10 @@ PER_LEG_THRESHOLDS: Dict[Tuple[str, str], Tuple[str, float]] = {
     # absolute number there; the scaling_efficiency ratio (gated
     # above) is the honest cross-run signal
     ("serving_sharded", "tokens_per_sec"): ("higher", 0.30),
+    # the fleet leg's tok/s on CPU smoke times N engines multiplexed
+    # onto one physical CPU — same caveat as the sharded leg; the
+    # scaling/RTO/affinity ratios above are the cross-run signal
+    ("serving_fleet", "tokens_per_sec"): ("higher", 0.30),
     # the disagg leg's improvement columns sit near zero on CPU smoke
     # (both tiers timeshare one core — the split buys nothing there),
     # so single-digit-point jitter is all noise; gate loosely and let
