@@ -698,6 +698,98 @@ def bench_decode(pt, jax, on_tpu: bool):
     return out
 
 
+def bench_decode_ssm(pt, jax, on_tpu: bool):
+    """L7 serving leg for the O(1)-cache model class (docs §5p):
+    KV-cached autoregressive decode of an ``SSMLM`` through the SAME
+    ``DecodeSession`` the transformer decode leg times — same prefill/
+    generation lengths, same ``measure_decode_marginal`` methodology,
+    same hidden size / layer count as the transformer leg's geometry,
+    so the two legs' tokens/s compare like with like.
+
+    The model-class claim is stamped NUMERICALLY, not asserted:
+    ``slots_per_gb`` (how many concurrent decode slots one GB of HBM
+    holds when a slot's whole state is ``layers x d_state`` fp32) next
+    to ``slots_per_gb_transformer`` (the same GB holding dense fp32
+    K/V at max_len for the transformer leg's geometry) and their
+    ratio.  ``_leg_promotable`` REJECTS a decode_ssm leg whose timed
+    sub-legs miss the numeric ``slots_per_gb`` stamp — an O(1)-cache
+    tokens/s without its capacity figure cannot say what the constant
+    state bought."""
+    from paddle_tpu.jit import DecodeSession
+    from paddle_tpu.models import gpt_1p3b_config
+    from paddle_tpu.nn import SSMLM
+
+    prefill, gen = 512, 128
+    # the transformer decode leg's geometry, reused so hidden/layers
+    # (and therefore the capacity comparison) match that leg exactly
+    cfg = gpt_1p3b_config()
+    if on_tpu:
+        cfg.update(num_layers=6)
+    else:
+        _cpu_smoke_shrink(cfg, max_position=1024)
+    max_len = prefill + gen
+    pt.seed(0)
+    model = SSMLM(vocab_size=cfg["vocab_size"],
+                  hidden_size=cfg["hidden_size"],
+                  num_layers=cfg["num_layers"], dropout=0.0)
+    state_bytes_per_slot = cfg["num_layers"] * model.d_state * 4
+    # dense fp32 K/V at max_len for the SAME geometry: what one
+    # transformer slot pins in the baseline layout (2 = K and V)
+    kv_bytes_per_slot = 2 * cfg["num_layers"] * cfg["hidden_size"] \
+        * max_len * 4
+    slots_per_gb = (1 << 30) // state_bytes_per_slot
+    slots_per_gb_tf = (1 << 30) // kv_bytes_per_slot
+    rng = np.random.RandomState(0)
+    sess = DecodeSession(model, max_len=max_len, buckets=[prefill],
+                         cache_layout="recurrent")
+    legs = {}
+    best_tps = 0.0
+    for batch in (1, 8):
+        ids = rng.randint(0, cfg["vocab_size"],
+                          (batch, prefill)).astype("int32")
+        m = measure_decode_marginal(sess, ids, gen)
+        tps = batch / m["per_token_s"]
+        cost = sess._decode_jit.last_cost() or {}
+        flops = cost.get("flops")
+        nbytes = cost.get("bytes_accessed")
+        legs["recurrent_fp32_batch%d" % batch] = dict(
+            m, cache_layout="recurrent", cache_dtype="float32",
+            decode_route=sess.route,
+            decode_tokens_per_sec=round(tps, 1),
+            cost_flops_per_token=(None if flops is None
+                                  else flops / batch),
+            cost_bytes_per_token=(None if nbytes is None
+                                  else nbytes / batch),
+            cost_kv_cache_bytes=cost.get("kv_cache_bytes"),
+            state_bytes_per_slot=state_bytes_per_slot,
+            slots_per_gb=slots_per_gb)
+        best_tps = max(best_tps, tps)
+    out = {
+        "tokens_per_sec": best_tps,
+        "prefill": prefill,
+        "generated": gen,
+        "cache_layouts": ["recurrent"],
+        "cache_dtypes": ["float32"],
+        "d_state": model.d_state,
+        "num_layers": cfg["num_layers"],
+        "hidden_size": cfg["hidden_size"],
+        "state_bytes_per_slot": state_bytes_per_slot,
+        "kv_bytes_per_slot_transformer": kv_bytes_per_slot,
+        "slots_per_gb": slots_per_gb,
+        "slots_per_gb_transformer": slots_per_gb_tf,
+        "slots_per_gb_ratio": round(slots_per_gb / slots_per_gb_tf, 1),
+        "compile_counts": sess.compile_counts(),
+        "input_staged": False,
+        "transfer_note": (
+            "prompt upload (batch x 512 int32, <=16 KB) sits in the "
+            "prefill term, which the marginal differencing SUBTRACTS "
+            "out; the per-token figure's only host traffic is the "
+            "sampled [batch] token ids (4 B/row) fetched per step"),
+    }
+    out.update(legs)
+    return out
+
+
 def _histogram_quantile(hist, q: float):
     """A serving Histogram's quantile as a JSON-safe number: the bucket
     upper-bound estimate, None when the histogram is empty or the
@@ -2338,6 +2430,7 @@ def _leg_promotable(name: str, leg: dict):
                        "understates 2x" % (leg.get("mfu_convention"),
                                            RESNET_MFU_CONVENTION))
     cache_stamp_keys = {"decode": "per_token_s",
+                        "decode_ssm": "per_token_s",
                         "serving": "ttft_p50_s",
                         "serving_faults": "recovery_wall_s",
                         "serving_restart": "restore_rto_s",
@@ -2382,6 +2475,20 @@ def _leg_promotable(name: str, leg: dict):
                            "on %s: a fused-kernel number must carry "
                            "the sustained-bandwidth stamp it exists "
                            "to improve" % (name, unstamped))
+        if name == "decode_ssm":
+            # an O(1)-cache tokens/s without its NUMERIC capacity stamp
+            # (slots per GB of HBM at constant per-slot state) cannot
+            # say what the model class bought over positional K/V — the
+            # capacity figure IS the number's provenance (§5p)
+            uncapped = sorted(
+                k for k, v in timed.items()
+                if not isinstance(v.get("slots_per_gb"), (int, float))
+                or isinstance(v.get("slots_per_gb"), bool))
+            if uncapped:
+                return False, ("decode_ssm leg missing numeric "
+                               "slots_per_gb on %s: an O(1)-cache "
+                               "number must carry the capacity stamp "
+                               "it exists to improve" % (uncapped,))
         if name == "serving_faults":
             # a recovery wall time whose survivors LOST tokens measured
             # a broken recovery, not a working one: greedy survivors are
@@ -2737,6 +2844,7 @@ def _measure_and_print():
                      ("bert_k8_multistep", bench_bert_multistep),
                      ("mnist_k32_multistep", bench_mnist_multistep),
                      ("decode", bench_decode),
+                     ("decode_ssm", bench_decode_ssm),
                      ("serving", bench_serving),
                      ("serving_faults", bench_serving_faults),
                      ("serving_restart", bench_serving_restart),

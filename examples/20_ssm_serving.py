@@ -1,0 +1,208 @@
+"""Example 20: O(1)-cache serving — the recurrent/SSM model class
+(DESIGN.md §5p).
+
+A transformer slot pins K/V that GROWS with the sequence; an ``SSMLM``
+slot pins a constant ``layers x d_state`` carry.  This timeline shows
+the SAME serving machinery carrying the second model class:
+
+1. **byte-identity**: a ``GenerationPool`` with
+   ``cache_layout="recurrent"`` (bucketed prefill + per-token decode)
+   emits greedy tokens byte-identical to the eager per-token reference,
+   in fp32, under the exactly-two-compiles contract — the prefill runs
+   the recurrence as a *sequential* scan precisely so both paths reduce
+   in the same operation order;
+2. **the capacity claim, numerically**: ``cache_stats()`` stamps
+   ``state_bytes_per_slot`` next to what dense fp32 K/V at the same
+   geometry and max_len would pin — the ratio is the point of the
+   model class;
+3. **the spill ladder transfers**: a victim preempts into the DISK
+   tier (its carry written through the same versioned ``PTKV``
+   transfer contract paged pools use), resumes byte-identically, zero
+   new compiles;
+4. **migration transfers, adoption is fingerprint-gated**: a second
+   engine adopts the detached transfer file byte-identically, while a
+   TRANSFORMER engine sharing the spill directory refuses it with a
+   logged ``xfer.reject reason=fingerprint`` — never a crash, never a
+   silent wrong answer;
+5. **positional features refuse by name**: prefix sharing and
+   speculative decoding raise typed construction errors (a carry has
+   no blocks to share and no earlier position to rewind to).
+
+Run: python examples/20_ssm_serving.py [--tokens 8]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import argparse
+import io
+import json
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.core.errors import InvalidArgumentError
+from paddle_tpu.inference import GenerationPool, SpeculativePool
+from paddle_tpu.nn import SSMLM
+from paddle_tpu.serving import log as slog
+
+
+def build_model(seed=0):
+    pt.seed(seed)
+    return SSMLM(vocab_size=256, hidden_size=64, num_layers=2,
+                 d_state=96, dropout=0.0)
+
+
+def eager_reference(model, ids, n):
+    """Greedy tokens via the eager per-token cache loop — the oracle
+    the served path must match byte-for-byte."""
+    cache = model.gen_decode_cache(1, len(ids) + n)
+    logits, cache = model(ids[None], cache=cache)
+    out = [int(np.argmax(np.asarray(logits.value)[0, -1]))]
+    while len(out) < n:
+        step = np.asarray([[out[-1]]], np.int32)
+        logits, cache = model(step, cache=cache)
+        out.append(int(np.argmax(np.asarray(logits.value)[0, -1])))
+    return np.asarray(out, np.int32)
+
+
+def make_pool(model, spill_dir=None, slots=2):
+    kw = {}
+    if spill_dir is not None:
+        kw = dict(spill_tier="disk", spill_dir=spill_dir)
+    return GenerationPool(model, max_len=96, slots=slots, buckets=[32],
+                          cache_layout="recurrent", **kw)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tokens", type=int, default=8)
+    args = ap.parse_args()
+    n = args.tokens
+
+    model = build_model()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 256, (ln,)).astype("int32")
+               for ln in (7, 19, 12)]
+
+    # -- 1. served == eager, exactly two compiles ------------------------
+    pool = make_pool(model)
+    for i, ids in enumerate(prompts):
+        pool.submit(ids, n, request_id="r%d" % i)
+    served = pool.run()
+    for i, ids in enumerate(prompts):
+        np.testing.assert_array_equal(served["r%d" % i],
+                                      eager_reference(model, ids, n))
+    counts = pool.compile_counts()
+    print("[1] served == eager reference for %d prompts; compiles %s"
+          % (len(prompts), counts))
+    assert counts["prefill"] == 1 and counts["pool_decode"] == 1
+
+    # -- 2. the capacity claim, stamped ---------------------------------
+    stats = pool.cache_stats()
+    state_bytes = stats["state_bytes_per_slot"]
+    # dense fp32 K/V for the same hidden/layers at this max_len: what
+    # one TRANSFORMER slot would pin (2 = K and V)
+    kv_equiv = 2 * 2 * 64 * 96 * 4
+    print("[2] cache_layout=%s  state %d B/slot vs dense-KV %d B/slot "
+          "(x%.1f): slots/GB %d vs %d"
+          % (stats["cache_layout"], state_bytes, kv_equiv,
+             kv_equiv / state_bytes, (1 << 30) // state_bytes,
+             (1 << 30) // kv_equiv))
+    assert state_bytes == 2 * 96 * 4  # layers * d_state * fp32
+
+    with tempfile.TemporaryDirectory() as spill:
+        # -- 3. preempt -> disk -> resume, byte-identical ----------------
+        pool = make_pool(model, spill)
+        committed = {}  # rid -> tokens seen so far (the §5o fleet's
+        pool.on_token = (  # forwarded-token record, in miniature)
+            lambda rid, tok: committed.setdefault(rid, []).append(tok))
+        for i, ids in enumerate(prompts):
+            pool.submit(ids, n, request_id="r%d" % i)
+        pool.step()
+        pool.step()
+        info = pool.preempt("r0")  # the whole victim is one tiny carry
+        files = os.listdir(spill)
+        print("[3] preempted r0: %d B carry in a PTKV file %s "
+              "(%d committed tokens ride the record)"
+              % (info["state_bytes"], files, info["committed_tokens"]))
+        got = pool.run()
+        for i, ids in enumerate(prompts):
+            np.testing.assert_array_equal(got["r%d" % i],
+                                          served["r%d" % i])
+        assert pool.compile_counts() == counts  # resume compiled nothing
+        ss = pool.spill_stats()
+        print("    resumed byte-identical, zero new compiles; "
+              "spill_stats: preempts=%d resumes=%d upload_bytes=%d"
+              % (ss["preempts_total"], ss["resumes_total"],
+                 ss["upload_bytes_total"]))
+
+        # -- 4. migrate the file; fingerprint gates adoption -------------
+        donor = make_pool(model, spill)
+        committed = {}
+        donor.on_token = (
+            lambda rid, tok: committed.setdefault(rid, []).append(tok))
+        donor.submit(prompts[0], n, request_id="mig")
+        donor.step()
+        donor.step()
+        donor.preempt("mig")
+        handoff = donor.detach_spilled("mig")
+        print("[4] donor detached %r: %d committed tokens, %d B file"
+              % (handoff["rid"], handoff["committed_tokens"],
+                 handoff["spill_bytes"]))
+
+        # a transformer engine sharing the directory REFUSES the file
+        from paddle_tpu.models import TransformerLM
+        pt.seed(1)
+        tf = TransformerLM(vocab_size=256, hidden_size=64, num_layers=2,
+                           num_heads=4, intermediate_size=128,
+                           max_position=256, causal=True, dropout=0.0)
+        alien = GenerationPool(tf, max_len=96, slots=2, buckets=[32],
+                               cache_layout="paged", block_size=8,
+                               spill_tier="disk", spill_dir=spill)
+        buf = io.StringIO()
+        with slog.logging_to(buf):
+            ok = alien.adopt_spill("mig", prompts[0],
+                                   committed["mig"], n)
+        rej = [json.loads(l) for l in buf.getvalue().splitlines()
+               if json.loads(l)["event"] == "xfer.reject"][0]
+        assert not ok and rej["reason"] == "fingerprint"
+        print("    transformer engine refused it: xfer.reject "
+              "reason=%s keys=%s (file left on disk)"
+              % (rej["reason"], rej["keys"]))
+
+        # the rightful peer adopts byte-identically, via the carry
+        # upload — no re-prefill
+        peer = make_pool(model, spill)
+        assert peer.adopt_spill("mig", prompts[0], committed["mig"], n)
+        np.testing.assert_array_equal(peer.run()["mig"], served["r0"])
+        print("    peer engine adopted byte-identically "
+              "(upload_bytes=%d)"
+              % peer.spill_stats()["upload_bytes_total"])
+
+    # -- 5. positional features refuse by name ---------------------------
+    for build in (
+            lambda: GenerationPool(model, max_len=96, slots=2,
+                                   buckets=[32],
+                                   cache_layout="recurrent",
+                                   prefix_sharing=True),
+            lambda: SpeculativePool(model, build_model(1), 96,
+                                    spec_k=2, slots=2, buckets=[32],
+                                    cache_layout="recurrent")):
+        try:
+            build()
+        except InvalidArgumentError as e:
+            print("[5] typed refusal: %s" % str(e).splitlines()[0][:72])
+        else:
+            raise AssertionError("positional feature accepted "
+                                 "a recurrent layout")
+
+    print("OK: one engine, two model classes — the O(1) carry rides "
+          "the same spill, transfer and migration machinery.")
+
+
+if __name__ == "__main__":
+    main()

@@ -98,6 +98,7 @@ import numpy as np
 from ..core.errors import (AlreadyExistsError, InvalidArgumentError,
                            NotFoundError, PreconditionNotMetError)
 from ..jit import aot
+from ..jit.cache import get_layout
 from ..jit.decode import DecodeSession, classify_finish
 from ..jit.mesh import DecodeMesh
 
@@ -381,11 +382,24 @@ class GenerationPool:
             raise InvalidArgumentError(
                 "tenant_slot_cap must be >= 1 slots per tenant (or None "
                 "for no fairness cap), got %r" % (tenant_slot_cap,))
+        # resolve the layout FIRST (jit.cache registry — typed error
+        # naming the registry for an unknown string), so every guard
+        # below can dispatch on layout capabilities instead of string
+        # comparisons, and a non-positional layout combined with a
+        # positional-only knob fails HERE naming the layout — never a
+        # silent no-op faking hit rates downstream
+        self._layout = get_layout(cache_layout)
         if prefill_chunk_tokens is not None and cache_layout != "paged":
             # the chunk path writes through the block table (per-slot
             # scatter routed to the scratch block past the reservation);
             # the dense layout keeps its one-shot bucketed prefill, so
             # dense pools are byte-for-byte unaffected by this feature
+            if not self._layout.positional:
+                raise InvalidArgumentError(
+                    "prefill_chunk_tokens cannot apply to cache_layout="
+                    "'recurrent': a recurrence has no positional K/V to "
+                    "chunk into — its whole prefill is one O(L·d_state) "
+                    "scan, already cheap enough to run in-tick")
             raise InvalidArgumentError(
                 "prefill_chunk_tokens is a paged-cache knob (chunk "
                 "writes route through the block table); pass "
@@ -396,6 +410,13 @@ class GenerationPool:
                 "prefill_chunk_tokens must be >= 1 tokens of prompt "
                 "work per tick, got %r" % (prefill_chunk_tokens,))
         if prefix_sharing and cache_layout != "paged":
+            if not self._layout.positional:
+                raise InvalidArgumentError(
+                    "prefix_sharing cannot apply to cache_layout="
+                    "'recurrent': the recurrence folds the whole prefix "
+                    "into one carry, so there are no per-position "
+                    "blocks two requests could share — every request's "
+                    "state is already O(1)")
             raise InvalidArgumentError(
                 "prefix_sharing shares physical KV blocks through the "
                 "block table; pass cache_layout='paged' (got %r)"
@@ -597,11 +618,12 @@ class GenerationPool:
                 "engine) or 'disk' (crash-durable .npz files under "
                 "spill_dir), got %r" % (spill_tier,))
         if spill_tier == "disk":
-            if cache_layout != "paged":
+            if not self._layout.spillable:
                 raise InvalidArgumentError(
-                    "spill_tier='disk' spills paged K/V blocks; a dense "
-                    "pool has no block granularity to spill — pass "
-                    "cache_layout='paged'")
+                    "spill_tier='disk' spills per-slot decode state "
+                    "(paged K/V blocks, or a recurrent state carry); a "
+                    "dense pool has no spill granularity — pass "
+                    "cache_layout='paged' or 'recurrent'")
             if spill_dir is None:
                 raise InvalidArgumentError(
                     "spill_tier='disk' needs spill_dir= (the directory "
@@ -625,6 +647,12 @@ class GenerationPool:
                 "prefill_only=True exports finished prefills over the "
                 "K/V transfer contract, which lives in the disk spill "
                 "tier — pass spill_tier='disk' (and spill_dir=)")
+        if prefill_only and cache_layout == "recurrent":
+            raise InvalidArgumentError(
+                "prefill_only=True (the disaggregated prefill tier) is "
+                "not wired for cache_layout='recurrent': a recurrent "
+                "prefill is one cheap O(L·d_state) scan, so there is "
+                "nothing to disaggregate — run a fused engine")
         self._prefill_only = bool(prefill_only)
         # rid -> (slot, _SlotState) for prefill-complete parked
         # requests awaiting export_kv()
@@ -682,42 +710,13 @@ class GenerationPool:
         id, true length and (paged) block ids are traced, so every refill
         reuses one compilation.
 
-        Paged: the row cache is an identity-tabled batch-1 pool (row
-        block 1+j holds logical block j — see ``gen_decode_cache``), so
-        the splice is ONE scatter copying every logical block to the
-        physical ids in ``blocks``; entries past the request's
-        reservation are 0, harmlessly dumping their (pad-garbage) blocks
-        into the scratch block.  The slot's table row then IS ``blocks``.
+        The splice body is the layout's (``jit.cache.CacheLayout
+        .insert_row`` — the paged scatter through ``blocks``, the dense
+        per-slot set, the recurrent state-carry copy); this wrapper
+        owns the jit/donation plumbing around it.
         """
-        out = []
-        for cp, cr in zip(pool_cache, row_cache):
-            if hasattr(cp, "table"):
-                upd = dict(
-                    k=cp.k.at[blocks].set(cr.k[1:].astype(cp.k.dtype)),
-                    v=cp.v.at[blocks].set(cr.v[1:].astype(cp.v.dtype)),
-                    table=cp.table.at[slot].set(blocks),
-                    index=cp.index.at[slot].set(
-                        jnp.asarray(length, jnp.int32)))
-                if cp.k_scale is not None:
-                    # int8 cache: the row's per-block scales splice with
-                    # their blocks (same ids), so a spliced block can
-                    # never be read under another request's scale
-                    upd.update(
-                        k_scale=cp.k_scale.at[blocks].set(cr.k_scale[1:]),
-                        v_scale=cp.v_scale.at[blocks].set(cr.v_scale[1:]))
-                out.append(cp._replace(**upd))
-            else:
-                upd = dict(
-                    k=cp.k.at[slot].set(cr.k[0].astype(cp.k.dtype)),
-                    v=cp.v.at[slot].set(cr.v[0].astype(cp.v.dtype)),
-                    index=cp.index.at[slot].set(
-                        jnp.asarray(length, jnp.int32)))
-                if cp.k_scale is not None:
-                    upd.update(
-                        k_scale=cp.k_scale.at[slot].set(cr.k_scale[0]),
-                        v_scale=cp.v_scale.at[slot].set(cr.v_scale[0]))
-                out.append(cp._replace(**upd))
-        return out
+        return self._layout.insert_row(pool_cache, row_cache, slot,
+                                       length, blocks)
 
     def _pool_decode(self, param_vals, buf_vals, cache, toks, active, key):
         """One batched decode step over every slot; inactive slots are
@@ -739,8 +738,10 @@ class GenerationPool:
         logits, new_cache = sess._run_model(param_vals, buf_vals,
                                             toks[:, None], cache)
         tok, key = sess._sample(logits[:, 0], key)
-        new_cache = [c._replace(index=jnp.where(active, c.index, old.index))
-                     for c, old in zip(new_cache, cache)]
+        # layout-owned freeze (jit.cache): positional layouts merge the
+        # index; the recurrent layout must also restore inactive slots'
+        # state carry (a recurrence updates every row every step)
+        new_cache = self._layout.freeze_step(new_cache, cache, active)
         if tables is not None:
             new_cache = [c._replace(table=t)
                          for c, t in zip(new_cache, tables)]
@@ -1199,11 +1200,11 @@ class GenerationPool:
 
     def can_preempt(self, request_id) -> bool:
         """True when ``preempt(request_id)`` would succeed right now:
-        the request is actively DECODING on a paged pool and every
-        subclass resume precondition holds.  The serving engine's
-        degradation ladder filters victims through this instead of
-        catching mid-tick errors."""
-        if self.cache_layout != "paged":
+        the request is actively DECODING on a spillable layout (paged
+        or recurrent) and every subclass resume precondition holds.
+        The serving engine's degradation ladder filters victims through
+        this instead of catching mid-tick errors."""
+        if not self._layout.spillable:
             return False
         for slot, st in self._active.items():
             if st.rid == request_id:
@@ -1232,11 +1233,11 @@ class GenerationPool:
         ordering.  Host-side bookkeeping plus eager array ops only —
         no tracked executable runs, so ``compile_counts()`` is
         unchanged (test-pinned)."""
-        if self.cache_layout != "paged":
+        if not self._layout.spillable:
             raise PreconditionNotMetError(
-                "preemption spills paged K/V blocks to the host tier; "
-                "a dense pool has no block granularity to spill — use "
-                "cache_layout='paged'")
+                "preemption spills per-slot decode state to the host "
+                "tier; a dense pool has no spill granularity — use "
+                "cache_layout='paged' (or 'recurrent')")
         slot = next((s for s, st in self._active.items()
                      if st.rid == request_id), None)
         if slot is None:
@@ -1248,6 +1249,8 @@ class GenerationPool:
                    sorted(str(st.rid) for st in self._active.values())))
         st = self._active[slot]
         self._preempt_guard(slot, st)
+        if self.cache_layout == "recurrent":
+            return self._preempt_recurrent(slot, st)
         bs = self._block_size
         shard = self._shard_of_slot(slot)
         # K/V are written for positions [0, pos): the last committed
@@ -1316,6 +1319,86 @@ class GenerationPool:
                 "blocks_freed": freed, "spill_bytes": host_bytes,
                 "committed_tokens": len(st.tokens)}
 
+    def _preempt_recurrent(self, slot: int, st: _SlotState) -> dict:
+        """Recurrent-layout preemption: the victim's entire decode
+        state is one ``[layers, d_state]`` carry — download the slot's
+        state rows in one ``device_get`` (the same spill-boundary sync
+        as the paged gather, minus the gather: there are no blocks),
+        park it in the host/disk tier, and free the slot.  No allocator
+        interaction at all; resume uploads the carry into any free slot
+        and greedy decode continues byte-identically."""
+        # the carry covers positions [0, pos): the last committed token
+        # is the next step's input, exactly the positional convention
+        host = jax.device_get([(np.asarray(c.state[slot]),)
+                               for c in self._cache])
+        host_bytes = sum(arr.nbytes for layer in host for arr in layer)
+        host_path = None
+        if self.spill_tier == "disk":
+            # write BEFORE any pool mutation (the paged ordering): a
+            # failed write leaves the victim decoding, nothing to unwind
+            host_path = self._spill_write(st, host, written=0)
+            host = None
+        self._active.pop(slot)
+        self._free.append(slot)
+        self._membership_dirty = True
+        sp = _SpillState(st, 0, 0, host, host_bytes,
+                         shard=self._shard_of_slot(slot))
+        sp.host_path = host_path
+        self._spilled[st.rid] = sp
+        self._preempts_total += 1
+        self._spill_bytes_total += host_bytes
+        return {"rid": st.rid, "slot": slot, "blocks_spilled": 0,
+                "blocks_freed": 0, "spill_bytes": host_bytes,
+                "state_bytes": host_bytes,
+                "committed_tokens": len(st.tokens)}
+
+    def _resume_recurrent(self, sp: _SpillState) -> None:
+        """Re-activate a recurrent-layout victim: page the carry in
+        (host tier: process RAM; disk tier: the PTKV transfer file,
+        with the per-victim bad-file fallback — drop the spill and
+        resubmit prompt+committed, byte-identical either way), upload
+        it into any free slot's state row, and restore the index and
+        last-token input."""
+        host_src = sp.host
+        if host_src is None:
+            try:
+                host_src = self._spill_read(sp)
+            except Exception:  # noqa: BLE001 - per-victim fallback
+                self._spill_drop(sp)
+                self._used_rids.discard(sp.rid)
+                ids = np.concatenate(
+                    [sp.ids, np.asarray(sp.tokens, np.int32)])
+                self.submit(ids, sp.remaining, request_id=sp.rid,
+                            priority=sp.priority, tenant=sp.tenant,
+                            deadline=sp.deadline)
+                return
+        # any free slot works: the carry has no shard-resident blocks
+        # pinning it (state rows shard over dp, but an upload into any
+        # row is just a placed scatter)
+        slot = self._pop_free_slot()
+        pos = len(sp.ids) + len(sp.tokens) - 1
+        pos_dev = jnp.asarray(pos, jnp.int32)
+        self._cache = [
+            c._replace(state=c.state.at[slot].set(
+                           jnp.asarray(host_src[layer][0])),
+                       index=c.index.at[slot].set(pos_dev))
+            for layer, c in enumerate(self._cache)]
+        state = _SlotState(sp.rid, sp.ids, sp.tokens, sp.remaining,
+                           priority=sp.priority, tenant=sp.tenant,
+                           deadline=sp.deadline, seq=sp.seq)
+        self._active[slot] = state
+        self._last_tok[slot] = sp.tokens[-1]
+        self._membership_dirty = True
+        self._resumes_total += 1
+        self._upload_bytes_total += sp.host_bytes
+        self._spill_drop(sp)
+        self._on_resumed(slot, sp)
+        if self.on_resume is not None:
+            self.on_resume(sp.rid, {
+                "slot": slot, "blocks_remapped": 0, "blocks_uploaded": 0,
+                "state_bytes": sp.host_bytes,
+                "committed_tokens": len(sp.tokens)})
+
     def _resume(self, sp: _SpillState) -> None:
         """Re-activate one parked request into a free slot: re-map its
         still-device-resident spilled blocks IN PLACE (zero copy),
@@ -1332,6 +1415,8 @@ class GenerationPool:
         # applies — resubmit is always available and always correct —
         # so the loss is contained to THIS victim: its device copies
         # free, and prompt+committed re-queues under its identity.
+        if self.cache_layout == "recurrent":
+            return self._resume_recurrent(sp)
         host_src = sp.host
         if host_src is None and any(
                 sp.dev_blocks[j] is None for j in range(sp.written)):
@@ -1440,7 +1525,7 @@ class GenerationPool:
         written blocks whose content is held host-side (every spilled
         request's written span, device-resident or not)."""
         return {
-            "enabled": self.cache_layout == "paged",
+            "enabled": self._layout.spillable,
             "spill_tier": self.spill_tier,
             "preempts_total": self._preempts_total,
             "resumes_total": self._resumes_total,
@@ -1481,14 +1566,22 @@ class GenerationPool:
         leaves the pool untouched."""
         path = self._spill_path(st.rid)
         arrays = {}
+        recurrent = self.cache_layout == "recurrent"
         for i, layer in enumerate(host):
             for j, arr in enumerate(layer):
-                arrays["l%d_f%d" % (i, j)] = arr[:written]
+                # recurrent payload is whole state rows, not a written-
+                # blocks prefix (written == 0 by convention there)
+                arrays["l%d_f%d" % (i, j)] = (arr if recurrent
+                                              else arr[:written])
         meta = {"rid": str(st.rid), "prompt_len": int(len(st.ids)),
                 "committed": len(st.tokens), "written": int(written),
-                "block_size": self._block_size,
+                "cache_layout": self.cache_layout,
                 "layers": len(host), "fields": len(host[0]),
-                "cache_dtype": str(np.dtype(self._cache[0].k.dtype))}
+                "cache_dtype": self._layout.cache_dtype_str(self._cache)}
+        if recurrent:
+            meta["d_state"] = int(self._cache[0].state.shape[-1])
+        else:
+            meta["block_size"] = self._block_size
         return _transfer_mod().write_transfer(
             path, self.config_fingerprint(), meta, arrays,
             seam=seam, rid=st.rid)
@@ -1540,7 +1633,7 @@ class GenerationPool:
         the file is STALE), shape/dtype/block-size mismatch against
         this pool's cache, or a subclass veto.  Never raises for a bad
         file: resubmit is always available and always correct."""
-        if self.spill_tier != "disk" or self.cache_layout != "paged":
+        if self.spill_tier != "disk" or not self._layout.spillable:
             return False
         if request_id in self._used_rids:
             return False
@@ -1555,14 +1648,21 @@ class GenerationPool:
         path = self._spill_path(request_id)
         if not os.path.exists(path):
             return False
-        bs = self._block_size
-        pos = int(len(ids)) + len(tokens) - 1
-        written = -(-pos // bs)
-        total = self._blocks_needed(len(ids), int(max_new_tokens))
-        if total > self._blocks_per_shard - 1:
-            return False
+        recurrent = self.cache_layout == "recurrent"
         first = self._cache[0]
-        nf = 4 if first.k_scale is not None else 2
+        if recurrent:
+            # the carry is O(1): no block math, no capacity gate — a
+            # free slot is the only resource resume needs
+            written = total = 0
+            nf = 1
+        else:
+            bs = self._block_size
+            pos = int(len(ids)) + len(tokens) - 1
+            written = -(-pos // bs)
+            total = self._blocks_needed(len(ids), int(max_new_tokens))
+            if total > self._blocks_per_shard - 1:
+                return False
+            nf = 4 if first.k_scale is not None else 2
         xfer = _transfer_mod()
         try:
             r = xfer.TransferReader(path)
@@ -1619,18 +1719,29 @@ class GenerationPool:
                 except OSError:
                     pass
                 return False
-            if (meta.get("block_size") != bs
-                    or meta.get("layers") != len(self._cache)
-                    or meta.get("fields") != nf
-                    or meta.get("cache_dtype")
-                    != str(np.dtype(first.k.dtype))):
+            structural_ok = (
+                meta.get("layers") == len(self._cache)
+                and meta.get("fields") == nf
+                and meta.get("cache_dtype")
+                == self._layout.cache_dtype_str(self._cache))
+            if recurrent:
+                structural_ok = (
+                    structural_ok
+                    and meta.get("d_state")
+                    == int(first.state.shape[-1])
+                    and tuple(r.arrays["l0_f0"].shape)
+                    == tuple(first.state.shape[1:]))
+            else:
+                structural_ok = (
+                    structural_ok
+                    and meta.get("block_size") == bs
+                    and tuple(r.arrays["l0_f0"].shape)
+                    == (written,) + tuple(first.k.shape[1:]))
+            if not structural_ok:
                 # structural mismatch against THIS pool's cache:
                 # possibly another config's pool sharing the dir —
                 # fall back without deleting what is not ours to
                 # judge
-                return False
-            if tuple(r.arrays["l0_f0"].shape) \
-                    != (written,) + tuple(first.k.shape[1:]):
                 return False
             host_bytes = int(r.nbytes)
         except Exception:  # noqa: BLE001 - a bad file falls back, always
@@ -1645,9 +1756,11 @@ class GenerationPool:
                         priority=int(priority), tenant=tenant,
                         deadline=deadline, seq=self._seq)
         # no device-resident copies to pin the shard: park where the
-        # most blocks are free (dp == 1: shard 0, the common case)
-        shard = max(range(self._dp),
-                    key=lambda s: len(self._free_by_shard[s]))
+        # most blocks are free (dp == 1: shard 0, the common case;
+        # recurrent carries need no blocks at all — any slot works)
+        shard = 0 if recurrent else max(
+            range(self._dp),
+            key=lambda s: len(self._free_by_shard[s]))
         sp = _SpillState(st, total, written, None, host_bytes,
                          shard=shard)
         sp.host_path = path
@@ -1777,14 +1890,16 @@ class GenerationPool:
             "vocab_size": (None if self._vocab is None
                            else int(self._vocab)),
             "cache_layout": self.cache_layout,
-            "cache_dtype": str(np.dtype(self._cache[0].k.dtype)),
+            "cache_dtype": self._layout.cache_dtype_str(self._cache),
             "mesh": (None if self._mesh is None
                      else {"dp": int(self._mesh.dp),
                            "mp": int(self._mesh.mp)}),
         }
-        if self.cache_layout == "paged":
-            fp["block_size"] = self._block_size
-            fp["num_blocks"] = self._num_blocks
+        # layout geometry (paged: block_size/num_blocks; recurrent:
+        # d_state) — carried so a transformer engine can never adopt a
+        # recurrent engine's spill file or journal, and vice versa
+        # (check_fingerprint treats these as identity, not capacity)
+        fp.update(self._layout.fingerprint_extra(self))
         return fp
 
     def _shared_block_count(self) -> int:
@@ -2132,6 +2247,14 @@ class GenerationPool:
                 break  # every candidate is tenant-capped right now
             kind, item = pick
             if kind == "resume":
+                if self.cache_layout == "recurrent":
+                    # an O(1) carry holds no device blocks and is not
+                    # shard-pinned (its restorable copy is host/disk
+                    # bytes): any free slot resumes it, and the while
+                    # condition already guarantees one
+                    self._spilled.pop(item.rid)
+                    self._resume(item)
+                    continue
                 # a resume is SHARD-PINNED: its zero-copy device blocks
                 # and its table row's partition live in the shard it
                 # was preempted from — block-wait for a slot there
@@ -2542,6 +2665,38 @@ class GenerationPool:
         preallocation of the same pool would pin — the paged win,
         quantified from the allocator state rather than asserted."""
         first = self._cache[0]
+        if self.cache_layout == "recurrent":
+            # O(1)-state accounting: the whole cache is [slots, d_state]
+            # per layer — no positional axis, so reachable == resident
+            # == the state pytree, independent of sequence length (the
+            # model-class argument, quantified).  state_bytes_per_slot
+            # is the capacity planner's figure: slots/GB falls out as
+            # 2**30 // it (the bench leg's slots_per_gb stamp).
+            state_total = sum(int(c.state.size) * c.state.dtype.itemsize
+                              for c in self._cache)
+            stats = {
+                "cache_layout": self.cache_layout,
+                "cache_dtype": self._layout.cache_dtype_str(self._cache),
+                "decode_route": self._session.route,
+                "d_state": int(first.state.shape[-1]),
+                "num_layers": len(self._cache),
+                "state_bytes_per_slot": self._layout.state_bytes_per_slot(
+                    self._cache, self.slots, self.max_len),
+                "reachable_bytes": state_total,
+                "pool_bytes": state_total,
+            }
+            if self._mesh is not None:
+                stats["mesh"] = self._mesh.describe()
+            stats["per_shard"] = [
+                {"shard": s, "reachable_bytes": state_total // self._dp,
+                 "pool_bytes": state_total // self._dp}
+                for s in range(self._dp)]
+            if self._mesh is not None:
+                # dp splits the slot axis; the state vector is whole
+                # per slot (mp does not shard it — mesh.py axis rules)
+                stats["pool_bytes_per_device"] = \
+                    state_total // self._mesh.dp
+            return stats
         dims = dict(max_len=self.max_len, num_layers=len(self._cache),
                     num_heads=first.k.shape[1], head_dim=first.k.shape[3],
                     dtype=first.k.dtype)
@@ -2558,6 +2713,11 @@ class GenerationPool:
                  # from the fused kernel must never be presented as a
                  # composition number (bench legs stamp this)
                  "decode_route": self._session.route,
+                 # worst-case cache bytes one slot pins at max_len —
+                 # comparable across model classes (the recurrent
+                 # branch stamps the same key for its O(1) state)
+                 "state_bytes_per_slot": self._layout.state_bytes_per_slot(
+                     self._cache, self.slots, self.max_len),
                  "dense_equiv_bytes": dense_bytes}
         if self._mesh is not None:
             stats["mesh"] = self._mesh.describe()

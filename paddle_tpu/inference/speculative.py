@@ -91,6 +91,15 @@ class SpeculativePool(GenerationPool):
             raise InvalidArgumentError(
                 "spec_k must be >= 1 draft tokens per round, got %r"
                 % (spec_k,))
+        if cache_layout == "recurrent":
+            raise InvalidArgumentError(
+                "speculative decoding does not support "
+                "cache_layout='recurrent': verify-rewind moves a "
+                "POSITIONAL index pointer back over rejected drafts, "
+                "but a recurrent carry folds every step into one state "
+                "vector — there is no earlier position to rewind to "
+                "without re-running the prefix; use GenerationPool for "
+                "recurrent/SSM models")
         check_draft_compatible(draft_model, model)
         # top_k/top_p are accepted (and forwarded) so the pool stays a
         # DROP-IN for GenerationPool under ServingEngine's **pool_kwargs

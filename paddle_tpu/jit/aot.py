@@ -60,7 +60,10 @@ def kv_arg_bytes(cache) -> int:
     ``inference.kv_reachable_bytes`` accounting (pinned by tests)."""
     total = 0
     for c in cache:
-        for field in ("k", "v", "k_scale", "v_scale"):
+        # "state" is the recurrent layout's whole payload (jit.cache):
+        # positional caches have no such field, so the transformer
+        # figures are unchanged
+        for field in ("k", "v", "k_scale", "v_scale", "state"):
             a = getattr(c, field, None)
             if a is not None:
                 total += int(a.size) * a.dtype.itemsize

@@ -1,0 +1,310 @@
+"""The cache-layout protocol: every way a decode cache can exist.
+
+PRs 4–17 grew three consumers of the ``gen_decode_cache(layout=...)``
+pytree contract — ``DecodeSession`` (aligned batches),
+``inference.GenerationPool`` (slot-batched serving) and the PTKV
+spill/transfer path — and each of them dispatched on the layout with
+``hasattr(c, "table")`` / ``cache_layout == "paged"`` string checks.
+That worked while there were exactly two layouts, both positional K/V;
+it stops working the moment a model class with a DIFFERENT kind of
+decode state arrives (the "Compiler-First State Space Duality and
+Portable O(1) Autoregressive Caching" direction in PAPERS.md: a
+recurrence carry instead of an attention prefix).
+
+This module names the operations those consumers actually perform as a
+:class:`CacheLayout` protocol and registers one singleton per layout:
+
+==================  =====================================================
+operation            who calls it / what it decides
+==================  =====================================================
+``begin_prefill``    DecodeSession._prefill — layout-specific cache prep
+                     BEFORE the forward (the recurrent layout clamps its
+                     update window to the true prompt length so padded
+                     bucket positions are identity steps; positional
+                     layouts need nothing — pad K/V is simply never
+                     attended)
+``finalize_prefill`` DecodeSession._prefill — commit the true length
+                     after the forward (all layouts set ``index``; the
+                     recurrent layout also re-opens its update window)
+``insert_row``       GenerationPool._insert — splice a batch-1 prefilled
+                     row cache into a pool slot (traced; ONE compile)
+``freeze_step``      GenerationPool._pool_decode — merge a decode step's
+                     cache for INACTIVE slots back to the pre-step value
+                     (positional layouts freeze the index; the recurrent
+                     layout must also restore the state carry, because a
+                     recurrence updates every row every step)
+``field_axes``       DecodeMesh.place_cache — PartitionSpec axes per
+                     cache field (k/v shard ('dp','mp'); a recurrence
+                     state shards ('dp', None): slots over dp, the state
+                     vector replicated within an mp group)
+``cache_dtype_str``  cache_stats()/config_fingerprint() provenance — the
+                     payload dtype without assuming a ``.k`` field
+``state_bytes_per_slot``  cache_stats() — the decode-state HBM one slot
+                     pins at full span, the figure the slots-per-GB
+                     capacity comparison is made of
+``fingerprint_extra``  config_fingerprint() — layout-private geometry
+                     (paged: block_size/num_blocks; recurrent: d_state)
+                     so the PTKV fingerprint check can never let one
+                     model class adopt another's spill file
+==================  =====================================================
+
+Capability flags gate the serving features that CANNOT transfer across
+layouts, so a pool kwarg that silently no-ops is impossible:
+
+- ``positional``: the cache addresses individual past positions.
+  Chunked prefill, prefix sharing and speculative verify-rewind all
+  require it; the recurrent layout folds history into one carry, so
+  those knobs raise typed errors at construction naming the layout.
+- ``paged``: the cache is a block pool behind a table (allocator,
+  scratch-block masking, block-granular spill live in the pool — they
+  are paged POLICY, not protocol).
+- ``spillable``: preempt/resume/adopt can move a slot's state through
+  the host/disk tiers and the PTKV transfer contract.
+
+The traced-method bodies (``insert_row``/``freeze_step``/the prefill
+hooks) are the EXACT code the pool and session inlined before this
+module existed — re-registering the dense/paged layouts against the
+protocol changes no jaxpr, so the byte-identity and compile-count pins
+across the serving suite hold unmodified.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import InvalidArgumentError
+
+__all__ = ["CacheLayout", "DenseLayout", "PagedLayout", "RecurrentLayout",
+           "CACHE_LAYOUTS", "get_layout"]
+
+
+class CacheLayout:
+    """One decode-cache layout's operations and capabilities.
+
+    Subclasses are stateless singletons (all state lives in the cache
+    pytree and the pool); methods marked *traced* run inside jitted
+    bodies and must keep the exact semantics the compile-count pins
+    were taken against.
+    """
+
+    #: registry key and the ``cache_layout=`` string users pass
+    name: str = "?"
+    #: cache addresses individual past positions (prefix tree, chunked
+    #: prefill and speculative rewind are only meaningful here)
+    positional: bool = True
+    #: cache is a block pool behind a per-slot table
+    paged: bool = False
+    #: preempt/resume/adopt can move per-slot state through the
+    #: host/disk spill tiers and PTKV transfer files
+    spillable: bool = False
+
+    # -- prefill hooks (traced) ------------------------------------------
+    def begin_prefill(self, cache, true_len):
+        """Layout prep before the prefill forward (identity for
+        positional layouts: pad K/V is written but never attended)."""
+        return cache
+
+    def finalize_prefill(self, cache, true_len, max_len):
+        """Commit the true prompt length after the prefill forward."""
+        return [c._replace(index=true_len) for c in cache]
+
+    # -- pool splice / step freeze (traced) ------------------------------
+    def insert_row(self, pool_cache, row_cache, slot, length, blocks=None):
+        raise NotImplementedError
+
+    def freeze_step(self, new_cache, prev_cache, active):
+        """Merge a decode step's cache back to the pre-step value for
+        inactive slots (positional layouts: only the index advances
+        per step, so only the index needs freezing)."""
+        return [c._replace(index=jnp.where(active, c.index, old.index))
+                for c, old in zip(new_cache, prev_cache)]
+
+    # -- placement / accounting ------------------------------------------
+    def field_axes(self, field: str):
+        """PartitionSpec axes for one cache field on a dp×mp
+        :class:`~paddle_tpu.jit.mesh.DecodeMesh`."""
+        if field in ("k", "v", "k_scale", "v_scale"):
+            return ("dp", "mp")
+        if field in ("table", "index"):
+            return ("dp",)
+        raise InvalidArgumentError(
+            "unknown decode-cache field %r for layout %r"
+            % (field, self.name))
+
+    def cache_dtype_str(self, cache) -> str:
+        """Payload dtype as provenance (``cache_stats`` /
+        ``config_fingerprint`` stamp this)."""
+        return str(np.dtype(cache[0].k.dtype))
+
+    def state_bytes_per_slot(self, cache, slots: int, max_len: int) -> int:
+        """Decode-state bytes ONE slot pins at full span — the
+        denominator of the slots-per-GB capacity figure.  For the
+        positional layouts this is the dense-equivalent per-slot K/V
+        slab (scales included): what admitting one more concurrent
+        request costs in HBM when every request can run to max_len."""
+        total = 0
+        for c in cache:
+            for field in ("k", "v", "k_scale", "v_scale"):
+                a = getattr(c, field, None)
+                if a is None:
+                    continue
+                per_tok = int(np.prod(a.shape)) * a.dtype.itemsize
+                # dense: [slots, H, max_len, D] -> bytes / slots.
+                # paged: [blocks, H, bs, D] -> bytes-per-token * max_len
+                if self.paged:
+                    tokens = int(a.shape[0]) * int(a.shape[2])
+                    total += per_tok // tokens * max_len
+                else:
+                    total += per_tok // int(slots)
+        return total
+
+    def fingerprint_extra(self, pool) -> dict:
+        """Layout-private geometry for ``config_fingerprint()`` — keys
+        the PTKV/journal fingerprint comparison treats as identity, so
+        cross-layout (and cross-geometry) adoption is impossible."""
+        return {}
+
+
+class DenseLayout(CacheLayout):
+    """Preallocated ``[slots, H, max_len, D]`` K/V per slot."""
+
+    name = "dense"
+
+    def insert_row(self, pool_cache, row_cache, slot, length, blocks=None):
+        out = []
+        for cp, cr in zip(pool_cache, row_cache):
+            upd = dict(
+                k=cp.k.at[slot].set(cr.k[0].astype(cp.k.dtype)),
+                v=cp.v.at[slot].set(cr.v[0].astype(cp.v.dtype)),
+                index=cp.index.at[slot].set(
+                    jnp.asarray(length, jnp.int32)))
+            if cp.k_scale is not None:
+                upd.update(
+                    k_scale=cp.k_scale.at[slot].set(cr.k_scale[0]),
+                    v_scale=cp.v_scale.at[slot].set(cr.v_scale[0]))
+            out.append(cp._replace(**upd))
+        return out
+
+
+class PagedLayout(CacheLayout):
+    """Fixed-size K/V blocks addressed through a per-slot table; the
+    allocator (free lists, refcounted prefix sharing, scratch-block
+    masking, block-granular spill) is pool policy layered on top."""
+
+    name = "paged"
+    paged = True
+    spillable = True
+
+    def insert_row(self, pool_cache, row_cache, slot, length, blocks=None):
+        # the row cache is an identity-tabled batch-1 pool (row block
+        # 1+j holds logical block j), so the splice is ONE scatter
+        # copying every logical block to the physical ids in ``blocks``;
+        # entries past the reservation are 0, harmlessly dumping their
+        # pad-garbage blocks into the scratch block
+        out = []
+        for cp, cr in zip(pool_cache, row_cache):
+            upd = dict(
+                k=cp.k.at[blocks].set(cr.k[1:].astype(cp.k.dtype)),
+                v=cp.v.at[blocks].set(cr.v[1:].astype(cp.v.dtype)),
+                table=cp.table.at[slot].set(blocks),
+                index=cp.index.at[slot].set(
+                    jnp.asarray(length, jnp.int32)))
+            if cp.k_scale is not None:
+                # int8 cache: the row's per-block scales splice with
+                # their blocks (same ids), so a spliced block can never
+                # be read under another request's scale
+                upd.update(
+                    k_scale=cp.k_scale.at[blocks].set(cr.k_scale[1:]),
+                    v_scale=cp.v_scale.at[blocks].set(cr.v_scale[1:]))
+            out.append(cp._replace(**upd))
+        return out
+
+    def fingerprint_extra(self, pool) -> dict:
+        return {"block_size": pool._block_size,
+                "num_blocks": pool._num_blocks}
+
+
+class RecurrentLayout(CacheLayout):
+    """Constant-size recurrence carry (``nn.ssm.RecurrentDecodeCache``:
+    ``state [B, d_state]`` + ``index`` + ``limit`` per layer): O(1)
+    state per token, no block table, no paging, no prefix tree.
+
+    ``limit`` is the layout's pad-garbage discipline.  A positional
+    cache can write garbage K/V for padded bucket positions because the
+    index keeps them from ever being ATTENDED; a recurrence has no such
+    afterthought — every update folds into the one carry forever.  So
+    the prefill hook narrows the update window to the true prompt
+    length (positions past it are identity steps), and finalize re-opens
+    it to max_len for decode.
+    """
+
+    name = "recurrent"
+    positional = False
+    spillable = True
+
+    def begin_prefill(self, cache, true_len):
+        return [c._replace(limit=true_len) for c in cache]
+
+    def finalize_prefill(self, cache, true_len, max_len):
+        lim = jnp.asarray(max_len, jnp.int32)
+        return [c._replace(index=true_len, limit=lim) for c in cache]
+
+    def insert_row(self, pool_cache, row_cache, slot, length, blocks=None):
+        return [cp._replace(
+            state=cp.state.at[slot].set(
+                cr.state[0].astype(cp.state.dtype)),
+            index=cp.index.at[slot].set(jnp.asarray(length, jnp.int32)))
+            for cp, cr in zip(pool_cache, row_cache)]
+
+    def freeze_step(self, new_cache, prev_cache, active):
+        # the recurrence updated EVERY row's carry this step; an
+        # inactive slot's update folds its stale last token into state
+        # a resumed/refilled request would then inherit — restore the
+        # carry, not just the index
+        return [c._replace(
+            state=jnp.where(active[:, None], c.state, old.state),
+            index=jnp.where(active, c.index, old.index))
+            for c, old in zip(new_cache, prev_cache)]
+
+    def field_axes(self, field: str):
+        if field == "state":
+            # slots over dp; the state vector stays whole per slot (no
+            # head axis to split — replicated within an mp group)
+            return ("dp", None)
+        if field == "index":
+            return ("dp",)
+        if field == "limit":
+            return ()  # scalar window bound: replicated
+        raise InvalidArgumentError(
+            "unknown decode-cache field %r for layout 'recurrent'"
+            % (field,))
+
+    def cache_dtype_str(self, cache) -> str:
+        return str(np.dtype(cache[0].state.dtype))
+
+    def state_bytes_per_slot(self, cache, slots: int, max_len: int) -> int:
+        # constant in max_len — the whole point
+        return sum(
+            int(np.prod(c.state.shape)) * c.state.dtype.itemsize // int(slots)
+            for c in cache)
+
+    def fingerprint_extra(self, pool) -> dict:
+        return {"d_state": int(pool._cache[0].state.shape[-1])}
+
+
+CACHE_LAYOUTS = {
+    layout.name: layout
+    for layout in (DenseLayout(), PagedLayout(), RecurrentLayout())
+}
+
+
+def get_layout(name: str) -> CacheLayout:
+    """The registered :class:`CacheLayout` singleton for ``name``; a
+    typed error naming the registry otherwise — the single validation
+    every cache consumer (session, pool, sweep, bench) routes through."""
+    layout = CACHE_LAYOUTS.get(name)
+    if layout is None:
+        raise InvalidArgumentError(
+            "cache_layout must be one of %s, got %r"
+            % (sorted(CACHE_LAYOUTS), name))
+    return layout

@@ -244,16 +244,36 @@ class DecodeSession:
         # while greedy output stays token-identical over the pinned
         # short-horizon corpus (tests/test_quant_cache.py).
         self._cache_dtype = normalize_cache_dtype(cache_dtype)
+        if cache_layout == "recurrent" and self._cache_dtype != "float32":
+            # mirror SSMLM.gen_decode_cache's refusal at construction,
+            # not inside the first prefill trace: the carry is the
+            # exact serving state, so quantizing it changes tokens
+            raise InvalidArgumentError(
+                "cache_layout='recurrent' supports only "
+                "cache_dtype='float32' (got %r): the recurrence carry "
+                "is the exact decode state, not a re-read cache"
+                % (cache_dtype,))
         # "dense" preallocates [B, H, max_len, D] per row; "paged" stores
         # K/V in fixed-size blocks addressed through a block table
         # (identity-mapped here — the aligned batch needs no allocator;
         # inference.GenerationPool runs a real free-list over the same
-        # layout).  Both compile exactly two functions per bucket and are
-        # token-identical under greedy decoding.
-        if cache_layout not in ("dense", "paged"):
+        # layout); "recurrent" is the O(1)-state carry of SSM decoders
+        # (nn.ssm.SSMLM).  All compile exactly two functions per bucket
+        # and are token-identical under greedy decoding.
+        from .cache import get_layout
+
+        self._layout = get_layout(cache_layout)
+        supported = getattr(model, "cache_layouts", ("dense", "paged"))
+        if self._layout.name not in supported:
+            # fail at construction naming both sides; gen_decode_cache
+            # would also refuse, but only inside the first prefill trace
             raise InvalidArgumentError(
-                "cache_layout must be 'dense' or 'paged', got %r"
-                % (cache_layout,))
+                "model %s supports cache_layouts=%r, not %r: positional "
+                "K/V layouts ('dense'/'paged') belong to attention "
+                "models, 'recurrent' to constant-state models like "
+                "nn.ssm.SSMLM"
+                % (type(model).__name__, tuple(supported),
+                   self._layout.name))
         if int(block_size) < 1:
             raise InvalidArgumentError(
                 "block_size must be >= 1, got %r" % (block_size,))
@@ -331,12 +351,17 @@ class DecodeSession:
         ``true_len``, overwriting pad garbage first.
         """
         b = ids.shape[0]
+        true_len = jnp.asarray(true_len, jnp.int32)
         cache = self._model.gen_decode_cache(
             b, self.max_len, self._cache_dtype,
             layout=self.cache_layout, block_size=self.block_size)
+        # layout prep BEFORE the forward (jit.cache): identity for the
+        # positional layouts; the recurrent layout narrows its update
+        # window to the true length so pad positions are identity steps
+        cache = self._layout.begin_prefill(cache, true_len)
         logits, cache = self._run_model(param_vals, buf_vals, ids, cache)
-        true_len = jnp.asarray(true_len, jnp.int32)
-        cache = [c._replace(index=true_len) for c in cache]
+        cache = self._layout.finalize_prefill(cache, true_len,
+                                              self.max_len)
         last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                             keepdims=False)  # [B, V]
         tok, key = self._sample(last, key)

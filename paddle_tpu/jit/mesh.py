@@ -114,13 +114,20 @@ class DecodeMesh:
 
     # -- cache placement -------------------------------------------------
     def cache_field_axes(self, field: str):
-        """The partition axes for one decode-cache field (dense or
-        paged — the leading axis is slots or blocks, both 'dp'; the
-        head axis is 'mp'; the table/index carry only the slot axis)."""
+        """The partition axes for one decode-cache field (dense, paged
+        or recurrent — the leading axis is slots or blocks, both 'dp';
+        the head axis is 'mp'; the table/index carry only the slot
+        axis; a recurrence state shards slots over 'dp' with the state
+        vector whole per slot, and its scalar window bound
+        replicates)."""
         if field in ("k", "v", "k_scale", "v_scale"):
             return ("dp", "mp")
         if field in ("table", "index"):
             return ("dp",)
+        if field == "state":
+            return ("dp", None)
+        if field == "limit":
+            return ()
         raise InvalidArgumentError(
             "unknown decode-cache field %r" % (field,))
 

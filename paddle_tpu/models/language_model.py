@@ -71,6 +71,11 @@ def gpt_1p3b_config() -> dict:
 class TransformerLM(Layer):
     """Transformer language model with tied input/output embeddings."""
 
+    #: decode-cache layouts gen_decode_cache can build (the positional
+    #: K/V pair — jit.cache; nn.ssm.SSMLM conversely serves only
+    #: "recurrent").  DecodeSession checks this at construction.
+    cache_layouts = ("dense", "paged")
+
     def __init__(
         self,
         vocab_size: int = 30528,

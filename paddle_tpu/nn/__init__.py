@@ -48,3 +48,4 @@ from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
     TransformerEncoder, TransformerEncoderLayer,
 )
+from .ssm import GatedSSMBlock, RecurrentDecodeCache, SSMLM  # noqa: F401

@@ -32,6 +32,7 @@ SCRIPTS = [
     ("17_durable_serving.py", ["--tokens", "8"]),
     ("18_disagg_serving.py", ["--tokens", "8"]),
     ("19_fleet_serving.py", ["--tokens", "8"]),
+    ("20_ssm_serving.py", ["--tokens", "8"]),
 ]
 
 

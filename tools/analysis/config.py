@@ -40,7 +40,24 @@ HOT_ROOTS = (
 # with dynamic dispatch — a new seam needs a new line here, which
 # review can see.
 EXTRA_EDGES = {
-    "DecodeSession._run_model": ("TransformerLM.forward",),
+    "DecodeSession._run_model": ("TransformerLM.forward",
+                                 "SSMLM.forward"),
+    # O(1)-cache model class (docs §5p): the CacheLayout protocol's
+    # traced hooks dispatch through a layout object chosen at
+    # construction (an attribute call the AST cannot resolve), and the
+    # SSM forward fans into its recurrence blocks — declared so the
+    # session/pool prefill and step paths stay hot-path-audited for
+    # every registered layout
+    "DecodeSession._prefill": ("CacheLayout.begin_prefill",
+                               "CacheLayout.finalize_prefill",
+                               "RecurrentLayout.begin_prefill",
+                               "RecurrentLayout.finalize_prefill"),
+    "GenerationPool._insert": ("DenseLayout.insert_row",
+                               "PagedLayout.insert_row",
+                               "RecurrentLayout.insert_row"),
+    "GenerationPool._pool_decode": ("CacheLayout.freeze_step",
+                                    "RecurrentLayout.freeze_step"),
+    "SSMLM.forward": ("GatedSSMBlock.forward",),
     # fused pallas decode kernel (docs §5l): the ops-layer routing seam
     # dispatches to the pallas entry points behind function-local
     # imports (invisible to the AST), and both kernels sit on the

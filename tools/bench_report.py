@@ -127,6 +127,13 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "cost_bytes_per_shard": ("lower", 0.01),
     "cost_hbm_reserved_per_shard": ("lower", 0.01),
     "kv_resident_bytes_per_shard": ("lower", 0.01),
+    # O(1)-cache model class (decode_ssm, docs §5p): the capacity
+    # columns are byte accounting, deterministic per config — a fall
+    # in slots/GB (or growth in per-slot state bytes) is a contract
+    # change in the model class's whole value proposition, so tight
+    "slots_per_gb": ("higher", 0.01),
+    "slots_per_gb_ratio": ("higher", 0.01),
+    "state_bytes_per_slot": ("lower", 0.01),
 }
 
 # per-leg overrides: (leg, metric) -> (direction, threshold).  The

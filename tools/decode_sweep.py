@@ -39,6 +39,14 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
   forced route runs the compiled kernel; off-TPU it runs the pallas
   interpreter, which the route name says out loud).
 
+- a MODEL-CLASS axis (``--model-class transformer ssm``): the ssm
+  rows serve an ``SSMLM`` (docs/DESIGN.md §5p) at the transformer
+  sweep's hidden/layer geometry through the SAME ``DecodeSession`` and
+  the SAME marginal recipe, with a state-bytes-per-slot column next to
+  the dense K/V bytes the same slot would pin at that cache length —
+  and since the carry is O(1), the tok/s rows should read ~flat across
+  the cache-length axis, which is itself the measurement.
+
 - plain-vs-SPECULATIVE tokens/s with a ``--speculate K`` axis: the
   draft/verify pool (``inference.SpeculativePool``, K draft tokens per
   round against a 1-layer draft twin) timed against the plain pool at
@@ -50,7 +58,8 @@ Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
      [--gen 64] [--block-sizes 16 32 64 128]
      [--cache-dtypes float32 int8] [--speculate K]
      [--route auto composition pallas-interpret]
-     [--prompt-reuse f ...] [--cpu-smoke]
+     [--prompt-reuse f ...] [--model-class transformer ssm]
+     [--cpu-smoke]
      [--out decode_sweep.json]
 Writes the JSON report to --out (default: decode_sweep.json in the
 CWD — never into tools/, a measurement artifact is not source);
@@ -161,6 +170,65 @@ def sweep(pt, cfg, batches, buckets, gen, block_sizes, cache_dtypes,
             "%s%s_%s_%s" % (layout, "_bs%d" % bs if bs else "", dtype,
                             route_name): sess.compile_counts()
             for layout, bs, dtype, route_name, sess in sessions}
+    return legs, compiles
+
+
+def ssm_sweep(pt, cfg, batches, buckets, gen):
+    """tok/s AND state-bytes per (bucket, batch) for the O(1)-cache
+    model class (docs/DESIGN.md §5p): an ``SSMLM`` at the transformer
+    sweep's hidden/layer geometry, served by the same ``DecodeSession``
+    through the SAME marginal recipe.  Every row carries its
+    ``state_bytes_per_slot`` column next to the dense fp32 K/V bytes
+    the SAME slot would pin at that cache length, so the capacity
+    claim rides on the row, not in prose.  The cache-length axis is
+    vacuous here BY CONSTRUCTION — the carry is O(1) in sequence
+    length — so tok/s should read ~flat across buckets, and that
+    flatness is the measurement."""
+    from bench import measure_decode_marginal  # THE shared timing recipe
+    from paddle_tpu.jit import DecodeSession
+    from paddle_tpu.nn import SSMLM
+
+    pt.seed(0)
+    model = SSMLM(vocab_size=cfg["vocab_size"],
+                  hidden_size=cfg["hidden_size"],
+                  num_layers=cfg["num_layers"], dropout=0.0)
+    state_bytes = cfg["num_layers"] * model.d_state * 4
+    rng = np.random.RandomState(0)
+    legs = []
+    compiles = {}
+    for bucket in buckets:
+        # one session per bucket, same discipline as sweep(): the
+        # recurrent step does NOT scan a cache, but the prefill term
+        # is bucket-shaped and the compile counts are per session
+        max_len = bucket + gen
+        sess = DecodeSession(model, max_len=max_len, buckets=[bucket],
+                             cache_layout="recurrent")
+        # dense fp32 K/V at this cache length for the same geometry:
+        # what one transformer slot would pin (2 = K and V)
+        kv_equiv = 2 * cfg["num_layers"] * cfg["hidden_size"] \
+            * max_len * 4
+        for batch in batches:
+            ids = rng.randint(0, cfg["vocab_size"],
+                              (batch, bucket)).astype("int32")
+            m = measure_decode_marginal(sess, ids, gen, repeats=REPEATS)
+            tps = batch / m["per_token_s"]
+            legs.append(dict(
+                m, batch=batch, prefill=bucket, generated=gen,
+                cache_len=max_len, model_class="ssm",
+                cache_layout="recurrent", cache_dtype="float32",
+                d_state=model.d_state,
+                state_bytes_per_slot=state_bytes,
+                state_reachable_bytes=state_bytes * batch,
+                kv_equiv_bytes_per_slot=kv_equiv,
+                slots_per_gb=(1 << 30) // state_bytes,
+                decode_tokens_per_sec=round(tps, 1)))
+            print("bucket %-5d batch %-3d  ssm   recurrent fp32     "
+                  "prefill %.4fs  %.3f ms/tok  %8.1f tok/s"
+                  "  state %5.1f KiB/slot (dense-KV %6.2f MiB)"
+                  % (bucket, batch, m["prefill_s"],
+                     m["per_token_s"] * 1e3, tps,
+                     state_bytes / 2**10, kv_equiv / 2**20), flush=True)
+        compiles["bucket_%d" % bucket] = sess.compile_counts()
     return legs, compiles
 
 
@@ -401,6 +469,15 @@ def main():
                          "On TPU, pallas-interpret still forces the "
                          "COMPILED kernel; the name flags that off-TPU "
                          "it times the pallas interpreter")
+    ap.add_argument("--model-class", dest="model_class", nargs="+",
+                    default=["transformer"],
+                    choices=["transformer", "ssm"], metavar="C",
+                    help="model classes to sweep (transformer and/or "
+                         "ssm): ssm rows serve an SSMLM through the "
+                         "same DecodeSession with the recurrent O(1) "
+                         "carry (docs/DESIGN.md §5p) and record tok/s "
+                         "next to state-bytes-per-slot vs the dense "
+                         "K/V bytes the same slot would pin")
     ap.add_argument("--prompt-reuse", type=float, nargs="*", default=[],
                     metavar="F",
                     help="also sweep prefix sharing at these reuse "
@@ -477,9 +554,15 @@ def main():
     # the marginal recipe differences against a 1-token generation
     args.gen = max(args.gen, 2)
 
-    legs, compiles = sweep(pt, cfg, args.batches, args.buckets, args.gen,
-                           args.block_sizes, args.cache_dtypes,
-                           args.route)
+    legs, compiles = [], {}
+    if "transformer" in args.model_class:
+        legs, compiles = sweep(pt, cfg, args.batches, args.buckets,
+                               args.gen, args.block_sizes,
+                               args.cache_dtypes, args.route)
+    ssm_legs = ssm_compiles = None
+    if "ssm" in args.model_class:
+        ssm_legs, ssm_compiles = ssm_sweep(pt, cfg, args.batches,
+                                           args.buckets, args.gen)
     spec_legs = None
     if args.speculate > 0:
         spec_legs = speculative_sweep(pt, cfg, args.batches,
@@ -513,8 +596,11 @@ def main():
               "spec_k": args.speculate or None,
               "prompt_reuse": args.prompt_reuse or None,
               "mesh": [list(m) for m in meshes] or None,
+              "model_class": args.model_class,
               "compile_counts": compiles,
+              "ssm_compile_counts": ssm_compiles,
               "legs": legs,
+              "ssm_legs": ssm_legs,
               "speculative_legs": spec_legs,
               "prompt_reuse_legs": reuse_legs,
               "mesh_legs": mesh_legs}
