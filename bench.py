@@ -2302,6 +2302,207 @@ def bench_serving_fleet(pt, jax, on_tpu: bool):
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+def bench_serving_lora(pt, jax, on_tpu: bool):
+    """L7 multi-LoRA leg (docs/DESIGN.md §5q): IDENTICAL greedy traffic
+    over 8 fine-tunes served three ways — base-only on one engine
+    (``adapters_1``), all 8 adapters MIXED in one engine's batch off the
+    stacked bank (``shared_8``), and 8 dedicated one-adapter engines
+    (``dedicated_8``, the deployment shape the bank replaces).
+
+    Stamps the three claims the as-data adapter seam makes:
+
+    - ``tokens_per_sec``: mixed-adapter throughput on ONE engine vs the
+      aggregate of 8 dedicated engines on the same traffic.  On CPU
+      smoke all engines timeshare one core, so the dedicated aggregate
+      is sequential-sum wall — the column exists for the on-chip
+      comparison;
+    - ``weight_hbm_bytes``: resident weight bytes per sub-leg (base +
+      bank for the shared engine; 8 full base copies for the dedicated
+      fleet) and ``weight_bytes_saved`` — the HBM the bank buys back;
+    - ``compiles_during_traffic``: executable-cache growth while the
+      mixed-adapter/mixed-nothing traffic runs — MUST be 0 (the
+      exactly-two contract: adapter ids and sampling are traced DATA),
+      and ``hot_load_compiles`` pins that ``load_adapter`` of a fresh
+      fine-tune into the live engine is a device write, not a compile;
+      ``cost_version_changed`` must stay False across steady ticks.
+    - ``tokens_lost``: shared-bank tokens vs each request's dedicated
+      engine — the bank must change WHERE the delta math runs, never
+      the tokens (greedy byte-identity, refused by the gate if lossy).
+    """
+    from paddle_tpu.models import TransformerLM, gpt_1p3b_config
+    from paddle_tpu.nn import lora
+    from paddle_tpu.serving import ServingEngine
+
+    n_adapters = 8
+    cfg = gpt_1p3b_config()
+    if on_tpu:
+        cfg.update(num_layers=6)
+        prefill, gen, slots, rank = 256, 32, 8, 16
+    else:
+        _cpu_smoke_shrink(cfg, max_position=1024)
+        prefill, gen, slots, rank = 24, 6, 4, 4
+    max_len = prefill + gen
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg["vocab_size"], (prefill,))
+               .astype("int32") for _ in range(2 * n_adapters)]
+    # request i runs fine-tune (i % 8) + 1 — every adapter appears in
+    # the mixed batch, and the round-robin keeps the dedicated split
+    # balanced
+    want_adapter = [(i % n_adapters) + 1 for i in range(len(prompts))]
+
+    def make_model(bank_rows):
+        pt.seed(0)  # identical base weights across every sub-leg
+        m = TransformerLM(**cfg, dropout=0.0)
+        lora.attach_lora(m, n_adapters=bank_rows, rank=rank)
+        return m
+
+    def weight_hbm_bytes(model) -> int:
+        total = 0
+        for p in model.parameters():
+            v = getattr(p, "_value", None)
+            if v is not None:
+                total += int(np.prod(v.shape)) * v.dtype.itemsize
+        return total
+
+    def run(engine, idx, adapters):
+        """Time requests ``idx`` (adapter per ``adapters``) through a
+        warmed engine; returns (statuses, wall, compile/cost deltas)."""
+        engine.submit(rng.randint(0, cfg["vocab_size"],
+                                  (prefill,)).astype("int32"), 2)
+        while engine.pump(8):
+            pass
+        compiles0 = sum(engine.compile_counts().values())
+        cost0 = engine._pool.cost_version()
+        t0 = time.perf_counter()
+        streams = [engine.submit(prompts[i], gen, adapter=adapters[i],
+                                 request_id="r%d" % i) for i in idx]
+        while engine.pump(16):
+            pass
+        wall = time.perf_counter() - t0
+        statuses = [s.result(timeout_s=0) for s in streams]
+        compiled = sum(engine.compile_counts().values()) - compiles0
+        return statuses, wall, compiled, \
+            engine._pool.cost_version() != cost0
+
+    def leg(engine, statuses, wall, n_served, compiled, cost_moved):
+        stats = engine.cache_stats()
+        return {
+            "cache_layout": stats["cache_layout"],
+            "cache_dtype": stats["cache_dtype"],
+            "requests": len(statuses),
+            "adapters": n_served,
+            "tokens_per_sec": round(
+                sum(st.new_tokens for st in statuses) / wall, 1),
+            "wall_s": round(wall, 4),
+            "compiles_during_traffic": compiled,
+            "cost_version_changed": bool(cost_moved),
+        }
+
+    out = {
+        "adapters": n_adapters,
+        "rank": rank,
+        "prefill": prefill,
+        "generated": gen,
+        "slots": slots,
+        "input_staged": False,
+        "transfer_note": (
+            "prompt upload rides inside the prefill term identically "
+            "on every sub-leg; adapter weights are loaded OUTSIDE the "
+            "timed region (the hot-load stamp times nothing — it "
+            "counts compiles), so the timed traffic differs only in "
+            "the per-slot adapter ids riding the batch"),
+    }
+    all_idx = list(range(len(prompts)))
+
+    # -- shared engine: one base copy + the stacked bank -----------------
+    model = make_model(n_adapters + 1)
+    fresh = {i: lora.random_adapter(model, seed=i)
+             for i in range(1, n_adapters + 1)}
+    engine = ServingEngine(model, max_len=max_len, slots=slots,
+                           buckets=[prefill], max_queue=4 * len(prompts))
+    for i in range(1, n_adapters + 1):
+        engine.load_adapter(i, fresh[i])
+    # base-only traffic through the SAME bank-attached engine: the
+    # 1-adapter reading on the one-engine deployment
+    statuses, wall, compiled, moved = run(
+        engine, all_idx, [0] * len(prompts))
+    out["adapters_1"] = dict(
+        leg(engine, statuses, wall, 1, compiled, moved),
+        weight_hbm_bytes=weight_hbm_bytes(model),
+        adapter_bank_bytes=lora.adapter_bank_bytes(model))
+    # all 8 fine-tunes mixed in one batch
+    statuses, wall, compiled, moved = run(engine, all_idx, want_adapter)
+    shared_bytes = weight_hbm_bytes(model)
+    out["shared_8"] = dict(
+        leg(engine, statuses, wall, n_adapters, compiled, moved),
+        weight_hbm_bytes=shared_bytes,
+        adapter_bank_bytes=lora.adapter_bank_bytes(model))
+    shared_tokens = {st.request_id: np.asarray(st.tokens)
+                     for st in statuses}
+    # hot-load: overwrite a bank row on the LIVE engine — a device
+    # write, never a compile (the refresh_weights-style contract)
+    compiles0 = sum(engine.compile_counts().values())
+    cost0 = engine._pool.cost_version()
+    engine.load_adapter(1, lora.random_adapter(model, seed=101))
+    st = engine.submit(prompts[0], 2, adapter=1)
+    while engine.pump(8):
+        pass
+    st.result(timeout_s=0)
+    out["hot_load_compiles"] = \
+        sum(engine.compile_counts().values()) - compiles0
+    out["hot_load_cost_version_changed"] = \
+        engine._pool.cost_version() != cost0
+    engine.shutdown(drain=False)
+
+    # -- dedicated fleet: 8 engines, one fine-tune each ------------------
+    tokens_lost = 0
+    ded_bytes = 0
+    ded_tokens = 0
+    ded_wall = 0.0
+    ded_compiled = 0
+    ded_moved = False
+    for a in range(1, n_adapters + 1):
+        m = make_model(2)  # identity row + this engine's one fine-tune
+        # the SAME weights the shared bank serves for this fine-tune
+        # (random_adapter is keyed by shapes + seed, both identical)
+        lora.load_adapter(m, 1, lora.random_adapter(m, seed=a))
+        eng = ServingEngine(m, max_len=max_len, slots=slots,
+                            buckets=[prefill],
+                            max_queue=4 * len(prompts))
+        idx = [i for i in all_idx if want_adapter[i] == a]
+        statuses, wall, compiled, moved = run(
+            eng, idx, {i: 1 for i in idx})
+        ded_bytes += weight_hbm_bytes(m)
+        ded_tokens += sum(st.new_tokens for st in statuses)
+        ded_wall += wall
+        ded_compiled += compiled
+        ded_moved = ded_moved or moved
+        for st in statuses:
+            ref = shared_tokens[st.request_id]
+            got = np.asarray(st.tokens)
+            tokens_lost += max(0, len(ref) - len(got)) + int(
+                (got[:len(ref)] != ref[:len(got)]).sum())
+        last_stats = eng.cache_stats()
+        eng.shutdown(drain=False)
+    out["dedicated_8"] = {
+        "cache_layout": last_stats["cache_layout"],
+        "cache_dtype": last_stats["cache_dtype"],
+        "engines": n_adapters,
+        "requests": len(prompts),
+        "adapters": n_adapters,
+        "tokens_per_sec": round(ded_tokens / ded_wall, 1),
+        "wall_s": round(ded_wall, 4),
+        "compiles_during_traffic": ded_compiled,
+        "cost_version_changed": bool(ded_moved),
+        "weight_hbm_bytes": ded_bytes,
+    }
+    out["weight_bytes_saved"] = ded_bytes - shared_bytes
+    out["weight_bytes_ratio"] = round(shared_bytes / ded_bytes, 4)
+    out["tokens_lost"] = tokens_lost
+    out["tokens_per_sec"] = out["shared_8"]["tokens_per_sec"]
+    return out
+
+
 def _probe_accelerator(timeout_s: int = 180) -> bool:
     """Check from a THROWAWAY subprocess that the accelerator runtime
     answers; a wedged tunnel (the axon transport can hang for hours) must
@@ -2439,6 +2640,7 @@ def _leg_promotable(name: str, leg: dict):
                         "serving_sharded": "tokens_per_sec",
                         "serving_disagg": "ttft_p95_s",
                         "serving_fleet": "tokens_per_sec",
+                        "serving_lora": "tokens_per_sec",
                         "speculative": "tokens_per_sec"}
     if name in cache_stamp_keys:
         # a decode/serving/speculative number without its cache-layout
@@ -2671,6 +2873,45 @@ def _leg_promotable(name: str, leg: dict):
                                "prefix_affinity_hit_rate: cannot tell "
                                "an affinity-routed fleet from N "
                                "independent caches")
+        if name == "serving_lora":
+            # the multi-LoRA headline IS the shared-bank-vs-dedicated
+            # comparison under the as-data contract: a timed sub-leg
+            # that cannot say how many adapters it served compared
+            # nothing; a sub-leg that compiled during traffic (or
+            # whose cost fingerprint moved) broke the exactly-two
+            # contract the leg exists to demonstrate; a lossy record
+            # broke the bank's byte-identity contract; and a hot-load
+            # that compiled measured refresh_weights-by-retrace, not
+            # a hot swap
+            unadapted = sorted(
+                k for k, v in timed.items()
+                if not isinstance(v.get("adapters"), (int, float))
+                or isinstance(v.get("adapters"), bool))
+            if unadapted:
+                return False, ("serving_lora leg missing the numeric "
+                               "adapters stamp on %s: a multi-LoRA "
+                               "number that cannot say how many "
+                               "fine-tunes it mixed claims nothing"
+                               % (unadapted,))
+            recompiled = sorted(
+                k for k, v in timed.items()
+                if v.get("compiles_during_traffic", 1) != 0
+                or v.get("cost_version_changed", True))
+            if recompiled:
+                return False, ("serving_lora leg compiled (or moved "
+                               "cost_version) during traffic on %s: "
+                               "adapter ids and sampling are traced "
+                               "data — the exactly-two contract allows "
+                               "ZERO new executables" % (recompiled,))
+            if leg.get("tokens_lost", 1) != 0:
+                return False, ("serving_lora leg lost tokens vs the "
+                               "dedicated single-adapter engines: the "
+                               "stacked bank moves the delta math, "
+                               "never the tokens")
+            if leg.get("hot_load_compiles", 1) != 0:
+                return False, ("serving_lora leg's load_adapter "
+                               "compiled: a hot swap is a bank-row "
+                               "device write, never a retrace")
         if name == "serving":
             # the §5g tracing contract is that the flight recorder is
             # effectively free on the tick path; a serving number whose
@@ -2853,6 +3094,7 @@ def _measure_and_print():
                      ("serving_sharded", bench_serving_sharded),
                      ("serving_disagg", bench_serving_disagg),
                      ("serving_fleet", bench_serving_fleet),
+                     ("serving_lora", bench_serving_lora),
                      ("speculative", bench_speculative)):
         try:
             legs[name] = fn(pt, jax, on_tpu)

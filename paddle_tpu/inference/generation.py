@@ -99,7 +99,9 @@ from ..core.errors import (AlreadyExistsError, InvalidArgumentError,
                            NotFoundError, PreconditionNotMetError)
 from ..jit import aot
 from ..jit.cache import get_layout
-from ..jit.decode import DecodeSession, classify_finish
+from ..jit.decode import (DecodeSession, check_sampling, classify_finish,
+                          make_sampling_state, sample_logits_data)
+from ..nn import lora as _lora_mod
 from ..jit.mesh import DecodeMesh
 
 __all__ = ["GenerationPool", "kv_reachable_bytes",
@@ -209,29 +211,48 @@ def kv_reachable_bytes(tokens, max_len: int, num_layers: int,
     return sum(min(-(-t // bs) * bs, int(max_len))
                for t in toks) * per_token
 
+# per-request sampling config, resolved at the submit edge and carried
+# as DATA through the whole request lifecycle — slot, spill file,
+# journal record, PTKV migration header — so a preempted/migrated
+# sampled request resumes under ITS OWN config (docs §5q).  ``seed`` is
+# always a resolved int: row streams are fold_in(PRNGKey(seed), step)
+# with step = tokens already sampled, a pure function of the request.
+# ``draws`` is the stream offset at THIS submission — 0 for a fresh
+# request; a resubmission of prompt+committed passes the committed
+# count, so the re-prefill's draw lands at exactly the step the
+# original continuation would have used and the stream never restarts.
+_SamplingConfig = collections.namedtuple(
+    "_SamplingConfig", ["temperature", "top_k", "top_p", "seed",
+                        "draws"], defaults=(0,))
+
 # scheduling metadata rides every queued request: ``priority`` (higher
 # admits first), ``tenant`` (fairness-cap key), ``deadline`` (a number
 # on the caller's clock — the serving engine passes its absolute
 # deadline; the pool only ever compares it, None sorting last),
-# ``seq`` (arrival order, the FIFO tie-break)
+# ``seq`` (arrival order, the FIFO tie-break); ``sampling`` is the
+# resolved _SamplingConfig and ``adapter`` the request's LoRA bank row
 _Request = collections.namedtuple(
     "_Request", ["rid", "ids", "max_new_tokens", "priority", "tenant",
-                 "deadline", "seq"],
-    defaults=(0, None, None, 0))
+                 "deadline", "seq", "sampling", "adapter"],
+    defaults=(0, None, None, 0, None, 0))
 
 
 class _SlotState:
     """One actively-decoding slot.  ``ids`` (the prompt) is retained so
     preemption can spill and resume without the serving layer's help:
     the cache index to restore is ``len(ids) + len(tokens) - 1``, and
-    the speculative pool's draft twin re-prefills from it."""
+    the speculative pool's draft twin re-prefills from it.
+    ``sampling``/``adapter`` are the request's as-data config; the
+    row's next draw counter is ``sampling.draws + len(tokens)`` (the
+    prefill draw was step ``draws``), so no separate step mirror is
+    kept."""
 
     __slots__ = ("rid", "ids", "tokens", "remaining", "priority",
-                 "tenant", "deadline", "seq")
+                 "tenant", "deadline", "seq", "sampling", "adapter")
 
     def __init__(self, rid, ids, tokens, remaining: int,
                  priority: int = 0, tenant=None, deadline=None,
-                 seq: int = 0):
+                 seq: int = 0, sampling=None, adapter: int = 0):
         self.rid = rid
         self.ids = ids
         self.tokens = tokens
@@ -240,6 +261,8 @@ class _SlotState:
         self.tenant = tenant
         self.deadline = deadline
         self.seq = seq
+        self.sampling = sampling
+        self.adapter = adapter
 
 
 class _PrefillState:
@@ -252,12 +275,13 @@ class _PrefillState:
     is shareable while its first owner is still prefilling the tail."""
 
     __slots__ = ("rid", "ids", "pos", "max_new_tokens", "indexed",
-                 "chain_key", "priority", "tenant", "deadline", "seq")
+                 "chain_key", "priority", "tenant", "deadline", "seq",
+                 "sampling", "adapter")
 
     def __init__(self, rid, ids, pos: int, max_new_tokens: int,
                  matched_blocks: int = 0, chain_key=None,
                  priority: int = 0, tenant=None, deadline=None,
-                 seq: int = 0):
+                 seq: int = 0, sampling=None, adapter: int = 0):
         self.rid = rid
         self.ids = ids
         self.pos = pos
@@ -270,6 +294,8 @@ class _PrefillState:
         self.tenant = tenant
         self.deadline = deadline
         self.seq = seq
+        self.sampling = sampling
+        self.adapter = adapter
 
 
 class _SpillState:
@@ -289,7 +315,7 @@ class _SpillState:
     __slots__ = ("rid", "ids", "tokens", "remaining", "priority",
                  "tenant", "deadline", "seq", "total_blocks", "written",
                  "dev_blocks", "host", "host_bytes", "preempts", "shard",
-                 "host_path")
+                 "host_path", "sampling", "adapter")
 
     def __init__(self, st: "_SlotState", total_blocks: int,
                  written: int, host, host_bytes: int, shard: int = 0):
@@ -301,6 +327,11 @@ class _SpillState:
         self.tenant = st.tenant
         self.deadline = st.deadline
         self.seq = st.seq
+        # the as-data config rides the spill (docs §5q): resume — local
+        # or on a SECOND engine via the PTKV transfer file — continues
+        # the victim's own sampling stream byte-identically
+        self.sampling = st.sampling
+        self.adapter = st.adapter
         self.total_blocks = total_blocks
         self.written = written
         self.dev_blocks = [None] * written
@@ -451,6 +482,10 @@ class GenerationPool:
         self._cache_dtype = cache_dtype
         from ..jit.speculative import model_vocab_size
         self._vocab = model_vocab_size(model)
+        # LoRA bank GEOMETRY (nn.lora; (n_adapters, rank) or None):
+        # shapes are compiled into the executables and fingerprinted;
+        # bank CONTENTS are hot-swappable weights (load_adapter)
+        self._lora_cfg = _lora_mod.lora_config(model)
         self.slots = int(slots)
         self.max_len = int(max_len)
         self.eos_id = eos_id
@@ -585,11 +620,14 @@ class GenerationPool:
         # on_admit (same synchronous call chain): matched prefix tokens
         # of the LAST admission, None when sharing is off
         self.last_admit_prefix_tokens: Optional[int] = None
-        self._key = jax.random.PRNGKey(seed)
-        # retained for config_fingerprint(): the checkpoint header must
-        # name the sampling config (incl. the seed behind the key) so a
-        # restoring engine can refuse a journal it could not replay
-        # byte-identically (docs §5m)
+        # sampling is PER-REQUEST DATA (docs §5q): the constructor's
+        # temperature/top_k/top_p are only the DEFAULTS submit() applies
+        # when a request names none, and ``seed`` seeds the default
+        # per-request stream assignment (request seed = seed + seq).
+        # Nothing here is compiled in, so the config fingerprint no
+        # longer carries any of it — a journal/transfer peer with
+        # different defaults replays byte-identically, because every
+        # record carries its own resolved config.
         self._sampling_seed = int(seed)
         self._queue: collections.deque = collections.deque()
         self._active: Dict[int, _SlotState] = {}
@@ -682,6 +720,13 @@ class GenerationPool:
         # dirty for a one-off re-upload
         self._tok_dev = None
         self._active_dev = None
+        # per-slot as-data vectors (docs §5q): sampling config + adapter
+        # ids re-uploaded only on membership changes; the per-row draw
+        # counter (_step_dev) feeds back on-device from the decode step
+        # (inactive rows frozen), exactly like the token vector
+        self._samp_dev = None
+        self._step_dev = None
+        self._adapter_dev = None
         self._membership_dirty = True
         self._results: Dict[object, np.ndarray] = {}
         self._finish_reasons: Dict[object, str] = {}
@@ -718,10 +763,18 @@ class GenerationPool:
         return self._layout.insert_row(pool_cache, row_cache, slot,
                                        length, blocks)
 
-    def _pool_decode(self, param_vals, buf_vals, cache, toks, active, key):
+    def _pool_decode(self, param_vals, buf_vals, cache, toks, active,
+                     samp, step, adapter):
         """One batched decode step over every slot; inactive slots are
         frozen (their cache index does not advance, their token output is
         forced to 0) so a free slot can never creep past max_len.
+
+        ``samp`` (the (temperature, top_k, top_p, seed) [slots] vectors),
+        ``step`` (per-row draw counters) and ``adapter`` (per-row LoRA
+        ids) are DATA riding the step (docs §5q): every slot samples
+        under its own config and gathers its own adapter rows inside the
+        ONE compiled executable.  ``step`` advances only for active rows
+        and is returned to feed back on-device.
 
         Paged: an inactive slot's table row is zeroed FOR THE STEP so
         its (discarded) write lands in the scratch block — its old blocks
@@ -736,8 +789,11 @@ class GenerationPool:
             tables = [c.table for c in cache]
             cache = self._masked_tables(cache, active)
         logits, new_cache = sess._run_model(param_vals, buf_vals,
-                                            toks[:, None], cache)
-        tok, key = sess._sample(logits[:, 0], key)
+                                            toks[:, None], cache,
+                                            adapter)
+        temp, tk, tp, seed = samp
+        tok = sample_logits_data(logits[:, 0], temp, tk, tp, seed, step)
+        step = step + active.astype(step.dtype)
         # layout-owned freeze (jit.cache): positional layouts merge the
         # index; the recurrent layout must also restore inactive slots'
         # state carry (a recurrence updates every row every step)
@@ -745,7 +801,7 @@ class GenerationPool:
         if tables is not None:
             new_cache = [c._replace(table=t)
                          for c, t in zip(new_cache, tables)]
-        return new_cache, jnp.where(active, tok, 0), key
+        return new_cache, jnp.where(active, tok, 0), step
 
     def _masked_tables(self, cache, active):
         """Inactive slots' table rows routed to their OWN shard's
@@ -771,13 +827,17 @@ class GenerationPool:
                 for c in cache]
 
     def _prefill_chunk(self, param_vals, buf_vals, cache, toks, slot,
-                       start, length, key):
+                       start, length, samp, adapter):
         """One fixed-shape prompt chunk for ONE slot: run ``toks`` (a
         ``[C]`` vector holding ``length`` real tokens, zero-padded at
         the back to the fixed C) from
         absolute position ``start`` through the slot's table row, and
         sample the token at offset ``length - 1`` (only the final
-        chunk's sample — the request's FIRST token — is ever used).
+        chunk's sample — the request's FIRST token — is ever used;
+        ``samp`` is the request's (temperature, top_k, top_p, seed,
+        step) [1] vectors with step fixed at the submission's stream
+        offset, so intermediate chunks' discarded samples cost nothing
+        and the kept one matches the bucketed path exactly).
 
         The forward is a batch-1 view over the GLOBAL block pools: the
         slot's table row is sliced out, so writes scatter into the same
@@ -794,20 +854,89 @@ class GenerationPool:
                 c.table, (slot, 0), (1, c.table.shape[1])),
             index=jnp.full((1,), start, jnp.int32)) for c in cache]
         logits, new_views = sess._run_model(param_vals, buf_vals,
-                                            toks[None], views)
+                                            toks[None], views, adapter)
         last = jax.lax.dynamic_index_in_dim(logits[0], length - 1,
                                             axis=0, keepdims=False)
-        tok, key = sess._sample(last[None], key)
+        temp, tk, tp, seed, step = samp
+        tok = sample_logits_data(last[None], temp, tk, tp, seed, step)
         out = [c._replace(k=v.k, v=v.v, k_scale=v.k_scale,
                           v_scale=v.v_scale,
                           index=c.index.at[slot].set(
                               jnp.asarray(start + length, jnp.int32)))
                for c, v in zip(cache, new_views)]
-        return out, tok[0], key
+        return out, tok[0]
 
     # -- host API --------------------------------------------------------
+    def _resolve_sampling(self, temperature, top_k, top_p, seed) \
+            -> _SamplingConfig:
+        """Resolve a submit-edge sampling spec to a fully-concrete
+        ``_SamplingConfig``: None fields take the pool's constructor
+        defaults, and a None seed takes the deterministic per-request
+        default ``pool_seed + seq`` (distinct streams per request,
+        reproducible across runs).  The resolved record — never the
+        defaults — is what rides the slot, spill, journal and PTKV
+        header."""
+        sess = self._session
+        t = sess.temperature if temperature is None else float(temperature)
+        k = sess.top_k if top_k is None else int(top_k)
+        p = sess.top_p if top_p is None else float(top_p)
+        check_sampling(t, p)
+        if seed is None:
+            seed = self._sampling_seed + self._seq
+        return _SamplingConfig(t, k, p, int(seed) & 0xFFFFFFFF)
+
+    @staticmethod
+    def _resubmit_sampling(cfg: Optional[_SamplingConfig],
+                           committed: int) -> _SamplingConfig:
+        """The config a prompt+committed resubmission carries: same
+        temperature/top-k/top-p/seed, ``draws`` advanced by the tokens
+        already committed — the re-prefill's draw then lands at exactly
+        the stream step the original continuation would have used, so
+        even the degraded resubmit path stays byte-identical for
+        SAMPLED requests, not just greedy ones."""
+        if cfg is None:
+            cfg = _SamplingConfig(0.0, 0, 1.0, 0)
+        return cfg._replace(draws=cfg.draws + int(committed))
+
+    def _check_adapter(self, adapter) -> int:
+        """Validate a submit-edge adapter id against the attached bank
+        geometry (id 0 — the base model — is always valid, bank or
+        not)."""
+        adapter = int(adapter)
+        if adapter == 0:
+            return 0
+        if self._lora_cfg is None:
+            raise InvalidArgumentError(
+                "adapter=%d but the model has no LoRA bank attached: "
+                "call nn.lora.attach_lora(model, n_adapters, rank) "
+                "BEFORE constructing the pool (the bank must be in the "
+                "parameter snapshot), then load_adapter" % adapter)
+        n, _ = self._lora_cfg
+        if not 0 <= adapter < n:
+            raise InvalidArgumentError(
+                "adapter id must be in [0, n_adapters=%d), got %d"
+                % (n, adapter))
+        return adapter
+
+    @staticmethod
+    def _samp_vec(cfg: Optional[_SamplingConfig]):
+        """One resolved config as the (temperature, top_k, top_p, seed,
+        step) ``[1]`` device vectors the batch-1 chunk path consumes
+        (None -> greedy).  ``step`` is the config's ``draws`` offset —
+        a fresh request's prefill draw is stream step 0, a
+        resubmission's lands where the original stream left off."""
+        if cfg is None:
+            cfg = _SamplingConfig(0.0, 0, 1.0, 0)
+        return (jnp.asarray([cfg.temperature], jnp.float32),
+                jnp.asarray([cfg.top_k], jnp.int32),
+                jnp.asarray([cfg.top_p], jnp.float32),
+                jnp.asarray([cfg.seed & 0xFFFFFFFF], jnp.uint32),
+                jnp.asarray([cfg.draws], jnp.uint32))
+
     def submit(self, input_ids, max_new_tokens: int, request_id=None,
-               priority: int = 0, tenant=None, deadline=None):
+               priority: int = 0, tenant=None, deadline=None,
+               temperature=None, top_k=None, top_p=None, seed=None,
+               adapter: int = 0, _sampling=None):
         """Queue one prompt (1-D ids); returns the request id.
 
         ``priority`` (int, higher admits first), ``tenant`` (hashable
@@ -815,7 +944,13 @@ class GenerationPool:
         clock — the pool only compares it; earlier wins within a
         priority class, and None sorts last as infinitely lax) are
         SCHEDULING metadata consumed by ``_refill``'s candidate
-        selection; all default to the strict-FIFO behavior."""
+        selection; all default to the strict-FIFO behavior.
+
+        ``temperature``/``top_k``/``top_p``/``seed`` are THIS request's
+        sampling config (None -> the pool's constructor defaults; the
+        resolved values ride the batched step as per-slot data, so any
+        mix shares the one executable — docs §5q).  ``adapter`` picks
+        the request's LoRA bank row (0 = base model)."""
         if deadline is not None and (isinstance(deadline, bool)
                                      or not isinstance(deadline,
                                                        (int, float))):
@@ -896,9 +1031,16 @@ class GenerationPool:
             self._next_rid += 1
         self._used_rids.add(rid)
         self._seq += 1
+        # _sampling is the internal resubmission seam: an already-
+        # resolved config (with its non-zero ``draws`` stream offset)
+        # passes through verbatim so a resubmitted prompt+committed
+        # continues its original sampling stream byte-identically
+        samp = _sampling if _sampling is not None else \
+            self._resolve_sampling(temperature, top_k, top_p, seed)
         self._queue.append(_Request(rid, ids.astype(np.int32),
                                     int(max_new_tokens), int(priority),
-                                    tenant, deadline, self._seq))
+                                    tenant, deadline, self._seq, samp,
+                                    self._check_adapter(adapter)))
         return rid
 
     # -- mesh / shard mapping (docs §5k) ---------------------------------
@@ -1370,7 +1512,9 @@ class GenerationPool:
                     [sp.ids, np.asarray(sp.tokens, np.int32)])
                 self.submit(ids, sp.remaining, request_id=sp.rid,
                             priority=sp.priority, tenant=sp.tenant,
-                            deadline=sp.deadline)
+                            deadline=sp.deadline, adapter=sp.adapter,
+                            _sampling=self._resubmit_sampling(
+                                sp.sampling, len(sp.tokens)))
                 return
         # any free slot works: the carry has no shard-resident blocks
         # pinning it (state rows shard over dp, but an upload into any
@@ -1385,7 +1529,8 @@ class GenerationPool:
             for layer, c in enumerate(self._cache)]
         state = _SlotState(sp.rid, sp.ids, sp.tokens, sp.remaining,
                            priority=sp.priority, tenant=sp.tenant,
-                           deadline=sp.deadline, seq=sp.seq)
+                           deadline=sp.deadline, seq=sp.seq,
+                           sampling=sp.sampling, adapter=sp.adapter)
         self._active[slot] = state
         self._last_tok[slot] = sp.tokens[-1]
         self._membership_dirty = True
@@ -1435,7 +1580,9 @@ class GenerationPool:
                     [sp.ids, np.asarray(sp.tokens, np.int32)])
                 self.submit(ids, sp.remaining, request_id=sp.rid,
                             priority=sp.priority, tenant=sp.tenant,
-                            deadline=sp.deadline)
+                            deadline=sp.deadline, adapter=sp.adapter,
+                            _sampling=self._resubmit_sampling(
+                                sp.sampling, len(sp.tokens)))
                 return
         slot = self._pop_free_slot(sp.shard)
         blocks: List[int] = []
@@ -1488,7 +1635,8 @@ class GenerationPool:
         self._cache = new_cache
         state = _SlotState(sp.rid, sp.ids, sp.tokens, sp.remaining,
                            priority=sp.priority, tenant=sp.tenant,
-                           deadline=sp.deadline, seq=sp.seq)
+                           deadline=sp.deadline, seq=sp.seq,
+                           sampling=sp.sampling, adapter=sp.adapter)
         self._active[slot] = state
         self._last_tok[slot] = sp.tokens[-1]
         self._membership_dirty = True
@@ -1573,11 +1721,21 @@ class GenerationPool:
                 # blocks prefix (written == 0 by convention there)
                 arrays["l%d_f%d" % (i, j)] = (arr if recurrent
                                               else arr[:written])
+        cfg = st.sampling if st.sampling is not None \
+            else _SamplingConfig(0.0, 0, 1.0, 0)
         meta = {"rid": str(st.rid), "prompt_len": int(len(st.ids)),
                 "committed": len(st.tokens), "written": int(written),
                 "cache_layout": self.cache_layout,
                 "layers": len(host), "fields": len(host[0]),
-                "cache_dtype": self._layout.cache_dtype_str(self._cache)}
+                "cache_dtype": self._layout.cache_dtype_str(self._cache),
+                # the as-data config rides the transfer header (docs
+                # §5q): the adopting engine resumes the victim under
+                # ITS OWN sampling stream and adapter, not the peer's
+                # defaults
+                "sampling": [float(cfg.temperature), int(cfg.top_k),
+                             float(cfg.top_p), int(cfg.seed),
+                             int(cfg.draws)],
+                "adapter": int(st.adapter)}
         if recurrent:
             meta["d_state"] = int(self._cache[0].state.shape[-1])
         else:
@@ -1750,11 +1908,24 @@ class GenerationPool:
             self._adopt_guard(ids, tokens)
         except Exception:  # noqa: BLE001 - subclass veto -> resubmit
             return False
+        # the victim's as-data config from the transfer header: resume
+        # continues ITS stream (seed, draws+committed) and ITS adapter.
+        # An adapter this pool's bank cannot address (no bank, or id out
+        # of range) falls back — the fleet hot-loads before retrying
+        msamp = meta.get("sampling")
+        sampling = None if msamp is None else _SamplingConfig(
+            float(msamp[0]), int(msamp[1]), float(msamp[2]),
+            int(msamp[3]), int(msamp[4]) if len(msamp) > 4 else 0)
+        try:
+            adapter = self._check_adapter(meta.get("adapter", 0))
+        except InvalidArgumentError:
+            return False
         self._seq += 1
         st = _SlotState(request_id, ids, tokens,
                         int(max_new_tokens) - len(tokens),
                         priority=int(priority), tenant=tenant,
-                        deadline=deadline, seq=self._seq)
+                        deadline=deadline, seq=self._seq,
+                        sampling=sampling, adapter=adapter)
         # no device-resident copies to pin the shard: park where the
         # most blocks are free (dp == 1: shard 0, the common case;
         # recurrent carries need no blocks at all — any slot works)
@@ -1861,6 +2032,8 @@ class GenerationPool:
         self._release_blocks(slot)
         self._used_rids.discard(request_id)
         self._membership_dirty = True
+        cfg = st.sampling if st.sampling is not None \
+            else _SamplingConfig(0.0, 0, 1.0, 0)
         return {"rid": request_id, "path": path,
                 "transfer_bytes": int(transfer_bytes),
                 "blocks_written": int(written),
@@ -1868,22 +2041,38 @@ class GenerationPool:
                 "prompt_len": int(len(st.ids)),
                 "max_new_tokens": len(st.tokens) + st.remaining,
                 "priority": st.priority, "tenant": st.tenant,
-                "deadline": st.deadline}
+                "deadline": st.deadline,
+                "sampling": [float(cfg.temperature), int(cfg.top_k),
+                             float(cfg.top_p), int(cfg.seed),
+                             int(cfg.draws)],
+                "adapter": int(st.adapter)}
 
     def config_fingerprint(self) -> dict:
         """The JSON-stable identity of everything byte-identical replay
-        depends on: the sampling config (temperature/top-k/top-p and
-        the seed behind the PRNG key), the cache layout/dtype/geometry,
-        and the mesh shape.  Written into every journal's header;
+        depends on: the cache layout/dtype/geometry, the mesh shape,
+        and — since sampling became per-request data (docs §5q) — the
+        SAMPLING DISCIPLINE marker plus the LoRA bank geometry, never
+        the config values themselves.  The engine-global
+        temperature/top_k/top_p/sampling_seed fields of the v1
+        fingerprint are GONE: every journal record / spill meta carries
+        its request's own resolved config, so two engines with
+        different defaults replay each other's journals byte-
+        identically.  Written into every journal's header;
         ``ServingEngine.restore`` refuses a journal whose fingerprint
-        differs, naming both sides (docs §5m)."""
-        sess = self._session
+        differs, naming both sides (docs §5m) — with a one-shot upgrade
+        triage for v1 journals whose ONLY difference is the dropped
+        sampling fields."""
         fp = {
             "pool_type": type(self).__name__,
-            "temperature": sess.temperature,
-            "top_k": sess.top_k,
-            "top_p": sess.top_p,
-            "sampling_seed": self._sampling_seed,
+            # the discipline marker: a v1 peer (config-global sampling
+            # baked into the executable) can never exchange journals or
+            # K/V with a per-request pool, whatever its config said
+            "sampling": "per-request",
+            # bank GEOMETRY is compiled (shapes); contents are
+            # hot-swappable rows and stay out on purpose
+            "lora": (None if self._lora_cfg is None
+                     else {"n_adapters": int(self._lora_cfg[0]),
+                           "rank": int(self._lora_cfg[1])}),
             "eos_id": None if self.eos_id is None else int(self.eos_id),
             "max_len": self.max_len,
             "slots": self.slots,
@@ -1972,7 +2161,8 @@ class GenerationPool:
 
     def _activate(self, slot: int, rid, ids, first: int,
                   max_new_tokens: int, priority: int = 0, tenant=None,
-                  deadline=None, seq: int = 0) -> None:
+                  deadline=None, seq: int = 0, sampling=None,
+                  adapter: int = 0) -> None:
         """Promote a slot to decoding: its prompt is fully resident and
         ``first`` (the token sampled at the last prompt position) is
         committed.  One code path for both prefill modes, so the hook
@@ -1980,7 +2170,8 @@ class GenerationPool:
         ``on_token``) cannot diverge between them."""
         self._active[slot] = _SlotState(
             rid, ids, [first], max_new_tokens - 1, priority=priority,
-            tenant=tenant, deadline=deadline, seq=seq)
+            tenant=tenant, deadline=deadline, seq=seq,
+            sampling=sampling, adapter=adapter)
         self._last_tok[slot] = first
         self._membership_dirty = True
         finishes = max_new_tokens - 1 == 0 or \
@@ -2128,7 +2319,8 @@ class GenerationPool:
             req.rid, req.ids, matched_len, req.max_new_tokens,
             matched_blocks=len(matched_blocks), chain_key=chain_key,
             priority=req.priority, tenant=req.tenant,
-            deadline=req.deadline, seq=req.seq)
+            deadline=req.deadline, seq=req.seq, sampling=req.sampling,
+            adapter=req.adapter)
         if self.prefix_sharing:
             self._prefix_queries += 1
             if matched_len:
@@ -2312,14 +2504,23 @@ class GenerationPool:
             # runs BEFORE the slot is popped so a prefill failure can
             # never leak a slot
             _fire("pool.prefill")
+            # the request's resolved config rides the batch-1 prefill as
+            # a [1] SamplingState (prefill draw = stream step 0); the
+            # advanced state it returns is discarded — the slot's draw
+            # counter is derived from len(tokens) at membership sync
+            samp = make_sampling_state(
+                1, temperature=req.sampling.temperature,
+                top_k=req.sampling.top_k, top_p=req.sampling.top_p,
+                seed=req.sampling.seed, step=req.sampling.draws,
+                adapter=req.adapter)
             if tr is None:
-                row_cache, tok, self._key = self._session.prefill(
-                    req.ids[None], self._key)
+                row_cache, tok, _ = self._session.prefill(
+                    req.ids[None], samp)
             else:
                 with tr.span("tick.prefill", rid=req.rid,
                              prompt_tokens=len(req.ids)):
-                    row_cache, tok, self._key = self._session.prefill(
-                        req.ids[None], self._key)
+                    row_cache, tok, _ = self._session.prefill(
+                        req.ids[None], samp)
                     if tr.deep:
                         # deep-timing honesty: the prefill span ends at
                         # the fusion boundary, not at dispatch return
@@ -2351,7 +2552,8 @@ class GenerationPool:
             self._activate(slot, req.rid, req.ids, first,
                            req.max_new_tokens, priority=req.priority,
                            tenant=req.tenant, deadline=req.deadline,
-                           seq=req.seq)
+                           seq=req.seq, sampling=req.sampling,
+                           adapter=req.adapter)
 
     def _chunk_work(self, tr) -> None:
         """At most ``prefill_chunk_tokens`` of prompt work this tick:
@@ -2370,20 +2572,25 @@ class GenerationPool:
             self._state_cache = self._session._state_vals()
         params, bufs = self._state_cache
         _fire("pool.prefill")
+        # the request's resolved config as [1] vectors; every chunk
+        # passes the same (seed, step 0) stream, so only the FINAL
+        # chunk's kept sample matters and it matches the bucketed path
+        samp = self._samp_vec(st.sampling)
+        adpt = jnp.asarray([st.adapter], jnp.int32)
         if tr is None:
-            self._cache, tok_dev, self._key = self._chunk_jit(
+            self._cache, tok_dev = self._chunk_jit(
                 params, bufs, self._cache, jnp.asarray(toks),
                 jnp.asarray(slot, jnp.int32),
                 jnp.asarray(st.pos, jnp.int32),
-                jnp.asarray(n, jnp.int32), self._key)
+                jnp.asarray(n, jnp.int32), samp, adpt)
         else:
             with tr.span("tick.prefill", rid=st.rid, chunk_tokens=n,
                          pos=st.pos, prompt_tokens=len(st.ids)):
-                self._cache, tok_dev, self._key = self._chunk_jit(
+                self._cache, tok_dev = self._chunk_jit(
                     params, bufs, self._cache, jnp.asarray(toks),
                     jnp.asarray(slot, jnp.int32),
                     jnp.asarray(st.pos, jnp.int32),
-                    jnp.asarray(n, jnp.int32), self._key)
+                    jnp.asarray(n, jnp.int32), samp, adpt)
                 if tr.deep:
                     # deep-timing honesty: close the chunk span at the
                     # device edge, not at dispatch return
@@ -2405,25 +2612,59 @@ class GenerationPool:
         first = int(np.asarray(tok_dev))
         self._activate(slot, st.rid, st.ids, first, st.max_new_tokens,
                        priority=st.priority, tenant=st.tenant,
-                       deadline=st.deadline, seq=st.seq)
+                       deadline=st.deadline, seq=st.seq,
+                       sampling=st.sampling, adapter=st.adapter)
 
     def _sync_step_inputs(self):
         """The shared pre-step protocol (also the speculative pool's):
         rebuild the device-resident token/active vectors when slot
         membership changed, and lazily cache the weight value lists.
-        Returns ``(params, bufs)``."""
+        Returns ``(params, bufs)``.
+
+        The per-slot AS-DATA vectors (docs §5q) rebuild on the same
+        dirty flag: the sampling config stack ``_samp_dev`` =
+        (temperature, top_k, top_p, seed), the draw counter
+        ``_step_dev`` and the adapter ids ``_adapter_dev``.  A free
+        slot's row is greedy/base (temp 0, adapter 0) — its output is
+        discarded anyway, and greedy is the cheapest row.  The draw
+        counter needs no separate host mirror: a slot's next draw index
+        IS ``cfg.draws + len(st.tokens)`` (the submission's stream
+        offset plus the tokens committed since — the prefill draw was
+        step ``draws``), so the rebuild here and the on-device feedback
+        in ``_dispatch`` agree by construction."""
         if self._membership_dirty:
             active = np.zeros(self.slots, bool)
             active[list(self._active)] = True
+            temp = np.zeros(self.slots, np.float32)
+            tk = np.zeros(self.slots, np.int32)
+            tp = np.ones(self.slots, np.float32)
+            seed = np.zeros(self.slots, np.uint32)
+            step = np.zeros(self.slots, np.uint32)
+            adpt = np.zeros(self.slots, np.int32)
+            for slot, st in self._active.items():
+                cfg = st.sampling
+                draws = 0
+                if cfg is not None:
+                    temp[slot] = cfg.temperature
+                    tk[slot] = cfg.top_k
+                    tp[slot] = cfg.top_p
+                    seed[slot] = cfg.seed & 0xFFFFFFFF
+                    draws = cfg.draws
+                step[slot] = draws + len(st.tokens)
+                adpt[slot] = st.adapter
             if self._mesh is not None:
                 # commit the step vectors to their dp sharding up
                 # front: uncommitted inputs would let the compiled
                 # executable pick (and pay a reshard per call)
-                self._tok_dev = self._mesh.place(self._last_tok, "dp")
-                self._active_dev = self._mesh.place(active, "dp")
+                place = lambda a: self._mesh.place(a, "dp")
             else:
-                self._tok_dev = jnp.asarray(self._last_tok)
-                self._active_dev = jnp.asarray(active)
+                place = jnp.asarray
+            self._tok_dev = place(self._last_tok)
+            self._active_dev = place(active)
+            self._samp_dev = (place(temp), place(tk), place(tp),
+                              place(seed))
+            self._step_dev = place(step)
+            self._adapter_dev = place(adpt)
             self._membership_dirty = False
         if self._state_cache is None:
             self._state_cache = self._session._state_vals()
@@ -2481,10 +2722,11 @@ class GenerationPool:
 
     def _dispatch(self, params, bufs):
         """The one batched decode dispatch (cache donated and rebound in
-        the same statement)."""
-        self._cache, tok_dev, self._key = self._decode_jit(
+        the same statement).  The draw counter feeds back on-device like
+        the token vector — active rows advanced inside the step."""
+        self._cache, tok_dev, self._step_dev = self._decode_jit(
             params, bufs, self._cache, self._tok_dev, self._active_dev,
-            self._key)
+            self._samp_dev, self._step_dev, self._adapter_dev)
         return tok_dev
 
     def _deliver(self, tok) -> None:
@@ -2508,6 +2750,42 @@ class GenerationPool:
         _fire("weights.refresh")
         self._state_cache = None
 
+    # -- multi-LoRA hot-swap (nn.lora; docs §5q) -------------------------
+    @property
+    def lora_config(self):
+        """``(n_adapters, rank)`` of the attached bank, or None."""
+        return self._lora_cfg
+
+    def load_adapter(self, idx: int, weights) -> None:
+        """Write one adapter's weights into bank row ``idx`` and make
+        the next tick serve it — a row-granular weight push: shapes are
+        unchanged, so zero new compiles and an unchanged
+        ``cost_version()`` (the hot-swap contract tests pin)."""
+        _lora_mod.load_adapter(self._session._model, idx, weights)
+        self.refresh_weights()
+
+    def unload_adapter(self, idx: int) -> None:
+        """Zero bank row ``idx`` back to the identity.  Refuses while
+        any live request (queued, prefilling, active, parked or
+        spilled) is pinned to it — an in-flight request would silently
+        continue under the BASE model mid-stream."""
+        cfg = self._lora_cfg
+        if cfg is not None:
+            idx_i = int(idx)
+            live = [st.adapter for st in self._active.values()]
+            live += [st.adapter for st in self._prefilling.values()]
+            live += [sp.adapter for sp in self._spilled.values()]
+            live += [st.adapter for _, st in self._prefill_done.values()]
+            live += [rq.adapter for rq in self._queue]
+            if idx_i in live:
+                raise PreconditionNotMetError(
+                    "adapter %d still has live requests pinned to it; "
+                    "drain or cancel them before unloading — an "
+                    "in-flight request would silently fall back to the "
+                    "base model mid-stream" % idx_i)
+        _lora_mod.unload_adapter(self._session._model, idx)
+        self.refresh_weights()
+
     def reset(self):
         """Discard every request and all cache/allocator state — queue,
         slots, results, paged free list, the K/V arrays themselves —
@@ -2527,6 +2805,13 @@ class GenerationPool:
         self._last_tok = np.zeros(self.slots, np.int32)
         self._tok_dev = None
         self._active_dev = None
+        # per-slot as-data vectors (docs §5q): sampling config + adapter
+        # ids re-uploaded only on membership changes; the per-row draw
+        # counter (_step_dev) feeds back on-device from the decode step
+        # (inactive rows frozen), exactly like the token vector
+        self._samp_dev = None
+        self._step_dev = None
+        self._adapter_dev = None
         self._membership_dirty = True
         self._results.clear()
         self._finish_reasons.clear()

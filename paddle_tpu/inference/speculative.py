@@ -234,13 +234,19 @@ class SpeculativePool(GenerationPool):
                     jnp.asarray(length, jnp.int32))))
         return out
 
-    def _pool_verify(self, param_vals, buf_vals, cache, chunk, active):
+    def _pool_verify(self, param_vals, buf_vals, cache, chunk, active,
+                     adapter):
         """One per-slot chunk forward of the target over every slot's
         ``[pending, d_1..d_K]``; acceptance, emission and the index
         rewind all happen IN-TRACE, so the acceptance length is data
-        and the step compiles exactly once.  Inactive slots are frozen:
-        paged table rows masked to scratch before the write (slot-churn
-        discipline), emitted tokens zeroed, index unchanged."""
+        and the step compiles exactly once.  ``adapter`` is the pool's
+        per-slot LoRA id vector (docs §5q): the target judges every
+        row under ITS adapter inside the one executable — the draft
+        proposes from the base model, which only costs acceptance rate,
+        never correctness (emission is always the target's own argmax).
+        Inactive slots are frozen: paged table rows masked to scratch
+        before the write (slot-churn discipline), emitted tokens
+        zeroed, index unchanged."""
         sess = self._session
         idx0 = cache[0].index                                # [slots]
         tables = None
@@ -253,7 +259,7 @@ class SpeculativePool(GenerationPool):
             tables = [c.table for c in cache]
             cache = self._masked_tables(cache, active)
         logits, new_cache = sess._run_model(param_vals, buf_vals, chunk,
-                                            cache)
+                                            cache, adapter)
         m, emitted = greedy_accept(logits, chunk, active)    # [S], [S,K+1]
         new_idx = jnp.where(active, idx0 + m + 1, idx0)
         new_cache = [c._replace(index=new_idx) for c in new_cache]
@@ -275,15 +281,28 @@ class SpeculativePool(GenerationPool):
         continues from).  Fires for BOTH prefill modes — the bucketed
         one-shot path and the chunked path's final chunk — because the
         base pool funnels every activation through ``_activate``."""
-        row_cache, _tok, self._key = self._draft_session.prefill(
-            ids[None], self._key)
+        row_cache, _tok, _ = self._draft_session.prefill(
+            ids[None], self._draft_session.sampling_state(1, seed=0))
         self._draft_cache = self._draft_insert_jit(
             self._draft_cache, row_cache,
             jnp.asarray(slot, jnp.int32),
             jnp.asarray(len(ids), jnp.int32))
 
     def submit(self, input_ids, max_new_tokens: int, request_id=None,
-               priority: int = 0, tenant=None, deadline=None):
+               priority: int = 0, tenant=None, deadline=None,
+               temperature=None, top_k=None, top_p=None, seed=None,
+               adapter: int = 0, _sampling=None):
+        req_t = _sampling.temperature if _sampling is not None \
+            else temperature
+        if req_t is not None and float(req_t) != 0.0:
+            # greedy acceptance emits the target's argmax; honouring a
+            # sampled request here would need the rejection-sampling
+            # acceptance rule to preserve the target distribution
+            raise InvalidArgumentError(
+                "speculative decoding is greedy-only (temperature=0); "
+                "got per-request temperature=%r — submit sampled "
+                "requests to a plain GenerationPool/ServingEngine"
+                % (req_t,))
         ids = np.asarray(getattr(input_ids, "value", input_ids))
         if self._chunk_tokens is not None and ids.ndim == 1 and ids.size:
             # the TARGET needs no bucket under chunked prefill, but the
@@ -292,7 +311,10 @@ class SpeculativePool(GenerationPool):
             self._draft_session._bucket_for(ids.shape[0])
         return super().submit(input_ids, max_new_tokens,
                               request_id=request_id, priority=priority,
-                              tenant=tenant, deadline=deadline)
+                              tenant=tenant, deadline=deadline,
+                              temperature=temperature, top_k=top_k,
+                              top_p=top_p, seed=seed, adapter=adapter,
+                              _sampling=_sampling)
 
     def set_spec_k(self, k: int) -> None:
         """Change the RUNTIME draft count per round, within the
@@ -358,8 +380,8 @@ class SpeculativePool(GenerationPool):
         byte-identity guaranteed by the target side alone."""
         ids = sp.ids if len(sp.tokens) <= 1 else np.concatenate(
             [sp.ids, np.asarray(sp.tokens[:-1], np.int32)])
-        row_cache, _tok, self._key = self._draft_session.prefill(
-            ids[None], self._key)
+        row_cache, _tok, _ = self._draft_session.prefill(
+            ids[None], self._draft_session.sampling_state(1, seed=0))
         self._draft_cache = self._draft_insert_jit(
             self._draft_cache, row_cache,
             jnp.asarray(slot, jnp.int32),
@@ -443,7 +465,8 @@ class SpeculativePool(GenerationPool):
             t1 = time.perf_counter()
             self._draft_time_s += t1 - t0
         self._cache, emitted_dev, m_dev, pending_dev = self._verify_jit(
-            params, bufs, self._cache, chunk, self._active_dev)
+            params, bufs, self._cache, chunk, self._active_dev,
+            self._adapter_dev)
         if self._time_split:
             jax.block_until_ready(m_dev)
             self._verify_time_s += time.perf_counter() - t1

@@ -18,8 +18,15 @@ fused step, generation compiles exactly TWO functions —
   host round-trip beyond the sampled token ids.
 
 Sampling (greedy / temperature / top-k / top-p) runs INSIDE the compiled
-step under ``jax.random`` keys threaded through the call chain, so a
-128-token generation is 1 prefill dispatch + 127 decode dispatches.
+step with its config as per-row DATA (``SamplingState``: traced ``[B]``
+vectors for temperature/top-k/top-p/seed plus the per-row draw counter),
+so a 128-token generation is 1 prefill dispatch + 127 decode dispatches
+and a batch may mix greedy and arbitrarily-sampled rows — changing a
+request's sampling config never retraces anything.  Row r's stream is
+``fold_in(PRNGKey(seed[r]), step[r])``: a pure function of the request's
+own (seed, draw index), independent of slot position or batch
+composition, which is what makes preempted/migrated sampled requests
+resume byte-identically.
 
 Reference parity: the reference serves generation through external
 inference engines; here the engine is native because the jaxpr is the
@@ -30,7 +37,7 @@ runtime-managed allocator.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence
+from typing import List, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -42,9 +49,10 @@ from ..framework.tensor import Tensor
 from ..nn.layer.layers import Layer
 from . import aot
 
-__all__ = ["DecodeSession", "sample_logits", "default_buckets",
-           "FINISH_EOS", "FINISH_LENGTH", "classify_finish",
-           "truncate_at_eos"]
+__all__ = ["DecodeSession", "sample_logits", "sample_logits_data",
+           "SamplingState", "make_sampling_state", "check_sampling",
+           "default_buckets", "FINISH_EOS", "FINISH_LENGTH",
+           "classify_finish", "truncate_at_eos"]
 
 # The decode layer's finish-reason vocabulary: a generation ends either
 # because the model emitted the EOS id or because the max_new_tokens
@@ -129,6 +137,116 @@ def sample_logits(logits, key, temperature: float = 0.0, top_k: int = 0,
         logits = jnp.where(logits.astype(jnp.float32) < kept_min, neg,
                            logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+class SamplingState(NamedTuple):
+    """Per-row decode-time request state, as DATA (docs/DESIGN.md §5q).
+
+    Every field is a traced ``[B]`` vector riding the compiled step as an
+    ordinary argument — NEVER a Python constant baked into the trace —
+    so one executable serves any mix of greedy and sampled rows and any
+    mix of LoRA adapters with zero retraces:
+
+    - ``temperature`` f32 (0 = greedy argmax for that row),
+    - ``top_k`` i32 (<= 0 or >= vocab keeps the whole vocab),
+    - ``top_p`` f32 (1 keeps everything),
+    - ``seed``/``step`` u32: row r draws with
+      ``fold_in(PRNGKey(seed[r]), step[r])`` where ``step`` counts the
+      row's own draws — the stream is a pure function of the REQUEST's
+      (seed, draw index), independent of slot position or batch
+      composition, so preemption/migration resumes byte-identically,
+    - ``adapter`` i32: the row's LoRA adapter id (``nn.lora``; 0 is the
+      reserved identity row — the base model).
+    """
+
+    temperature: jax.Array
+    top_k: jax.Array
+    top_p: jax.Array
+    seed: jax.Array
+    step: jax.Array
+    adapter: jax.Array
+
+
+def check_sampling(temperature, top_p) -> None:
+    """Typed admission-edge validation shared by the session constructor
+    and the pool/engine per-request ``submit`` params (same message, so
+    a bad config fails identically whichever edge it enters through)."""
+    if float(temperature) < 0.0 or not 0.0 < float(top_p) <= 1.0:
+        raise InvalidArgumentError(
+            "sampling config: temperature must be >= 0 and top_p in "
+            "(0, 1]; got temperature=%r top_p=%r" % (temperature, top_p))
+
+
+def make_sampling_state(batch: int, temperature=0.0, top_k=0, top_p=1.0,
+                        seed=None, step=0, adapter=0) -> SamplingState:
+    """Host-side constructor of a ``[batch]`` :class:`SamplingState`.
+
+    Scalar args broadcast to every row; array args pass through
+    unchanged.  A scalar ``seed`` gives row r the stream ``seed + r``
+    (distinct per row, reproducible across runs); ``seed=None`` draws a
+    fresh base seed from the global key chain."""
+    def vec(x, dtype):
+        a = np.asarray(x, dtype)
+        return jnp.asarray(np.broadcast_to(a, (batch,)) if a.ndim == 0
+                           else a)
+
+    if seed is None:
+        seed = int(jax.random.randint(next_key(), (), 0,
+                                      np.int32(2 ** 31 - 1)))
+    s = np.asarray(seed, np.uint32)
+    if s.ndim == 0:
+        s = s + np.arange(batch, dtype=np.uint32)
+    return SamplingState(vec(temperature, np.float32),
+                         vec(top_k, np.int32), vec(top_p, np.float32),
+                         jnp.asarray(s), vec(step, np.uint32),
+                         vec(adapter, np.int32))
+
+
+def sample_logits_data(logits, temperature, top_k, top_p, seed, step):
+    """Sample token ids [B] from logits [B, V] with the config as per-row
+    traced DATA (the vectors of :class:`SamplingState`) — the as-data
+    twin of :func:`sample_logits`, branch-free so every row of one
+    compiled step can carry a different config.
+
+    Row semantics match the scalar sampler: ``temperature == 0`` is
+    greedy argmax (seed unused); otherwise temperature scaling, top-k
+    truncation (``top_k <= 0`` or ``>= V`` keeps all; ties at the k-th
+    value keep both), then nucleus truncation (tokens whose EXCLUSIVE
+    prefix mass under the sorted distribution already reaches ``top_p``
+    are dropped; ``top_p == 1`` keeps all), then a categorical draw
+    under ``fold_in(PRNGKey(seed[r]), step[r])``.  ONE descending sort
+    serves both truncations — the masks are arithmetic over it, never a
+    Python branch, so the trace is config-independent."""
+    v = logits.shape[-1]
+    lf = logits.astype(jnp.float32)
+    temp = jnp.asarray(temperature, jnp.float32)
+    tk = jnp.asarray(top_k, jnp.int32)
+    tp = jnp.asarray(top_p, jnp.float32)
+    neg = jnp.float32(jnp.finfo(jnp.float32).min)
+    # temperature 0 rows scale by 1 (their draw is discarded for argmax)
+    safe_t = jnp.where(temp > 0, temp, jnp.float32(1.0))
+    scaled = lf / safe_t[:, None]
+    sorted_desc = jnp.sort(scaled, axis=-1)[..., ::-1]
+    # top-k: the row's k-th largest value is the keep threshold
+    kk = jnp.clip(tk, 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (kk - 1)[:, None], axis=-1)
+    apply_k = ((tk > 0) & (tk < v))[:, None]
+    keep = jnp.where(apply_k, scaled >= kth, True)
+    # top-p: smallest set covering top_p mass (exclusive-prefix cut);
+    # rows with top_p == 1 never cut, so kept_min is the row minimum
+    probs = jax.nn.softmax(sorted_desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cut = (cum - probs) >= tp[:, None]
+    kept_min = jnp.min(jnp.where(cut, jnp.inf, sorted_desc), axis=-1,
+                       keepdims=True)
+    keep = keep & (scaled >= kept_min)
+    masked = jnp.where(keep, scaled, neg)
+    keys = jax.vmap(
+        lambda s, t: jax.random.fold_in(jax.random.PRNGKey(s), t))(
+            jnp.asarray(seed, jnp.uint32), jnp.asarray(step, jnp.uint32))
+    drawn = jax.vmap(jax.random.categorical)(keys, masked)
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temp == 0, greedy, drawn).astype(jnp.int32)
 
 
 def default_buckets(max_len: int, lo: int = 64) -> List[int]:
@@ -224,15 +342,15 @@ class DecodeSession:
         if not self.buckets:
             raise InvalidArgumentError(
                 "no prefill bucket <= max_len=%d (got %r)" % (max_len, bks))
+        # session-level DEFAULTS only (docs §5q): the traced bodies never
+        # read these — sampling config rides each call as SamplingState
+        # vectors, so per-request overrides (the pool's submit params)
+        # share the same two executables
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self.top_p = float(top_p)
-        if self.temperature < 0.0 or not 0.0 < self.top_p <= 1.0:
-            # fail at construction, not at first trace
-            raise InvalidArgumentError(
-                "sampling config: temperature must be >= 0 and top_p in "
-                "(0, 1]; got temperature=%r top_p=%r"
-                % (temperature, top_p))
+        # fail at construction, not at first trace
+        check_sampling(temperature, top_p)
         from ..nn.layer.transformer import normalize_cache_dtype
 
         # fail at construction with the supported set named, not as a
@@ -306,14 +424,21 @@ class DecodeSession:
                 "kv_cache_bytes": aot.kv_arg_bytes(cache)})
 
     # -- traced bodies ---------------------------------------------------
-    def _run_model(self, param_vals, buf_vals, ids, cache):
+    def _run_model(self, param_vals, buf_vals, ids, cache, adapter=None):
         """One cached forward with the session's weights swapped in.
 
         Decode is ALWAYS inference: the training flag is forced off for
         the duration of the trace (and restored after), so a session
         owned by a training loop neither samples with dropout nor — the
         nastier failure — silently flips the shared model to eval mode
-        as a constructor side effect."""
+        as a constructor side effect.
+
+        ``adapter`` (a traced [B] id vector, or None for base-only)
+        becomes the ambient per-row LoRA selection for the forward
+        (``nn.lora.adapter_ids``): every bank-attached Linear under the
+        stack gathers its delta rows by it — models without a bank
+        no-op, so the draft model of a speculative pair needs nothing."""
+        from ..nn.lora import adapter_ids
         from ..ops.flash_attention import decode_route
 
         binding = self._binding
@@ -326,7 +451,7 @@ class DecodeSession:
             # decode-attention call under the layer stack (this
             # session's steps AND the pool/speculative bodies that call
             # _run_model) routes by it without a kwarg through forward
-            with decode_route(self.route):
+            with decode_route(self.route), adapter_ids(adapter):
                 logits, new_cache = self._model(
                     Tensor(ids, stop_gradient=True), cache=cache)
             raw = logits.value if isinstance(logits, Tensor) else logits
@@ -336,14 +461,16 @@ class DecodeSession:
             binding.swap_out(saved)
         return raw, new_cache
 
-    def _sample(self, logits, key):
-        key, sub = jax.random.split(key)
-        tok = sample_logits(logits, sub, self.temperature, self.top_k,
-                            self.top_p)
-        return tok, key
+    def _sample(self, logits, samp: SamplingState):
+        """One per-row draw under the as-data config; advances each
+        row's draw counter (the traced bodies never read the session's
+        scalar defaults — that would bake them into the executable)."""
+        tok = sample_logits_data(logits, samp.temperature, samp.top_k,
+                                 samp.top_p, samp.seed, samp.step)
+        return tok, samp._replace(step=samp.step + jnp.uint32(1))
 
-    def _prefill(self, param_vals, buf_vals, ids, true_len, key):
-        """(cache, first_token, key') from a bucket-padded prompt.
+    def _prefill(self, param_vals, buf_vals, ids, true_len, samp):
+        """(cache, first_token, samp') from a bucket-padded prompt.
 
         The cache is built INSIDE the trace (zeros fused away by XLA) and
         its index reset to ``true_len``: pad positions' K/V stay in the
@@ -359,20 +486,21 @@ class DecodeSession:
         # positional layouts; the recurrent layout narrows its update
         # window to the true length so pad positions are identity steps
         cache = self._layout.begin_prefill(cache, true_len)
-        logits, cache = self._run_model(param_vals, buf_vals, ids, cache)
+        logits, cache = self._run_model(param_vals, buf_vals, ids, cache,
+                                        samp.adapter)
         cache = self._layout.finalize_prefill(cache, true_len,
                                               self.max_len)
         last = jax.lax.dynamic_index_in_dim(logits, true_len - 1, axis=1,
                                             keepdims=False)  # [B, V]
-        tok, key = self._sample(last, key)
-        return cache, tok, key
+        tok, samp = self._sample(last, samp)
+        return cache, tok, samp
 
-    def _decode(self, param_vals, buf_vals, cache, tok, key):
+    def _decode(self, param_vals, buf_vals, cache, tok, samp):
         """One token in, one token out — the steady-state serving step."""
         logits, cache = self._run_model(param_vals, buf_vals,
-                                        tok[:, None], cache)
-        tok, key = self._sample(logits[:, 0], key)
-        return cache, tok, key
+                                        tok[:, None], cache, samp.adapter)
+        tok, samp = self._sample(logits[:, 0], samp)
+        return cache, tok, samp
 
     # -- host API --------------------------------------------------------
     def _bucket_for(self, length: int) -> int:
@@ -394,8 +522,24 @@ class DecodeSession:
         return ([p._value for p in self._binding.params],
                 [b._value for b in self._binding.buffers])
 
-    def prefill(self, input_ids, key=None):
-        """Run the bucketed prefill; (cache, first_token [B] np, key)."""
+    def sampling_state(self, batch: int, seed=None, temperature=None,
+                       top_k=None, top_p=None, adapter=0) -> SamplingState:
+        """A ``[batch]`` :class:`SamplingState` from the session's
+        defaults, any of them overridden per call — the host-side seam
+        the pool uses to give every request its own config over the
+        same executables."""
+        return make_sampling_state(
+            batch,
+            self.temperature if temperature is None else temperature,
+            self.top_k if top_k is None else top_k,
+            self.top_p if top_p is None else top_p,
+            seed=seed, adapter=adapter)
+
+    def prefill(self, input_ids, sampling: Optional[SamplingState] = None):
+        """Run the bucketed prefill; (cache, first_token [B] np, samp')
+        where ``samp'`` is the per-row sampling state advanced past the
+        prefill draw — thread it into ``_decode_jit`` exactly as the
+        returned cache."""
         ids = np.asarray(getattr(input_ids, "value", input_ids))
         if ids.ndim == 1:
             ids = ids[None]
@@ -408,21 +552,22 @@ class DecodeSession:
         bucket = self._bucket_for(t)
         padded = np.zeros((b, bucket), ids.dtype)
         padded[:, :t] = ids
-        key = next_key() if key is None else key
+        samp = self.sampling_state(b) if sampling is None else sampling
         params, bufs = self._state_vals()
-        cache, tok, key = self._prefill_jit(
+        cache, tok, samp = self._prefill_jit(
             params, bufs, jnp.asarray(padded), jnp.asarray(t, jnp.int32),
-            key)
-        return cache, tok, key
+            samp)
+        return cache, tok, samp
 
     def generate(self, input_ids, max_new_tokens: int, seed=None,
                  eos_id: Optional[int] = None):
         """Autoregressive generation; np.int32 [B, max_new_tokens].
 
         1 prefill dispatch + N-1 decode dispatches, zero recompilation
-        after the first call per bucket.  ``seed`` fixes the sampling key
-        (greedy ignores it); with ``eos_id``, rows past their EOS are
-        padded with it and the loop stops early once every row finished.
+        after the first call per bucket.  ``seed`` fixes the sampling
+        streams (row r draws under ``seed + r``; greedy ignores it);
+        with ``eos_id``, rows past their EOS are padded with it and the
+        loop stops early once every row finished.
         """
         ids = np.asarray(getattr(input_ids, "value", input_ids))
         if ids.ndim == 1:
@@ -435,8 +580,8 @@ class DecodeSession:
             raise InvalidArgumentError(
                 "prompt %d + max_new_tokens %d exceeds cache max_len %d"
                 % (t, max_new_tokens, self.max_len))
-        key = next_key() if seed is None else jax.random.PRNGKey(seed)
-        cache, tok, key = self.prefill(ids, key)
+        samp = self.sampling_state(ids.shape[0], seed=seed)
+        cache, tok, samp = self.prefill(ids, samp)
         params, bufs = self._state_vals()
         if eos_id is None:
             # dispatch the WHOLE loop before fetching anything: the token
@@ -447,8 +592,8 @@ class DecodeSession:
             # host-RTT over a thin transport)
             dev_toks = [tok]
             for _ in range(max_new_tokens - 1):
-                cache, tok, key = self._decode_jit(params, bufs, cache,
-                                                   tok, key)
+                cache, tok, samp = self._decode_jit(params, bufs, cache,
+                                                    tok, samp)
                 dev_toks.append(tok)
             return np.stack(jax.device_get(dev_toks),
                             axis=1).astype(np.int32)
@@ -459,8 +604,8 @@ class DecodeSession:
         for _ in range(max_new_tokens - 1):
             if bool(done.all()):
                 break
-            cache, tok, key = self._decode_jit(params, bufs, cache, tok,
-                                               key)
+            cache, tok, samp = self._decode_jit(params, bufs, cache, tok,
+                                                samp)
             # rows already past their EOS emit eos_id, not the model's
             # continuation (the step still runs for unfinished rows)
             host_tok = np.where(done, eos_id,

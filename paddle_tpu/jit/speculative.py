@@ -46,7 +46,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.errors import InvalidArgumentError
-from ..core.random import next_key
 from . import aot
 from .decode import DecodeSession, truncate_at_eos
 
@@ -246,12 +245,18 @@ class SpeculativeDecodeSession:
                 "max_new_tokens %d + spec_k %d exceeds cache max_len %d;"
                 " raise max_len or lower max_new_tokens/spec_k"
                 % (k, t, max_new_tokens, k, self.max_len))
-        key = next_key() if seed is None else jax.random.PRNGKey(seed)
-        cache_t, tok, key = self._target.prefill(ids, key)
+        # greedy-only session: the as-data sampling states are all-zero
+        # temperature vectors (``seed`` is accepted for signature parity
+        # but greedy never draws), threaded through prefill/decode in
+        # the key position the compiled signatures expect
+        del seed
+        cache_t, tok, _samp_t = self._target.prefill(
+            ids, self._target.sampling_state(1, temperature=0.0))
         # the draft prefills the SAME prompt; its sampled token is
         # discarded — the target's first token is the ground truth the
         # draft must continue from
-        cache_d, _tok_d, key = self._draft.prefill(ids, key)
+        samp_d = self._draft.sampling_state(1, temperature=0.0)
+        cache_d, _tok_d, samp_d = self._draft.prefill(ids, samp_d)
         params_t, bufs_t = self._target._state_vals()
         params_d, bufs_d = self._draft._state_vals()
         toks = [int(np.asarray(tok)[0])]
@@ -262,8 +267,8 @@ class SpeculativeDecodeSession:
             d_toks = []
             tk = pending
             for _ in range(k):
-                cache_d, tk, key = self._draft._decode_jit(
-                    params_d, bufs_d, cache_d, tk, key)
+                cache_d, tk, samp_d = self._draft._decode_jit(
+                    params_d, bufs_d, cache_d, tk, samp_d)
                 d_toks.append(tk)
             chunk = jnp.concatenate(
                 [pending[:, None]] + [x[:, None] for x in d_toks],
@@ -282,8 +287,8 @@ class SpeculativeDecodeSession:
                 # (d_K was its pending output) — one catch-up step of
                 # the SAME compiled executable writes it; the sampled
                 # token is discarded
-                cache_d, _tk, key = self._draft._decode_jit(
-                    params_d, bufs_d, cache_d, d_toks[-1], key)
+                cache_d, _tk, samp_d = self._draft._decode_jit(
+                    params_d, bufs_d, cache_d, d_toks[-1], samp_d)
             else:
                 # rejection rewind: move the index pointer; the stale
                 # draft rows are overwritten before they could ever be

@@ -49,3 +49,5 @@ from .layer.transformer import (  # noqa: F401
     TransformerEncoder, TransformerEncoderLayer,
 )
 from .ssm import GatedSSMBlock, RecurrentDecodeCache, SSMLM  # noqa: F401
+from . import lora  # noqa: F401
+from .lora import attach_lora, load_adapter, unload_adapter  # noqa: F401

@@ -22,7 +22,20 @@ class Linear(Layer):
         self.bias = self.create_parameter([out_features], attr=bias_attr, is_bias=True)
 
     def forward(self, x):
-        return F.linear(x, self.weight, self.bias)
+        out = F.linear(x, self.weight, self.bias)
+        # batched multi-LoRA (nn.lora, docs/DESIGN.md §5q): when a bank
+        # is attached AND a decode body has set the ambient per-row
+        # adapter-id vector, add the gathered low-rank delta — id 0 rows
+        # (the reserved zero row) stay bit-identical to the base path
+        lora_a = self._parameters.get("lora_a")
+        if lora_a is not None:
+            from .. import lora as _lora
+
+            ids = _lora.current_adapter_ids()
+            if ids is not None:
+                out = _lora.apply_delta(out, x, lora_a,
+                                        self._parameters["lora_b"], ids)
+        return out
 
     def extra_repr(self):
         return "in_features=%d, out_features=%d" % (self.in_features, self.out_features)

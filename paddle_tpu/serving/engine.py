@@ -98,7 +98,8 @@ import numpy as np
 
 from ..core.errors import (InvalidArgumentError, NotFoundError,
                            PreconditionNotMetError, UnavailableError)
-from ..inference.generation import DuplicateRequestError, GenerationPool
+from ..inference.generation import (DuplicateRequestError, GenerationPool,
+                                    _SamplingConfig)
 from ..profiler import StepTimer
 from . import faults, trace
 from . import log as slog
@@ -124,6 +125,28 @@ def _jsonable_rid(rid):
     if isinstance(rid, np.integer):
         return int(rid)
     return rid
+
+
+def _samp_json(cfg):
+    """A resolved per-request sampling config as its journal/migration
+    wire form — the 5-list ``[temperature, top_k, top_p, seed, draws]``
+    (None passes through: a record written without per-request
+    sampling replays greedy)."""
+    if cfg is None:
+        return None
+    return [float(cfg.temperature), int(cfg.top_k), float(cfg.top_p),
+            int(cfg.seed), int(cfg.draws)]
+
+
+def _samp_from_json(val):
+    """Inverse of :func:`_samp_json`; tolerates the 4-list form (no
+    ``draws`` field) so wire records from the first per-request-sampling
+    writers replay with a zero stream offset."""
+    if val is None:
+        return None
+    return _SamplingConfig(
+        float(val[0]), int(val[1]), float(val[2]), int(val[3]),
+        int(val[4]) if len(val) > 4 else 0)
 
 
 def _normalize_priority(priority) -> int:
@@ -185,10 +208,11 @@ class _Record:
     __slots__ = ("rid", "stream", "state", "prompt", "prompt_len",
                  "max_new", "deadline_abs", "submit_t", "first_t",
                  "last_t", "tokens", "retries", "priority", "tenant",
-                 "preempts", "preempted_at")
+                 "preempts", "preempted_at", "sampling", "adapter")
 
     def __init__(self, rid, stream, prompt, max_new, deadline_abs,
-                 submit_t, priority=0, tenant=None):
+                 submit_t, priority=0, tenant=None, sampling=None,
+                 adapter=0):
         self.rid = rid
         self.stream = stream
         self.state = RequestState.QUEUED
@@ -205,6 +229,12 @@ class _Record:
         self.tenant = tenant
         self.preempts = 0
         self.preempted_at = None
+        # resolved per-request sampling config (None = greedy under the
+        # pool defaults) and LoRA adapter id — they ride the record so
+        # EVERY resubmit path (recovery, restore, migration) reproduces
+        # the request's own stream and adapter, never a pool global
+        self.sampling = sampling
+        self.adapter = adapter
 
 
 class ServingEngine:
@@ -637,7 +667,8 @@ class ServingEngine:
     # -- admission -------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int, request_id=None,
                deadline_s: Optional[float] = None, priority=0,
-               tenant=None) -> ResponseStream:
+               tenant=None, temperature=None, top_k=None, top_p=None,
+               seed=None, adapter: int = 0) -> ResponseStream:
         """Admit one request; returns its :class:`ResponseStream`.
 
         ``priority`` (an int, or a named class from
@@ -646,6 +677,14 @@ class ServingEngine:
         fairness-cap key when the pool was built with
         ``tenant_slot_cap=``) are scheduling metadata passed through to
         the pool's candidate selection (docs/DESIGN.md §5j).
+
+        ``temperature``/``top_k``/``top_p``/``seed`` are THIS request's
+        sampling config (docs §5q: sampling is per-request data, not
+        engine config; None fields take the pool's constructor
+        defaults) and ``adapter`` its LoRA adapter id (0 = base model).
+        The config is resolved ONCE here — seed included — and rides
+        the request record, so recovery, journal replay and migration
+        all continue the same sampled stream byte-identically.
 
         Fails fast: :class:`QueueFullError` past ``max_queue`` waiting
         requests (retryable), :class:`DeadlineUnattainableError` when
@@ -671,6 +710,13 @@ class ServingEngine:
                 raise PreconditionNotMetError(
                     "engine is draining/shut down: admissions are "
                     "stopped (drain()/shutdown() was called)")
+            # resolve the per-request sampling config and adapter id at
+            # the admission edge (typed errors for bad values belong to
+            # the submit call, not a later tick) — the resolved seed is
+            # what makes every downstream resubmit deterministic
+            samp = self._pool._resolve_sampling(temperature, top_k,
+                                                top_p, seed)
+            adapter = self._pool._check_adapter(adapter)
             if self._restoring:
                 # RESTORING defers admission, never drops it: the
                 # journal replay owns the pool right now, so the
@@ -725,7 +771,7 @@ class ServingEngine:
                      int(max_new_tokens),
                      (None if deadline_s is None
                       else self._clock() + float(deadline_s)),
-                     priority, tenant, stream))
+                     priority, tenant, samp, adapter, stream))
                 trace.instant("req.deferred", rid=request_id,
                               restoring=True)
                 return stream
@@ -783,11 +829,13 @@ class ServingEngine:
             rid = self._pool.submit(ids, max_new_tokens,
                                     request_id=request_id,
                                     priority=priority, tenant=tenant,
-                                    deadline=deadline_abs)
+                                    deadline=deadline_abs,
+                                    adapter=adapter, _sampling=samp)
             stream = ResponseStream(self, rid, int(max_new_tokens))
             self._live[rid] = _Record(
                 rid, stream, ids.astype(np.int32), int(max_new_tokens),
-                deadline_abs, now, priority=priority, tenant=tenant)
+                deadline_abs, now, priority=priority, tenant=tenant,
+                sampling=samp, adapter=adapter)
             if self._journal is not None:
                 # WAL discipline: the admission is durable BEFORE the
                 # request can commit a token.  A failed (retried)
@@ -796,7 +844,8 @@ class ServingEngine:
                 # journal could never replay.
                 try:
                     self._journal_admit(rid, ids, max_new_tokens,
-                                        deadline_s, priority, tenant)
+                                        deadline_s, priority, tenant,
+                                        sampling=samp, adapter=adapter)
                 except Exception as e:  # noqa: BLE001 - reject, typed
                     self._pool.cancel(rid)
                     self._live.pop(rid, None)
@@ -993,7 +1042,8 @@ class ServingEngine:
 
     def adopt_transfer(self, request_id, input_ids, tokens,
                        max_new_tokens: int, priority=0, tenant=None,
-                       deadline_abs=None) -> dict:
+                       deadline_abs=None, sampling=None,
+                       adapter: int = 0) -> dict:
         """Decode-role admission: adopt one handed-off request —
         ``input_ids`` + committed ``tokens`` are the journal-grade
         ground truth, the transfer file (if present and exact) is the
@@ -1015,11 +1065,12 @@ class ServingEngine:
                 "path (this engine's role is %r)" % (self.role,))
         return self._adopt_live(request_id, input_ids, tokens,
                                 max_new_tokens, priority, tenant,
-                                deadline_abs)
+                                deadline_abs, sampling, adapter)
 
     def adopt_migration(self, request_id, input_ids, tokens,
                         max_new_tokens: int, priority=0, tenant=None,
-                        deadline_abs=None) -> dict:
+                        deadline_abs=None, sampling=None,
+                        adapter: int = 0) -> dict:
         """Fleet live-migration admission (docs/DESIGN.md §5o): the
         same adoption mechanics as :meth:`adopt_transfer` — transfer
         file as the K/V fast path, prompt+committed resubmit as the
@@ -1034,15 +1085,20 @@ class ServingEngine:
                 "request: it has no decode step to finish it with")
         return self._adopt_live(request_id, input_ids, tokens,
                                 max_new_tokens, priority, tenant,
-                                deadline_abs)
+                                deadline_abs, sampling, adapter)
 
     def _adopt_live(self, request_id, input_ids, tokens,
                     max_new_tokens: int, priority=0, tenant=None,
-                    deadline_abs=None) -> dict:
+                    deadline_abs=None, sampling=None,
+                    adapter: int = 0) -> dict:
         """Shared adoption body behind :meth:`adopt_transfer` (tier
         hand-off) and :meth:`adopt_migration` (fleet migration): the
         role gates differ, the mechanics — journal WAL, ``adopt_spill``
-        fast path, resubmit fallback — must not."""
+        fast path, resubmit fallback — must not.  ``sampling`` is the
+        donor's wire 5-list (or an already-parsed config);
+        ``adapter`` must name a loaded bank row HERE — the typed
+        rejection fires before any state lands, so the fleet router can
+        hot-load the adapter and retry the adoption."""
         with self._lock:
             if self._draining:
                 raise PreconditionNotMetError(
@@ -1053,6 +1109,10 @@ class ServingEngine:
                     "request_id %r is already live on this engine"
                     % (request_id,))
             priority = _normalize_priority(priority)
+            if isinstance(sampling, (list, tuple)) \
+                    and not isinstance(sampling, _SamplingConfig):
+                sampling = _samp_from_json(sampling)
+            adapter = self._pool._check_adapter(adapter)
             ids = np.asarray(getattr(input_ids, "value",
                                      input_ids)).astype(np.int32)
             toks = [int(t) for t in tokens]
@@ -1061,7 +1121,8 @@ class ServingEngine:
                                     int(max_new_tokens))
             rec = _Record(request_id, stream, ids,
                           int(max_new_tokens), deadline_abs, now,
-                          priority=priority, tenant=tenant)
+                          priority=priority, tenant=tenant,
+                          sampling=sampling, adapter=adapter)
             rec.tokens = list(toks)
             if toks:
                 # the decode tier observes ITL only from here on: TTFT
@@ -1082,7 +1143,8 @@ class ServingEngine:
                         request_id, ids, max_new_tokens,
                         (None if deadline_abs is None
                          else max(0.001, deadline_abs - now)),
-                        priority, tenant)
+                        priority, tenant, sampling=sampling,
+                        adapter=adapter)
                     if toks:
                         self._jl_tick_toks.setdefault(
                             request_id, []).extend(toks)
@@ -1132,7 +1194,10 @@ class ServingEngine:
         with the tier-terminal the fleet front never surfaces) and
         returns the migration entry: ``{"rid", "prompt", "tokens",
         "max_new", "priority", "tenant", "deadline_abs", "retries",
-        "spill_path"}`` — everything ``adopt_migration`` needs."""
+        "sampling", "adapter", "spill_path"}`` — everything
+        ``adopt_migration`` needs (the sampling 5-list and adapter id
+        let the peer continue the request's own stream under its own
+        adapter, docs §5q)."""
         with self._lock:
             rec = self._live.get(request_id)
             if rec is None:
@@ -1167,6 +1232,8 @@ class ServingEngine:
                      "tenant": rec.tenant,
                      "deadline_abs": rec.deadline_abs,
                      "retries": rec.retries,
+                     "sampling": _samp_json(rec.sampling),
+                     "adapter": int(rec.adapter),
                      "spill_path": spill_path}
             trace.instant("sched.migrate_out", rid=rec.rid,
                           spilled=spill_path is not None,
@@ -1410,12 +1477,13 @@ class ServingEngine:
                     for i, entry in enumerate(self._deferred_submits):
                         if entry[0] == request_id:
                             (rid, ids, max_new, _dl, priority, tenant,
-                             stream) = entry
+                             samp, adapter, stream) = entry
                             del self._deferred_submits[i]
                             rec = _Record(rid, stream, ids, max_new,
                                           None, self._clock(),
                                           priority=priority,
-                                          tenant=tenant)
+                                          tenant=tenant, sampling=samp,
+                                          adapter=adapter)
                             self._c_cancelled.inc()
                             self._finalize(rec, RequestState.CANCELLED,
                                            "cancelled", [])
@@ -1466,7 +1534,14 @@ class ServingEngine:
                           request_id=rec.rid,
                           priority=rec.priority,
                           tenant=rec.tenant,
-                          deadline=rec.deadline_abs)
+                          deadline=rec.deadline_abs,
+                          adapter=rec.adapter,
+                          # draws advances by the committed count, so a
+                          # SAMPLED victim's re-prefill draw lands at
+                          # the step its uninterrupted continuation
+                          # would have used (docs §5q)
+                          _sampling=self._pool._resubmit_sampling(
+                              rec.sampling, len(rec.tokens)))
         rec.state = RequestState.QUEUED
         rec.preempted_at = None
 
@@ -1546,7 +1621,7 @@ class ServingEngine:
             % (request_id,))
 
     def _journal_admit(self, rid, ids, max_new, deadline_s, priority,
-                       tenant) -> None:
+                       tenant, sampling=None, adapter=0) -> None:
         """Make ONE admission durable — the WAL step shared by
         ``submit()`` and ``_admit_deferred`` so the two admission
         paths can never diverge.  Drains any backlog FIRST (journal
@@ -1575,6 +1650,11 @@ class ServingEngine:
                  "priority": int(priority), "tenant": tenant,
                  "deadline_s": (None if deadline_s is None
                                 else float(deadline_s)),
+                 # v2 fields (docs §5q): the request's RESOLVED
+                 # sampling config and adapter id — replay resumes the
+                 # same stream under the same adapter
+                 "sampling": _samp_json(sampling),
+                 "adapter": int(adapter),
                  # WALL clock (engine clocks may be injected and do
                  # not cross processes): restore deducts the elapsed
                  # time so a replayed deadline keeps its REMAINING
@@ -1699,6 +1779,8 @@ class ServingEngine:
                                    else max(0.001,
                                             rec.deadline_abs - now)),
                     "ts": time.time(),
+                    "sampling": _samp_json(rec.sampling),
+                    "adapter": int(rec.adapter),
                     "retries": rec.retries})
             ckpt = {"t": "checkpoint", "live": live}
             if self._journal is not None:
@@ -1753,10 +1835,13 @@ class ServingEngine:
             self._wake.set()
 
     def _admit_deferred(self, rid, ids, max_new, deadline_abs, priority,
-                        tenant, stream) -> None:
+                        tenant, samp, adapter, stream) -> None:
         """``deadline_abs`` was anchored at the original submit (the
         restore wait already counts against it — an exhausted budget
-        expires at the first tick, never gets served past its SLA)."""
+        expires at the first tick, never gets served past its SLA);
+        ``samp`` was RESOLVED there too, so the request's sampling
+        stream does not depend on how long the restore took or what
+        replayed meanwhile."""
         with self._lock:
             now = self._clock()
             try:
@@ -1774,11 +1859,14 @@ class ServingEngine:
                                         request_id=rid,
                                         priority=priority,
                                         tenant=tenant,
-                                        deadline=deadline_abs)
+                                        deadline=deadline_abs,
+                                        adapter=adapter,
+                                        _sampling=samp)
             except Exception as e:  # noqa: BLE001 - to the stream
                 rec = _Record(rid, stream, ids, int(max_new),
                               deadline_abs, now, priority=priority,
-                              tenant=tenant)
+                              tenant=tenant, sampling=samp,
+                              adapter=adapter)
                 self._c_failed.inc()
                 self._finalize(rec, RequestState.FAILED, "error", [],
                                error="deferred admission failed: %s: %s"
@@ -1789,7 +1877,8 @@ class ServingEngine:
             # before any token can flow
             stream.request_id = rid
             rec = _Record(rid, stream, ids, int(max_new), deadline_abs,
-                          now, priority=priority, tenant=tenant)
+                          now, priority=priority, tenant=tenant,
+                          sampling=samp, adapter=adapter)
             self._live[rid] = rec
             if self._journal is not None:
                 try:
@@ -1801,7 +1890,8 @@ class ServingEngine:
                         rid, ids, max_new,
                         (None if deadline_abs is None
                          else max(0.001, deadline_abs - now)),
-                        priority, tenant)
+                        priority, tenant, sampling=samp,
+                        adapter=adapter)
                 except Exception as e:  # noqa: BLE001 - to the stream
                     self._pool.cancel(rid)
                     self._live.pop(rid, None)
@@ -1815,6 +1905,67 @@ class ServingEngine:
             trace.instant("req.queued", rid=rid, deferred=True,
                           prompt_tokens=int(ids.shape[0]),
                           max_new_tokens=int(max_new))
+
+    @staticmethod
+    def _fingerprint_upgrade(fp: dict, mine: dict):
+        """v1→v2 journal upgrade triage (docs/DESIGN.md §5q).
+
+        A v1 header's fingerprint carries pool-GLOBAL sampling scalars
+        (``temperature``/``top_k``/``top_p``/``sampling_seed``) where a
+        v2 fingerprint carries the ``"sampling": "per-request"`` marker
+        plus the LoRA bank geometry.  When the two agree on EVERY other
+        field — and this engine serves the base model only (a v1 writer
+        cannot have journaled adapter ids) — the journal is adoptable:
+        every live entry replays through the prompt+committed resubmit
+        fallback with the old global config applied per-request.
+        Returns that config as a :class:`_SamplingConfig`, or None when
+        the journals genuinely disagree (the caller then raises the
+        normal mismatch error)."""
+        v1_keys = ("temperature", "top_k", "top_p", "sampling_seed")
+        if "sampling" in fp or not all(k in fp for k in v1_keys):
+            return None
+        if mine.get("sampling") != "per-request" \
+                or mine.get("lora") is not None:
+            return None
+        rest = {k: v for k, v in fp.items() if k not in v1_keys}
+        mine_rest = {k: v for k, v in mine.items()
+                     if k not in ("sampling", "lora")}
+        if rest != mine_rest:
+            return None
+        return _SamplingConfig(
+            float(fp["temperature"]), int(fp["top_k"]),
+            float(fp["top_p"]), int(fp["sampling_seed"]) & 0xFFFFFFFF)
+
+    # -- multi-LoRA adapter management (docs §5q) ------------------------
+    def load_adapter(self, idx: int, weights: dict) -> None:
+        """Hot-load adapter ``idx``'s low-rank weights into the pool's
+        stacked bank — an in-place device write under the engine lock,
+        never a recompile; in-flight requests on other adapter rows are
+        untouched (their ids index unchanged rows)."""
+        with self._lock:
+            self._pool.load_adapter(idx, weights)
+
+    def unload_adapter(self, idx: int) -> None:
+        """Zero adapter ``idx``'s bank row; refuses (typed) while any
+        live request is pinned to it."""
+        with self._lock:
+            self._pool.unload_adapter(idx)
+
+    def has_adapter(self, idx: int) -> bool:
+        """Whether ``idx`` is servable here: 0 (base) always; a
+        nonzero id needs an attached bank with that row.  The fleet
+        router keys adapter-aware placement off this."""
+        try:
+            self._pool._check_adapter(idx)
+        except InvalidArgumentError:
+            return False
+        return True
+
+    @property
+    def lora_config(self):
+        """The pool's attached bank geometry ``(n_adapters, rank)``,
+        or None (base model only)."""
+        return self._pool.lora_config
 
     def restore(self, path: str) -> dict:
         """Adopt the journal at ``path``: validate its fingerprint
@@ -1878,8 +2029,21 @@ class ServingEngine:
                         dropped_records=stats["records_dropped"],
                         dropped_bytes=stats["bytes_dropped"])
                 mine = self._pool.config_fingerprint()
+                legacy_samp = None
                 if fp != mine:
-                    raise FingerprintMismatchError(fp, mine)
+                    # v1→v2 upgrade triage (docs §5q): a v1 journal
+                    # that matches modulo the sampling fields replays
+                    # through the resubmit fallback with its old
+                    # GLOBAL config applied per-request; any other
+                    # mismatch still refuses, naming both sides
+                    legacy_samp = self._fingerprint_upgrade(fp, mine)
+                    if legacy_samp is None:
+                        raise FingerprintMismatchError(fp, mine)
+                    slog.emit("journal.upgrade", path=path,
+                              temperature=legacy_samp.temperature,
+                              top_k=legacy_samp.top_k,
+                              top_p=legacy_samp.top_p,
+                              seed=legacy_samp.seed)
                 live, counts = replay(records)
                 now = self._clock()
                 eos = self._pool.eos_id
@@ -1902,11 +2066,30 @@ class ServingEngine:
                             - max(0.0, time.time() - entry["ts"]))
                     deadline_abs = None if deadline_s is None \
                         else now + float(deadline_s)
+                    msamp = entry.get("sampling")
+                    if msamp is not None:
+                        samp = _samp_from_json(msamp)
+                    elif legacy_samp is not None:
+                        # v1 entry: the old pool-global config, with a
+                        # per-request seed offset so replayed sampled
+                        # streams stay distinct (v1's batch-positional
+                        # key chain is unrecoverable — the upgrade
+                        # contract is deterministic-going-forward via
+                        # the resubmit fallback, not byte-identity
+                        # with the crashed v1 engine)
+                        samp = legacy_samp._replace(
+                            seed=(legacy_samp.seed + replayed)
+                            & 0xFFFFFFFF)
+                    else:
+                        samp = None
                     stream = ResponseStream(self, rid, max_new)
                     rec = _Record(rid, stream, ids, max_new,
                                   deadline_abs, now,
                                   priority=entry["priority"],
-                                  tenant=entry["tenant"])
+                                  tenant=entry["tenant"],
+                                  sampling=samp,
+                                  adapter=int(entry.get("adapter")
+                                              or 0))
                     rec.retries = entry["retries"]
                     rec.tokens = list(toks)
                     # the committed history replays into the FRESH
@@ -1933,11 +2116,15 @@ class ServingEngine:
                                         else "length"), rec.tokens)
                         finished += 1
                         continue
-                    if self._pool.adopt_spill(
+                    if legacy_samp is None and self._pool.adopt_spill(
                             rid, ids, toks, max_new,
                             priority=entry["priority"],
                             tenant=entry["tenant"],
                             deadline=deadline_abs):
+                        # (v1 journals skip the spill fast path: their
+                        # spill files predate the per-request sampling
+                        # meta — the resubmit fallback IS the upgrade
+                        # path)
                         # the crashed engine's disk-spilled K/V are
                         # exact for this committed count: re-park the
                         # request — it resumes via page-in, skipping

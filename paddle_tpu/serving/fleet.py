@@ -112,10 +112,12 @@ class _FleetRecord:
     __slots__ = ("rid", "stream", "engine_id", "engine_stream",
                  "prompt", "prompt_len", "tokens", "max_new",
                  "deadline_abs", "submit_t", "first_t", "last_t",
-                 "priority", "tenant", "migrations")
+                 "priority", "tenant", "migrations", "sampling",
+                 "adapter")
 
     def __init__(self, rid, stream, engine_id, engine_stream, prompt,
-                 max_new, submit_t, priority, tenant, deadline_abs):
+                 max_new, submit_t, priority, tenant, deadline_abs,
+                 sampling=None, adapter=0):
         self.rid = rid
         self.stream = stream
         self.engine_id = engine_id
@@ -131,6 +133,12 @@ class _FleetRecord:
         self.priority = priority
         self.tenant = tenant
         self.migrations = 0
+        # the engine-resolved per-request sampling config and adapter
+        # id (docs §5q): the death-path re-adoption hands them to the
+        # adopter so a migrated request continues ITS stream under ITS
+        # adapter — the fleet record is the donor-independent copy
+        self.sampling = sampling
+        self.adapter = adapter
 
 
 class ServingFleet:
@@ -199,6 +207,12 @@ class ServingFleet:
         self._next_rid = 0
         self._handles: Dict[str, _EngineHandle] = {}
         self._records: Dict[object, _FleetRecord] = {}
+        # fleet-level adapter registry (docs §5q): {idx: weights}.
+        # register_adapter() hot-loads onto every active engine and
+        # every later spawn; the router only places adapter traffic on
+        # engines that hold (or can hot-load) the row, and migration
+        # hot-loads on the adopter before the hand-off
+        self._adapters: Dict[int, dict] = {}
 
         self.metrics = metrics if metrics is not None \
             else MetricsRegistry()
@@ -263,6 +277,11 @@ class ServingFleet:
                 "engine_factory must return a NOT-started engine: the "
                 "fleet pumps its engines itself (engine %r has a "
                 "background loop)" % (eid,))
+        for idx, weights in self._adapters.items():
+            # a replacement/scale-up engine serves the same adapter
+            # traffic as its peers from its first tick — an in-place
+            # bank write per adapter, never a recompile
+            engine.load_adapter(idx, weights)
         h = _EngineHandle(eid, engine, registry, self._ticks)
         self._handles[eid] = h
         self._as_ticks_since_change = 0
@@ -388,18 +407,75 @@ class ServingFleet:
         return {"engine_id": engine_id, "migrated": len(victims),
                 "adopted_from_file": from_file}
 
+    # -- multi-LoRA adapter registry (docs §5q) --------------------------
+    def register_adapter(self, idx: int, weights: dict) -> None:
+        """Register adapter ``idx`` fleet-wide: hot-load its weights
+        onto every active engine NOW (in-place bank writes — zero
+        recompiles, ``cost_version()`` unchanged) and onto every later
+        spawn, and keep the weights so migration can hot-load an
+        adopter that missed the broadcast.  Typed errors propagate from
+        the first engine that refuses (no attached bank, bad idx/key/
+        shape) — the registry only records a load the fleet proved."""
+        for h in self._active_handles():
+            if not h.engine.has_adapter(idx) \
+                    or idx not in self._adapters:
+                h.engine.load_adapter(idx, weights)
+        self._adapters[idx] = weights
+        trace.instant("fleet.adapter_load", adapter=int(idx),
+                      engines=len(self._active_handles()))
+        slog.emit("fleet.adapter_load", adapter=int(idx),
+                  engines=len(self._active_handles()))
+
+    def unregister_adapter(self, idx: int) -> None:
+        """Drop adapter ``idx`` fleet-wide: every engine's bank row is
+        zeroed (each refuses, typed, while a live request is pinned to
+        it) and the registry forgets the weights."""
+        for h in self._active_handles():
+            if h.engine.has_adapter(idx):
+                h.engine.unload_adapter(idx)
+        self._adapters.pop(int(idx), None)
+        slog.emit("fleet.adapter_unload", adapter=int(idx))
+
+    @property
+    def adapters(self) -> tuple:
+        """Registered adapter ids, ascending."""
+        return tuple(sorted(self._adapters))
+
+    def _ensure_adapter(self, h: _EngineHandle, adapter: int) -> bool:
+        """True when ``h`` can serve ``adapter`` — already holding the
+        row, or hot-loadable from the registry right now (the
+        migration/routing fallback the §5q contract names)."""
+        adapter = int(adapter)
+        if adapter == 0 or h.engine.has_adapter(adapter):
+            return True
+        weights = self._adapters.get(adapter)
+        if weights is None:
+            return False
+        try:
+            h.engine.load_adapter(adapter, weights)
+        except Exception:  # noqa: BLE001 - candidate disqualified
+            return False
+        trace.instant("fleet.adapter_hotload", adapter=adapter,
+                      engine=h.engine_id)
+        return True
+
     # -- migration mechanics ---------------------------------------------
     def _pick_adopter(self, rec: _FleetRecord
                       ) -> Optional[_EngineHandle]:
         """Choose the peer to move ``rec`` onto: affinity over the full
         resume point (prompt + committed tokens — the adopter
         re-prefills exactly that on the resubmit path), else least
-        loaded; never the current owner."""
+        loaded; never the current owner.  An adapter-pinned request
+        only lands where its bank row is servable — resident already,
+        or hot-loaded from the fleet registry at the pick."""
         ids = rec.prompt if not rec.tokens else np.concatenate(
             [rec.prompt, np.asarray(rec.tokens, np.int32)])
         ranked = self._ranked_candidates(ids,
                                          exclude={rec.engine_id})
-        return ranked[0][0] if ranked else None
+        for h, _reason, _matched in ranked:
+            if self._ensure_adapter(h, rec.adapter):
+                return h
+        return None
 
     def _migrate_record(self, rec: _FleetRecord,
                         target: Optional[_EngineHandle],
@@ -434,12 +510,23 @@ class ServingFleet:
                      "tokens": list(rec.tokens),
                      "max_new": rec.max_new,
                      "priority": rec.priority, "tenant": rec.tenant,
-                     "deadline_abs": rec.deadline_abs}
+                     "deadline_abs": rec.deadline_abs,
+                     "sampling": rec.sampling,
+                     "adapter": rec.adapter}
+        adapter = int(entry.get("adapter") or 0)
+        if adapter and not self._ensure_adapter(target, adapter):
+            raise PreconditionNotMetError(
+                "engine %r cannot serve adapter %d (no resident bank "
+                "row and no registry weights to hot-load) — the "
+                "migration of %r needs an adapter-capable adopter"
+                % (target.engine_id, adapter, rec.rid))
         res = target.engine.adopt_migration(
             entry["rid"], entry["prompt"], entry["tokens"],
             entry["max_new"], priority=entry["priority"],
             tenant=entry["tenant"],
-            deadline_abs=entry["deadline_abs"])
+            deadline_abs=entry["deadline_abs"],
+            sampling=entry.get("sampling"),
+            adapter=adapter)
         rec.engine_stream = res["stream"]
         rec.engine_id = target.engine_id
         rec.migrations += 1
@@ -529,7 +616,8 @@ class ServingFleet:
     # -- admission -------------------------------------------------------
     def submit(self, input_ids, max_new_tokens: int, request_id=None,
                deadline_s: Optional[float] = None, priority=0,
-               tenant=None) -> ResponseStream:
+               tenant=None, temperature=None, top_k=None, top_p=None,
+               seed=None, adapter: int = 0) -> ResponseStream:
         """Admit one request somewhere in the fleet; returns the
         FRONT's stream — tokens keep flowing on this one handle across
         any number of migrations.  Candidates are tried best-first:
@@ -539,7 +627,14 @@ class ServingFleet:
         error propagate — fleet admission control is the union of the
         engines' own.  Auto request-ids are fleet-assigned (``"f0"``,
         ``"f1"``, ...): N engines each minting their own integers
-        would collide in the shared spill directory."""
+        would collide in the shared spill directory.
+
+        ``temperature``/``top_k``/``top_p``/``seed`` are this request's
+        sampling config and ``adapter`` its LoRA id (docs §5q), passed
+        through to the owning engine; adapter traffic is only placed on
+        engines holding (or hot-loading, from the fleet registry) the
+        bank row, and both ride the fleet record so migration keeps
+        serving the same stream under the same adapter."""
         if self._draining:
             raise PreconditionNotMetError(
                 "fleet front is draining/shut down")
@@ -560,13 +655,28 @@ class ServingFleet:
             raise QueueFullError(
                 "no healthy active engine in the fleet; back off and "
                 "retry")
+        adapter = int(adapter)
+        if adapter:
+            placeable = [c for c in ranked
+                         if self._ensure_adapter(c[0], adapter)]
+            if not placeable:
+                raise InvalidArgumentError(
+                    "adapter %d is not servable anywhere in the fleet "
+                    "(no engine holds the bank row and the fleet "
+                    "registry has no weights for it — "
+                    "register_adapter(%d, weights) first)"
+                    % (adapter, adapter))
+            ranked = placeable
         last_exc = None
         for h, reason, matched in ranked:
             try:
                 es = h.engine.submit(ids, max_new_tokens,
                                      request_id=rid,
                                      deadline_s=deadline_s,
-                                     priority=priority, tenant=tenant)
+                                     priority=priority, tenant=tenant,
+                                     temperature=temperature,
+                                     top_k=top_k, top_p=top_p,
+                                     seed=seed, adapter=adapter)
             except (UnavailableError, PreconditionNotMetError) as e:
                 # retryable per-engine refusal (queue full, deadline
                 # estimate, tightened admission, draining): the next
@@ -575,10 +685,17 @@ class ServingFleet:
                 continue
             now = self._clock()
             stream = ResponseStream(self, rid, int(max_new_tokens))
+            eng_rec = h.engine._live.get(rid)
             self._records[rid] = _FleetRecord(
                 rid, stream, h.engine_id, es, ids,
                 int(max_new_tokens), now, priority, tenant,
-                None if deadline_s is None else now + float(deadline_s))
+                None if deadline_s is None else now + float(deadline_s),
+                # the ENGINE resolved the config (seed included) at its
+                # admission edge; the fleet copies it so the death path
+                # can re-adopt without asking a dead donor
+                sampling=(None if eng_rec is None
+                          else eng_rec.sampling),
+                adapter=adapter)
             self._c_submitted.inc()
             self._routed[reason].inc()
             trace.instant("fleet.route", rid=rid, engine=h.engine_id,

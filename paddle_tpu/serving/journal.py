@@ -64,11 +64,23 @@ from ..core.errors import (InvalidArgumentError, PreconditionNotMetError,
                            UnavailableError)
 from . import faults
 
-__all__ = ["MAGIC", "JournalWriter", "JournalCorruptError",
-           "JournalWriteError", "FingerprintMismatchError",
-           "read_journal", "replay", "frame_record"]
+__all__ = ["MAGIC", "JOURNAL_VERSION", "JournalWriter",
+           "JournalCorruptError", "JournalWriteError",
+           "FingerprintMismatchError", "read_journal", "replay",
+           "frame_record"]
 
 MAGIC = b"PTWJ1\n"
+# Header schema version.  v1 fingerprints carried pool-GLOBAL sampling
+# scalars (temperature/top_k/top_p/sampling_seed); v2 moved sampling to
+# per-request data (docs/DESIGN.md §5q) — the fingerprint carries the
+# "sampling": "per-request" marker plus the LoRA bank geometry, and
+# admit/checkpoint records carry each request's own resolved
+# ``sampling`` 5-list ([temperature, top_k, top_p, seed, draws]) and
+# ``adapter`` id.  The engine's restore path triages a v1 header
+# (engine._fingerprint_upgrade): equal-modulo-sampling journals replay
+# through the resubmit fallback with the old global config applied
+# per-request.
+JOURNAL_VERSION = 2
 _FRAME = struct.Struct("<II")  # payload length, crc32(payload)
 # a frame length past this is framing garbage, not a record — the
 # reader treats it as the torn tail (prompts are token-id arrays; even
@@ -208,7 +220,12 @@ def read_journal(path: str) -> Tuple[dict, List[dict], dict]:
     stats = {"bytes_total": len(data), "bytes_valid": off,
              "bytes_dropped": dropped_bytes, "records": len(records),
              "records_dropped": dropped_records,
-             "truncated": bool(dropped_bytes)}
+             "truncated": bool(dropped_bytes),
+             # header schema version (v1 journals predate per-request
+             # sampling; a missing field means v1) — the restore path
+             # keys its upgrade triage off the FINGERPRINT shape, but
+             # operators and tests read the declared version here
+             "version": int(header.get("v") or 1)}
     return header.get("fingerprint") or {}, records, stats
 
 
@@ -218,8 +235,9 @@ def replay(records: List[dict]) -> Tuple[List[dict], dict]:
 
     ``live`` is the ordered list of still-live requests, each
     ``{"rid", "ids", "tokens", "max_new", "priority", "tenant",
-    "deadline_s", "retries"}`` — exactly what the engine resubmits
-    (prompt + committed determine greedy state).  ``counts`` reconciles
+    "deadline_s", "sampling", "adapter", "retries"}`` — exactly what
+    the engine resubmits (prompt + committed + the per-request
+    sampling/adapter data determine decode state).  ``counts`` reconciles
     the replay: ``admitted`` / ``terminals`` / ``committed_tokens`` /
     ``checkpoints`` — with no checkpoint record,
     ``admitted - terminals == len(live)`` exactly (test-pinned)."""
@@ -239,6 +257,11 @@ def replay(records: List[dict]) -> Tuple[List[dict], dict]:
                 # elapsed time from deadline_s so a crash does not
                 # silently GRANT a request its full budget again
                 "ts": rec.get("ts"),
+                # v2 per-request fields; None/0 on a v1 admit record —
+                # the engine's upgrade triage supplies the old global
+                # config in that case
+                "sampling": rec.get("sampling"),
+                "adapter": int(rec.get("adapter") or 0),
                 "retries": 0}
         elif t == "commit":
             for rid, toks in rec.get("toks", ()):
@@ -267,6 +290,8 @@ def replay(records: List[dict]) -> Tuple[List[dict], dict]:
                     # deduct the downtime since then, same as admits
                     "deadline_s": entry.get("deadline_s"),
                     "ts": entry.get("ts"),
+                    "sampling": entry.get("sampling"),
+                    "adapter": int(entry.get("adapter") or 0),
                     "retries": int(entry.get("retries") or 0)}
         # unknown record types are skipped, not fatal: a NEWER writer's
         # extra record must not brick an older reader's replay
@@ -379,7 +404,8 @@ class JournalWriter:
         else:
             self._f = open(self.path, "wb", buffering=0)
             head = MAGIC + frame_record(
-                {"t": "header", "v": 1, "fingerprint": self.fingerprint})
+                {"t": "header", "v": JOURNAL_VERSION,
+                 "fingerprint": self.fingerprint})
             _write_all(self._f, head)
             os.fsync(self._f.fileno())
             _fsync_dir(self.path)
@@ -433,7 +459,8 @@ class JournalWriter:
         alone.  Returns ``{"path", "bytes", "records"}``."""
         target = self.path if path is None else str(path)
         body = MAGIC + frame_record(
-            {"t": "header", "v": 1, "fingerprint": self.fingerprint})
+            {"t": "header", "v": JOURNAL_VERSION,
+             "fingerprint": self.fingerprint})
         for rec in records:
             body += frame_record(rec)
         tmp = target + ".compact.tmp"

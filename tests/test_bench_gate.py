@@ -742,3 +742,97 @@ def test_live_serving_fleet_leg_passes_its_own_gate():
     assert isinstance(leg["migration_rto_s"], float)
     assert isinstance(leg["scaling_efficiency"], float)
     assert leg["prefix_affinity_hit_rate"] > 0
+
+
+def test_serving_lora_gate_structural_cases():
+    """The §5q multi-LoRA leg: a timed sub-leg without its numeric
+    adapters stamp, any compile (or cost_version movement) during
+    traffic, a lossy shared-vs-dedicated comparison, or a hot load
+    that compiled is structurally unpromotable — and the usual
+    cache-provenance stamps apply to every timed sub-leg."""
+    def leg(**over):
+        def sub(**s):
+            d = {"cache_layout": "dense", "cache_dtype": "float32",
+                 "tokens_per_sec": 1100.0, "adapters": 8,
+                 "compiles_during_traffic": 0,
+                 "cost_version_changed": False}
+            d.update(s)
+            return d
+
+        out = {"input_staged": False,
+               "transfer_note": "identical traffic on every sub-leg",
+               "adapters_1": sub(adapters=1),
+               "shared_8": sub(),
+               "dedicated_8": sub(tokens_per_sec=600.0),
+               "tokens_lost": 0, "hot_load_compiles": 0,
+               "hot_load_cost_version_changed": False,
+               "weight_bytes_saved": 1 << 24,
+               "weight_bytes_ratio": 0.14,
+               "tokens_per_sec": 1100.0}
+        out.update(over)
+        return out
+
+    ok, why = bench._leg_promotable("serving_lora", leg())
+    assert ok, why
+    # a sub-leg that cannot say how many fine-tunes it mixed claims
+    # nothing; a BOOL adapters stamp is a bug wearing a number's type
+    bad = leg()
+    del bad["shared_8"]["adapters"]
+    ok, why = bench._leg_promotable("serving_lora", bad)
+    assert not ok and "adapters stamp" in why
+    bad = leg()
+    bad["dedicated_8"]["adapters"] = True
+    ok, why = bench._leg_promotable("serving_lora", bad)
+    assert not ok and "adapters stamp" in why
+    # the exactly-two contract allows ZERO new executables mid-traffic
+    bad = leg()
+    bad["shared_8"]["compiles_during_traffic"] = 1
+    ok, why = bench._leg_promotable("serving_lora", bad)
+    assert not ok and "ZERO new executables" in why
+    bad = leg()
+    bad["adapters_1"]["cost_version_changed"] = True
+    ok, why = bench._leg_promotable("serving_lora", bad)
+    assert not ok and "ZERO new executables" in why
+    # the bank moves the delta math, never the tokens; an UNSTAMPED
+    # tokens_lost defaults to lossy
+    ok, why = bench._leg_promotable("serving_lora", leg(tokens_lost=3))
+    assert not ok and "lost tokens" in why
+    bad = leg()
+    del bad["tokens_lost"]
+    ok, why = bench._leg_promotable("serving_lora", bad)
+    assert not ok and "lost tokens" in why
+    # a hot swap is a bank-row device write, never a retrace
+    ok, why = bench._leg_promotable("serving_lora",
+                                    leg(hot_load_compiles=2))
+    assert not ok and "hot swap" in why
+    # cache provenance applies to every timed sub-leg
+    bad = leg()
+    del bad["dedicated_8"]["cache_dtype"]
+    ok, why = bench._leg_promotable("serving_lora", bad)
+    assert not ok and "cache_layout/cache_dtype" in why
+
+
+@pytest.mark.slow
+def test_live_serving_lora_leg_passes_its_own_gate():
+    """The leg bench.py actually emits must satisfy its own gate AND
+    the §5q acceptance contract: zero tokens lost vs the dedicated
+    engines, zero compiles (and no cost_version movement) during the
+    mixed-adapter traffic AND across the hot load, and the weight-
+    bytes comparison stamped — slow-marked (it compiles one shared
+    engine plus eight dedicated ones)."""
+    import jax
+
+    import paddle_tpu as pt
+
+    leg = bench.bench_serving_lora(pt, jax, False)
+    ok, why = bench._leg_promotable("serving_lora", leg)
+    assert ok, why
+    assert leg["tokens_lost"] == 0
+    assert leg["hot_load_compiles"] == 0
+    assert leg["hot_load_cost_version_changed"] is False
+    for sub in ("adapters_1", "shared_8", "dedicated_8"):
+        assert leg[sub]["compiles_during_traffic"] == 0
+        assert leg[sub]["cost_version_changed"] is False
+    assert leg["weight_bytes_saved"] > 0
+    assert 0.0 < leg["weight_bytes_ratio"] < 1.0
+    assert leg["shared_8"]["adapter_bank_bytes"] > 0

@@ -194,3 +194,63 @@ def test_single_record_history_passes(tmp_path):
     rc, out = _run(["--history", path, "--rounds", "", "--check"])
     assert rc == 0
     assert "fewer than 2" in out
+
+
+def _lora_legs(adapters=8):
+    legs = copy.deepcopy(BASE_LEGS)
+    legs["serving_lora"] = {
+        "tokens_per_sec": 1100.0,
+        "adapters_1": {"tokens_per_sec": 1150.0, "adapters": 1},
+        "shared_8": {"tokens_per_sec": 1100.0, "adapters": adapters},
+        "dedicated_8": {"tokens_per_sec": 600.0, "adapters": 8},
+    }
+    return legs
+
+
+def test_structural_gate_refuses_unadapted_lora_leg(tmp_path):
+    # a timed serving_lora sub-leg must carry its numeric adapters
+    # stamp: --check fails on the LATEST record even with no diff pair
+    bad = _lora_legs(adapters=None)
+    path = _history_file(tmp_path, [_record("aaa", bad)])
+    rc, out = _run(["--history", path, "--rounds", "", "--check"])
+    assert rc == 1
+    assert "STRUCTURAL" in out and "'shared_8'" in out \
+        and "'adapters'" in out
+    assert "1 structural" in out
+    # a BOOL stamp is refused the same way (True is not a count)
+    path = _history_file(tmp_path, [_record("bbb", _lora_legs(True))])
+    rc, out = _run(["--history", path, "--rounds", "", "--check"])
+    assert rc == 1 and "STRUCTURAL" in out
+    # without --check the violation is reported but never gates
+    rc, _ = _run(["--history", path, "--rounds", ""])
+    assert rc == 0
+
+
+def test_structural_gate_passes_stamped_lora_leg(tmp_path):
+    path = _history_file(tmp_path, [_record("aaa", _lora_legs()),
+                                    _record("bbb", _lora_legs(),
+                                            at="2026-01-02T00:00:00Z")])
+    rc, out = _run(["--history", path, "--rounds", "", "--check"])
+    assert rc == 0 and "STRUCTURAL" not in out
+    # only the LATEST record is gated: an old unstamped record must
+    # not brick the history forever
+    path = _history_file(tmp_path, [
+        _record("aaa", _lora_legs(adapters=None)),
+        _record("bbb", _lora_legs(), at="2026-01-02T00:00:00Z")])
+    rc, out = _run(["--history", path, "--rounds", "", "--check"])
+    assert rc == 0
+
+
+def test_structural_violation_rides_json_report(tmp_path):
+    path = _history_file(tmp_path,
+                         [_record("aaa", _lora_legs(adapters=None))])
+    rc, out = _run(["--history", path, "--rounds", "", "--json",
+                    "--check"])
+    assert rc == 1
+    report = json.loads(out)
+    assert report["exit_code"] == 1
+    rows = report["structural_violations"]
+    assert [r["metric"] for r in rows] == ["shared_8.adapters"]
+    assert rows[0]["leg"] == "serving_lora"
+    assert rows[0]["status"] == "invalid"
+    assert "numeric 'adapters' stamp" in rows[0]["reason"]

@@ -33,6 +33,7 @@ SCRIPTS = [
     ("18_disagg_serving.py", ["--tokens", "8"]),
     ("19_fleet_serving.py", ["--tokens", "8"]),
     ("20_ssm_serving.py", ["--tokens", "8"]),
+    ("21_multi_lora_serving.py", ["--tokens", "8"]),
 ]
 
 

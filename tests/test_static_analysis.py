@@ -175,6 +175,37 @@ def test_retrace_hazard(tmp_path):
     assert "rebuilt on every call" in by_scope["inline"]
 
 
+def test_retrace_hazard_sampling_constants(tmp_path):
+    # docs §5q: sampling scalars / adapter ids read off self inside a
+    # jit-traced step are Python constants — one executable per config
+    # value.  The as-data discipline passes them as traced vectors.
+    root = _tree(tmp_path, {"mod.py": """
+        import jax
+
+        class Pool:
+            def __init__(self):
+                self.temperature = 0.8
+                self.adapter = 1
+                self._step = jax.jit(self._decode)
+
+            def _decode(self, logits, temp_vec):
+                bad = logits / self.temperature   # constant: flagged
+                a = self.adapter                  # constant: flagged
+                good = logits / temp_vec          # traced data: quiet
+                return bad, a, good
+
+            def host_side(self):
+                return self.temperature           # untraced: quiet
+        """})
+    got = _findings(root, "retrace-hazard")
+    msgs = sorted(f.message for f in got)
+    assert len(msgs) == 2, msgs
+    assert any("self.temperature" in m for m in msgs)
+    assert any("self.adapter" in m for m in msgs)
+    assert all("Pool._decode" in m for m in msgs)
+    assert all("per-request DATA" in m for m in msgs)
+
+
 def test_donation_reuse(tmp_path):
     root = _tree(tmp_path, {"mod.py": """
         import jax

@@ -188,13 +188,27 @@ class TracedBranch(Rule):
 
 
 # -- 3. retrace-hazard ---------------------------------------------------
+# per-request quantities that must enter a traced step as DATA
+# (docs/DESIGN.md §5q): read off ``self`` inside traced code they are
+# Python constants — the executable bakes them in and retraces per
+# distinct value, which is exactly the per-config compile explosion the
+# sampling-as-data refactor removed
+_SAMPLING_ATTRS = frozenset({
+    "temperature", "top_k", "top_p", "sampling_seed",
+    "adapter", "adapter_id", "adapter_ids",
+})
+
+
 class RetraceHazard(Rule):
     """Compile-budget leaks: ``jax.jit`` evaluated inside a loop (one
     fresh compile cache per iteration), an inline
     ``jax.jit(...)(...)``-and-discard in library code (a fresh callable
-    — and compile — per invocation of the enclosing function), and
-    f-string dict keys inside traced code (pytree structure that varies
-    with runtime strings retraces per key set)."""
+    — and compile — per invocation of the enclosing function), f-string
+    dict keys inside traced code (pytree structure that varies with
+    runtime strings retraces per key set), and sampling scalars /
+    adapter ids read off ``self`` inside traced code (per-request
+    config captured as a Python constant retraces per distinct value —
+    sampling is data, docs §5q)."""
 
     id = "retrace-hazard"
     severity = "warning"
@@ -239,6 +253,20 @@ class RetraceHazard(Rule):
                         "f-string dict key inside jit-traced %s: pytree "
                         "structure depending on runtime strings "
                         "retraces per distinct key set" % fi.qualname))
+                if is_traced and not in_tests \
+                        and isinstance(node, ast.Attribute) \
+                        and isinstance(node.ctx, ast.Load) \
+                        and isinstance(node.value, ast.Name) \
+                        and node.value.id == "self" \
+                        and node.attr in _SAMPLING_ATTRS:
+                    out.append(self.finding(
+                        fi.file, node, fi.qualname,
+                        "self.%s read inside jit-traced %s: a sampling "
+                        "scalar/adapter id captured as a Python "
+                        "constant bakes into the executable and "
+                        "retraces per distinct value — sampling is "
+                        "per-request DATA; pass it as a traced vector "
+                        "argument" % (node.attr, fi.qualname)))
         return out
 
     @staticmethod

@@ -134,6 +134,20 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "slots_per_gb": ("higher", 0.01),
     "slots_per_gb_ratio": ("higher", 0.01),
     "state_bytes_per_slot": ("lower", 0.01),
+    # multi-LoRA serving (serving_lora, docs §5q): the weight columns
+    # are byte accounting, deterministic per config — growth in the
+    # shared engine's resident weights (or shrinkage of what the bank
+    # saves over dedicated engines) is a contract change in the tier's
+    # whole value proposition, so tight.  The compile columns are the
+    # exactly-two contract itself: adapter ids and sampling are traced
+    # DATA, so ANY compile during traffic (or on a hot-load) is a
+    # regression — gated at zero absolute growth
+    "weight_hbm_bytes": ("lower", 0.01),
+    "adapter_bank_bytes": ("lower", 0.01),
+    "weight_bytes_saved": ("higher", 0.01),
+    "weight_bytes_ratio": ("lower", 0.01),
+    "compiles_during_traffic": ("lower_abs", 0.0),
+    "hot_load_compiles": ("lower_abs", 0.0),
 }
 
 # per-leg overrides: (leg, metric) -> (direction, threshold).  The
@@ -162,7 +176,49 @@ PER_LEG_THRESHOLDS: Dict[Tuple[str, str], Tuple[str, float]] = {
     # the on-chip run's thresholds ride the global entries
     ("serving_disagg", "ttft_p95_improvement_pct"): ("higher_abs", 40.0),
     ("serving_disagg", "itl_p95_improvement_pct"): ("higher_abs", 40.0),
+    # the lora leg's dedicated sub-leg times 8 engines multiplexed
+    # onto one CPU on smoke runs — same caveat as the fleet leg; the
+    # weight-byte and compile columns above are the cross-run signal
+    ("serving_lora", "tokens_per_sec"): ("higher", 0.30),
 }
+
+# structural requirements on the LATEST record, enforced by --check
+# even when there is no earlier record to diff against: a timed
+# sub-leg (a dict stamped with tokens_per_sec) of these legs must
+# carry the named numeric columns.  A serving_lora number that cannot
+# say how many fine-tunes it mixed claims nothing — the reporter
+# REFUSES it rather than letting an unstamped record seed the history
+# the next round diffs against.
+STRUCTURAL_REQUIRED: Dict[str, Tuple[str, ...]] = {
+    "serving_lora": ("adapters",),
+}
+
+
+def validate_structure(record: dict) -> List[dict]:
+    """Violation rows for structurally-invalid legs of one record."""
+    rows: List[dict] = []
+    for leg_name, required in sorted(STRUCTURAL_REQUIRED.items()):
+        leg = (record.get("legs") or {}).get(leg_name)
+        if not isinstance(leg, dict):
+            continue
+        timed = {k: v for k, v in leg.items()
+                 if isinstance(v, dict) and "tokens_per_sec" in v}
+        for sub, metrics in sorted(timed.items()):
+            for field in required:
+                val = metrics.get(field)
+                if isinstance(val, bool) or \
+                        not isinstance(val, (int, float)):
+                    rows.append({
+                        "leg": leg_name,
+                        "metric": "%s.%s" % (sub, field),
+                        "prev": None, "latest": None,
+                        "status": "invalid",
+                        "direction": "higher_abs", "threshold": 0.0,
+                        "delta_pct": None,
+                        "reason": ("timed sub-leg %r is missing the "
+                                   "numeric %r stamp" % (sub, field)),
+                    })
+    return rows
 
 
 def load_history(path: str,
@@ -333,7 +389,18 @@ def build_report(records: List[dict],
         "legs": {},
         "regressions": [],
         "improvements": [],
+        "structural_violations": [],
     }
+    if records:
+        # structural refusal gates the LATEST record alone — a record
+        # whose timed sub-legs are missing required stamps must fail
+        # --check even on a fresh history with nothing to diff
+        report["structural_violations"] = validate_structure(
+            records[-1])
+        for row in report["structural_violations"]:
+            report["notes"].append(
+                "STRUCTURAL: %s leg refused — %s"
+                % (row["leg"], row["reason"]))
     if len(records) < 2:
         report["notes"].append(
             "fewer than 2 parseable records: nothing to diff (a fresh "
@@ -482,7 +549,9 @@ def main(argv=None) -> int:
     # as "oldest known", keeping the dated history authoritative
     records.sort(key=lambda r: r.get("measured_at") or "")
     report = build_report(records, notes=notes)
-    rc = 1 if (args.check and report["regressions"]) else 0
+    rc = 1 if (args.check and (report["regressions"]
+                               or report["structural_violations"])) \
+        else 0
     if args.json:
         report["exit_code"] = rc
         json.dump(report, sys.stdout, indent=1)
@@ -490,11 +559,11 @@ def main(argv=None) -> int:
         return rc
     sys.stdout.write(render_markdown(report))
     if args.check:
+        n_reg = len(report["regressions"])
+        n_bad = len(report["structural_violations"])
         sys.stdout.write("--check: %s\n"
-                         % ("FAIL (%d regression%s)"
-                            % (len(report["regressions"]),
-                               "" if len(report["regressions"]) == 1
-                               else "s")
+                         % ("FAIL (%d regression%s, %d structural)"
+                            % (n_reg, "" if n_reg == 1 else "s", n_bad)
                             if rc else "pass"))
     return rc
 

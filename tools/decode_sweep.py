@@ -47,6 +47,16 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
   and since the carry is O(1), the tok/s rows should read ~flat across
   the cache-length axis, which is itself the measurement.
 
+- an ADAPTERS axis (``--adapters N``): batched multi-LoRA rows
+  (docs/DESIGN.md §5q) serve a bank-attached model at the same
+  geometry through the SAME ``DecodeSession`` and the SAME marginal
+  recipe, with every batch row pinned round-robin to a different
+  fine-tune by per-row adapter ids riding the ``SamplingState`` as
+  traced data; an ``adapters=0`` baseline row rides along, each row
+  records tok/s next to ``adapter_bank_bytes``, and the per-bucket
+  compile counts are stamped so an id that leaked into a compiled
+  constant shows up as a count, not a vibe.
+
 - plain-vs-SPECULATIVE tokens/s with a ``--speculate K`` axis: the
   draft/verify pool (``inference.SpeculativePool``, K draft tokens per
   round against a 1-layer draft twin) timed against the plain pool at
@@ -59,7 +69,7 @@ Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
      [--cache-dtypes float32 int8] [--speculate K]
      [--route auto composition pallas-interpret]
      [--prompt-reuse f ...] [--model-class transformer ssm]
-     [--cpu-smoke]
+     [--adapters N] [--cpu-smoke]
      [--out decode_sweep.json]
 Writes the JSON report to --out (default: decode_sweep.json in the
 CWD — never into tools/, a measurement artifact is not source);
@@ -229,6 +239,84 @@ def ssm_sweep(pt, cfg, batches, buckets, gen):
                      m["per_token_s"] * 1e3, tps,
                      state_bytes / 2**10, kv_equiv / 2**20), flush=True)
         compiles["bucket_%d" % bucket] = sess.compile_counts()
+    return legs, compiles
+
+
+def lora_sweep(pt, cfg, batches, buckets, gen, adapter_counts, rank=4):
+    """tok/s per (bucket, batch, adapter-count) for the batched
+    multi-LoRA seam (docs/DESIGN.md §5q): a bank-attached
+    ``TransformerLM`` served by the SAME ``DecodeSession`` through the
+    SAME marginal recipe as every other axis, with every batch row
+    pinned to a different fine-tune (round-robin over the bank).
+    Adapter ids ride the ``SamplingState`` as per-row traced DATA, so
+    the per-(count, bucket) compile counts are recorded and must read
+    exactly-two like the plain sweep's — a count that grew with the
+    adapter axis means an id leaked into a compiled constant.  Rows
+    stamp ``adapter_bank_bytes`` next to tok/s: the marginal slowdown
+    vs the ``adapters=0`` baseline rows is the price of the gathered
+    delta einsums, and the bank bytes are what it buys (8 fine-tunes
+    resident for one base copy).  ``--adapters 0`` rows serve the
+    plain un-banked model — the in-run baseline."""
+    from bench import measure_decode_marginal  # THE shared timing recipe
+    from paddle_tpu.jit import DecodeSession
+    from paddle_tpu.models import TransformerLM
+    from paddle_tpu.nn import lora
+
+    class _MixedAdapterSession(DecodeSession):
+        """The plain session whose sampling-state DEFAULT pins batch
+        row r to fine-tune ``(r % N) + 1`` — the mixed-batch shape the
+        bank exists for, reached through ``generate()`` so the sweep
+        reuses the shared marginal recipe verbatim."""
+
+        def __init__(self, *args, sweep_adapters=0, **kw):
+            self._sweep_adapters = int(sweep_adapters)
+            super().__init__(*args, **kw)
+
+        def sampling_state(self, batch, **kw):
+            if self._sweep_adapters and not np.any(kw.get("adapter", 0)):
+                kw["adapter"] = (np.arange(batch, dtype=np.int32)
+                                 % self._sweep_adapters) + 1
+            return super().sampling_state(batch, **kw)
+
+    rng = np.random.RandomState(0)
+    legs = []
+    compiles = {}
+    for n in adapter_counts:
+        pt.seed(0)  # identical base weights across the axis
+        model = TransformerLM(**cfg, dropout=0.0)
+        bank_bytes = 0
+        if n > 0:
+            lora.attach_lora(model, n_adapters=n + 1, rank=rank)
+            for a in range(1, n + 1):
+                lora.load_adapter(model, a,
+                                  lora.random_adapter(model, seed=a))
+            bank_bytes = lora.adapter_bank_bytes(model)
+        for bucket in buckets:
+            max_len = bucket + gen
+            sess = _MixedAdapterSession(model, max_len=max_len,
+                                        buckets=[bucket],
+                                        sweep_adapters=n)
+            for batch in batches:
+                ids = rng.randint(0, cfg["vocab_size"],
+                                  (batch, bucket)).astype("int32")
+                m = measure_decode_marginal(sess, ids, gen,
+                                            repeats=REPEATS)
+                tps = batch / m["per_token_s"]
+                legs.append(dict(
+                    m, batch=batch, prefill=bucket, generated=gen,
+                    cache_len=max_len, adapters=n,
+                    rank=(rank if n else None),
+                    cache_layout="dense", cache_dtype="float32",
+                    adapter_bank_bytes=bank_bytes,
+                    decode_tokens_per_sec=round(tps, 1)))
+                print("bucket %-5d batch %-3d  lora x%-3d rank %-4s "
+                      "prefill %.4fs  %.3f ms/tok  %8.1f tok/s"
+                      "  bank %6.2f MiB"
+                      % (bucket, batch, n, rank if n else "-",
+                         m["prefill_s"], m["per_token_s"] * 1e3, tps,
+                         bank_bytes / 2**20), flush=True)
+            compiles["adapters_%d_bucket_%d" % (n, bucket)] = \
+                sess.compile_counts()
     return legs, compiles
 
 
@@ -484,6 +572,16 @@ def main():
                          "fractions (each F = fraction of prompts "
                          "opening with one shared prefix; rows record "
                          "hit-rate AND tok/s columns)")
+    ap.add_argument("--adapters", type=int, default=0, metavar="N",
+                    help="also sweep batched multi-LoRA at N resident "
+                         "fine-tunes (0 = off): rows serve a "
+                         "bank-attached model through the same "
+                         "DecodeSession and the same marginal recipe, "
+                         "with every batch row pinned round-robin to a "
+                         "different adapter via per-row SamplingState "
+                         "ids (docs/DESIGN.md §5q); an adapters=0 "
+                         "baseline row rides along, and every row "
+                         "records tok/s next to adapter_bank_bytes")
     ap.add_argument("--speculate", type=int, default=0, metavar="K",
                     help="also sweep the speculative draft/verify pool "
                          "at K draft tokens per round (0 = off); every "
@@ -563,6 +661,11 @@ def main():
     if "ssm" in args.model_class:
         ssm_legs, ssm_compiles = ssm_sweep(pt, cfg, args.batches,
                                            args.buckets, args.gen)
+    lora_legs = lora_compiles = None
+    if args.adapters > 0:
+        lora_legs, lora_compiles = lora_sweep(pt, cfg, args.batches,
+                                              args.buckets, args.gen,
+                                              [0, args.adapters])
     spec_legs = None
     if args.speculate > 0:
         spec_legs = speculative_sweep(pt, cfg, args.batches,
@@ -593,14 +696,17 @@ def main():
               "block_sizes": args.block_sizes,
               "cache_dtypes": args.cache_dtypes,
               "routes": args.route,
+              "adapters": args.adapters or None,
               "spec_k": args.speculate or None,
               "prompt_reuse": args.prompt_reuse or None,
               "mesh": [list(m) for m in meshes] or None,
               "model_class": args.model_class,
               "compile_counts": compiles,
               "ssm_compile_counts": ssm_compiles,
+              "lora_compile_counts": lora_compiles,
               "legs": legs,
               "ssm_legs": ssm_legs,
+              "lora_legs": lora_legs,
               "speculative_legs": spec_legs,
               "prompt_reuse_legs": reuse_legs,
               "mesh_legs": mesh_legs}
