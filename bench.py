@@ -1829,12 +1829,19 @@ def _sharded_bench_child():
     }
     base_tps = None
     best = 0.0
-    for dp, mp in ((1, 1), (2, 1), (1, 2), (2, 2)):
+    # the _qint8 sub-legs re-run the mp>1 meshes with the decode-step
+    # mp all-reduces replaced by the block-int8 two-stage collectives
+    # (docs §5r) on IDENTICAL traffic; every mp>1 leg stamps its
+    # traced-shape collective_bytes_per_token so quantized-vs-dense is
+    # a stamped comparison, never a vibe
+    for dp, mp, cq in ((1, 1, "none"), (2, 1, "none"), (1, 2, "none"),
+                       (2, 2, "none"), (1, 2, "int8"), (2, 2, "int8")):
         if dp * mp > n_dev or cfg["num_heads"] % mp or slots % dp:
             continue
         pt.seed(0)
         model = TransformerLM(**cfg, dropout=0.0)
-        mesh = None if dp == mp == 1 else DecodeMesh(dp, mp)
+        mesh = None if dp == mp == 1 \
+            else DecodeMesh(dp, mp, collective_quant=cq)
         pool = GenerationPool(model, max_len, slots=slots,
                               buckets=[prefill], cache_layout="paged",
                               block_size=16, mesh=mesh)
@@ -1853,6 +1860,8 @@ def _sharded_bench_child():
         stats = pool.cache_stats()
         cost = pool.cost_report().get("derived") or {}
         name = "mesh_%dx%d" % (dp, mp)
+        if cq != "none":
+            name += "_q%s" % cq
         if mesh is None:
             base_tps = tps
             scaling = None
@@ -1876,6 +1885,16 @@ def _sharded_bench_child():
         }
         if scaling is not None:
             leg["scaling_efficiency"] = round(scaling, 4)
+        if mesh is not None:
+            leg["collective_quant"] = cq
+            # present whenever the decode step has mp-axis collectives
+            # (mp>1): traced-shape wire bytes per committed token, the
+            # quantized figure beside the dense ring equivalent
+            if "collective_bytes_per_token" in cost:
+                leg["collective_bytes_per_token"] = \
+                    cost["collective_bytes_per_token"]
+                leg["collective_dense_bytes_per_token"] = \
+                    cost["collective_dense_bytes_per_token"]
         out[name] = leg
         best = max(best, tps)
     out["tokens_per_sec"] = round(best, 1)
@@ -2806,6 +2825,25 @@ def _leg_promotable(name: str, leg: dict):
                                "carry its measured-vs-ideal scaling "
                                "and what one shard asks of its chip"
                                % (unscaled,))
+            # a QUANTIZED-collective sub-leg (§5r) without its NUMERIC
+            # traced-shape wire-byte stamp cannot say what the
+            # quantization bought over the dense ring — the byte
+            # column IS the number's provenance (off-TPU the emulated
+            # mesh's tok/s certainly can't say it)
+            unquant = sorted(
+                k for k, v in timed.items()
+                if v.get("collective_quant") not in (None, "none")
+                and (not isinstance(v.get("collective_bytes_per_token"),
+                                    (int, float))
+                     or isinstance(v.get("collective_bytes_per_token"),
+                                   bool)))
+            if unquant:
+                return False, ("serving_sharded leg missing numeric "
+                               "collective_bytes_per_token on "
+                               "quantized sub-legs %s: a quantized-"
+                               "collective number must carry the "
+                               "traced wire-byte stamp it exists to "
+                               "shrink" % (unquant,))
         if name == "serving_disagg":
             # the tier split's headline IS the fused-vs-disagg
             # comparison: a record missing either improvement column

@@ -35,6 +35,7 @@ from .collective import (  # noqa: F401
     wait,
 )
 from . import launch  # noqa: F401
+from . import qcollectives  # noqa: F401
 from .parallel import (  # noqa: F401
     DataParallel,
     ParallelEnv,

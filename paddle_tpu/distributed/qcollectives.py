@@ -1,0 +1,397 @@
+"""Quantized model-parallel collectives for the decode step (EQuARX-style).
+
+The mp axis of a :class:`~paddle_tpu.jit.mesh.DecodeMesh` pays for its
+sharded matmuls with activation collectives: GSPMD inserts an fp32
+all-reduce after every row-parallel projection (attention ``out_proj``,
+MLP ``linear2``).  At decode batch sizes those all-reduces are pure
+interconnect bandwidth — EQuARX (arXiv:2506.17615) shows a
+block-quantized all-reduce inside XLA recovers most of it at negligible
+accuracy cost.  This module is that idea as explicit ``shard_map``
+primitives over the serving mesh (docs/DESIGN.md §5r):
+
+- :func:`quantize_int8` / :func:`dequantize_int8` — int8 payload with
+  fp32 scales, per contiguous last-axis BLOCK (default) or per last-axis
+  CHANNEL (the accuracy-envelope knob, off by default).
+- :func:`qpsum` — quantized psum over a bound mesh axis in TWO stages:
+  a reduce-scatter (``all_to_all`` of each shard's quantized chunks;
+  dequantize and SUM IN FP32 on arrival) then an all-gather of the
+  re-quantized reduced chunk.  Partial sums therefore never accumulate
+  in int8 — each wire hop quantizes exactly one tensor once.
+- :func:`qall_gather` — quantized all-gather (int8 + scales through the
+  wire, dequantized on arrival).
+- :func:`collective_quant` — the ambient trace-region seam (the
+  ``decode_route`` discipline from ops/flash_attention.py): the decode
+  sessions install it around their DECODE traces only, and the
+  transformer's row-parallel call sites route through
+  :func:`row_parallel_linear` when it is active.  PYTHON-static: the
+  mode selects which ops get traced, so compile counts and the
+  exactly-two-compiles contract are untouched, and ``"none"`` traces
+  the exact jaxpr HEAD traced (byte-identity, test-pinned).
+
+Byte accounting is computed from the traced collective shapes — never
+measured, never faked: every figure is the per-device wire bytes of the
+standard ring algorithm for that collective (all-reduce moves
+``2·(n-1)/n`` of the payload per device; the two-stage quantized form
+moves ``2·(n-1)`` chunk payloads), recorded into the installing
+session's sink at trace time and surfaced per-token by the pool's
+cost report / ``cache_stats``.
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..core.errors import InvalidArgumentError
+from .collective import axis_size, shard_map
+
+__all__ = [
+    "COLLECTIVE_QUANT_MODES", "COLLECTIVE_QUANT_SCALES", "QUANT_BLOCK",
+    "normalize_collective_quant", "normalize_collective_scale",
+    "quantize_int8", "dequantize_int8", "qpsum", "qall_gather",
+    "qpsum_wire_bytes", "psum_wire_bytes",
+    "collective_quant", "active", "row_parallel_linear",
+]
+
+# "none": the GSPMD path exactly as traced today (fp32 all-reduce
+#   inserted by the partitioner) — byte-identical to a build without
+#   this module; under a mesh the seam still RECORDS the dense ring
+#   bytes so the comparison column exists.
+# "int8": the explicit two-stage quantized reduction at the
+#   row-parallel seams of the DECODE step (prefill stays dense — its
+#   batch-1 bucketed shapes don't shard over dp, and its cost is
+#   amortized over the whole prompt, not paid per token).
+COLLECTIVE_QUANT_MODES = ("none", "int8")
+
+# Scale granularity: "block" quantizes contiguous QUANT_BLOCK-element
+# chunks of the last axis with one fp32 scale each; "channel" carries
+# one fp32 scale per last-axis channel (amax over every leading axis) —
+# the ROADMAP's carried accuracy-envelope follow-up, off by default.
+COLLECTIVE_QUANT_SCALES = ("block", "channel")
+
+# Elements per block scale.  32 keeps the scale overhead at one fp32
+# per 32 int8 payload bytes (12.5%) while bounding the amax blast
+# radius a single outlier can inflict on its neighbours.
+QUANT_BLOCK = 32
+
+
+def normalize_collective_quant(mode) -> str:
+    """Validated mode name, or a typed error naming the choices —
+    checked at mesh/session/pool construction so a typo'd mode fails
+    loudly instead of silently decoding dense."""
+    if mode not in COLLECTIVE_QUANT_MODES:
+        raise InvalidArgumentError(
+            "collective_quant must be one of %s, got %r"
+            % (list(COLLECTIVE_QUANT_MODES), mode))
+    return mode
+
+
+def normalize_collective_scale(scale_mode) -> str:
+    """Validated scale-granularity name ('block' or 'channel')."""
+    if scale_mode not in COLLECTIVE_QUANT_SCALES:
+        raise InvalidArgumentError(
+            "collective_quant_scale must be one of %s, got %r"
+            % (list(COLLECTIVE_QUANT_SCALES), scale_mode))
+    return scale_mode
+
+
+# -- quantize / dequantize ---------------------------------------------------
+
+def quantize_int8(x, scale_mode: str = "block", block: int = QUANT_BLOCK):
+    """One shard's activation as an int8 payload + fp32 scales.
+
+    ``block``:   returns ``q`` of shape ``x.shape[:-1] + (nb, block)``
+    (last block zero-padded) and ``scale`` of ``x.shape[:-1] + (nb,)``
+    — symmetric amax per contiguous last-axis chunk.
+    ``channel``: returns ``q`` of ``x.shape`` and ``scale`` of ``(d,)``
+    — amax per last-axis channel over all leading axes.
+
+    A zero amax maps to scale 1 so an all-zero block round-trips to
+    zeros instead of dividing by zero.
+    """
+    scale_mode = normalize_collective_scale(scale_mode)
+    if scale_mode == "channel":
+        amax = jnp.max(jnp.abs(x), axis=tuple(range(x.ndim - 1)))
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+        q = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+        return q, scale
+    d = x.shape[-1]
+    nb = -(-d // block)
+    pad = nb * block - d
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xb = x.reshape(x.shape[:-1] + (nb, block))
+    amax = jnp.max(jnp.abs(xb), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(xb / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale, d: int, scale_mode: str = "block"):
+    """fp32 reconstruction of :func:`quantize_int8`'s payload (block
+    padding stripped back to the original last-axis size ``d``)."""
+    scale_mode = normalize_collective_scale(scale_mode)
+    if scale_mode == "channel":
+        return q.astype(jnp.float32) * scale
+    x = q.astype(jnp.float32) * scale[..., None]
+    x = x.reshape(x.shape[:-2] + (x.shape[-2] * x.shape[-1],))
+    return x[..., :d]
+
+
+# -- collective primitives (traced, inside shard_map) ------------------------
+
+def qpsum(x, axis_name: str, scale_mode: str = "block",
+          block: int = QUANT_BLOCK):
+    """Quantized psum over a bound shard_map axis, two-stage so partial
+    sums never accumulate in int8:
+
+    1. **reduce-scatter**: split the last axis into ``n`` chunks, one
+       per shard; quantize each chunk; ``all_to_all`` the int8 payload
+       + scales (shard ``j`` receives every shard's chunk ``j``);
+       dequantize each arrival and sum IN FP32.
+    2. **all-gather**: quantize the reduced chunk once; ``all_gather``
+       the int8 payload + scales; dequantize on arrival and reassemble
+       the full last axis.
+
+    Requires the last axis divisible by the axis size (the mesh's
+    mp | d_model / mp | intermediate_size validation guarantees this at
+    the transformer seams).  Identity when the axis has size 1.
+    """
+    n = axis_size(axis_name)
+    if n == 1:
+        return x
+    d = x.shape[-1]
+    if d % n:
+        raise InvalidArgumentError(
+            "qpsum needs the last axis (%d) divisible by the %r axis "
+            "size (%d): the reduce-scatter stage assigns one equal "
+            "chunk per shard" % (d, axis_name, n))
+    chunk = d // n
+    xs = x.reshape(x.shape[:-1] + (n, chunk))
+    xs = jnp.moveaxis(xs, -2, 0)                       # [n, ..., chunk]
+    q, s = jax.vmap(lambda t: quantize_int8(t, scale_mode, block))(xs)
+    # stage 1 wire: after the exchange, slot j along axis 0 holds shard
+    # j's quantized chunk-for-me (int8 + fp32 scales are what moved)
+    q = lax.all_to_all(q, axis_name, 0, 0, tiled=True)
+    s = lax.all_to_all(s, axis_name, 0, 0, tiled=True)
+    deq = jax.vmap(
+        lambda qq, ss: dequantize_int8(qq, ss, chunk, scale_mode))(q, s)
+    red = jnp.sum(deq, axis=0)                         # fp32 accumulate
+    # stage 2 wire: the reduced chunk, quantized exactly once
+    q2, s2 = quantize_int8(red, scale_mode, block)
+    q2 = lax.all_gather(q2, axis_name)
+    s2 = lax.all_gather(s2, axis_name)
+    out = jax.vmap(
+        lambda qq, ss: dequantize_int8(qq, ss, chunk, scale_mode))(q2, s2)
+    out = jnp.moveaxis(out, 0, -2)                     # [..., n, chunk]
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def qall_gather(x, axis_name: str, axis: int = 0, scale_mode: str = "block",
+                block: int = QUANT_BLOCK):
+    """Quantized all-gather: each shard's payload crosses the wire as
+    int8 + fp32 scales and is dequantized on arrival.  Like
+    ``lax.all_gather`` the shards stack along a NEW axis at position
+    ``axis`` (axis-index order)."""
+    q, s = quantize_int8(x, scale_mode, block)
+    q = lax.all_gather(q, axis_name)
+    s = lax.all_gather(s, axis_name)
+    out = jax.vmap(
+        lambda qq, ss: dequantize_int8(qq, ss, x.shape[-1], scale_mode))(q, s)
+    if axis:
+        out = jnp.moveaxis(out, 0, axis)
+    return out.astype(x.dtype)
+
+
+# -- wire-byte accounting (python ints, from traced shapes) ------------------
+
+def _int8_payload(shape, scale_mode: str, block: int):
+    """(int8_bytes, fp32_scale_bytes) of one quantized tensor."""
+    d = int(shape[-1])
+    lead = 1
+    for s in shape[:-1]:
+        lead *= int(s)
+    if scale_mode == "channel":
+        return lead * d, d * 4
+    nb = -(-d // block)
+    return lead * nb * block, lead * nb * 4
+
+
+def psum_wire_bytes(shape, n: int, itemsize: int = 4) -> int:
+    """Per-device wire bytes of the dense ring all-reduce the GSPMD
+    partitioner inserts for this payload: ``2·(n-1)/n`` of the tensor
+    crosses each device's links (reduce-scatter + all-gather phases of
+    the ring).  0 when the axis has size 1."""
+    if n <= 1:
+        return 0
+    elems = 1
+    for s in shape:
+        elems *= int(s)
+    return int(round(2 * (n - 1) / n * elems * itemsize))
+
+
+def qpsum_wire_bytes(shape, n: int, scale_mode: str = "block",
+                     block: int = QUANT_BLOCK) -> int:
+    """Per-device wire bytes of :func:`qpsum` over an axis of size
+    ``n``: stage 1's ``all_to_all`` sends ``n-1`` of this shard's ``n``
+    quantized chunks, stage 2's ``all_gather`` sends the reduced chunk
+    to the ``n-1`` peers — ``2·(n-1)`` chunk payloads total, each an
+    int8 body plus its fp32 scales."""
+    if n <= 1:
+        return 0
+    d = int(shape[-1])
+    if d % n:
+        raise InvalidArgumentError(
+            "qpsum_wire_bytes: last axis %d not divisible by n=%d"
+            % (d, n))
+    cq, cs = _int8_payload(tuple(shape[:-1]) + (d // n,), scale_mode, block)
+    return 2 * (n - 1) * (cq + cs)
+
+
+# -- the ambient decode seam -------------------------------------------------
+
+# Thread-local like the decode route (ops/flash_attention.py): the
+# serving engine's loop thread traces under its own seam while the main
+# thread may be warming another session.
+_cq_state = threading.local()
+
+
+class _SeamCtx:
+    """One installed seam: the mode, the mesh whose axes the shard_map
+    binds, the scale granularity, and the byte sink the installing
+    session reads back after the trace."""
+
+    __slots__ = ("mode", "mesh", "scale_mode", "block", "sink")
+
+    def __init__(self, mode, mesh, scale_mode, block, sink):
+        self.mode = mode
+        self.mesh = mesh
+        self.scale_mode = scale_mode
+        self.block = block
+        self.sink = sink
+
+
+def _cq_stack() -> list:
+    stack = getattr(_cq_state, "stack", None)
+    if stack is None:
+        stack = _cq_state.stack = []
+    return stack
+
+
+def active() -> Optional[_SeamCtx]:
+    """The innermost installed seam, or None outside any decode trace
+    region (the transformer's row-parallel call sites gate on this)."""
+    stack = _cq_stack()
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def collective_quant(mode, mesh, scale_mode: str = "block",
+                     block: Optional[int] = None,
+                     sink: Optional[dict] = None):
+    """Install the quantized-collective seam for a trace region.
+
+    The decode sessions wrap their DECODE forwards in this (never the
+    prefill: its batch-1 bucketed shapes don't shard over dp and its
+    collectives amortize over the whole prompt).  PYTHON-static in the
+    ``decode_route`` sense: the mode selects which ops get traced, so a
+    session's executables are compiled for exactly one path and the
+    compile-count contract is untouched.  ``mode="none"`` installs a
+    RECORDING-ONLY seam — the traced ops are exactly the GSPMD path's,
+    but the dense wire bytes still land in ``sink`` so the comparison
+    column exists.
+    """
+    mode = normalize_collective_quant(mode)
+    scale_mode = normalize_collective_scale(scale_mode)
+    if mesh is None:
+        raise InvalidArgumentError(
+            "collective_quant needs a DecodeMesh: the quantized "
+            "collectives shard_map over its ('dp', 'mp') axes")
+    stack = _cq_stack()
+    if block is None:
+        # resolved at install time (not def time) so tests and sweeps
+        # can vary the module-level default
+        block = QUANT_BLOCK
+    stack.append(_SeamCtx(mode, mesh, scale_mode, int(block), sink))
+    try:
+        yield
+    finally:
+        stack.pop()
+
+
+def _record(ctx: _SeamCtx, wire: int, dense: int, tokens: int) -> None:
+    """Trace-time bookkeeping into the installing session's sink: wire
+    bytes of the traced collective (mode-dependent), the dense ring
+    equivalent, and the per-device tokens the step commits (max across
+    seams — every seam of one step sees the same token count)."""
+    sink = ctx.sink
+    if sink is None:
+        return
+    sink["calls"] = sink.get("calls", 0) + 1
+    sink["wire_bytes"] = sink.get("wire_bytes", 0) + int(wire)
+    sink["dense_bytes"] = sink.get("dense_bytes", 0) + int(dense)
+    sink["tokens"] = max(sink.get("tokens", 0), int(tokens))
+
+
+def row_parallel_linear(x, w, b, ctx: _SeamCtx):
+    """The decode-step seam for one row-parallel projection.
+
+    ``x``: ``[B, ..., K]`` activation with ``K`` sharded over mp (the
+    merged attention heads / the MLP hidden), ``w``: ``[K, N]`` weight
+    placed ``P('mp', None)`` by the mesh axis rules, ``b``: ``[N]``
+    bias or None (added AFTER the reduce, replicated — adding it to a
+    partial sum would count it mp times).
+
+    Returns the global ``[B, ..., N]`` result computed as
+    ``shard_map(local matmul → qpsum over 'mp')``, or None when
+    ``ctx.mode == "none"`` — the caller then takes the plain Linear
+    path, whose jaxpr is byte-identical to a build without the seam
+    (the dense wire bytes are still recorded).  Raw jax values in and
+    out; the nn layer owns Tensor wrapping.
+    """
+    mesh = ctx.mesh
+    dp, mp = mesh.dp, mesh.mp
+    bsz, k = int(x.shape[0]), int(x.shape[-1])
+    n_out = int(w.shape[-1])
+    if bsz % dp:
+        raise InvalidArgumentError(
+            "collective_quant=%r: decode batch %d must be divisible by "
+            "dp=%d — the quantized seam shard_maps the batch axis over "
+            "'dp' (the pool guarantees slots %% dp == 0; a bare "
+            "DecodeSession needs a batch the mesh divides)"
+            % (ctx.mode, bsz, dp))
+    if k % mp:
+        raise InvalidArgumentError(
+            "collective_quant=%r: contraction axis %d must be divisible "
+            "by mp=%d (DecodeMesh.validate_model guarantees this for "
+            "the transformer seams)" % (ctx.mode, k, mp))
+    # per-device figures: the partial-product psum payload and the
+    # tokens this device's dp shard commits in the step
+    part_shape = (bsz // dp,) + tuple(int(s) for s in x.shape[1:-1]) \
+        + (n_out,)
+    tokens = (bsz // dp) * math.prod(int(s) for s in x.shape[1:-1])
+    dense = psum_wire_bytes(part_shape, mp)
+    if ctx.mode == "none":
+        _record(ctx, dense, dense, tokens)
+        return None
+    _record(ctx, qpsum_wire_bytes(part_shape, mp, ctx.scale_mode,
+                                  ctx.block), dense, tokens)
+
+    def body(x_l, w_l):
+        part = jnp.einsum("...k,kn->...n", x_l, w_l)
+        return qpsum(part, "mp", ctx.scale_mode, ctx.block)
+
+    mid = (None,) * (x.ndim - 2)
+    out = shard_map(
+        body, mesh.mesh,
+        in_specs=(P("dp", *mid, "mp"), P("mp", None)),
+        out_specs=P("dp", *mid, None))(x, w)
+    if b is not None:
+        out = out + b
+    return out

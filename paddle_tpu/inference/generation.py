@@ -392,7 +392,9 @@ class GenerationPool:
                  mesh: Optional[DecodeMesh] = None,
                  route: str = "auto", spill_tier: str = "host",
                  spill_dir: Optional[str] = None,
-                 prefill_only: bool = False):
+                 prefill_only: bool = False,
+                 collective_quant: Optional[str] = None,
+                 collective_quant_scale: Optional[str] = None):
         if slots < 1:
             raise InvalidArgumentError("GenerationPool needs slots >= 1")
         if mesh is not None and not isinstance(mesh, DecodeMesh):
@@ -473,11 +475,17 @@ class GenerationPool:
         # for every traced body that goes through _run_model — the
         # pool's batched decode step, the chunk prefill, and the
         # speculative subclass's draft/verify included (§5l)
+        # the mp-collective quant mode rides the session (validated
+        # there, defaulting to the mesh's) and is ambient for the
+        # DECODE traced bodies only — this pool's slot-batched step
+        # included; prefill/chunk bodies stay dense (docs §5r)
         self._session = DecodeSession(
             model, max_len, buckets=buckets, temperature=temperature,
             top_k=top_k, top_p=top_p, cache_dtype=cache_dtype,
             donate=donate, cache_layout=cache_layout,
-            block_size=block_size, mesh=mesh, route=route)
+            block_size=block_size, mesh=mesh, route=route,
+            collective_quant=collective_quant,
+            collective_quant_scale=collective_quant_scale)
         self._model = model
         self._cache_dtype = cache_dtype
         from ..jit.speculative import model_vocab_size
@@ -790,7 +798,8 @@ class GenerationPool:
             cache = self._masked_tables(cache, active)
         logits, new_cache = sess._run_model(param_vals, buf_vals,
                                             toks[:, None], cache,
-                                            adapter)
+                                            adapter,
+                                            collective_seam=True)
         temp, tk, tp, seed = samp
         tok = sample_logits_data(logits[:, 0], temp, tk, tp, seed, step)
         step = step + active.astype(step.dtype)
@@ -2918,6 +2927,11 @@ class GenerationPool:
             out["basis"] += ("; SPMD executable — compiler analyses "
                              "are per-device over dp×mp=%d devices"
                              % self._mesh.devices_n)
+            # mp-axis activation-collective bytes (docs §5r): derived
+            # from the shapes the seam recorded while the decode step
+            # traced — quantized wire bytes beside the dense fp32 ring
+            # equivalent, both per committed token, never faked
+            out.update(self._session.collective_report())
         return out
 
     def cost_report(self) -> dict:
@@ -2972,6 +2986,10 @@ class GenerationPool:
             }
             if self._mesh is not None:
                 stats["mesh"] = self._mesh.describe()
+                # a recurrence has no attention/MLP row-parallel seams,
+                # so the mode is stamped (provenance) but no collective
+                # byte columns exist to report
+                stats["collective_quant"] = self._session.collective_quant
             stats["per_shard"] = [
                 {"shard": s, "reachable_bytes": state_total // self._dp,
                  "pool_bytes": state_total // self._dp}
@@ -3006,6 +3024,13 @@ class GenerationPool:
                  "dense_equiv_bytes": dense_bytes}
         if self._mesh is not None:
             stats["mesh"] = self._mesh.describe()
+            # the mp-collective mode is provenance like layout/route: a
+            # tok/s figure from quantized collectives must never be
+            # presented as a dense one.  The byte columns (docs §5r)
+            # appear once the decode step has traced under the seam —
+            # derived from traced collective shapes, never faked
+            stats["collective_quant"] = self._session.collective_quant
+            stats.update(self._session.collective_report())
         if self.cache_layout == "paged":
             bs = self._block_size
             # resident = unique blocks some live slot's table row maps

@@ -81,7 +81,9 @@ class SpeculativePool(GenerationPool):
                  prefill_chunk_tokens: Optional[int] = None,
                  prefix_sharing: bool = False, mesh=None,
                  route: str = "auto", spill_tier: str = "host",
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 collective_quant: Optional[str] = None,
+                 collective_quant_scale: Optional[str] = None):
         if float(temperature) != 0.0:
             raise InvalidArgumentError(
                 "speculative decoding is greedy-only (temperature=0): "
@@ -118,7 +120,16 @@ class SpeculativePool(GenerationPool):
                          prefill_chunk_tokens=prefill_chunk_tokens,
                          prefix_sharing=prefix_sharing, mesh=mesh,
                          route=route, spill_tier=spill_tier,
-                         spill_dir=spill_dir)
+                         spill_dir=spill_dir,
+                         collective_quant=collective_quant,
+                         collective_quant_scale=collective_quant_scale)
+        # the mode is accepted (drop-in under ServingEngine's
+        # **pool_kwargs) and validated by the target session, but the
+        # speculative VERIFY step keeps dense collectives this PR: its
+        # multi-token rows amortize the mp all-reduce over spec_k+1
+        # tokens, so the single-token decode step is where the
+        # bandwidth win lives (ROADMAP names the verify leg as the
+        # on-TPU follow-up)
         self.spec_k = int(spec_k)
         # the draft session owns the draft binding and its bucketed
         # batch-1 prefill (compiled once per bucket); its decode step is

@@ -37,6 +37,7 @@ runtime-managed allocator.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import List, NamedTuple, Optional, Sequence
 
 import jax
@@ -281,7 +282,9 @@ class DecodeSession:
                  top_p: float = 1.0, cache_dtype="float32",
                  donate: Optional[bool] = None,
                  cache_layout: str = "dense", block_size: int = 32,
-                 mesh=None, route: str = "auto"):
+                 mesh=None, route: str = "auto",
+                 collective_quant: Optional[str] = None,
+                 collective_quant_scale: Optional[str] = None):
         from . import _StateBinding
         from ..ops.flash_attention import normalize_decode_route
 
@@ -311,6 +314,38 @@ class DecodeSession:
                     % (type(mesh).__name__,))
             mesh.place_weights(model)
         self.mesh = mesh
+        # mp-axis activation-collective mode (docs §5r): defaults ride
+        # the MESH (an interconnect property), a per-session kwarg
+        # overrides.  PYTHON-static like route=: the mode selects which
+        # ops the decode body traces — "none" traces the GSPMD fp32
+        # all-reduce exactly as today (byte-identity, test-pinned),
+        # "int8" traces the explicit two-stage quantized reduction at
+        # the row-parallel seams; either way the executable set and the
+        # exactly-two-compiles contract are untouched
+        from ..distributed import qcollectives as _qc
+
+        if collective_quant is None:
+            collective_quant = getattr(mesh, "collective_quant", "none") \
+                if mesh is not None else "none"
+        if collective_quant_scale is None:
+            collective_quant_scale = getattr(
+                mesh, "collective_quant_scale", "block") \
+                if mesh is not None else "block"
+        self.collective_quant = _qc.normalize_collective_quant(
+            collective_quant)
+        self.collective_quant_scale = _qc.normalize_collective_scale(
+            collective_quant_scale)
+        if self.collective_quant != "none" and mesh is None:
+            raise InvalidArgumentError(
+                "collective_quant=%r needs a DecodeMesh: the quantized "
+                "collectives replace the mp-axis all-reduces, and an "
+                "unsharded session has none (pass mesh=DecodeMesh(dp, "
+                "mp) or collective_quant='none')"
+                % (self.collective_quant,))
+        # populated at trace time by the seam's byte sink (collective
+        # bytes of ONE decode step); mp == 1 meshes never install the
+        # seam, so "int8" there is a documented no-op
+        self._collective_trace: Optional[dict] = None
         if not hasattr(model, "gen_decode_cache"):
             raise InvalidArgumentError(
                 "DecodeSession needs a model with gen_decode_cache() and "
@@ -424,7 +459,32 @@ class DecodeSession:
                 "kv_cache_bytes": aot.kv_arg_bytes(cache)})
 
     # -- traced bodies ---------------------------------------------------
-    def _run_model(self, param_vals, buf_vals, ids, cache, adapter=None):
+    @contextlib.contextmanager
+    def _collective_seam(self):
+        """The ambient quantized-collective seam for one DECODE trace
+        region (distributed.qcollectives, docs §5r).  Installed only
+        when the mesh has an mp axis to quantize over; mode "none"
+        installs the recording-only form — the traced ops are exactly
+        the GSPMD path's, but the dense wire bytes still land in the
+        sink so the comparison column exists.  The sink is published to
+        ``_collective_trace`` after the region so a partial trace never
+        leaves half-recorded figures behind."""
+        if self.mesh is None or self.mesh.mp == 1:
+            yield
+            return
+        from ..distributed import qcollectives as _qc
+
+        rec = {"mode": self.collective_quant,
+               "scale_mode": self.collective_quant_scale,
+               "calls": 0, "wire_bytes": 0, "dense_bytes": 0, "tokens": 0}
+        with _qc.collective_quant(self.collective_quant, self.mesh,
+                                  scale_mode=self.collective_quant_scale,
+                                  sink=rec):
+            yield
+        self._collective_trace = rec
+
+    def _run_model(self, param_vals, buf_vals, ids, cache, adapter=None,
+                   collective_seam: bool = False):
         """One cached forward with the session's weights swapped in.
 
         Decode is ALWAYS inference: the training flag is forced off for
@@ -450,8 +510,12 @@ class DecodeSession:
             # the session's route is ambient for the trace: every
             # decode-attention call under the layer stack (this
             # session's steps AND the pool/speculative bodies that call
-            # _run_model) routes by it without a kwarg through forward
-            with decode_route(self.route), adapter_ids(adapter):
+            # _run_model) routes by it without a kwarg through forward.
+            # ``collective_seam`` opts a DECODE body into the quantized
+            # mp-collective seam the same way (prefill stays dense)
+            seam = self._collective_seam() if collective_seam \
+                else contextlib.nullcontext()
+            with decode_route(self.route), adapter_ids(adapter), seam:
                 logits, new_cache = self._model(
                     Tensor(ids, stop_gradient=True), cache=cache)
             raw = logits.value if isinstance(logits, Tensor) else logits
@@ -498,7 +562,8 @@ class DecodeSession:
     def _decode(self, param_vals, buf_vals, cache, tok, samp):
         """One token in, one token out — the steady-state serving step."""
         logits, cache = self._run_model(param_vals, buf_vals,
-                                        tok[:, None], cache, samp.adapter)
+                                        tok[:, None], cache, samp.adapter,
+                                        collective_seam=True)
         tok, samp = self._sample(logits[:, 0], samp)
         return cache, tok, samp
 
@@ -641,3 +706,30 @@ class DecodeSession:
         compilations): consumers re-read ``cost_report()`` only when
         this moves, so steady-state polling costs two int reads."""
         return self._prefill_jit.compiles + self._decode_jit.compiles
+
+    def collective_report(self) -> dict:
+        """Per-token wire bytes of the decode step's mp-axis activation
+        collectives, derived from the shapes the seam recorded at trace
+        time (distributed.qcollectives, docs §5r) — never measured,
+        never faked.  ``collective_bytes_per_token`` is what the traced
+        mode actually moves; ``collective_dense_bytes_per_token`` is the
+        fp32 ring equivalent (equal under mode "none", strictly below it
+        under "int8" — test-pinned).  ``{}`` before the decode body's
+        first trace, off-mesh, or at mp == 1 (no mp collectives
+        exist)."""
+        rec = self._collective_trace
+        if not rec or not rec.get("tokens"):
+            return {}
+        t = float(rec["tokens"])
+        return {
+            "collective_quant": self.collective_quant,
+            "collective_quant_scale": self.collective_quant_scale,
+            "collective_bytes_per_token": rec["wire_bytes"] / t,
+            "collective_dense_bytes_per_token": rec["dense_bytes"] / t,
+            "collective_calls_per_step": int(rec["calls"]),
+            "collective_basis": "per-device ring wire bytes of the "
+                                "decode step's row-parallel reductions "
+                                "(from traced collective shapes) over "
+                                "the per-device tokens the step "
+                                "commits",
+        }

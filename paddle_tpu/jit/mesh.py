@@ -71,10 +71,25 @@ class DecodeMesh:
     compile different (mesh-annotated) executables.
     """
 
-    def __init__(self, dp: int = 1, mp: int = 1, devices=None):
+    def __init__(self, dp: int = 1, mp: int = 1, devices=None,
+                 collective_quant: str = "none",
+                 collective_quant_scale: str = "block"):
         import jax
         from jax.sharding import Mesh
 
+        from ..distributed.qcollectives import (normalize_collective_quant,
+                                                normalize_collective_scale)
+
+        # the mp-axis activation-collective mode rides the MESH (the
+        # session/pool inherit it, and may override per-instance): the
+        # choice is a property of the interconnect the mesh spans, not
+        # of any one session.  "none" = the GSPMD fp32 all-reduce
+        # exactly as today; "int8" = the explicit block-quantized
+        # two-stage reduction (distributed.qcollectives, docs §5r) at
+        # the decode step's row-parallel seams
+        self.collective_quant = normalize_collective_quant(collective_quant)
+        self.collective_quant_scale = normalize_collective_scale(
+            collective_quant_scale)
         dp, mp = int(dp), int(mp)
         if dp < 1 or mp < 1:
             raise InvalidArgumentError(
@@ -226,7 +241,9 @@ class DecodeMesh:
 
     def describe(self) -> dict:
         """JSON-safe mesh description (cache_stats / bench stamps)."""
-        return {"dp": self.dp, "mp": self.mp, "devices": self.devices_n}
+        return {"dp": self.dp, "mp": self.mp, "devices": self.devices_n,
+                "collective_quant": self.collective_quant,
+                "collective_quant_scale": self.collective_quant_scale}
 
     def __repr__(self) -> str:  # pragma: no cover - debug nicety
         return "DecodeMesh(dp=%d, mp=%d)" % (self.dp, self.mp)

@@ -452,8 +452,15 @@ class MultiHeadAttention(Layer):
                    if isinstance(cache, self.DecodeCache)
                    else self._paged_decode_forward)
             out_raw, cache = fwd(q, k_new, v_new, attn_mask, cache)
-            out = self.out_proj(self._merge_heads(
-                _T(out_raw, stop_gradient=True)))
+            merged = self._merge_heads(_T(out_raw, stop_gradient=True))
+            # row-parallel seam 1 (docs §5r): inside a decode trace with
+            # the quantized-collective seam installed, the out_proj
+            # reduction goes through the explicit int8 qpsum instead of
+            # the GSPMD fp32 all-reduce; None = dense path, as traced
+            # before the seam existed
+            out = _row_parallel_seam(self.out_proj, merged)
+            if out is None:
+                out = self.out_proj(merged)
             if self.need_weights:
                 return out, None, cache
             return out, cache
@@ -489,6 +496,45 @@ class MultiHeadAttention(Layer):
         if self.need_weights:
             return out, None
         return out
+
+
+def _row_parallel_seam(linear, x):
+    """Route one row-parallel projection (attention ``out_proj`` / MLP
+    ``linear2`` — weight placed ``P('mp', None)`` by the mesh axis
+    rules) through the quantized mp-collective seam when a decode trace
+    installed it (``distributed.qcollectives``, docs/DESIGN.md §5r).
+
+    Returns None when the seam is inactive OR recording-only
+    (``collective_quant="none"``) — the caller then takes the plain
+    Linear call, whose jaxpr is exactly what an unseamed build traces
+    (byte-identity, test-pinned).  A bank-attached Linear's LoRA delta
+    is re-applied on the reduced result in ``Linear.forward``'s order:
+    the delta contracts the GLOBAL input against the replicated bank,
+    so it rides outside the mp reduction unquantized.
+    """
+    from ...distributed import qcollectives as _qc
+
+    ctx = _qc.active()
+    if ctx is None:
+        return None
+    from ...framework.tensor import Tensor as _T
+
+    bias = getattr(linear, "bias", None)
+    out = _qc.row_parallel_linear(
+        getattr(x, "value", x), linear.weight.value,
+        None if bias is None else bias.value, ctx)
+    if out is None:
+        return None
+    out = _T(out, stop_gradient=True)
+    lora_a = linear._parameters.get("lora_a")
+    if lora_a is not None:
+        from .. import lora as _lora
+
+        ids = _lora.current_adapter_ids()
+        if ids is not None:
+            out = _lora.apply_delta(out, x, lora_a,
+                                    linear._parameters["lora_b"], ids)
+    return out
 
 
 class TransformerEncoderLayer(Layer):
@@ -538,7 +584,13 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
-        src = self.linear2(self.dropout(self._act(self.linear1(src))))
+        hidden = self.dropout(self._act(self.linear1(src)))
+        # row-parallel seam 2 (docs §5r): the MLP down-projection's
+        # mp reduction, quantized exactly like out_proj's when a decode
+        # trace installed the seam; None = the dense GSPMD path
+        src = _row_parallel_seam(self.linear2, hidden)
+        if src is None:
+            src = self.linear2(hidden)
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)

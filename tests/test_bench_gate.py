@@ -521,6 +521,27 @@ def test_serving_sharded_leg_gate():
                      "mesh_1x1": dict(base)}
     ok, why = bench._leg_promotable("serving_sharded", baseline_only)
     assert not ok and "no sharded mesh sub-leg" in why
+    # a QUANTIZED-collective sub-leg (§5r) must stamp its numeric
+    # traced-shape wire bytes per token — the byte column is the
+    # number's provenance
+    qmesh = dict(mesh, collective_quant="int8",
+                 collective_bytes_per_token=576.0,
+                 collective_dense_bytes_per_token=2048.0)
+    qgood = {"input_staged": False, "transfer_note": "x",
+             "mesh_1x1": dict(base), "mesh_1x2_qint8": dict(qmesh)}
+    ok, why = bench._leg_promotable("serving_sharded", qgood)
+    assert ok, why
+    for bad_bpt in (None, True):
+        qbad = {"input_staged": False, "transfer_note": "x",
+                "mesh_1x1": dict(base),
+                "mesh_1x2_qint8": dict(
+                    qmesh, collective_bytes_per_token=bad_bpt)}
+        ok, why = bench._leg_promotable("serving_sharded", qbad)
+        assert not ok and "collective_bytes_per_token" in why \
+            and "mesh_1x2_qint8" in why
+    # a DENSE mesh sub-leg carries no quantized-byte obligation (mp=1
+    # meshes have no mp collectives at all): the plain gate above
+    # already passed `good` without the column
 
 
 @pytest.mark.slow
@@ -545,6 +566,16 @@ def test_live_serving_sharded_leg_passes_its_own_gate():
         if sub["mesh_dp"] > 1:
             assert sub["kv_resident_bytes_per_shard"] < \
                 leg["mesh_1x1"]["kv_resident_bytes"]
+    # the quantized sub-legs (§5r) ran the same traffic and stamped
+    # traced wire bytes strictly below the dense ring's
+    for name in ("mesh_1x2_qint8", "mesh_2x2_qint8"):
+        sub = leg[name]
+        assert sub["collective_quant"] == "int8"
+        assert sub["collective_bytes_per_token"] \
+            < sub["collective_dense_bytes_per_token"]
+        dense_twin = leg[name.replace("_qint8", "")]
+        assert sub["collective_bytes_per_token"] \
+            < dense_twin["collective_bytes_per_token"]
 
 
 def test_serving_restart_gate_structural_cases():

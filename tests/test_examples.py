@@ -34,6 +34,7 @@ SCRIPTS = [
     ("19_fleet_serving.py", ["--tokens", "8"]),
     ("20_ssm_serving.py", ["--tokens", "8"]),
     ("21_multi_lora_serving.py", ["--tokens", "8"]),
+    ("22_qcollective_serving.py", ["--tokens", "8"]),
 ]
 
 

@@ -110,7 +110,9 @@ def test_paged_byte_identity_and_compile_counts(dp, mp, dtype):
     _check_partition(pool)
     stats = pool.cache_stats()
     assert stats["mapped_blocks"] == 0
-    assert stats["mesh"] == {"dp": dp, "mp": mp, "devices": dp * mp}
+    assert stats["mesh"] == {"dp": dp, "mp": mp, "devices": dp * mp,
+                             "collective_quant": "none",
+                             "collective_quant_scale": "block"}
 
 
 def test_dense_byte_identity_dp_mp():

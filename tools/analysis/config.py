@@ -40,8 +40,11 @@ HOT_ROOTS = (
 # with dynamic dispatch — a new seam needs a new line here, which
 # review can see.
 EXTRA_EDGES = {
+    # (collective_quant is the §5r seam install: the decode body runs
+    # under the contextmanager, so its region is part of the hot path)
     "DecodeSession._run_model": ("TransformerLM.forward",
-                                 "SSMLM.forward"),
+                                 "SSMLM.forward",
+                                 "collective_quant"),
     # O(1)-cache model class (docs §5p): the CacheLayout protocol's
     # traced hooks dispatch through a layout object chosen at
     # construction (an attribute call the AST cannot resolve), and the
@@ -124,6 +127,21 @@ EXTRA_EDGES = {
     "SpeculativePool._new_draft_cache": ("DecodeMesh.place_cache",),
     "DecodeMesh.place_cache": ("DecodeMesh.place",),
     "DecodeMesh.place": ("DecodeMesh.sharding",),
+    # quantized mp collectives (docs §5r): the transformer's two
+    # row-parallel call sites gate on the thread-local seam (active()
+    # returns a context installed by the session's _collective_seam —
+    # pure dynamic state the AST cannot follow), row_parallel_linear's
+    # shard_map body closes over qpsum, and qpsum's quantize/dequantize
+    # run under jax.vmap wrappers (lambda indirection) — the whole
+    # seam→shard_map→qpsum→(de)quantize chain is declared so the
+    # decode hot path stays audited through the quantized collectives
+    "TransformerEncoderLayer.forward": ("_row_parallel_seam",),
+    "MultiHeadAttention.forward": ("_row_parallel_seam",),
+    "_row_parallel_seam": ("row_parallel_linear",),
+    "row_parallel_linear": ("qpsum", "psum_wire_bytes",
+                            "qpsum_wire_bytes"),
+    "qpsum": ("quantize_int8", "dequantize_int8"),
+    "qall_gather": ("quantize_int8", "dequantize_int8"),
     # crash-durability plane (docs §5m): the journal handle is a
     # conditional constructor assignment (`None if ... else
     # JournalWriter(...)`) the local-constructor inference cannot see

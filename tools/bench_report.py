@@ -127,6 +127,12 @@ THRESHOLDS: Dict[str, Tuple[str, float]] = {
     "cost_bytes_per_shard": ("lower", 0.01),
     "cost_hbm_reserved_per_shard": ("lower", 0.01),
     "kv_resident_bytes_per_shard": ("lower", 0.01),
+    # quantized mp collectives (docs §5r): per-token wire bytes of the
+    # decode step's activation collectives, computed from the traced
+    # shapes — deterministic per config, so tight: growth means either
+    # the quantized path widened (scale granularity / block-size
+    # change) or a seam silently fell back to the dense ring
+    "collective_bytes_per_token": ("lower", 0.01),
     # O(1)-cache model class (decode_ssm, docs §5p): the capacity
     # columns are byte accounting, deterministic per config — a fall
     # in slots/GB (or growth in per-slot state bytes) is a contract

@@ -57,6 +57,17 @@ length.  This sweep measures both axes of ``jit.DecodeSession``:
   compile counts are stamped so an id that leaked into a compiled
   constant shows up as a count, not a vibe.
 
+- a COLLECTIVE-QUANT axis (``--collective-quant none int8``, riding
+  the ``--mesh`` legs): each mp>1 mesh point re-runs with the decode
+  step's mp-axis all-reduces replaced by the block-int8 two-stage
+  collectives (docs/DESIGN.md §5r), and every mesh row records its
+  ``collective_bytes_per_token`` (computed from the traced collective
+  shapes) next to tok/s.  Off-TPU the tok/s delta times the EMULATED
+  mesh — forced host devices share one memory bus, so there is no
+  interconnect to save and the run says so out loud (the
+  ``pallas-interpret`` discipline); the byte columns are the portable
+  measurement.
+
 - plain-vs-SPECULATIVE tokens/s with a ``--speculate K`` axis: the
   draft/verify pool (``inference.SpeculativePool``, K draft tokens per
   round against a 1-layer draft twin) timed against the plain pool at
@@ -69,7 +80,8 @@ Run: python tools/decode_sweep.py [--batches 1 2 4 8] [--buckets 128 256 512]
      [--cache-dtypes float32 int8] [--speculate K]
      [--route auto composition pallas-interpret]
      [--prompt-reuse f ...] [--model-class transformer ssm]
-     [--adapters N] [--cpu-smoke]
+     [--adapters N] [--mesh DP,MP ...] [--collective-quant none int8]
+     [--cpu-smoke]
      [--out decode_sweep.json]
 Writes the JSON report to --out (default: decode_sweep.json in the
 CWD — never into tools/, a measurement artifact is not source);
@@ -454,12 +466,22 @@ def prefix_reuse_sweep(pt, cfg, batches, buckets, gen, reuse_fracs):
     return legs
 
 
-def mesh_sweep(pt, cfg, batches, buckets, gen, meshes, block_size):
+def mesh_sweep(pt, cfg, batches, buckets, gen, meshes, block_size,
+               cquants=("none",)):
     """Sharded (GSPMD, docs §5k) pool tok/s per (bucket, batch, dp×mp
     mesh) against the in-run unsharded baseline, with PER-SHARD HBM
     columns from the allocator and a scaling-efficiency column
     (measured tok/s ÷ baseline × devices).  Meshes that don't fit the
-    device set or the model's head count are skipped out loud."""
+    device set or the model's head count are skipped out loud.
+
+    ``cquants`` adds the COLLECTIVE-QUANT axis (docs §5r): each mp>1
+    mesh point re-runs with the decode-step mp all-reduces replaced by
+    the block-int8 two-stage collectives, and every mesh row records
+    ``collective_bytes_per_token`` (traced-shape wire bytes) next to
+    tok/s.  Off-TPU the tok/s delta times the EMULATED mesh — host
+    devices share one memory bus, so there is no interconnect to save;
+    the byte columns are the portable measurement, and the run says so
+    out loud (the ``--route pallas-interpret`` discipline)."""
     import jax
 
     from paddle_tpu.inference import GenerationPool
@@ -468,6 +490,13 @@ def mesh_sweep(pt, cfg, batches, buckets, gen, meshes, block_size):
 
     rng = np.random.RandomState(0)
     n_dev = len(jax.devices())
+    if any(cq != "none" for cq in cquants) \
+            and jax.default_backend() == "cpu":
+        print("NOTE: collective-quant rows on CPU time the EMULATED "
+              "mesh (forced host devices share one memory bus): the "
+              "collective_bytes_per_token columns are traced-shape "
+              "facts, the tok/s delta is NOT an interconnect "
+              "measurement", flush=True)
     legs = []
     for bucket in buckets:
         max_len = bucket + gen
@@ -485,48 +514,69 @@ def mesh_sweep(pt, cfg, batches, buckets, gen, meshes, block_size):
                     print("mesh %dx%d skipped: mp must divide "
                           "num_heads=%d" % (dp, mp, cfg["num_heads"]))
                     continue
-                slots = batch if batch % dp == 0 \
-                    else dp * (-(-batch // dp))
-                # fresh model per pool: weight placement MUTATES params
-                pt.seed(0)
-                model = TransformerLM(**cfg, dropout=0.0)
-                pool = GenerationPool(
-                    model, max_len, slots=slots, buckets=[bucket],
-                    cache_layout="paged", block_size=block_size,
-                    mesh=None if dp == mp == 1 else DecodeMesh(dp, mp))
-                pool.generate(prompts[:1], 2)  # compile + warm
-                walls, toks = [], 0
-                for _ in range(REPEATS):
-                    t0 = time.perf_counter()
-                    outs = pool.generate(prompts, gen)
-                    walls.append(time.perf_counter() - t0)
-                    toks = sum(len(o) for o in outs)
-                tps = toks / float(np.median(walls))
-                if dp == mp == 1:
-                    base_tps = tps
-                    scaling = None
-                else:
-                    scaling = round(tps / (base_tps * dp * mp), 4) \
-                        if base_tps else None
-                stats = pool.cache_stats()
-                legs.append(dict(
-                    batch=batch, prefill=bucket, generated=gen,
-                    mesh_dp=dp, mesh_mp=mp, slots=slots,
-                    cache_layout="paged", cache_dtype="float32",
-                    block_size=block_size,
-                    kv_resident_bytes=stats["pool_bytes"],
-                    kv_resident_bytes_per_shard=stats["per_shard"][0]
-                    ["pool_bytes"],
-                    kv_resident_bytes_per_device=stats.get(
-                        "pool_bytes_per_device", stats["pool_bytes"]),
-                    decode_tokens_per_sec=round(tps, 1),
-                    scaling_efficiency=scaling))
-                print("bucket %-5d batch %-3d  mesh %dx%d  %8.1f tok/s"
-                      "  shard-HBM %6.2f MiB%s"
-                      % (bucket, batch, dp, mp, tps,
-                         legs[-1]["kv_resident_bytes_per_shard"] / 2**20,
-                         ("  eff %.3f" % scaling)
-                         if scaling is not None else ""), flush=True)
+                for cq in cquants:
+                    if cq != "none" and mp == 1:
+                        # documented no-op: a pure-dp mesh has no
+                        # mp-axis collectives to quantize
+                        print("collective-quant %s skipped on mesh "
+                              "%dx%d: no mp-axis collectives" %
+                              (cq, dp, mp))
+                        continue
+                    slots = batch if batch % dp == 0 \
+                        else dp * (-(-batch // dp))
+                    # fresh model per pool: weight placement MUTATES
+                    # params
+                    pt.seed(0)
+                    model = TransformerLM(**cfg, dropout=0.0)
+                    pool = GenerationPool(
+                        model, max_len, slots=slots, buckets=[bucket],
+                        cache_layout="paged", block_size=block_size,
+                        mesh=None if dp == mp == 1
+                        else DecodeMesh(dp, mp, collective_quant=cq))
+                    pool.generate(prompts[:1], 2)  # compile + warm
+                    walls, toks = [], 0
+                    for _ in range(REPEATS):
+                        t0 = time.perf_counter()
+                        outs = pool.generate(prompts, gen)
+                        walls.append(time.perf_counter() - t0)
+                        toks = sum(len(o) for o in outs)
+                    tps = toks / float(np.median(walls))
+                    if dp == mp == 1:
+                        base_tps = tps
+                        scaling = None
+                    else:
+                        scaling = round(tps / (base_tps * dp * mp), 4) \
+                            if base_tps else None
+                    stats = pool.cache_stats()
+                    legs.append(dict(
+                        batch=batch, prefill=bucket, generated=gen,
+                        mesh_dp=dp, mesh_mp=mp, slots=slots,
+                        cache_layout="paged", cache_dtype="float32",
+                        block_size=block_size,
+                        collective_quant=cq,
+                        collective_bytes_per_token=stats.get(
+                            "collective_bytes_per_token"),
+                        collective_dense_bytes_per_token=stats.get(
+                            "collective_dense_bytes_per_token"),
+                        kv_resident_bytes=stats["pool_bytes"],
+                        kv_resident_bytes_per_shard=stats["per_shard"]
+                        [0]["pool_bytes"],
+                        kv_resident_bytes_per_device=stats.get(
+                            "pool_bytes_per_device",
+                            stats["pool_bytes"]),
+                        decode_tokens_per_sec=round(tps, 1),
+                        scaling_efficiency=scaling))
+                    cbpt = legs[-1]["collective_bytes_per_token"]
+                    print("bucket %-5d batch %-3d  mesh %dx%d  cq %-4s"
+                          "  %8.1f tok/s  shard-HBM %6.2f MiB%s%s"
+                          % (bucket, batch, dp, mp, cq, tps,
+                             legs[-1]["kv_resident_bytes_per_shard"]
+                             / 2**20,
+                             ("  coll-B/tok %.0f" % cbpt)
+                             if cbpt is not None else "",
+                             ("  eff %.3f" % scaling)
+                             if scaling is not None else ""),
+                          flush=True)
     return legs
 
 
@@ -594,6 +644,19 @@ def main():
                          "efficiency vs the in-run unsharded baseline. "
                          "With --cpu-smoke, 8 virtual host devices are "
                          "forced so the meshes fit")
+    ap.add_argument("--collective-quant", dest="collective_quant",
+                    nargs="+", default=["none"],
+                    choices=["none", "int8"], metavar="Q",
+                    help="mp-axis activation-collective modes to sweep "
+                         "on the --mesh legs (docs/DESIGN.md §5r): "
+                         "int8 re-runs each mp>1 mesh point with the "
+                         "decode all-reduces replaced by block-int8 "
+                         "two-stage collectives; every mesh row "
+                         "records collective_bytes_per_token (traced "
+                         "shapes) next to tok/s.  Off-TPU the tok/s "
+                         "delta times the EMULATED mesh — the run "
+                         "says so out loud; the byte columns are the "
+                         "portable measurement")
     ap.add_argument("--cpu-smoke", action="store_true",
                     help="tiny model on CPU to exercise the harness")
     ap.add_argument("--out",
@@ -675,7 +738,8 @@ def main():
     if meshes:
         mesh_legs = mesh_sweep(pt, cfg, args.batches, args.buckets,
                                args.gen, meshes,
-                               block_size=(args.block_sizes or [16])[0])
+                               block_size=(args.block_sizes or [16])[0],
+                               cquants=args.collective_quant)
     reuse_legs = None
     if args.prompt_reuse:
         bad = [f for f in args.prompt_reuse if not 0.0 <= f <= 1.0]
@@ -700,6 +764,7 @@ def main():
               "spec_k": args.speculate or None,
               "prompt_reuse": args.prompt_reuse or None,
               "mesh": [list(m) for m in meshes] or None,
+              "collective_quant": args.collective_quant,
               "model_class": args.model_class,
               "compile_counts": compiles,
               "ssm_compile_counts": ssm_compiles,
